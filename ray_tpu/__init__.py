@@ -1,0 +1,43 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+Task/actor/object core (analog of Ray Core) plus a JAX/XLA-first device
+plane: meshes, GSPMD shardings, Pallas kernels, and the AI library surface
+(data, train, tune, serve) built on them.
+"""
+
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.runtime.object_ref import ObjectRef
+from ray_tpu.utils import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "cluster_resources",
+    "available_resources",
+    "ObjectRef",
+    "exceptions",
+    "__version__",
+]

@@ -1,0 +1,595 @@
+"""The in-worker runtime: submission, ownership, scheduling, execution.
+
+Local-mode analog of the reference's core_worker + raylet pair
+(``src/ray/core_worker/core_worker.cc`` + ``src/ray/raylet/``): a dependency
+manager gates tasks on their arguments (reference
+``transport/dependency_resolver.cc``), a dispatcher accounts resources and
+hands ready tasks to a worker pool (reference ``LocalTaskManager``), actors
+get dedicated ordered execution queues (reference
+``DirectActorTaskSubmitter`` + ``ActorSchedulingQueue``), and failures flow
+through retry bookkeeping (reference ``TaskManager::RetryTaskIfPossible``,
+``task_manager.h:369``).
+
+Execution here is thread-based (one process); the cluster backend swaps the
+executor layer for multiprocess workers while reusing this scheduling core.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.runtime.object_ref import ObjectRef
+from ray_tpu.runtime.object_store import ObjectStore
+from ray_tpu.runtime.task_spec import ResourceSet, TaskSpec, TaskType
+from ray_tpu.utils import exceptions as exc
+from ray_tpu.utils.config import Config, get_config
+from ray_tpu.utils.ids import ActorID, JobID, NodeID, ObjectID, TaskID, _Counter
+
+
+# ---------------------------------------------------------------------------
+# Actor bookkeeping
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ActorState:
+    actor_id: ActorID
+    name: str | None
+    instance: Any = None
+    dead: bool = False
+    death_reason: str = ""
+    max_restarts: int = 0
+    num_restarts: int = 0
+    creation_spec: TaskSpec | None = None
+    # Ordered execution: a dedicated single-thread (or N-thread) executor.
+    executor: ThreadPoolExecutor | None = None
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    # In-order dispatch (reference: SequentialActorSubmitQueue +
+    # ActorSchedulingQueue): tasks are sequenced at submission and dispatched
+    # to the executor strictly in sequence order, even if an earlier call's
+    # argument dependencies resolve after a later call's.
+    submit_seq: _Counter = field(default_factory=_Counter)
+    next_to_dispatch: int = 1
+    seq_buffer: dict[int, TaskSpec] = field(default_factory=dict)
+    # Tasks handed to the executor but not yet completed (for kill cleanup).
+    in_flight: dict[TaskID, TaskSpec] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Runtime
+# ---------------------------------------------------------------------------
+
+class Runtime:
+    """Singleton runtime: object store + scheduler + actor registry."""
+
+    def __init__(self, config: Config | None = None, resources: dict | None = None):
+        self.config = config or get_config()
+        self.job_id = JobID.from_random()
+        self.node_id = NodeID.from_random()
+        self.store = ObjectStore()
+        self._task_counter = _Counter()
+
+        # --- resource accounting (reference: LocalResourceManager) ---
+        ncpu = float(os.cpu_count() or 1)
+        self.total_resources: dict[str, float] = {"CPU": ncpu, "memory": 0.0}
+        if resources:
+            self.total_resources.update({k: float(v) for k, v in resources.items()})
+        self.available_resources = dict(self.total_resources)
+        self._res_lock = threading.Lock()
+        self._res_cv = threading.Condition(self._res_lock)
+
+        # --- dependency manager ---
+        self._dep_lock = threading.Lock()
+        # object id -> list of task specs blocked on it
+        self._waiting_on: dict[ObjectID, list[TaskSpec]] = {}
+        # task id -> set of unresolved dep ids
+        self._unresolved: dict[TaskID, set[ObjectID]] = {}
+        self.store.subscribe_put(self._on_object_available)
+
+        # --- dispatch queue + worker pool ---
+        nworkers = self.config.num_workers or int(ncpu)
+        self._ready: deque[TaskSpec] = deque()
+        self._ready_cv = threading.Condition()
+        # Feasible-but-busy tasks parked until resources free up (reference:
+        # LocalTaskManager's waiting queue; avoids head-of-line blocking).
+        self._blocked: deque[TaskSpec] = deque()
+        # Future waiters keyed by object id (as_future resolution, threadless).
+        self._future_waiters: dict[ObjectID, list[Future]] = {}
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, nworkers), thread_name_prefix="ray_tpu-worker"
+        )
+        self._shutdown = False
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="ray_tpu-dispatcher", daemon=True
+        )
+        self._dispatcher.start()
+
+        # --- actors ---
+        self._actors: dict[ActorID, ActorState] = {}
+        self._named_actors: dict[str, ActorID] = {}
+        self._actor_lock = threading.Lock()
+
+        # --- cancellation ---
+        self._cancelled: set[TaskID] = set()
+        self._return_owner: dict[ObjectID, TaskID] = {}
+
+        # --- observability (reference: TaskEventBuffer) ---
+        self.metrics = {
+            "tasks_submitted": _Counter(),
+            "tasks_finished": _Counter(),
+            "tasks_failed": _Counter(),
+            "tasks_retried": _Counter(),
+            "actors_created": _Counter(),
+        }
+
+    # ------------------------------------------------------------------
+    # Public object API
+    # ------------------------------------------------------------------
+
+    def put(self, value) -> ObjectRef:
+        oid = ObjectID.from_random()
+        self.store.put(oid, value)
+        return ObjectRef(oid)
+
+    def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
+        return self.store.get([r.id for r in refs], timeout=timeout)
+
+    def wait(self, refs: list[ObjectRef], num_returns=1, timeout=None):
+        ready_ids, not_ready_ids = self.store.wait(
+            [r.id for r in refs], num_returns, timeout
+        )
+        by_id = {r.id: r for r in refs}
+        return [by_id[i] for i in ready_ids], [by_id[i] for i in not_ready_ids]
+
+    def as_future(self, ref: ObjectRef) -> Future:
+        """Threadless future: resolved by the store's put notification."""
+        fut: Future = Future()
+        with self._dep_lock:
+            found, value, is_error = self.store.get_entry(ref.id)
+            if not found:
+                self._future_waiters.setdefault(ref.id, []).append(fut)
+                return fut
+        if is_error:
+            fut.set_exception(value)
+        else:
+            fut.set_result(value)
+        return fut
+
+    # ------------------------------------------------------------------
+    # Task submission
+    # ------------------------------------------------------------------
+
+    def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
+        # Infeasible demands fail fast (the reference surfaces these to the
+        # autoscaler; with a fixed local cluster they can never be satisfied).
+        if not spec.resources.fits_in(self.total_resources):
+            raise ValueError(
+                f"Task {spec.function_name!r} requires "
+                f"{spec.resources.resources}, which exceeds cluster capacity "
+                f"{self.total_resources}"
+            )
+        spec.return_ids = [ObjectID.from_random() for _ in range(spec.num_returns)]
+        spec.submitted_at = time.monotonic()
+        if spec.task_type == TaskType.ACTOR_TASK:
+            state = self._actors.get(spec.actor_id)
+            if state is not None:
+                spec.sequence_number = state.submit_seq.next()
+        self.metrics["tasks_submitted"].next()
+        self._resolve_or_queue(spec)
+        return [ObjectRef(oid) for oid in spec.return_ids]
+
+    def _task_dependencies(self, spec: TaskSpec) -> set[ObjectID]:
+        deps: set[ObjectID] = set()
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if isinstance(a, ObjectRef) and not self.store.contains(a.id):
+                deps.add(a.id)
+        return deps
+
+    def _resolve_or_queue(self, spec: TaskSpec):
+        deps = self._task_dependencies(spec)
+        if not deps:
+            self._mark_ready(spec)
+            return
+        with self._dep_lock:
+            # Re-check under the lock: objects may have landed meanwhile.
+            deps = {d for d in deps if not self.store.contains(d)}
+            if not deps:
+                pass
+            else:
+                self._unresolved[spec.task_id] = deps
+                for d in deps:
+                    self._waiting_on.setdefault(d, []).append(spec)
+                return
+        self._mark_ready(spec)
+
+    def _on_object_available(self, oid: ObjectID):
+        newly_ready: list[TaskSpec] = []
+        with self._dep_lock:
+            for spec in self._waiting_on.pop(oid, []):
+                pending = self._unresolved.get(spec.task_id)
+                if pending is None:
+                    continue
+                pending.discard(oid)
+                if not pending:
+                    del self._unresolved[spec.task_id]
+                    newly_ready.append(spec)
+            waiters = self._future_waiters.pop(oid, [])
+        for spec in newly_ready:
+            self._mark_ready(spec)
+        if waiters:
+            found, value, is_error = self.store.get_entry(oid)
+            for fut in waiters:
+                if not found:
+                    continue
+                if is_error:
+                    fut.set_exception(value)
+                else:
+                    fut.set_result(value)
+
+    def _mark_ready(self, spec: TaskSpec):
+        if spec.task_type == TaskType.ACTOR_TASK:
+            self._dispatch_actor_task(spec)
+            return
+        with self._ready_cv:
+            self._ready.append(spec)
+            self._ready_cv.notify()
+
+    # ------------------------------------------------------------------
+    # Dispatcher (reference: LocalTaskManager::ScheduleAndDispatchTasks)
+    # ------------------------------------------------------------------
+
+    def _dispatch_loop(self):
+        """Dispatch ready tasks that fit in available resources; park the rest
+        (no head-of-line blocking — a busy big task must not starve small
+        ones, and resource waits must not deadlock dependent chains)."""
+        while True:
+            with self._ready_cv:
+                while not self._ready and not self._shutdown:
+                    self._ready_cv.wait(timeout=0.5)
+                if self._shutdown:
+                    return
+                spec = self._ready.popleft()
+            if self._try_acquire(spec.resources):
+                self._pool.submit(self._execute_task, spec)
+            else:
+                with self._res_cv:
+                    self._blocked.append(spec)
+
+    def _try_acquire(self, rs: ResourceSet) -> bool:
+        if rs.is_empty():
+            return True
+        with self._res_cv:
+            if not rs.fits_in(self.available_resources):
+                return False
+            for k, v in rs.resources.items():
+                self.available_resources[k] = self.available_resources.get(k, 0.0) - v
+            return True
+
+    def _release_resources(self, rs: ResourceSet):
+        if rs.is_empty():
+            return
+        unparked: list[TaskSpec] = []
+        with self._res_cv:
+            for k, v in rs.resources.items():
+                self.available_resources[k] = self.available_resources.get(k, 0.0) + v
+            unparked = list(self._blocked)
+            self._blocked.clear()
+            self._res_cv.notify_all()
+        if unparked:
+            with self._ready_cv:
+                self._ready.extend(unparked)
+                self._ready_cv.notify()
+
+    # ------------------------------------------------------------------
+    # Execution (reference: _raylet.pyx execute_task)
+    # ------------------------------------------------------------------
+
+    def _materialize_args(self, spec: TaskSpec):
+        args = [
+            self.store.get([a.id])[0] if isinstance(a, ObjectRef) else a
+            for a in spec.args
+        ]
+        kwargs = {
+            k: self.store.get([v.id])[0] if isinstance(v, ObjectRef) else v
+            for k, v in spec.kwargs.items()
+        }
+        return args, kwargs
+
+    def _store_results(self, spec: TaskSpec, result):
+        try:
+            if spec.num_returns == 1:
+                self.store.put(spec.return_ids[0], result)
+            else:
+                values = list(result)  # may raise on non-iterable results
+                if len(values) != spec.num_returns:
+                    raise ValueError(
+                        f"Task declared num_returns={spec.num_returns} but "
+                        f"returned {len(values)} values"
+                    )
+                for oid, v in zip(spec.return_ids, values):
+                    self.store.put(oid, v)
+        except BaseException as e:  # noqa: BLE001 - must never lose return ids
+            self._store_error(spec, exc.TaskError(spec.function_name, e))
+            return
+        self._task_done(spec)
+
+    def _store_error(self, spec: TaskSpec, error: BaseException):
+        for oid in spec.return_ids:
+            self.store.put(oid, error, is_error=True)
+        self._task_done(spec)
+
+    def _task_done(self, spec: TaskSpec):
+        """Completion bookkeeping: drop per-task tracking state so long-running
+        drivers don't leak (one entry per task otherwise)."""
+        for oid in spec.return_ids:
+            self._return_owner.pop(oid, None)
+        self._cancelled.discard(spec.task_id)
+        if spec.actor_id is not None:
+            state = self._actors.get(spec.actor_id)
+            if state is not None:
+                state.in_flight.pop(spec.task_id, None)
+
+    def _execute_task(self, spec: TaskSpec):
+        if spec.task_id in self._cancelled:
+            self._release_resources(spec.resources)
+            self._store_error(spec, exc.TaskCancelledError(spec.task_id))
+            return
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            # Creation holds its resources for the actor's lifetime; release
+            # happens in kill_actor / creation-failure, not here.
+            self._execute_actor_creation(spec)
+            return
+        try:
+            try:
+                args, kwargs = self._materialize_args(spec)
+            except BaseException as e:  # dep failed -> propagate as task error
+                self.metrics["tasks_failed"].next()
+                self._store_error(spec, e)
+                return
+            try:
+                result = spec.function(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                if spec.max_retries > 0 and spec.retry_exceptions:
+                    spec.max_retries -= 1
+                    self.metrics["tasks_retried"].next()
+                    self._resolve_or_queue(spec)
+                    return
+                self.metrics["tasks_failed"].next()
+                self._store_error(spec, exc.TaskError(spec.function_name, e))
+                return
+            self._store_results(spec, result)
+            self.metrics["tasks_finished"].next()
+        finally:
+            self._release_resources(spec.resources)
+
+    # ------------------------------------------------------------------
+    # Actors (reference: GcsActorManager + DirectActorTaskSubmitter)
+    # ------------------------------------------------------------------
+
+    def create_actor(self, spec: TaskSpec, name: str | None = None) -> ActorID:
+        actor_id = ActorID.from_random()
+        spec.actor_id = actor_id
+        state = ActorState(
+            actor_id=actor_id,
+            name=name,
+            max_restarts=spec.max_restarts,
+            creation_spec=spec,
+        )
+        state.executor = ThreadPoolExecutor(
+            max_workers=max(1, spec.max_concurrency),
+            thread_name_prefix=f"actor-{actor_id.hex()[:8]}",
+        )
+        with self._actor_lock:
+            if name is not None:
+                if name in self._named_actors:
+                    raise ValueError(f"Actor name {name!r} already taken")
+                self._named_actors[name] = actor_id
+            self._actors[actor_id] = state
+        self.metrics["actors_created"].next()
+        self._resolve_or_queue(spec)  # creation waits on arg deps like any task
+        return actor_id
+
+    def _execute_actor_creation(self, spec: TaskSpec):
+        # NOTE: actor resources are held for the actor's LIFETIME (released in
+        # kill_actor/shutdown), matching the reference's lease semantics — not
+        # released when __init__ returns.
+        state = self._actors[spec.actor_id]
+        try:
+            args, kwargs = self._materialize_args(spec)
+            cls = spec.function
+            instance = cls(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            state.dead = True
+            state.death_reason = f"__init__ failed: {e!r}"
+            self._release_resources(spec.resources)
+            self._store_error(
+                spec, exc.ActorDiedError(spec.actor_id, state.death_reason)
+            )
+            self._fail_pending_actor_tasks(state)
+            return
+        with state.lock:
+            state.instance = instance
+        # Creation "return" marks readiness (reference: actor creation task
+        # return signals schedulability of queued method calls).
+        self._store_results(spec, None)
+
+    def _dispatch_actor_task(self, spec: TaskSpec):
+        """Buffer by sequence number; dispatch strictly in submission order
+        (reference: SequentialActorSubmitQueue). An early call whose arg deps
+        resolve late must still run before later calls on the same actor."""
+        state = self._actors.get(spec.actor_id)
+        if state is None or state.dead:
+            reason = state.death_reason if state else "unknown actor"
+            self._store_error(spec, exc.ActorDiedError(spec.actor_id, reason))
+            return
+        with state.lock:
+            state.seq_buffer[spec.sequence_number] = spec
+            runnable = []
+            while state.next_to_dispatch in state.seq_buffer:
+                s = state.seq_buffer.pop(state.next_to_dispatch)
+                state.next_to_dispatch += 1
+                state.in_flight[s.task_id] = s
+                runnable.append(s)
+        for s in runnable:
+            state.executor.submit(self._execute_actor_task, state, s)
+
+    def _fail_pending_actor_tasks(self, state: ActorState):
+        """Store ActorDiedError for every queued/buffered call so get() never
+        hangs on a killed actor's in-flight results."""
+        with state.lock:
+            buffered = list(state.seq_buffer.values())
+            state.seq_buffer.clear()
+            in_flight = list(state.in_flight.values())
+            state.in_flight.clear()
+        err_specs = buffered + in_flight
+        for s in err_specs:
+            # Store.put is first-write-wins: if the task already completed,
+            # this is a no-op; otherwise consumers observe the death.
+            self._store_error(
+                s, exc.ActorDiedError(state.actor_id, state.death_reason)
+            )
+
+    def _execute_actor_task(self, state: ActorState, spec: TaskSpec):
+        if spec.task_id in self._cancelled:
+            self._store_error(spec, exc.TaskCancelledError(spec.task_id))
+            return
+        if state.dead:
+            self._store_error(
+                spec, exc.ActorDiedError(state.actor_id, state.death_reason)
+            )
+            return
+        # Wait for __init__ to finish (creation task runs on the main pool).
+        while state.instance is None and not state.dead:
+            time.sleep(0.001)
+        if state.dead:
+            self._store_error(
+                spec, exc.ActorDiedError(state.actor_id, state.death_reason)
+            )
+            return
+        try:
+            args, kwargs = self._materialize_args(spec)
+            method = getattr(state.instance, spec.actor_method_name)
+            result = method(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            self.metrics["tasks_failed"].next()
+            self._store_error(
+                spec, exc.TaskError(f"{spec.function_name}", e)
+            )
+            return
+        self._store_results(spec, result)
+        self.metrics["tasks_finished"].next()
+
+    def get_actor(self, name: str) -> ActorID:
+        with self._actor_lock:
+            if name not in self._named_actors:
+                raise ValueError(f"Failed to look up actor with name {name!r}")
+            return self._named_actors[name]
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        with self._actor_lock:
+            state = self._actors.get(actor_id)
+            if state is None:
+                return
+            already_dead = state.dead
+            state.dead = True
+            state.death_reason = "killed via kill()"
+            if state.name:
+                self._named_actors.pop(state.name, None)
+        if already_dead:
+            return
+        if state.executor:
+            state.executor.shutdown(wait=False, cancel_futures=True)
+        self._fail_pending_actor_tasks(state)
+        if state.creation_spec is not None:
+            self._release_resources(state.creation_spec.resources)
+
+    def actor_state(self, actor_id: ActorID) -> ActorState | None:
+        return self._actors.get(actor_id)
+
+    # ------------------------------------------------------------------
+    # Cancellation
+    # ------------------------------------------------------------------
+
+    def cancel(self, ref: ObjectRef):
+        # Best-effort: mark every task whose return id matches. Local mode
+        # cannot interrupt a running Python frame (same caveat as the
+        # reference for non-async actors); queued tasks fail fast.
+        # Find the owning spec lazily: we track via return-id -> task map.
+        tid = self._return_owner.get(ref.id)
+        if tid is not None:
+            self._cancelled.add(tid)
+
+    def note_return_owner(self, spec: TaskSpec):
+        for oid in spec.return_ids:
+            self._return_owner[oid] = spec.task_id
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def shutdown(self):
+        self._shutdown = True
+        with self._ready_cv:
+            self._ready_cv.notify_all()
+        with self._res_cv:
+            self._res_cv.notify_all()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        with self._actor_lock:
+            actors = list(self._actors.values())
+            self._actors.clear()
+            self._named_actors.clear()
+        for state in actors:
+            state.dead = True
+            state.death_reason = "runtime shutdown"
+            if state.executor:
+                state.executor.shutdown(wait=False, cancel_futures=True)
+            self._fail_pending_actor_tasks(state)
+
+    def cluster_resources(self) -> dict:
+        return dict(self.total_resources)
+
+    def available_resources_snapshot(self) -> dict:
+        with self._res_lock:
+            return dict(self.available_resources)
+
+
+# ---------------------------------------------------------------------------
+# Global runtime management
+# ---------------------------------------------------------------------------
+
+_runtime: Runtime | None = None
+_runtime_lock = threading.Lock()
+
+
+def get_runtime() -> Runtime:
+    global _runtime
+    if _runtime is None:
+        raise RuntimeError(
+            "ray_tpu is not initialized; call ray_tpu.init() first."
+        )
+    return _runtime
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def init_runtime(config: Config | None = None, resources: dict | None = None) -> Runtime:
+    global _runtime
+    with _runtime_lock:
+        if _runtime is None:
+            _runtime = Runtime(config=config, resources=resources)
+        return _runtime
+
+
+def shutdown_runtime():
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            _runtime.shutdown()
+            _runtime = None

@@ -1,0 +1,63 @@
+"""ObjectRef: a future-like handle to a (possibly not yet computed) object.
+
+Analog of the reference's ``ObjectRef`` (owned by the submitting worker; see
+``src/ray/core_worker/reference_count.h``). Resolution goes through the active
+runtime, so refs can be freely passed as task arguments (the runtime resolves
+them before execution — same semantics as the reference's dependency
+resolution in ``transport/dependency_resolver.cc``).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from ray_tpu.utils.ids import ObjectID
+
+if TYPE_CHECKING:
+    from ray_tpu.runtime.core import Runtime
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_hint", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_hint: str | None = None):
+        self._id = object_id
+        self._owner_hint = owner_hint
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self._id, self._owner_hint))
+
+    # Convenience: ref.get() / await-ability via the runtime.
+    def get(self, timeout: float | None = None):
+        from ray_tpu.runtime.core import get_runtime
+
+        return get_runtime().get([self], timeout=timeout)[0]
+
+    def future(self):
+        """Return a concurrent.futures.Future resolved with the object value."""
+        from ray_tpu.runtime.core import get_runtime
+
+        return get_runtime().as_future(self)
+
+    def __await__(self):
+        import asyncio
+
+        from ray_tpu.runtime.core import get_runtime
+
+        return asyncio.wrap_future(get_runtime().as_future(self)).__await__()

@@ -1,0 +1,47 @@
+"""Object serialization with zero-copy buffer handling.
+
+Analog of the reference's ``python/ray/_private/serialization.py``: cloudpickle
+for arbitrary Python objects, with pickle protocol-5 out-of-band buffers so
+large numpy/jax host arrays serialize without copying. The (meta, buffers)
+split mirrors plasma's metadata/data separation — buffers can be placed in
+shared memory by the cluster backend and mapped read-only by consumers.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from dataclasses import dataclass, field
+
+import cloudpickle
+
+
+@dataclass
+class SerializedObject:
+    """A serialized value: metadata stream + out-of-band buffers."""
+
+    meta: bytes
+    buffers: list = field(default_factory=list)
+
+    @property
+    def total_bytes(self) -> int:
+        return len(self.meta) + sum(len(b) for b in self.buffers)
+
+
+def serialize(value) -> SerializedObject:
+    buffers: list = []
+
+    def buffer_callback(buf: pickle.PickleBuffer):
+        view = buf.raw()
+        buffers.append(view)
+        return False  # do not serialize in-band
+
+    stream = io.BytesIO()
+    cloudpickle.CloudPickler(stream, protocol=5, buffer_callback=buffer_callback).dump(
+        value
+    )
+    return SerializedObject(meta=stream.getvalue(), buffers=buffers)
+
+
+def deserialize(obj: SerializedObject):
+    return pickle.loads(obj.meta, buffers=[pickle.PickleBuffer(b) for b in obj.buffers])

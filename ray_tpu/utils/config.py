@@ -1,0 +1,107 @@
+"""Central flag registry.
+
+Analog of the reference's ``RAY_CONFIG`` macro system
+(``src/ray/common/ray_config_def.h`` — 209 typed flags, each overridable via a
+``RAY_<name>`` environment variable). Here: typed flags declared once, each
+overridable via ``RAY_TPU_<NAME>`` env vars or a ``system_config`` dict passed
+to ``init()``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, fields
+from typing import Any
+
+
+def _env_override(name: str, default: Any) -> Any:
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    ty = type(default)
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(raw)
+    if ty is float:
+        return float(raw)
+    return raw
+
+
+@dataclass
+class Config:
+    """Runtime configuration flags. Defaults mirror the reference's semantics
+    where applicable (e.g. 5 MiB transfer chunks, ``ray_config_def.h:355``)."""
+
+    # --- scheduling ---
+    # Hybrid policy spread threshold (reference: RAY_scheduler_spread_threshold).
+    scheduler_spread_threshold: float = 0.5
+    # Top-k fraction of nodes considered for random tie-break in hybrid policy.
+    scheduler_top_k_fraction: float = 0.2
+    scheduler_top_k_absolute: int = 1
+    # Max tasks a worker lease request pipelines (reference lease batching).
+    max_tasks_in_flight_per_worker: int = 10
+
+    # --- object store ---
+    # Per-node shared-memory store capacity (bytes). 0 = auto (30% of RAM).
+    object_store_memory: int = 0
+    # Objects smaller than this stay in the owner's in-process memory store.
+    max_direct_call_object_size: int = 100 * 1024
+    # Node-to-node transfer chunk size (reference: 5 MiB).
+    object_transfer_chunk_size: int = 5 * 1024 * 1024
+    # Fraction of store capacity at which LRU eviction kicks in.
+    object_store_eviction_fraction: float = 0.8
+    # Enable automatic spilling to disk under memory pressure.
+    object_spilling_enabled: bool = True
+
+    # --- workers ---
+    num_workers: int = 0  # 0 = num_cpus
+    worker_register_timeout_s: float = 30.0
+    worker_lease_timeout_s: float = 30.0
+
+    # --- fault tolerance ---
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    health_check_period_s: float = 1.0
+    health_check_failure_threshold: int = 5
+
+    # --- TPU / device plane ---
+    # Logical mesh axis names, outer to inner. ICI-contiguous inner axes.
+    mesh_axis_names: str = "dp,fsdp,tp"
+    # Default matmul precision for the device plane.
+    default_matmul_precision: str = "bfloat16"
+    # Checkpointing: async by default.
+    async_checkpointing: bool = True
+
+    # --- observability ---
+    metrics_report_interval_s: float = 2.0
+    event_buffer_size: int = 10000
+    log_level: str = "INFO"
+
+    def __post_init__(self):
+        for f in fields(self):
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+
+    def apply_overrides(self, overrides: dict | None):
+        if not overrides:
+            return self
+        for k, v in overrides.items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown config flag: {k!r}")
+            setattr(self, k, v)
+        return self
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config()
+    return _global_config
+
+
+def reset_config():
+    global _global_config
+    _global_config = None

@@ -1,0 +1,91 @@
+"""Error hierarchy.
+
+Analog of the reference's ``python/ray/exceptions.py`` (RayError, RayTaskError,
+ActorDiedError, ObjectLostError, OutOfMemoryError, GetTimeoutError, ...).
+Task errors wrap the remote traceback so the driver sees the real failure site.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A remote task raised an exception. Re-raised at ``get()`` on the caller,
+    carrying the remote traceback (reference: ``RayTaskError``)."""
+
+    def __init__(self, function_name: str, cause: BaseException, tb: str | None = None):
+        self.function_name = function_name
+        self.cause = cause
+        self.remote_traceback = tb or "".join(
+            traceback.format_exception(type(cause), cause, cause.__traceback__)
+        )
+        super().__init__(
+            f"Task {function_name!r} failed: {type(cause).__name__}: {cause}\n"
+            f"--- remote traceback ---\n{self.remote_traceback}"
+        )
+
+
+class ActorError(RayTpuError):
+    """Base for actor failures."""
+
+
+class ActorDiedError(ActorError):
+    """The actor process died (or was killed) before/while executing the call."""
+
+    def __init__(self, actor_id=None, reason: str = "actor died"):
+        self.actor_id = actor_id
+        super().__init__(f"Actor {actor_id} unavailable: {reason}")
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object value was lost from the store and could not be reconstructed."""
+
+    def __init__(self, object_id=None, reason: str = "object lost"):
+        self.object_id = object_id
+        super().__init__(f"Object {object_id} lost: {reason}")
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    """Lineage re-execution could not rebuild the object (retries exhausted)."""
+
+
+class OwnerDiedError(ObjectLostError):
+    """The object's owner process died; value unrecoverable."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get(ref, timeout=...)`` expired before the object was ready."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ``cancel()`` before or during execution."""
+
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled")
+
+
+class OutOfMemoryError(RayTpuError):
+    """Raised when the memory monitor kills a task/worker under host-RAM
+    pressure (reference: raylet worker-killing policies)."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    """Object store is at capacity and eviction/spilling could not make room."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Per-task/actor runtime environment failed to materialize."""
+
+
+class PlacementGroupUnavailableError(RayTpuError):
+    """Placement group bundles could not be reserved."""

@@ -1,0 +1,111 @@
+"""Unique identifiers for objects, tasks, actors, nodes, jobs, placement groups.
+
+TPU-native analog of the reference's C++ ID layer (``src/ray/common/id.h``):
+fixed-width random IDs with cheap hashing/equality, hex round-trip, and a
+``nil`` sentinel. We keep them as immutable Python values (bytes-backed) so
+they pickle compactly and can cross process boundaries without translation.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_HEX = "0123456789abcdef"
+
+
+class BaseID:
+    """Fixed-width immutable identifier backed by raw bytes."""
+
+    SIZE = 16
+    __slots__ = ("_bytes", "_hash")
+
+    def __init__(self, id_bytes: bytes):
+        if len(id_bytes) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
+            )
+        self._bytes = id_bytes
+        self._hash = hash(id_bytes)
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\xff" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\xff" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class ObjectID(BaseID):
+    SIZE = 16
+
+
+class TaskID(BaseID):
+    SIZE = 12
+
+
+class ActorID(BaseID):
+    SIZE = 12
+
+
+class NodeID(BaseID):
+    SIZE = 16
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 12
+
+
+class _Counter:
+    """Thread-safe monotonically increasing counter (sequence numbers)."""
+
+    def __init__(self, start: int = 0):
+        self._value = start
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._value += 1
+            return self._value
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value

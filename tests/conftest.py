@@ -1,0 +1,37 @@
+"""Test fixtures.
+
+JAX is forced onto a virtual 8-device CPU platform BEFORE first import so
+multi-chip sharding paths compile and run without TPU hardware (the driver's
+``dryrun_multichip`` uses the same mechanism). Analog of the reference's
+``ray_start_regular`` fixture (``python/ray/tests/conftest.py:410``) for the
+runtime tests.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_tpu_start():
+    """Fresh runtime per test (local in-process cluster)."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=8, num_tpus=0)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
+    return devices

@@ -1,0 +1,278 @@
+"""Core task/actor/object API tests.
+
+Modeled on the reference's ``python/ray/tests/test_basic.py`` /
+``test_actor.py`` coverage: submission, chaining, multiple returns, errors,
+retries, wait semantics, actor ordering, named actors, kill.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.utils.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    TaskError,
+)
+
+
+def test_put_get(ray_tpu_start):
+    ref = ray_tpu.put({"a": 1})
+    assert ray_tpu.get(ref) == {"a": 1}
+
+
+def test_put_objectref_rejected(ray_tpu_start):
+    ref = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        ray_tpu.put(ref)
+
+
+def test_simple_task(ray_tpu_start):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_kwargs(ray_tpu_start):
+    @ray_tpu.remote
+    def f(a, b=10):
+        return a * b
+
+    assert ray_tpu.get(f.remote(2, b=3)) == 6
+    assert ray_tpu.get(f.remote(2)) == 20
+
+
+def test_task_chaining_ref_args(ray_tpu_start):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    r1 = double.remote(1)
+    r2 = double.remote(r1)
+    r3 = double.remote(r2)
+    assert ray_tpu.get(r3) == 8
+
+
+def test_many_parallel_tasks(ray_tpu_start):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(200)]
+    assert ray_tpu.get(refs) == [i * i for i in range(200)]
+
+
+def test_num_returns(ray_tpu_start):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_tpu_start):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kapow")
+
+    with pytest.raises(TaskError, match="kapow"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_propagates_through_chain(ray_tpu_start):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("root cause")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(TaskError, match="root cause"):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_retry_exceptions(ray_tpu_start):
+    counter = {"n": 0}
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky():
+        counter["n"] += 1
+        if counter["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote()) == "ok"
+    assert counter["n"] == 3
+
+
+def test_get_timeout(ray_tpu_start):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.1)
+
+
+def test_wait(ray_tpu_start):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.01)
+    slow = sleepy.remote(2.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=1.0)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_wait_all(ray_tpu_start):
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    refs = [f.remote(i) for i in range(10)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=10, timeout=10.0)
+    assert len(ready) == 10 and not not_ready
+
+
+def test_options_override(ray_tpu_start):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(num_cpus=2).remote()) == 1
+
+
+def test_direct_call_rejected(ray_tpu_start):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+# --- actors ---
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, by=1):
+        self.n += by
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def fail(self):
+        raise RuntimeError("actor method failed")
+
+
+def test_actor_basic(ray_tpu_start):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote(5)) == 6
+    assert ray_tpu.get(c.value.remote()) == 6
+
+
+def test_actor_init_args(ray_tpu_start):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.value.remote()) == 100
+
+
+def test_actor_ordering(ray_tpu_start):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(100)]
+    # Ordered execution: the i-th call must observe i prior increments.
+    assert ray_tpu.get(refs) == list(range(1, 101))
+
+
+def test_actor_method_error(ray_tpu_start):
+    c = Counter.remote()
+    with pytest.raises(TaskError, match="actor method failed"):
+        ray_tpu.get(c.fail.remote())
+    # actor still alive afterwards
+    assert ray_tpu.get(c.incr.remote()) == 1
+
+
+def test_actor_ref_args(ray_tpu_start):
+    @ray_tpu.remote
+    def produce():
+        return 7
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote(produce.remote())) == 7
+
+
+def test_named_actor(ray_tpu_start):
+    Counter.options(name="global_counter").remote(42)
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.value.remote()) == 42
+
+
+def test_named_actor_duplicate(ray_tpu_start):
+    Counter.options(name="dup").remote()
+    with pytest.raises(ValueError):
+        Counter.options(name="dup").remote()
+
+
+def test_kill_actor(ray_tpu_start):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    ray_tpu.kill(c)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(c.incr.remote())
+
+
+def test_actor_handle_passing(ray_tpu_start):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(handle):
+        return ray_tpu.get(handle.incr.remote())
+
+    assert ray_tpu.get(bump.remote(c)) == 1
+
+
+def test_actor_init_failure(ray_tpu_start):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("bad init")
+
+        def ping(self):
+            return "pong"
+
+    b = Broken.remote()
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(b.ping.remote())
+
+
+def test_max_concurrency(ray_tpu_start):
+    @ray_tpu.remote(max_concurrency=4)
+    class Parallel:
+        def block(self, t):
+            time.sleep(t)
+            return t
+
+    p = Parallel.remote()
+    start = time.monotonic()
+    refs = [p.block.remote(0.2) for _ in range(4)]
+    ray_tpu.get(refs)
+    elapsed = time.monotonic() - start
+    assert elapsed < 0.6, f"expected concurrent execution, took {elapsed:.2f}s"
+
+
+def test_cluster_resources(ray_tpu_start):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 8.0

@@ -1,0 +1,163 @@
+"""Regression tests for scheduling/ownership edge cases found in review."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.utils.exceptions import ActorDiedError, TaskError
+
+
+def test_non_iterable_with_num_returns_raises_not_hangs(ray_tpu_start):
+    @ray_tpu.remote(num_returns=2)
+    def bad():
+        return 5  # not iterable
+
+    a, b = bad.remote()
+    with pytest.raises(TaskError):
+        ray_tpu.get(a, timeout=5)
+
+
+def test_wrong_return_count_raises(ray_tpu_start):
+    @ray_tpu.remote(num_returns=3)
+    def two():
+        return 1, 2
+
+    refs = two.remote()
+    with pytest.raises(TaskError, match="num_returns=3"):
+        ray_tpu.get(refs[0], timeout=5)
+
+
+def test_infeasible_task_fails_fast(ray_tpu_start):
+    @ray_tpu.remote(num_cpus=999)
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="exceeds cluster capacity"):
+        f.remote()
+
+
+def test_big_task_does_not_starve_small(ray_tpu_start):
+    # A queued 8-CPU task must not block a 1-CPU task behind it while the
+    # 8 CPUs are partly held (no head-of-line blocking).
+    @ray_tpu.remote(num_cpus=4)
+    def hold():
+        time.sleep(1.0)
+        return "held"
+
+    @ray_tpu.remote(num_cpus=8)
+    def big():
+        return "big"
+
+    @ray_tpu.remote(num_cpus=1)
+    def small():
+        return "small"
+
+    h = hold.remote()
+    b = big.remote()  # cannot run until hold finishes
+    s = small.remote()  # fits right now; must not wait behind big
+    assert ray_tpu.get(s, timeout=0.5) == "small"
+    assert ray_tpu.get([h, b], timeout=10) == ["held", "big"]
+
+
+def test_actor_ordering_with_late_dependency(ray_tpu_start):
+    # An earlier actor call blocked on a slow dependency must still execute
+    # before a later dependency-free call on the same actor.
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(0.3)
+        return 10
+
+    @ray_tpu.remote
+    class Box:
+        def __init__(self):
+            self.v = 0
+
+        def set(self, v):
+            self.v = v
+            return self.v
+
+        def read(self):
+            return self.v
+
+    box = Box.remote()
+    set_ref = box.set.remote(slow_value.remote())
+    read_ref = box.read.remote()  # submitted later; must see v=10
+    assert ray_tpu.get(read_ref, timeout=5) == 10
+    assert ray_tpu.get(set_ref) == 10
+
+
+def test_kill_fails_inflight_calls_not_hang(ray_tpu_start):
+    @ray_tpu.remote
+    class Slow:
+        def work(self, t):
+            time.sleep(t)
+            return t
+
+    s = Slow.remote()
+    r1 = s.work.remote(0.5)
+    r2 = s.work.remote(0.5)  # queued behind r1
+    time.sleep(0.1)
+    ray_tpu.kill(s)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(r2, timeout=5)
+
+
+def test_actor_resources_held_for_lifetime(ray_tpu_start):
+    @ray_tpu.remote(num_cpus=3)
+    class Holder:
+        def ping(self):
+            return "pong"
+
+    h1 = Holder.remote()
+    h2 = Holder.remote()
+    assert ray_tpu.get(h1.ping.remote()) == "pong"
+    assert ray_tpu.get(h2.ping.remote()) == "pong"
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] == pytest.approx(2.0)  # 8 - 2*3
+    ray_tpu.kill(h1)
+    time.sleep(0.1)
+    assert ray_tpu.available_resources()["CPU"] == pytest.approx(5.0)
+
+
+def test_options_typo_rejected_everywhere(ray_tpu_start):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError, match="Invalid task options"):
+        f.options(num_gpus=1)
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 1
+
+    with pytest.raises(ValueError, match="Invalid actor options"):
+        A.options(max_retrys=3)
+
+
+def test_task_bookkeeping_cleanup(ray_tpu_start):
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    rt = ray_tpu_start
+    refs = [f.remote(i) for i in range(50)]
+    ray_tpu.get(refs)
+    time.sleep(0.1)
+    assert len(rt._return_owner) == 0
+
+
+def test_as_future_threadless(ray_tpu_start):
+    import threading
+
+    @ray_tpu.remote
+    def f():
+        time.sleep(0.2)
+        return 42
+
+    before = threading.active_count()
+    futs = [f.remote().future() for _ in range(20)]
+    assert threading.active_count() - before < 10  # no thread-per-future
+    assert [x.result(timeout=5) for x in futs] == [42] * 20
