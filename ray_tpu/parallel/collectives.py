@@ -1,0 +1,245 @@
+"""Collective communication.
+
+Two planes, mirroring SURVEY.md §5's breakdown:
+
+1. **Device plane (ICI/DCN)** — XLA collectives inside jit/shard_map. These
+   are thin wrappers over ``jax.lax`` primitives; XLA compiles them onto the
+   torus. This replaces the reference's NCCL groups entirely.
+
+2. **Host plane (CPU tensors, control data)** — an actor-group collective API
+   with the same surface as the reference's ``ray.util.collective``
+   (``collective.py:120 init_collective_group``, ``:258 allreduce``,
+   ``:531 send``): declarative groups keyed by name, ranks are actors. The
+   local-mode backend reduces via the object store (Gloo analog); a C++
+   backend can slot in underneath without changing the API.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# Device plane: in-jit collectives (use inside shard_map/pjit)
+# ---------------------------------------------------------------------------
+
+
+def psum(x, axis: str):
+    return lax.psum(x, axis_name=axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis_name=axis)
+
+def pmax(x, axis: str):
+    return lax.pmax(x, axis_name=axis)
+
+
+def all_gather(x, axis: str, *, gather_axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name=axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_axis: int = 0, tiled: bool = True):
+    return lax.psum_scatter(
+        x, axis_name=axis, scatter_dimension=scatter_axis, tiled=tiled
+    )
+
+
+def ppermute(x, axis: str, perm):
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def ring_shift(x, axis: str, *, shift: int = 1):
+    """Shift values around the mesh-axis ring (building block of ring
+    attention / pipeline microbatch rotation)."""
+    n = lax.psum(1, axis_name=axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int, tiled: bool = True):
+    return lax.all_to_all(
+        x, axis_name=axis, split_axis=split_axis, concat_axis=concat_axis,
+        tiled=tiled,
+    )
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.psum(1, axis_name=axis)
+
+
+# ---------------------------------------------------------------------------
+# Host plane: actor collective groups (reference: ray.util.collective)
+# ---------------------------------------------------------------------------
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MAX = "max"
+    MIN = "min"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda arrs: np.sum(arrs, axis=0),
+    ReduceOp.PRODUCT: lambda arrs: np.prod(arrs, axis=0),
+    ReduceOp.MAX: lambda arrs: np.max(arrs, axis=0),
+    ReduceOp.MIN: lambda arrs: np.min(arrs, axis=0),
+}
+
+
+@dataclass
+class _GroupState:
+    name: str
+    world_size: int
+    backend: str
+    lock: threading.Lock
+    cv: threading.Condition
+    # per-collective rendezvous state, keyed by op sequence number
+    contributions: dict
+    results: dict
+    seq: dict
+
+
+class GroupManager:
+    """Host-collective group registry (reference: ``GroupManager`` at
+    ``util/collective/collective.py:40``). Local-mode rendezvous barrier +
+    numpy reduction; ranks may be any threads/actors in this process."""
+
+    def __init__(self):
+        self._groups: dict[str, _GroupState] = {}
+        self._lock = threading.Lock()
+
+    def create(self, name: str, world_size: int, backend: str = "local"):
+        with self._lock:
+            if name in self._groups:
+                raise ValueError(f"Collective group {name!r} already exists")
+            lock = threading.Lock()
+            self._groups[name] = _GroupState(
+                name=name, world_size=world_size, backend=backend, lock=lock,
+                cv=threading.Condition(lock), contributions={}, results={},
+                seq={},
+            )
+
+    def get(self, name: str) -> _GroupState:
+        with self._lock:
+            if name not in self._groups:
+                raise KeyError(f"No collective group {name!r}")
+            return self._groups[name]
+
+    def destroy(self, name: str):
+        with self._lock:
+            self._groups.pop(name, None)
+
+    def _rendezvous(self, group: str, rank: int, key: str, value, combine):
+        """Generic barrier: all ranks contribute; `combine` runs once on the
+        full contribution dict; every rank receives the result.
+
+        Each rank's n-th call with a given `key` joins epoch n, so
+        back-to-back collectives on the same group can't cross-talk even if
+        a fast rank starts the next op before slow ranks finish this one.
+        """
+        g = self.get(group)
+        with g.cv:
+            epoch = g.seq.get((key, rank), 0)
+            g.seq[(key, rank)] = epoch + 1
+            op_id = (key, epoch)
+            bucket = g.contributions.setdefault(op_id, {})
+            if rank in bucket:
+                raise RuntimeError(
+                    f"rank {rank} contributed twice to {op_id} in {group!r}"
+                )
+            bucket[rank] = value
+            if len(bucket) == g.world_size:
+                g.results[op_id] = [combine(bucket), 0]
+                del g.contributions[op_id]
+                g.cv.notify_all()
+            else:
+                while op_id not in g.results:
+                    if not g.cv.wait(timeout=60.0):
+                        raise TimeoutError(
+                            f"collective {key!r} timed out in group {group!r} "
+                            f"(rank {rank}, epoch {epoch}, "
+                            f"{len(g.contributions.get(op_id, {}))}/"
+                            f"{g.world_size} arrived)"
+                        )
+            slot = g.results[op_id]
+            slot[1] += 1
+            if slot[1] == g.world_size:  # last rank out frees the slot
+                del g.results[op_id]
+            return slot[0]
+
+
+_group_manager = GroupManager()
+
+
+def group_manager() -> GroupManager:
+    return _group_manager
+
+
+def init_collective_group(world_size: int, rank: int, *,
+                          group_name: str = "default", backend: str = "local"):
+    """Declarative group creation (reference ``collective.py:120``). Safe to
+    call from every rank; first caller creates the group."""
+    try:
+        _group_manager.create(group_name, world_size, backend)
+    except ValueError:
+        pass
+    return rank
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _group_manager.destroy(group_name)
+
+
+def allreduce(tensor, rank: int, *, group_name: str = "default",
+              op: str = ReduceOp.SUM):
+    arr = np.asarray(tensor)
+    result = _group_manager._rendezvous(
+        group_name, rank, f"allreduce_{op}",
+        arr, lambda bucket: _REDUCERS[op](np.stack(list(bucket.values()))),
+    )
+    return result
+
+
+def allgather(tensor, rank: int, *, group_name: str = "default"):
+    arr = np.asarray(tensor)
+    return _group_manager._rendezvous(
+        group_name, rank, "allgather",
+        arr, lambda bucket: [bucket[r] for r in sorted(bucket)],
+    )
+
+
+def broadcast(tensor, rank: int, *, src_rank: int = 0,
+              group_name: str = "default"):
+    arr = np.asarray(tensor) if tensor is not None else None
+    return _group_manager._rendezvous(
+        group_name, rank, "broadcast",
+        arr, lambda bucket: bucket[src_rank],
+    )
+
+
+def reducescatter(tensor, rank: int, *, group_name: str = "default",
+                  op: str = ReduceOp.SUM):
+    arr = np.asarray(tensor)
+
+    def combine(bucket):
+        full = _REDUCERS[op](np.stack(list(bucket.values())))
+        return np.array_split(full, len(bucket), axis=0)
+
+    chunks = _group_manager._rendezvous(
+        group_name, rank, f"reducescatter_{op}", arr, combine
+    )
+    return chunks[rank]
+
+def barrier(rank: int, *, group_name: str = "default"):
+    _group_manager._rendezvous(group_name, rank, "barrier", None,
+                               lambda bucket: True)

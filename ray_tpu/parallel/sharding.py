@@ -1,0 +1,152 @@
+"""Logical-axis sharding rules → GSPMD partition specs.
+
+The TPU-native replacement for the reference's wrapper-level sharded
+parallelism (DDP/FSDP wraps in ``train/torch/train_loop_utils.py:74,246``):
+instead of wrapping modules, every array in the model pytree carries *logical*
+axis names, and a rule table maps logical axes onto mesh axes. Changing the
+parallelism strategy = swapping the rule table; the model code never changes.
+
+Logical axes used by the model library:
+
+    batch    — per-example batch dim        → dp/fsdp (data parallel)
+    seq      — sequence/token dim           → sp (sequence/context parallel)
+    embed    — model (d_model) dim          → fsdp sharding of activations/params
+    heads    — attention heads              → tp
+    kv_heads — kv heads (GQA)               → tp
+    mlp      — FFN hidden dim               → tp
+    vocab    — vocabulary dim               → tp
+    expert   — MoE expert dim               → ep
+    stage    — pipeline stage dim           → pp
+    (None)   — replicated
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    """Mapping from logical axis name to mesh axis (or tuple of mesh axes,
+    or None for replicated)."""
+
+    batch: Any = ("dp", "fsdp")
+    seq: Any = None
+    embed: Any = None
+    heads: Any = None
+    kv_heads: Any = None
+    mlp: Any = None
+    vocab: Any = None
+    expert: Any = None
+    stage: Any = None
+
+    def mesh_axes(self, logical: tuple) -> P:
+        out = []
+        for ax in logical:
+            if ax is None:
+                out.append(None)
+            else:
+                out.append(getattr(self, ax))
+        return P(*out)
+
+    def with_overrides(self, **kw) -> "ShardingRules":
+        return replace(self, **kw)
+
+
+# --- presets (the §2c parallelism inventory as one-liners) ---
+
+# Pure data parallel: params replicated, batch split.
+DP_RULES = ShardingRules(batch=("dp", "fsdp"))
+
+# Fully-sharded data parallel (ZeRO-3 analog): params/grads/optimizer sharded
+# on fsdp axis; batch split over dp×fsdp.
+FSDP_RULES = ShardingRules(batch=("dp", "fsdp"), embed="fsdp")
+
+# Megatron-style tensor parallel: heads/mlp/vocab split on tp.
+TP_RULES = ShardingRules(batch=("dp", "fsdp"), heads="tp", kv_heads="tp",
+                         mlp="tp", vocab="tp")
+
+# FSDP × TP (the common 2D layout for 7B+ on a slice).
+FSDP_TP_RULES = ShardingRules(
+    batch=("dp", "fsdp"), embed="fsdp", heads="tp", kv_heads="tp", mlp="tp",
+    vocab="tp",
+)
+
+# + sequence parallel: activations sharded along seq on the sp axis.
+FSDP_TP_SP_RULES = FSDP_TP_RULES.with_overrides(seq="sp")
+
+# MoE: experts split on ep, everything else as FSDP×TP.
+MOE_RULES = FSDP_TP_RULES.with_overrides(expert="ep")
+
+PRESETS = {
+    "dp": DP_RULES,
+    "fsdp": FSDP_RULES,
+    "tp": TP_RULES,
+    "fsdp_tp": FSDP_TP_RULES,
+    "fsdp_tp_sp": FSDP_TP_SP_RULES,
+    "moe": MOE_RULES,
+}
+
+
+def _filter_spec_for_mesh(spec: P, mesh: Mesh) -> P:
+    """Drop mesh axes the mesh doesn't have (so FSDP_TP rules work on a
+    dp-only mesh: tp entries become replicated), and drop repeated uses of a
+    mesh axis (first dim wins): one array can map each mesh axis to at most
+    one positional dimension — e.g. activations [batch(dp,fsdp), embed(fsdp)]
+    keep fsdp on batch and replicate embed."""
+    names = set(mesh.axis_names)
+    used: set = set()
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = []
+            for e in entry:
+                if e in names and e not in used:
+                    used.add(e)
+                    kept.append(e)
+            return tuple(kept) if kept else None
+        if entry in names and entry not in used:
+            used.add(entry)
+            return entry
+        return None
+
+    return P(*(keep(e) for e in spec))
+
+
+def logical_sharding(logical: tuple, mesh: Mesh, rules: ShardingRules) -> NamedSharding:
+    """NamedSharding for one array annotated with logical axis names."""
+    spec = _filter_spec_for_mesh(rules.mesh_axes(logical), mesh)
+    return NamedSharding(mesh, spec)
+
+
+def tree_shardings(logical_tree, mesh: Mesh, rules: ShardingRules):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings.
+    ``logical_tree`` leaves are tuples like ("embed", "mlp")."""
+    return jax.tree.map(
+        lambda logical: logical_sharding(tuple(logical), mesh, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def shard_tree(tree, logical_tree, mesh: Mesh, rules: ShardingRules):
+    """Device-put a pytree according to its logical annotations."""
+    shardings = tree_shardings(logical_tree, mesh, rules)
+    return jax.device_put(tree, shardings)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, rules: ShardingRules, ndim: int = 2) -> NamedSharding:
+    """Sharding for an input batch [batch, seq, ...]: batch axis split per
+    rules, sequence split if sp is active, rest replicated."""
+    logical = ("batch", "seq") + (None,) * (ndim - 2)
+    return logical_sharding(logical, mesh, rules)

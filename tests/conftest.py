@@ -1,18 +1,55 @@
 """Test fixtures.
 
-JAX is forced onto a virtual 8-device CPU platform BEFORE first import so
-multi-chip sharding paths compile and run without TPU hardware (the driver's
-``dryrun_multichip`` uses the same mechanism). Analog of the reference's
-``ray_start_regular`` fixture (``python/ray/tests/conftest.py:410``) for the
-runtime tests.
+Tests run on a virtual 8-device CPU platform so multi-chip sharding paths
+compile and execute without TPU hardware (same mechanism the driver's
+``dryrun_multichip`` uses). Two environments must work:
+
+1. Clean env: set JAX_PLATFORMS/XLA_FLAGS before jax's first import.
+2. The axon TPU-tunnel env: a sitecustomize on PYTHONPATH has ALREADY
+   imported jax and registered the 'axon' PJRT plugin (whose backend init
+   dials a tunnel and can block for minutes). We unregister non-CPU
+   factories and force the platform to cpu before any backend initializes.
+
+Analog of the reference's ``ray_start_regular`` fixture
+(``python/ray/tests/conftest.py:410``) for the runtime tests.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Append (not guard): XLA's flag parsing is last-occurrence-wins, so this
+# forces 8 virtual devices even if the env already set a different count.
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
 ).strip()
+
+import jax  # noqa: E402
+
+# Drop tunnel/TPU backends registered by sitecustomize before any backend
+# init, and FAIL FAST if we cannot guarantee cpu — a silent miss here means
+# the first jax.devices() call dials the tunnel and hangs the whole session.
+try:
+    from jax._src import xla_bridge as _xb
+
+    if _xb._backends:
+        raise RuntimeError(
+            "a JAX backend was already initialized before conftest ran "
+            f"({list(_xb._backends)}); tests cannot force the cpu platform. "
+            "Run pytest in a fresh process."
+        )
+    for _name in ("axon", "tpu"):
+        _xb._backend_factories.pop(_name, None)
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    # jax internals moved. If jax was imported fresh in this process, the
+    # JAX_PLATFORMS env var above still latched at import; verify it did.
+    if jax.config.jax_platforms != "cpu":
+        raise RuntimeError(
+            "cannot force JAX onto cpu: xla_bridge internals unavailable and "
+            f"jax_platforms={jax.config.jax_platforms!r}; tests would dial "
+            "the TPU tunnel and hang"
+        ) from None
 
 import pytest  # noqa: E402
 
@@ -30,8 +67,6 @@ def ray_tpu_start():
 
 @pytest.fixture(scope="session")
 def cpu_mesh_devices():
-    import jax
-
     devices = jax.devices()
     assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
     return devices

@@ -1,0 +1,228 @@
+"""Mesh/sharding/collective layer tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel import collectives as col
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    create_hybrid_mesh,
+    create_mesh,
+    mesh_registry,
+    slice_topology,
+)
+from ray_tpu.parallel.sharding import (
+    FSDP_TP_RULES,
+    PRESETS,
+    batch_sharding,
+    logical_sharding,
+    shard_tree,
+    tree_shardings,
+)
+
+
+def test_mesh_spec_wildcard():
+    assert MeshSpec({"dp": -1, "tp": 2}).resolved(8) == {"dp": 4, "tp": 2}
+    assert MeshSpec({"fsdp": 8}).resolved(8) == {"fsdp": 8}
+    with pytest.raises(ValueError):
+        MeshSpec({"dp": 3, "tp": 2}).resolved(8)
+    with pytest.raises(ValueError):
+        MeshSpec({"dp": -1, "tp": -1}).resolved(8)
+
+
+def test_mesh_axis_canonical_order():
+    resolved = MeshSpec({"tp": 2, "dp": 2, "fsdp": 2}).resolved(8)
+    assert list(resolved.keys()) == ["dp", "fsdp", "tp"]
+
+
+def test_create_mesh(cpu_mesh_devices):
+    mesh = create_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    assert mesh.axis_names == ("dp", "fsdp", "tp")
+    assert mesh.devices.shape == (2, 2, 2)
+
+
+def test_hybrid_mesh(cpu_mesh_devices):
+    mesh = create_hybrid_mesh({"tp": 4}, {"dp": 2})
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.devices.shape == (2, 4)
+
+
+def test_mesh_registry(cpu_mesh_devices):
+    reg = mesh_registry()
+    m = reg.get_or_create("test_mesh", {"dp": -1})
+    assert reg.get("test_mesh") is m
+    with pytest.raises(ValueError):
+        reg.register("test_mesh", m)
+    reg.remove("test_mesh")
+    with pytest.raises(KeyError):
+        reg.get("test_mesh")
+
+
+def test_slice_topology(cpu_mesh_devices):
+    info = slice_topology()
+    assert info["num_devices"] == 8
+    assert info["platform"] == "cpu"
+
+
+def test_logical_sharding_rules(cpu_mesh_devices):
+    mesh = create_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    s = logical_sharding(("embed", "mlp"), mesh, FSDP_TP_RULES)
+    assert s.spec == P("fsdp", "tp")
+    # batch sharding over dp×fsdp
+    bs = batch_sharding(mesh, FSDP_TP_RULES, ndim=2)
+    assert bs.spec == P(("dp", "fsdp"), None)
+
+
+def test_rules_filtered_for_small_mesh(cpu_mesh_devices):
+    # FSDP_TP rules on a dp-only mesh: tp/fsdp references drop to replicated.
+    mesh = create_mesh({"dp": 8})
+    s = logical_sharding(("embed", "mlp"), mesh, FSDP_TP_RULES)
+    assert s.spec == P(None, None)
+
+
+def test_shard_tree_places_arrays(cpu_mesh_devices):
+    mesh = create_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    params = {"w": jnp.ones((16, 32)), "b": jnp.ones((32,))}
+    logical = {"w": ("embed", "mlp"), "b": ("mlp",)}
+    sharded = shard_tree(params, logical, mesh, FSDP_TP_RULES)
+    assert sharded["w"].sharding.spec == P("fsdp", "tp")
+    assert sharded["b"].sharding.spec == P("tp")
+    np.testing.assert_allclose(np.asarray(sharded["w"]), 1.0)
+
+
+def test_all_presets_produce_shardings(cpu_mesh_devices):
+    mesh = create_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    for name, rules in PRESETS.items():
+        s = logical_sharding(("batch", "seq", "embed"), mesh, rules)
+        assert isinstance(s, NamedSharding), name
+
+
+# --- device-plane collectives via shard_map ---
+
+
+def test_shard_map_psum(cpu_mesh_devices):
+    from jax.experimental.shard_map import shard_map
+
+    mesh = create_mesh({"dp": 8})
+    x = jnp.arange(8.0)
+
+    f = shard_map(
+        lambda v: col.psum(v, "dp"),
+        mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+    )
+    np.testing.assert_allclose(np.asarray(f(x)), np.full(8, 28.0))
+
+
+def test_shard_map_ring_shift(cpu_mesh_devices):
+    from jax.experimental.shard_map import shard_map
+
+    mesh = create_mesh({"sp": 8})
+    x = jnp.arange(8.0)
+    f = shard_map(
+        lambda v: col.ring_shift(v, "sp"),
+        mesh=mesh, in_specs=P("sp"), out_specs=P("sp"),
+    )
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
+
+
+def test_shard_map_all_to_all(cpu_mesh_devices):
+    from jax.experimental.shard_map import shard_map
+
+    mesh = create_mesh({"ep": 4}, devices=jax.devices()[:4])
+    x = jnp.arange(16.0).reshape(4, 4)  # [tokens, experts]
+    f = shard_map(
+        lambda v: col.all_to_all(v, "ep", split_axis=1, concat_axis=0),
+        mesh=mesh, in_specs=P("ep", None), out_specs=P("ep", None),
+    )
+    out = np.asarray(f(x))
+    assert out.shape == (16, 1)
+
+
+# --- host-plane actor collectives ---
+
+
+def test_host_allreduce_between_actors(ray_tpu_start):
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            self.rank = rank
+            col.init_collective_group(world, rank, group_name="g1")
+
+        def reduce(self, x):
+            return col.allreduce(np.array([x], dtype=np.float32), self.rank,
+                                 group_name="g1")
+
+    world = 4
+    actors = [Rank.remote(i, world) for i in range(world)]
+    refs = [a.reduce.remote(float(i)) for i, a in enumerate(actors)]
+    results = ray_tpu.get(refs, timeout=30)
+    for r in results:
+        np.testing.assert_allclose(r, [6.0])
+    col.destroy_collective_group("g1")
+
+
+def test_host_broadcast_and_allgather(ray_tpu_start):
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            self.rank = rank
+            col.init_collective_group(world, rank, group_name="g2")
+
+        def bcast(self, x):
+            return col.broadcast(x, self.rank, src_rank=0, group_name="g2")
+
+        def gather(self, x):
+            return col.allgather(np.array([x]), self.rank, group_name="g2")
+
+    world = 3
+    actors = [Rank.remote(i, world) for i in range(world)]
+    out = ray_tpu.get(
+        [a.bcast.remote(np.array([i * 1.0])) for i, a in enumerate(actors)],
+        timeout=30,
+    )
+    for r in out:
+        np.testing.assert_allclose(r, [0.0])
+    gathered = ray_tpu.get(
+        [a.gather.remote(float(i)) for i, a in enumerate(actors)], timeout=30
+    )
+    for g in gathered:
+        np.testing.assert_allclose(np.concatenate(g), [0.0, 1.0, 2.0])
+    col.destroy_collective_group("g2")
+
+
+def test_back_to_back_collectives_no_crosstalk(ray_tpu_start):
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            self.rank = rank
+            col.init_collective_group(world, rank, group_name="g3")
+
+        def many(self, n):
+            outs = []
+            for i in range(n):
+                outs.append(
+                    float(
+                        col.allreduce(
+                            np.array([float(i)]), self.rank, group_name="g3"
+                        )[0]
+                    )
+                )
+            return outs
+
+    world = 4
+    actors = [Rank.remote(i, world) for i in range(world)]
+    results = ray_tpu.get([a.many.remote(10) for a in actors], timeout=60)
+    expected = [i * 4.0 for i in range(10)]
+    for r in results:
+        assert r == expected
+    col.destroy_collective_group("g3")
