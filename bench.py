@@ -1,0 +1,110 @@
+"""Benchmark: Llama train-step throughput (tokens/sec/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no absolute numbers (BASELINE.md: envelope only), so
+vs_baseline is reported against the North-star target proxy of 1.0 until a
+measured reference exists.
+
+Env knobs:
+    BENCH_PRESET=small|base   (default base; small for CPU smoke runs)
+    BENCH_STEPS=N             (timed steps, default 10)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.mesh import create_mesh
+    from ray_tpu.train.trainer import JaxTrainer, TrainConfig
+
+    preset = os.environ.get("BENCH_PRESET", "base")
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+
+    if preset == "small":
+        model_cfg = llama.llama_tiny()
+        batch, seq = 8, 128
+    else:
+        # ~0.5B-param Llama-style model: fits one v5e chip with Adam state.
+        model_cfg = llama.LlamaConfig(
+            vocab_size=32768, d_model=1536, n_layers=12, n_heads=12,
+            n_kv_heads=4, head_dim=128, d_ff=6144, remat="full",
+        )
+        batch, seq = 8, 2048
+
+    # Multi-chip: shard params/optimizer on an fsdp axis; single chip: dp.
+    axis = "fsdp" if n_dev > 1 else "dp"
+    trainer = JaxTrainer(
+        model_cfg,
+        TrainConfig(
+            mesh_axes={axis: n_dev}, strategy="fsdp" if n_dev > 1 else "dp",
+            warmup_steps=10, total_steps=1000,
+        ),
+        mesh=create_mesh({axis: n_dev}),
+    )
+
+    key = jax.random.key(0)
+    state = trainer.init_state(key)
+    n_params = llama.num_params(state.params)
+
+    def batch_fn(i):
+        return jax.random.randint(
+            jax.random.key(i), (batch, seq + 1), 0, model_cfg.vocab_size,
+            dtype=jnp.int32,
+        )
+
+    # warmup (compile)
+    t0 = time.perf_counter()
+    state, metrics = trainer.train_step(state, batch_fn(0))
+    jax.block_until_ready(metrics["loss"])
+    compile_s = time.perf_counter() - t0
+    state, metrics = trainer.train_step(state, batch_fn(1))
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = trainer.train_step(state, batch_fn(i + 2))
+    jax.block_until_ready(metrics["loss"])
+    elapsed = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / elapsed
+    per_chip = tokens_per_sec / n_dev
+
+    result = {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "detail": {
+            "platform": platform,
+            "n_devices": n_dev,
+            "params": n_params,
+            "batch": batch,
+            "seq": seq,
+            "steps": steps,
+            "step_time_s": round(elapsed / steps, 4),
+            "compile_s": round(compile_s, 1),
+            "final_loss": round(float(metrics["loss"]), 4),
+            "model_flops_per_token": 6 * n_params,
+            "tflops_per_sec_per_chip": round(
+                6 * n_params * per_chip / 1e12, 2
+            ),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

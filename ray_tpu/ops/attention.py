@@ -1,0 +1,106 @@
+"""Attention ops: GQA/MHA causal attention with fp32 softmax.
+
+The default implementation is pure-XLA einsum attention — on TPU, XLA fuses
+the QK^T → softmax → PV chain reasonably well at small/medium sequence
+lengths. The Pallas flash kernel (``ray_tpu.ops.flash_attention``) replaces it
+on TPU for long sequences; ``attention()`` dispatches.
+
+Conventions: q/k/v are [batch, seq, heads, head_dim]; GQA is expressed by
+n_kv_heads < n_heads with n_heads % n_kv_heads == 0.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from einops import rearrange
+
+
+def _repeat_kv(k, n_rep: int):
+    if n_rep == 1:
+        return k
+    return jnp.repeat(k, n_rep, axis=2)
+
+
+def reference_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    segment_ids=None,
+    logits_soft_cap: float | None = None,
+    scale: float | None = None,
+):
+    """Einsum attention with fp32 logits/softmax.
+
+    ``segment_ids`` ([batch, seq], int) masks cross-segment attention —
+    used for sequence packing.
+    """
+    b, sq, nh, hd = q.shape
+    _, skv, nkv, _ = k.shape
+    n_rep = nh // nkv
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = scale if scale is not None else hd ** -0.5
+
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+    if logits_soft_cap is not None:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+
+    mask = None
+    if causal:
+        qpos = jnp.arange(sq)[:, None]
+        kpos = jnp.arange(skv)[None, :]
+        # allow decode: query block sits at the END of the kv window
+        mask = kpos <= qpos + (skv - sq)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        seg_mask = seg_mask[:, None, :, :]  # [b, 1, q, k]
+        mask = seg_mask if mask is None else (mask[None, None] & seg_mask)
+    elif mask is not None:
+        mask = mask[None, None]
+
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def attention(q, k, v, *, causal=True, segment_ids=None,
+              logits_soft_cap=None, impl: str = "auto"):
+    """Dispatching entry point. ``impl``: auto | reference | flash."""
+    if impl == "auto":
+        impl = "flash" if _flash_supported(q, segment_ids, logits_soft_cap, causal) else "reference"
+    if impl == "flash":
+        if segment_ids is not None or logits_soft_cap is not None:
+            raise ValueError(
+                "impl='flash' does not support segment_ids/logits_soft_cap "
+                "yet; use impl='reference'"
+            )
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    return reference_attention(
+        q, k, v, causal=causal, segment_ids=segment_ids,
+        logits_soft_cap=logits_soft_cap,
+    )
+
+
+def _flash_supported(q, segment_ids, logits_soft_cap, causal) -> bool:
+    if segment_ids is not None or logits_soft_cap is not None or not causal:
+        return False
+    # works under tracing: dispatch on the process-level default backend
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    # flash kernel block constraints
+    b, s, h, d = q.shape
+    return s >= 256 and s % 128 == 0 and d in (64, 128, 256)

@@ -1,0 +1,196 @@
+"""Flash attention for TPU in Pallas.
+
+Online-softmax blocked attention: O(seq) memory instead of the O(seq^2)
+logits tensor, KV streamed through VMEM block by block. Grid is
+(batch, heads, q_blocks, kv_blocks) with the kv axis innermost; running max,
+denominator and the output accumulator live in VMEM scratch that persists
+across the kv iterations of one q block (sequential grid execution on TPU).
+
+GQA reads each KV head once via the BlockSpec index map (no host-side
+repeat). The backward pass currently recomputes through the reference
+einsum attention via custom_vjp (correct; a dedicated backward kernel is a
+planned optimization — forward is the inference/serving hot path).
+
+Kernel design follows the public flash-attention-on-TPU recipe (see
+/opt/skills/guides/pallas_guide.md patterns; reference framework has no TPU
+attention kernels at all — SURVEY.md §2c "Ring attention: no").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+
+def _fwd_kernel(
+    q_ref,      # [1, 1, bq, d]
+    k_ref,      # [1, 1, bk, d]
+    v_ref,      # [1, 1, bk, d]
+    o_ref,      # [1, 1, bq, d]
+    m_scratch,  # [bq, 128] f32 running row max
+    l_scratch,  # [bq, 128] f32 running denominator
+    acc_scratch,  # [bq, d] f32 output accumulator
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    # causal: process only kv blocks whose start is <= this q block's end
+    should_run = True
+    if causal:
+        should_run = kj * block_k <= qi * block_q + (block_q - 1)
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0]  # [bq, d]
+        k = k_ref[0, 0]  # [bk, d]
+        v = v_ref[0, 0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        s = s * scale
+
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+        m_prev = m_scratch[:, :1]                       # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)       # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        # exp(-inf - -inf) guard: rows with no valid keys yet stay at 0
+        p = jnp.exp(s - m_new)                          # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)                  # [bq, 1]
+        l_new = l_scratch[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+
+        acc = acc_scratch[:] * corr
+        acc = acc + lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_scratch[:] = acc
+        m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+        l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_scratch[:, :1]
+        # guard fully-masked rows (shouldn't occur with causal diag present)
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scratch[:] / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    hk = k.shape[1]
+    skv = k.shape[2]
+    n_rep = h // hk
+    grid = (b, h, sq // block_q, skv // block_k)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i, j: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j: (b_, h_ // n_rep, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h_, i, j: (b_, h_ // n_rep, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h_, i, j: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q_k, interpret):
+    block_q, block_k = block_q_k
+    return _flash_fwd(q, k, v, scale=scale, causal=causal,
+                      block_q=block_q, block_k=block_k, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q_k, interpret):
+    out = _flash(q, k, v, scale, causal, block_q_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(scale, causal, block_q_k, interpret, res, g):
+    """Backward via the reference attention's VJP (recompute; no O(s^2)
+    residuals are saved in the forward)."""
+    from ray_tpu.ops.attention import reference_attention
+
+    q, k, v = res
+
+    def ref(q_, k_, v_):
+        # reference expects [b, s, h, d]
+        o = reference_attention(
+            q_.transpose(0, 2, 1, 3), k_.transpose(0, 2, 1, 3),
+            v_.transpose(0, 2, 1, 3), causal=causal, scale=scale,
+        )
+        return o.transpose(0, 2, 1, 3)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q, k, v, *, causal: bool = True, scale: float | None = None,
+    block_q: int = 256, block_k: int = 256, interpret: bool = False,
+):
+    """Flash attention. q/k/v: [batch, seq, heads, head_dim] (same layout as
+    ``reference_attention``); returns [batch, seq, heads, head_dim].
+    """
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    if sq % block_q or skv % block_k:
+        raise ValueError(
+            f"seq lengths ({sq}, {skv}) must be divisible by blocks "
+            f"({block_q}, {block_k})"
+        )
+    # kernel layout: [b, h, s, d]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash(qt, kt, vt, scale, causal, (block_q, block_k), interpret)
+    return out.transpose(0, 2, 1, 3)

@@ -96,6 +96,16 @@ _REDUCERS = {
 }
 
 
+def _private_copy(x):
+    """Copy combine() results so each rank owns its buffer (in-place math on
+    one rank's result must not corrupt another's)."""
+    if isinstance(x, np.ndarray):
+        return x.copy()
+    if isinstance(x, list):
+        return [_private_copy(e) for e in x]
+    return x
+
+
 @dataclass
 class _GroupState:
     name: str
@@ -139,13 +149,18 @@ class GroupManager:
         with self._lock:
             self._groups.pop(name, None)
 
-    def _rendezvous(self, group: str, rank: int, key: str, value, combine):
+    def _rendezvous(self, group: str, rank: int, key: str, value, combine,
+                    timeout: float = 60.0):
         """Generic barrier: all ranks contribute; `combine` runs once on the
-        full contribution dict; every rank receives the result.
+        full contribution dict; every rank receives a private copy of the
+        result (NCCL/gloo semantics: each rank owns its output buffer).
 
         Each rank's n-th call with a given `key` joins epoch n, so
         back-to-back collectives on the same group can't cross-talk even if
         a fast rank starts the next op before slow ranks finish this one.
+        On timeout the rank withdraws its contribution and rolls back its
+        epoch, so a retry re-joins the same epoch instead of desynchronizing
+        the group.
         """
         g = self.get(group)
         with g.cv:
@@ -164,10 +179,17 @@ class GroupManager:
                 g.cv.notify_all()
             else:
                 while op_id not in g.results:
-                    if not g.cv.wait(timeout=60.0):
+                    if not g.cv.wait(timeout=timeout):
+                        # withdraw cleanly so a retry can rejoin this epoch
+                        still = g.contributions.get(op_id)
+                        if still is not None:
+                            still.pop(rank, None)
+                            if not still:
+                                del g.contributions[op_id]
+                        g.seq[(key, rank)] = epoch
                         raise TimeoutError(
-                            f"collective {key!r} timed out in group {group!r} "
-                            f"(rank {rank}, epoch {epoch}, "
+                            f"collective {key!r} timed out in group "
+                            f"{group!r} (rank {rank}, epoch {epoch}, "
                             f"{len(g.contributions.get(op_id, {}))}/"
                             f"{g.world_size} arrived)"
                         )
@@ -175,7 +197,7 @@ class GroupManager:
             slot[1] += 1
             if slot[1] == g.world_size:  # last rank out frees the slot
                 del g.results[op_id]
-            return slot[0]
+            return _private_copy(slot[0])
 
 
 _group_manager = GroupManager()

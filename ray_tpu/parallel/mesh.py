@@ -45,7 +45,7 @@ class MeshSpec:
     axes: dict[str, int] = field(default_factory=dict)
 
     def resolved(self, n_devices: int) -> dict[str, int]:
-        axes = {k: v for k, v in self.axes.items() if v != 1 or True}
+        axes = dict(self.axes)
         wild = [k for k, v in axes.items() if v == -1]
         if len(wild) > 1:
             raise ValueError(f"At most one axis may be -1, got {wild}")
@@ -111,9 +111,17 @@ def create_hybrid_mesh(
     """
     if devices is None:
         devices = jax.devices()
+    if not devices:
+        raise ValueError("create_hybrid_mesh: no devices")
     n = len(devices)
     dcn_shape = tuple(dcn_axes.values())
-    per_slice = n // math.prod(dcn_shape)
+    n_slices = math.prod(dcn_shape)
+    if n % n_slices != 0:
+        raise ValueError(
+            f"{n} devices not divisible by dcn axes {dcn_axes} "
+            f"({n_slices} slices)"
+        )
+    per_slice = n // n_slices
     ici_resolved = MeshSpec(dict(ici_axes)).resolved(per_slice)
     names = tuple(dcn_axes.keys()) + tuple(ici_resolved.keys())
     if devices[0].platform == "tpu":
@@ -139,9 +147,12 @@ class MeshRegistry:
 
     def register(self, name: str, mesh: Mesh, *, overwrite: bool = False):
         with self._lock:
-            if name in self._meshes and not overwrite:
-                raise ValueError(f"Mesh {name!r} already registered")
-            self._meshes[name] = mesh
+            return self._register_locked(name, mesh, overwrite)
+
+    def _register_locked(self, name: str, mesh: Mesh, overwrite: bool):
+        if name in self._meshes and not overwrite:
+            raise ValueError(f"Mesh {name!r} already registered")
+        self._meshes[name] = mesh
         return mesh
 
     def get(self, name: str) -> Mesh:
@@ -153,11 +164,13 @@ class MeshRegistry:
             return self._meshes[name]
 
     def get_or_create(self, name: str, axes: dict[str, int], **kwargs) -> Mesh:
+        # Single critical section: a concurrent creator must get the winner's
+        # mesh back, not a ValueError from a lost register race.
         with self._lock:
             if name in self._meshes:
                 return self._meshes[name]
-        mesh = create_mesh(axes, **kwargs)
-        return self.register(name, mesh)
+            mesh = create_mesh(axes, **kwargs)
+            return self._register_locked(name, mesh, overwrite=False)
 
     def remove(self, name: str):
         with self._lock:

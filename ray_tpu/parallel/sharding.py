@@ -29,6 +29,29 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+class Axes(tuple):
+    """Marker type for a logical-axes annotation leaf. Distinguishable from
+    namedtuples (e.g. optax states) when used as a pytree leaf predicate."""
+
+    __slots__ = ()
+
+
+def is_axes_leaf(x) -> bool:
+    """True for annotation leaves: an ``Axes`` marker, or a plain tuple of
+    axis entries (str/None/tuple-of-str). Namedtuple containers (e.g. optax
+    states) are NOT leaves even though they subclass tuple."""
+    if isinstance(x, Axes):
+        return True
+    if isinstance(x, tuple) and not hasattr(x, "_fields"):
+        return all(
+            e is None or isinstance(e, str)
+            or (isinstance(e, (tuple, list))
+                and all(isinstance(s, str) for s in e))
+            for e in x
+        )
+    return False
+
+
 @dataclass(frozen=True)
 class ShardingRules:
     """Mapping from logical axis name to mesh axis (or tuple of mesh axes,
@@ -127,11 +150,11 @@ def logical_sharding(logical: tuple, mesh: Mesh, rules: ShardingRules) -> NamedS
 
 def tree_shardings(logical_tree, mesh: Mesh, rules: ShardingRules):
     """Map a pytree of logical-axis tuples to a pytree of NamedShardings.
-    ``logical_tree`` leaves are tuples like ("embed", "mlp")."""
+    ``logical_tree`` leaves are tuples like ("embed", "mlp") or ``Axes``."""
     return jax.tree.map(
         lambda logical: logical_sharding(tuple(logical), mesh, rules),
         logical_tree,
-        is_leaf=lambda x: isinstance(x, tuple),
+        is_leaf=is_axes_leaf,
     )
 
 

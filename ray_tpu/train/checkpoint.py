@@ -1,0 +1,78 @@
+"""Checkpointing: async, sharded, top-k retention.
+
+Analog of the reference Train's ``Checkpoint`` + ``StorageContext`` +
+``CheckpointManager`` (``train/_checkpoint.py:55``,
+``train/_internal/storage.py:350``, ``train/_internal/checkpoint_manager.py``)
+rebuilt on Orbax/tensorstore: every device writes only its own shards
+(OCDBT), saves are async (training continues during the write), and restore
+places shards directly onto the target mesh via the sharding pytree.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 async_save: bool = True):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any, *, metrics: dict | None = None,
+             force: bool = False) -> bool:
+        return self._mgr.save(
+            step,
+            args=ocp.args.StandardSave(state),
+            metrics=metrics,
+            force=force,
+        )
+
+    def restore(self, step: int | None = None, *, target: Any = None,
+                shardings: Any = None) -> Any:
+        """Restore ``step`` (default: latest). ``target`` is an abstract or
+        concrete state pytree; ``shardings`` (NamedSharding pytree) places
+        restored shards directly on the mesh."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"No checkpoints under {self.directory}"
+                )
+        if target is not None:
+            def abstractify(x, s):
+                if hasattr(x, "shape"):
+                    return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+                return x
+
+            if shardings is not None:
+                abstract = jax.tree.map(abstractify, target, shardings)
+            else:
+                abstract = jax.tree.map(lambda x: abstractify(x, None), target)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        return self._mgr.restore(step)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return list(self._mgr.all_steps())
+
+    def wait(self):
+        """Block until pending async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()

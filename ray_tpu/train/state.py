@@ -1,0 +1,68 @@
+"""Train state: params + optimizer state + step, with sharding helpers.
+
+Analog of the reference Train's per-rank model/optimizer setup
+(``train/torch/train_loop_utils.py prepare_model`` + optimizer), except state
+lives in ONE jit-visible pytree sharded by GSPMD — there is no per-rank
+wrapper object, the mesh is the "world".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import optax
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    @staticmethod
+    def create(params, optimizer: optax.GradientTransformation) -> "TrainState":
+        import jax.numpy as jnp
+
+        return TrainState(
+            params=params,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), dtype=jnp.int32),
+        )
+
+
+def state_logical_axes(state: TrainState, param_axes) -> TrainState:
+    """Logical-axis pytree for a TrainState: optimizer moments inherit the
+    axes of the params they track (ZeRO-style optimizer-state sharding comes
+    for free); scalars are replicated. Leaves are ``Axes`` markers so
+    namedtuple-based optax states aren't mistaken for annotation leaves."""
+    from ray_tpu.parallel.sharding import Axes
+
+    params_treedef = jax.tree.structure(state.params)
+    axes_tree = jax.tree.map(
+        lambda a: Axes(a), param_axes, is_leaf=lambda x: isinstance(x, tuple)
+    )
+
+    def is_param_tree(x):
+        """True for optimizer sub-pytrees (mu/nu moments) that mirror the
+        param tree's structure — matched positionally, NOT by array shape
+        (two same-shape params can have different shardings)."""
+        try:
+            return jax.tree.structure(x) == params_treedef
+        except Exception:  # noqa: BLE001
+            return False
+
+    def annotate(node):
+        if is_param_tree(node):
+            return axes_tree
+        shape = getattr(node, "shape", ())
+        return Axes((None,) * len(shape))
+
+    return TrainState(
+        params=axes_tree,
+        opt_state=jax.tree.map(annotate, state.opt_state,
+                               is_leaf=is_param_tree),
+        step=Axes(()),
+    )

@@ -38,8 +38,9 @@ try:
             f"({list(_xb._backends)}); tests cannot force the cpu platform. "
             "Run pytest in a fresh process."
         )
-    for _name in ("axon", "tpu"):
-        _xb._backend_factories.pop(_name, None)
+    # Pop only the tunnel backend: removing 'tpu' as well would delist it
+    # from MLIR's known platforms and break chex/optax imports.
+    _xb._backend_factories.pop("axon", None)
     jax.config.update("jax_platforms", "cpu")
 except ImportError:
     # jax internals moved. If jax was imported fresh in this process, the

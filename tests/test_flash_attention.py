@@ -1,0 +1,78 @@
+"""Flash attention kernel numerics (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import reference_attention
+from ray_tpu.ops.flash_attention import flash_attention
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("gqa", [1, 4])
+def test_flash_matches_reference(causal, gqa):
+    b, s, h, d = 2, 256, 4, 64
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d), dtype=jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (b, s, h // gqa, d), dtype=jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (b, s, h // gqa, d), dtype=jnp.float32)
+    got = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128,
+                          interpret=True)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_uneven_blocks():
+    b, s, h, d = 1, 384, 2, 64  # 384 = 3 * 128: q/kv block walk is uneven
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d))
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d))
+    got = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                          interpret=True)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_bf16():
+    b, s, h, d = 1, 256, 2, 64
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d)).astype(jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d)).astype(jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d)).astype(jnp.bfloat16)
+    got = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                          interpret=True)
+    want = reference_attention(q, k, v, causal=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, dtype=np.float32), np.asarray(want, dtype=np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def test_flash_grad_matches_reference():
+    b, s, h, d = 1, 256, 2, 64
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d))
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                            interpret=True) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_flash_rejects_bad_seq():
+    q = jnp.zeros((1, 200, 2, 64))
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, q, q, block_q=128, block_k=128, interpret=True)

@@ -1,0 +1,227 @@
+"""Numerics tests for ops and the Llama model on the CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import llama
+from ray_tpu.ops.attention import reference_attention
+from ray_tpu.ops.norms import layer_norm, rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_sin_cos
+
+
+def test_rms_norm_matches_manual():
+    x = jax.random.normal(jax.random.key(0), (4, 16), dtype=jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (16,)) * 0.1 + 1.0
+    got = rms_norm(x, w)
+    want = x / np.sqrt(np.mean(np.square(np.asarray(x)), -1, keepdims=True) + 1e-5)
+    want = want * np.asarray(w)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+
+def test_rms_norm_bf16_stats_in_fp32():
+    x = (jnp.ones((2, 8)) * 300.0).astype(jnp.bfloat16)  # squares overflow-ish in bf16
+    w = jnp.ones((8,), dtype=jnp.bfloat16)
+    out = rms_norm(x, w)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.ones((2, 8)), rtol=1e-2)
+
+
+def test_layer_norm():
+    x = jax.random.normal(jax.random.key(0), (3, 32))
+    out = np.asarray(layer_norm(x, jnp.ones(32), jnp.zeros(32)))
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), 1.0, atol=1e-2)
+
+
+def test_rope_preserves_norm_and_relative_shift():
+    b, s, h, d = 1, 8, 2, 16
+    x = jax.random.normal(jax.random.key(0), (b, s, h, d))
+    pos = jnp.arange(s)[None, :]
+    sin, cos = rope_sin_cos(pos, d, theta=10000.0)
+    rx = apply_rope(x, sin, cos)
+    # rotation preserves pairwise norms
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(rx), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5,
+    )
+    # dot(q_i, k_j) depends only on i-j: shift both positions by 3
+    q = jax.random.normal(jax.random.key(1), (1, 1, 1, d))
+    k = jax.random.normal(jax.random.key(2), (1, 1, 1, d))
+    def dot_at(pi, pj):
+        sq, cq = rope_sin_cos(jnp.array([[pi]]), d, theta=10000.0)
+        sk, ck = rope_sin_cos(jnp.array([[pj]]), d, theta=10000.0)
+        return float(jnp.sum(apply_rope(q, sq, cq) * apply_rope(k, sk, ck)))
+    assert dot_at(5, 2) == pytest.approx(dot_at(8, 5), rel=1e-4)
+
+
+def _naive_attention(q, k, v, causal=True):
+    b, s, h, d = q.shape
+    nkv = k.shape[2]
+    k = np.repeat(np.asarray(k), h // nkv, axis=2)
+    v = np.repeat(np.asarray(v), h // nkv, axis=2)
+    out = np.zeros_like(np.asarray(q), dtype=np.float32)
+    for bi in range(b):
+        for hi in range(h):
+            logits = np.asarray(q)[bi, :, hi] @ k[bi, :, hi].T / np.sqrt(d)
+            if causal:
+                mask = np.tril(np.ones((s, s), dtype=bool))
+                logits = np.where(mask, logits, -1e30)
+            p = np.exp(logits - logits.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            out[bi, :, hi] = p @ v[bi, :, hi]
+    return out
+
+
+def test_reference_attention_matches_naive():
+    rng = jax.random.key(0)
+    q = jax.random.normal(rng, (2, 16, 4, 8), dtype=jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (2, 16, 2, 8), dtype=jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (2, 16, 2, 8), dtype=jnp.float32)
+    got = np.asarray(reference_attention(q, k, v, causal=True))
+    want = _naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_attention_causality():
+    # Output at position t must not change when future tokens change.
+    q = jax.random.normal(jax.random.key(0), (1, 8, 2, 8))
+    k = jax.random.normal(jax.random.key(1), (1, 8, 2, 8))
+    v = jax.random.normal(jax.random.key(2), (1, 8, 2, 8))
+    out1 = reference_attention(q, k, v, causal=True)
+    k2 = k.at[:, 5:].set(99.0)
+    v2 = v.at[:, 5:].set(-99.0)
+    out2 = reference_attention(q, k2, v2, causal=True)
+    np.testing.assert_allclose(np.asarray(out1[:, :5]), np.asarray(out2[:, :5]),
+                               rtol=1e-5)
+
+
+def test_attention_segment_mask():
+    # Tokens in segment 2 must not attend to segment 1.
+    q = jax.random.normal(jax.random.key(0), (1, 8, 2, 8))
+    k = jax.random.normal(jax.random.key(1), (1, 8, 2, 8))
+    v = jax.random.normal(jax.random.key(2), (1, 8, 2, 8))
+    seg = jnp.array([[1, 1, 1, 1, 2, 2, 2, 2]])
+    out = reference_attention(q, k, v, causal=True, segment_ids=seg)
+    # position 4 (first of segment 2) attends only to itself
+    k_only = k[:, 4:5]
+    v_only = v[:, 4:5]
+    solo = reference_attention(q[:, 4:5], k_only, v_only, causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, 4]), np.asarray(solo[:, 0]),
+                               rtol=1e-5)
+
+
+def test_soft_cap():
+    q = jax.random.normal(jax.random.key(0), (1, 4, 1, 8)) * 10
+    k = jax.random.normal(jax.random.key(1), (1, 4, 1, 8)) * 10
+    v = jax.random.normal(jax.random.key(2), (1, 4, 1, 8))
+    out = reference_attention(q, k, v, causal=True, logits_soft_cap=5.0)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+# --- model ---
+
+
+def test_llama_forward_shapes_and_finite():
+    cfg = llama.llama_tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    logits = llama.forward(cfg, params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_llama_param_axes_align():
+    cfg = llama.llama_tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    axes = llama.param_logical_axes(cfg)
+    flat_p = jax.tree.leaves_with_path(params)
+    axes_map = {jax.tree_util.keystr(kp): v
+                for kp, v in jax.tree.leaves_with_path(
+                    axes, is_leaf=lambda x: isinstance(x, tuple))}
+    for kp, leaf in flat_p:
+        key = jax.tree_util.keystr(kp)
+        assert key in axes_map, f"missing logical axes for {key}"
+        assert len(axes_map[key]) == leaf.ndim, (
+            f"{key}: {axes_map[key]} vs shape {leaf.shape}"
+        )
+
+
+def test_llama_causal_property():
+    cfg = llama.llama_tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    t1 = jax.random.randint(jax.random.key(1), (1, 12), 0, cfg.vocab_size)
+    t2 = t1.at[0, 8:].set(7)  # change the future
+    l1 = llama.forward(cfg, params, t1)
+    l2 = llama.forward(cfg, params, t2)
+    np.testing.assert_allclose(np.asarray(l1[0, :8]), np.asarray(l2[0, :8]),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_cross_entropy_and_training_step_reduces_loss():
+    import optax
+
+    cfg = llama.llama_tiny(vocab_size=64)
+    params = llama.init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 64)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+    opt = optax.adamw(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = llama.forward(cfg, p, inputs)
+            return llama.cross_entropy_loss(logits, targets)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_loss_mask():
+    logits = jnp.zeros((1, 4, 8))
+    targets = jnp.zeros((1, 4), dtype=jnp.int32)
+    mask = jnp.array([[1, 1, 0, 0]])
+    full = llama.cross_entropy_loss(logits, targets)
+    masked = llama.cross_entropy_loss(logits, targets, mask=mask)
+    assert full == pytest.approx(np.log(8), rel=1e-5)
+    assert masked == pytest.approx(np.log(8), rel=1e-5)
+
+
+def test_llama_sharded_forward_matches_unsharded(cpu_mesh_devices):
+    from ray_tpu.parallel.mesh import create_mesh
+    from ray_tpu.parallel.sharding import (
+        PRESETS, batch_sharding, shard_tree, tree_shardings,
+    )
+
+    cfg = llama.llama_tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, cfg.vocab_size)
+    want = np.asarray(llama.forward(cfg, params, tokens))
+
+    mesh = create_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    rules = PRESETS["fsdp_tp"]
+    axes = llama.param_logical_axes(cfg)
+    sharded_params = shard_tree(params, axes, mesh, rules)
+    sharded_tokens = jax.device_put(tokens, batch_sharding(mesh, rules))
+
+    @jax.jit
+    def fwd(p, t):
+        return llama.forward(cfg, p, t)
+
+    got = np.asarray(fwd(sharded_params, sharded_tokens))
+    # bf16 intermediates: sharded matmuls reduce in a different order, so
+    # allow small absolute noise and require near-perfect correlation.
+    np.testing.assert_allclose(got, want, rtol=0.1, atol=0.1)
+    corr = np.corrcoef(got.ravel(), want.ravel())[0, 1]
+    assert corr > 0.9999, corr
