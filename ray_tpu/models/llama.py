@@ -141,22 +141,41 @@ def num_params(params) -> int:
 # Forward
 # ---------------------------------------------------------------------------
 
-def _block(cfg: LlamaConfig, x, layer_params, sin, cos, segment_ids, attn_impl):
-    """One transformer block: pre-norm attention + SwiGLU MLP."""
+def attention_sublayer(cfg, x, p, sin, cos, segment_ids, attn_impl,
+                       mesh=None, sp_axis="sp"):
+    """Pre-norm attention sublayer (shared by Llama and Mixtral blocks).
+    Returns the residual-added stream."""
     b, s, d = x.shape
-    p = layer_params
-
     h = rms_norm(x, p["attn_norm"], eps=cfg.rms_eps)
     q = (h @ p["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
     k = (h @ p["wk"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     v = (h @ p["wv"]).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, sin, cos)
     k = apply_rope(k, sin, cos)
-    attn_out = attention(q, k, v, causal=True, segment_ids=segment_ids,
-                         impl=attn_impl)
-    attn_out = attn_out.reshape(b, s, cfg.n_heads * cfg.head_dim)
-    x = x + attn_out @ p["wo"]
+    if attn_impl == "ring":
+        from ray_tpu.parallel.ring_attention import ring_attention
 
+        if mesh is None:
+            raise ValueError(
+                "attn_impl='ring' requires mesh= (and an sp mesh axis)"
+            )
+        if segment_ids is not None:
+            raise ValueError("ring attention does not support segment_ids yet")
+        attn_out = ring_attention(q, k, v, mesh=mesh, axis=sp_axis,
+                                  causal=True)
+    else:
+        attn_out = attention(q, k, v, causal=True, segment_ids=segment_ids,
+                             impl=attn_impl)
+    attn_out = attn_out.reshape(b, s, cfg.n_heads * cfg.head_dim)
+    return x + attn_out @ p["wo"]
+
+
+def _block(cfg: LlamaConfig, x, layer_params, sin, cos, segment_ids,
+           attn_impl, mesh=None, sp_axis="sp"):
+    """One transformer block: pre-norm attention + SwiGLU MLP."""
+    p = layer_params
+    x = attention_sublayer(cfg, x, p, sin, cos, segment_ids, attn_impl,
+                           mesh=mesh, sp_axis=sp_axis)
     h = rms_norm(x, p["mlp_norm"], eps=cfg.rms_eps)
     gated = jax.nn.silu(h @ p["w_gate"]) * (h @ p["w_up"])
     x = x + gated @ p["w_down"]
@@ -171,6 +190,8 @@ def forward(
     positions=None,     # [batch, seq] int32 (defaults to arange)
     segment_ids=None,   # [batch, seq] for packed sequences
     attn_impl: str = "auto",
+    mesh=None,          # required for attn_impl="ring" (sequence parallel)
+    sp_axis: str = "sp",
 ):
     """Token ids -> logits [batch, seq, vocab] (fp32)."""
     b, s = tokens.shape
@@ -180,7 +201,7 @@ def forward(
     sin, cos = rope_sin_cos(positions, cfg.head_dim, theta=cfg.rope_theta)
 
     body = partial(_block, cfg, sin=sin, cos=cos, segment_ids=segment_ids,
-                   attn_impl=attn_impl)
+                   attn_impl=attn_impl, mesh=mesh, sp_axis=sp_axis)
     if cfg.remat == "full":
         body = jax.checkpoint(body)
     elif cfg.remat == "dots":

@@ -1,0 +1,169 @@
+"""Mixtral-family sparse MoE transformer (BASELINE.json config
+"Mixtral 8x7B MoE with expert-parallel placement").
+
+Same skeleton as the Llama family (stacked blocks + lax.scan, logical-axis
+annotations) with the dense SwiGLU MLP replaced by a top-2 MoE FFN
+(``ray_tpu.ops.moe``). Expert weights carry the "expert" logical axis →
+the ``moe`` sharding preset maps it to the ``ep`` mesh axis and XLA emits
+the token all-to-all.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.llama import attention_sublayer, cross_entropy_loss
+from ray_tpu.ops.moe import moe_ffn
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import rope_sin_cos
+
+
+@dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    head_dim: int = 128
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    rope_theta: float = 1000000.0
+    rms_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: str = "full"
+
+    @property
+    def param_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def mixtral_8x7b() -> MixtralConfig:
+    return MixtralConfig()
+
+
+def mixtral_tiny(vocab_size: int = 512) -> MixtralConfig:
+    return MixtralConfig(
+        vocab_size=vocab_size, d_model=128, n_layers=2, n_heads=4,
+        n_kv_heads=2, d_ff=256, head_dim=32, n_experts=4, top_k=2,
+        remat="none",
+    )
+
+
+def param_logical_axes(cfg: MixtralConfig) -> dict:
+    block = {
+        "attn_norm": (None, "embed"),
+        "wq": (None, "embed", "heads"),
+        "wk": (None, "embed", "kv_heads"),
+        "wv": (None, "embed", "kv_heads"),
+        "wo": (None, "heads", "embed"),
+        "mlp_norm": (None, "embed"),
+        "router": (None, "embed", None),          # router stays replicated
+        "wi_gate": (None, "expert", "embed", "mlp"),
+        "wi_up": (None, "expert", "embed", "mlp"),
+        "wo_e": (None, "expert", "mlp", "embed"),
+    }
+    return {
+        "embedding": ("vocab", "embed"),
+        "blocks": block,
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(cfg: MixtralConfig, key) -> dict:
+    dt = cfg.param_dtype
+    k_emb, k_blocks, k_head = jax.random.split(key, 3)
+    d, l, e = cfg.d_model, cfg.n_layers, cfg.n_experts
+    qdim = cfg.n_heads * cfg.head_dim
+    kvdim = cfg.n_kv_heads * cfg.head_dim
+
+    def dense(key, shape, fan_in, dtype=dt):
+        scale = fan_in ** -0.5
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    ks = jax.random.split(k_blocks, 8)
+    blocks = {
+        "attn_norm": jnp.ones((l, d), dtype=dt),
+        "wq": dense(ks[0], (l, d, qdim), d),
+        "wk": dense(ks[1], (l, d, kvdim), d),
+        "wv": dense(ks[2], (l, d, kvdim), d),
+        "wo": dense(ks[3], (l, qdim, d), qdim),
+        "mlp_norm": jnp.ones((l, d), dtype=dt),
+        "router": dense(ks[4], (l, d, e), d, dtype=jnp.float32),
+        "wi_gate": dense(ks[5], (l, e, d, cfg.d_ff), d),
+        "wi_up": dense(ks[6], (l, e, d, cfg.d_ff), d),
+        "wo_e": dense(ks[7], (l, e, cfg.d_ff, d), cfg.d_ff),
+    }
+    return {
+        "embedding": dense(k_emb, (cfg.vocab_size, d), d),
+        "blocks": blocks,
+        "final_norm": jnp.ones((d,), dtype=dt),
+        "lm_head": dense(k_head, (d, cfg.vocab_size), d),
+    }
+
+
+def num_params(params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))
+
+
+def _block(cfg: MixtralConfig, x, p, sin, cos, segment_ids, attn_impl):
+    b, s, d = x.shape
+    x = attention_sublayer(cfg, x, p, sin, cos, segment_ids, attn_impl)
+
+    h = rms_norm(x, p["mlp_norm"], eps=cfg.rms_eps)
+    flat = h.reshape(b * s, d)
+    moe_out, aux = moe_ffn(
+        flat, p["router"], p["wi_gate"], p["wi_up"], p["wo_e"],
+        top_k=cfg.top_k, capacity_factor=cfg.capacity_factor,
+    )
+    x = x + moe_out.reshape(b, s, d)
+    return x, aux
+
+
+def forward(
+    cfg: MixtralConfig,
+    params: dict,
+    tokens,
+    *,
+    segment_ids=None,
+    attn_impl: str = "auto",
+    return_aux_loss: bool = False,
+):
+    """Token ids -> logits [batch, seq, vocab] (fp32); optionally also the
+    summed router load-balancing loss."""
+    b, s = tokens.shape
+    x = params["embedding"][tokens]
+    positions = jnp.arange(s, dtype=jnp.int32)[None, :]
+    sin, cos = rope_sin_cos(positions, cfg.head_dim, theta=cfg.rope_theta)
+
+    body = partial(_block, cfg, sin=sin, cos=cos, segment_ids=segment_ids,
+                   attn_impl=attn_impl)
+    if cfg.remat == "full":
+        body = jax.checkpoint(body)
+
+    def scan_fn(x, layer_params):
+        x, aux = body(x, layer_params)
+        return x, aux
+
+    x, aux_losses = lax.scan(scan_fn, x, params["blocks"])
+
+    x = rms_norm(x, params["final_norm"], eps=cfg.rms_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    if return_aux_loss:
+        return logits, jnp.sum(aux_losses) * cfg.aux_loss_weight
+    return logits
+
+
+def loss_fn(cfg, params, tokens, targets, *, mask=None):
+    logits, aux = forward(cfg, params, tokens, return_aux_loss=True)
+    return cross_entropy_loss(logits, targets, mask=mask) + aux

@@ -1,0 +1,107 @@
+"""Mixture-of-Experts ops: top-k router + capacity-based expert dispatch.
+
+GShard/Switch-style MoE, the TPU-idiomatic formulation: dispatch and combine
+are einsums against one-hot capacity tensors (static shapes, MXU-friendly,
+no gathers), and expert parallelism is pure sharding — with the expert dim of
+``wi``/``wo`` sharded on the ``ep`` mesh axis, XLA's SPMD partitioner emits
+the token all-to-all automatically. (Reference has NO MoE implementation —
+SURVEY.md §2c row EP; Mixtral is a BASELINE.json target config.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def router_topk(
+    logits,             # [T, E] fp32
+    *,
+    top_k: int,
+    capacity: int,
+):
+    """Top-k gating with per-expert capacity (GShard algorithm).
+
+    Returns (dispatch [T, E, C] bool-ish float, combine [T, E, C] float,
+    aux_loss scalar). Tokens over capacity are dropped (their combine weight
+    is 0 — the residual stream carries them unchanged).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # top-k experts per token
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)     # [T, K]
+    # renormalize the chosen gates
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+
+    # position of each token within its expert's queue, per choice slot
+    dispatch = jnp.zeros((t, e, capacity), dtype=jnp.float32)
+    combine = jnp.zeros((t, e, capacity), dtype=jnp.float32)
+    # running per-expert counts; iterate over the k slots (k is tiny/static)
+    counts = jnp.zeros((e,), dtype=jnp.int32)
+    for slot in range(top_k):
+        idx = gate_idx[:, slot]                            # [T]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)   # [T, E]
+        # position within expert queue = tokens for same expert before me
+        pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot  # [T, E]
+        pos = jnp.sum(pos_in_expert * onehot, axis=1) + counts[idx]  # [T]
+        keep = pos < capacity
+        pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T, C]
+        contrib = (
+            onehot.astype(jnp.float32)[:, :, None]
+            * pos_oh[:, None, :]
+            * keep.astype(jnp.float32)[:, None, None]
+        )
+        dispatch = dispatch + contrib
+        combine = combine + contrib * gate_vals[:, slot][:, None, None]
+        counts = counts + jnp.sum(onehot, axis=0)
+
+    # load-balancing auxiliary loss (Switch Transformer): E * sum(f_i * p_i)
+    me = jnp.mean(probs, axis=0)                            # mean router prob
+    ce = jnp.mean(
+        jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32), axis=0
+    )                                                       # fraction routed
+    aux_loss = e * jnp.sum(me * ce)
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(
+    x,                  # [T, D] tokens (flattened batch*seq)
+    router_w,           # [D, E]
+    wi_gate,            # [E, D, F]
+    wi_up,              # [E, D, F]
+    wo,                 # [E, F, D]
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+):
+    """SwiGLU expert FFN with top-k routing. Returns (out [T, D], aux_loss).
+
+    All expert compute is einsum over the expert dim; shard wi/wo on
+    ``ep`` to get expert parallelism (all-to-all inserted by XLA).
+    """
+    t, d = x.shape
+    e = router_w.shape[1]
+    capacity = max(1, int(capacity_factor * t * top_k / e))
+
+    logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))
+    dispatch, combine, aux = router_topk(
+        logits, top_k=top_k, capacity=capacity
+    )
+
+    dtype = x.dtype
+    expert_in = jnp.einsum("td,tec->ecd", x, dispatch.astype(dtype),
+                           preferred_element_type=jnp.float32).astype(dtype)
+    h = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", expert_in, wi_gate,
+                   preferred_element_type=jnp.float32)
+    ) * jnp.einsum("ecd,edf->ecf", expert_in, wi_up,
+                   preferred_element_type=jnp.float32)
+    h = h.astype(dtype)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, wo,
+                            preferred_element_type=jnp.float32).astype(dtype)
+    out = jnp.einsum("ecd,tec->td", expert_out, combine.astype(dtype),
+                     preferred_element_type=jnp.float32)
+    return out.astype(dtype), aux

@@ -1,0 +1,161 @@
+"""Pipeline parallelism: stage-sharded layers + microbatch rotation.
+
+Net-new capability vs. the reference (SURVEY.md §2c: pipeline parallel is
+ABSENT there). TPU-idiomatic GPipe: the stacked layer arrays are split into
+``n_stages`` contiguous groups sharded over the ``pp`` mesh axis; microbatches
+flow through the stage ring via ``lax.ppermute``. Each tick every stage runs
+its layer group on its current microbatch while the permute moves activations
+to the next stage — compute and ICI transfer overlap, and the whole schedule
+is one jit-compiled ``lax.scan`` (bubble fraction (S-1)/(M+S-1), the GPipe
+formula).
+
+The backward pass is jax.grad through the scan: XLA reverses the schedule
+automatically (reverse pipeline with the same overlap). 1F1B memory
+scheduling is a planned refinement; GPipe semantics are exact.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def split_stages(stacked_params, n_stages: int):
+    """Reshape stacked per-layer params [L, ...] -> [n_stages, L/ns, ...]."""
+
+    def reshape(a):
+        l = a.shape[0]
+        if l % n_stages:
+            raise ValueError(
+                f"{l} layers not divisible by {n_stages} pipeline stages"
+            )
+        return a.reshape(n_stages, l // n_stages, *a.shape[1:])
+
+    return jax.tree.map(reshape, stacked_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable,   # (stage_params, x) -> x, applied by every stage
+    stage_params,         # pytree, leaves [n_stages, L/ns, ...]
+    x_micro,              # [M, mb, ...] microbatched activations
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+):
+    """Run the GPipe schedule. Returns [M, mb, ...] outputs (replicated over
+    the pp axis)."""
+    n_stages = mesh.shape[axis]
+    m = x_micro.shape[0]
+    if m < n_stages:
+        raise ValueError(
+            f"need at least {n_stages} microbatches to fill the pipeline, "
+            f"got {m}"
+        )
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def device_body(stage_params_local, xm):
+        sid = lax.axis_index(axis)
+        # drop the sharded leading stage dim (local size 1)
+        sp = jax.tree.map(lambda a: a[0], stage_params_local)
+        buf = jnp.zeros_like(xm[0])
+        outs = jnp.zeros_like(xm)
+
+        def tick(carry, t):
+            buf, outs = carry
+            # stage 0 ingests microbatch t (clamped once the input is drained)
+            feed = xm[jnp.minimum(t, m - 1)]
+            inp = jnp.where(sid == 0, feed, buf)
+            y = stage_fn(sp, inp)
+            # last stage emits microbatch t-(n_stages-1)
+            out_t = t - (n_stages - 1)
+            write = jnp.logical_and(sid == n_stages - 1, out_t >= 0)
+            updated = lax.dynamic_update_index_in_dim(
+                outs, y.astype(outs.dtype), jnp.maximum(out_t, 0), 0
+            )
+            outs = jnp.where(write, updated, outs)
+            buf = lax.ppermute(y, axis, perm)
+            return (buf, outs), None
+
+        (buf, outs), _ = lax.scan(
+            tick, (buf, outs), jnp.arange(m + n_stages - 1)
+        )
+        # broadcast the last stage's outputs to every pp rank
+        mask = (sid == n_stages - 1).astype(outs.dtype)
+        return lax.psum(outs * mask, axis)
+
+    fn = jax.shard_map(
+        device_body, mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(stage_params, x_micro)
+
+
+# ---------------------------------------------------------------------------
+# Llama integration
+# ---------------------------------------------------------------------------
+
+
+def llama_forward_pipelined(
+    cfg,
+    params: dict,
+    tokens,                    # [batch, seq]
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    n_microbatches: int | None = None,
+    attn_impl: str = "reference",
+):
+    """Llama forward with the layer stack pipelined over ``axis``.
+
+    Embedding and the LM head run outside the pipelined region under plain
+    GSPMD (they live on every stage; their cost is O(vocab) once, not per
+    layer). Default positions only (no packing/segment support in v1).
+    """
+    from ray_tpu.models.llama import _block
+    from ray_tpu.ops.norms import rms_norm
+    from ray_tpu.ops.rope import rope_sin_cos
+
+    n_stages = mesh.shape[axis]
+    m = n_microbatches or n_stages
+    b, s = tokens.shape
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+
+    x = params["embedding"][tokens]  # [b, s, d]
+    positions = jnp.arange(s, dtype=jnp.int32)[None, :]
+    sin, cos = rope_sin_cos(positions, cfg.head_dim, theta=cfg.rope_theta)
+
+    def stage_fn(stage_blocks, xm):
+        body = partial(_block, cfg, sin=sin, cos=cos, segment_ids=None,
+                       attn_impl=attn_impl)
+        if cfg.remat == "full":
+            body = jax.checkpoint(body)
+        elif cfg.remat == "dots":
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            )
+
+        def scan_fn(x, layer_params):
+            return body(x, layer_params), None
+
+        out, _ = lax.scan(scan_fn, xm, stage_blocks)
+        return out
+
+    stage_params = split_stages(params["blocks"], n_stages)
+    x_micro = x.reshape(m, b // m, s, x.shape[-1])
+    out = pipeline_apply(stage_fn, stage_params, x_micro, mesh=mesh,
+                         axis=axis)
+    x = out.reshape(b, s, x.shape[-1])
+
+    x = rms_norm(x, params["final_norm"], eps=cfg.rms_eps)
+    head = params["embedding"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.einsum("bsd,dv->bsv", x, head,
+                      preferred_element_type=jnp.float32)

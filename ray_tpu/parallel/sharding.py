@@ -168,8 +168,14 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, rules: ShardingRules, ndim: int = 2) -> NamedSharding:
+def batch_sharding(mesh: Mesh, rules: ShardingRules, ndim: int = 2,
+                   *, shard_seq: bool = True) -> NamedSharding:
     """Sharding for an input batch [batch, seq, ...]: batch axis split per
-    rules, sequence split if sp is active, rest replicated."""
-    logical = ("batch", "seq") + (None,) * (ndim - 2)
+    rules, sequence split if sp is active, rest replicated.
+
+    ``shard_seq=False`` keeps the seq dim replicated — used for raw token
+    batches of length S+1 (the shifted-target column makes S+1 typically
+    indivisible by sp; ring attention's shard_map introduces the seq
+    sharding inside the step instead)."""
+    logical = ("batch", "seq" if shard_seq else None) + (None,) * (ndim - 2)
     return logical_sharding(logical, mesh, rules)

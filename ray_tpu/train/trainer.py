@@ -73,6 +73,14 @@ class JaxTrainer:
         )
         self.optimizer = self._make_optimizer()
         self._jit_step = None
+        # Sequence parallelism: use ring attention when the rules shard seq
+        # over a mesh axis that actually exists on this mesh.
+        sp = self.rules.seq
+        self.attn_impl = (
+            "ring" if sp is not None and sp in self.mesh.axis_names
+            and self.mesh.shape[sp] > 1 else "auto"
+        )
+        self.sp_axis = sp if self.attn_impl == "ring" else "sp"
 
     # --- optimizer (AdamW + cosine schedule + clip, the Llama recipe) ---
 
@@ -134,7 +142,9 @@ class JaxTrainer:
         targets = batch[:, 1:]
         mask = (targets != -1).astype(jnp.float32)
         logits = llama.forward(self.model_cfg, params, inputs,
-                               segment_ids=segment_ids)
+                               segment_ids=segment_ids,
+                               attn_impl=self.attn_impl,
+                               mesh=self.mesh, sp_axis=self.sp_axis)
         loss = llama.cross_entropy_loss(
             logits, jnp.maximum(targets, 0), mask=mask
         )
@@ -155,7 +165,7 @@ class JaxTrainer:
 
     def compile_step(self, state: TrainState):
         if self._jit_step is None:
-            batch_s = batch_sharding(self.mesh, self.rules, ndim=2)
+            batch_s = batch_sharding(self.mesh, self.rules, shard_seq=False)
             donate = (0,) if self.cfg.donate_state else ()
             self._jit_step = jax.jit(
                 self._step,
@@ -169,7 +179,7 @@ class JaxTrainer:
         (last column is the shifted target; -1 = padding)."""
         step_fn = self.compile_step(state)
         batch = jax.device_put(
-            batch, batch_sharding(self.mesh, self.rules, ndim=2)
+            batch, batch_sharding(self.mesh, self.rules, shard_seq=False)
         )
         return step_fn(state, batch)
 
