@@ -1,0 +1,220 @@
+"""ctypes bindings for the C++ shared-memory object store.
+
+Reference analog: the plasma client (``src/ray/object_manager/plasma/
+client.cc``) — create/seal/get/release/delete with zero-copy reads. Unlike
+the reference there is no store daemon: all processes attach the same shm
+segment and the C++ library coordinates through a robust process-shared
+mutex inside it (see ``src/store/shm_store.cc``).
+
+Zero-copy: ``get`` returns a read-only ``memoryview`` directly over the
+mapped segment; ``create`` returns a writable one. Buffers must be released
+(``release``) when consumers are done so eviction can reclaim space.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libtpustore.so")
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "src")
+
+TS_OK = 0
+TS_ERR = -1
+TS_EXISTS = -2
+TS_NOT_FOUND = -3
+TS_OOM = -4
+TS_TABLE_FULL = -5
+TS_NOT_SEALED = -6
+TS_TIMEOUT = -7
+
+_build_lock = threading.Lock()
+
+
+def _ensure_built() -> str:
+    if os.path.exists(_LIB_PATH):
+        return _LIB_PATH
+    with _build_lock:
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_SRC)],
+                check=True,
+                capture_output=True,
+            )
+    return _LIB_PATH
+
+
+def _load():
+    lib = ctypes.CDLL(_ensure_built())
+    u64 = ctypes.c_uint64
+    p = ctypes.c_void_p
+    lib.store_create.restype = p
+    lib.store_create.argtypes = [ctypes.c_char_p, u64, u64]
+    lib.store_attach.restype = p
+    lib.store_attach.argtypes = [ctypes.c_char_p]
+    lib.store_close.argtypes = [p]
+    lib.store_base.restype = ctypes.c_void_p
+    lib.store_base.argtypes = [p]
+    lib.store_capacity.restype = u64
+    lib.store_capacity.argtypes = [p]
+    lib.store_create_object.restype = ctypes.c_int
+    lib.store_create_object.argtypes = [p, ctypes.c_char_p, u64, u64,
+                                        ctypes.POINTER(u64)]
+    lib.store_seal.restype = ctypes.c_int
+    lib.store_seal.argtypes = [p, ctypes.c_char_p]
+    lib.store_get.restype = ctypes.c_int
+    lib.store_get.argtypes = [p, ctypes.c_char_p, ctypes.c_int64,
+                              ctypes.POINTER(u64), ctypes.POINTER(u64),
+                              ctypes.POINTER(u64)]
+    lib.store_release.restype = ctypes.c_int
+    lib.store_release.argtypes = [p, ctypes.c_char_p]
+    lib.store_delete.restype = ctypes.c_int
+    lib.store_delete.argtypes = [p, ctypes.c_char_p]
+    lib.store_contains.restype = ctypes.c_int
+    lib.store_contains.argtypes = [p, ctypes.c_char_p]
+    lib.store_evict_orphans.restype = ctypes.c_int
+    lib.store_evict_orphans.argtypes = [p]
+    lib.store_stats.argtypes = [p, ctypes.POINTER(u64 * 6)]
+    return lib
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def get_lib():
+    global _lib
+    if _lib is None:
+        with _lib_lock:
+            if _lib is None:
+                _lib = _load()
+    return _lib
+
+
+class ShmStoreError(Exception):
+    pass
+
+
+class ObjectExistsError(ShmStoreError):
+    pass
+
+
+class ObjectNotFoundError(ShmStoreError):
+    pass
+
+
+class StoreFullError(ShmStoreError):
+    pass
+
+
+def _check(rc: int, what: str):
+    if rc == TS_OK:
+        return
+    if rc == TS_EXISTS:
+        raise ObjectExistsError(what)
+    if rc in (TS_NOT_FOUND, TS_TIMEOUT):
+        raise ObjectNotFoundError(what)
+    if rc in (TS_OOM, TS_TABLE_FULL):
+        raise StoreFullError(what)
+    raise ShmStoreError(f"{what}: rc={rc}")
+
+
+class ShmObjectStore:
+    """One node's shared-memory object store (owner or attached client)."""
+
+    def __init__(self, name: str, capacity: int = 0, create: bool = False,
+                 table_cap: int = 0):
+        lib = get_lib()
+        self._lib = lib
+        self.name = name
+        if create:
+            self._h = lib.store_create(name.encode(), capacity, table_cap)
+        else:
+            self._h = lib.store_attach(name.encode())
+        if not self._h:
+            raise ShmStoreError(
+                f"failed to {'create' if create else 'attach'} store {name!r}"
+            )
+        self._base = lib.store_base(self._h)
+        self.capacity = lib.store_capacity(self._h)
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._lib.store_close(self._h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- object ops --------------------------------------------------------
+    def _view(self, offset: int, size: int, readonly: bool) -> memoryview:
+        buf = (ctypes.c_ubyte * size).from_address(self._base + offset)
+        # The view must keep the segment mapped: anchor the store on the
+        # ctypes array so memoryview -> array -> store prevents GC-driven
+        # munmap while any view is alive (explicit close() is still the
+        # caller's contract, as with plasma buffers).
+        buf._store = self
+        mv = memoryview(buf).cast("B")
+        return mv.toreadonly() if readonly else mv
+
+    def create(self, object_id: bytes, data_size: int,
+               meta_size: int = 0) -> memoryview:
+        """Allocate; returns a writable view of data+meta. Call seal() next."""
+        off = ctypes.c_uint64()
+        rc = self._lib.store_create_object(
+            self._h, object_id, data_size, meta_size, ctypes.byref(off))
+        _check(rc, f"create {object_id.hex()}")
+        return self._view(off.value, data_size + meta_size, readonly=False)
+
+    def put(self, object_id: bytes, data: bytes | memoryview) -> None:
+        """create + copy + seal convenience."""
+        data = memoryview(data)
+        buf = self.create(object_id, data.nbytes)
+        buf[:] = data
+        self.seal(object_id)
+
+    def seal(self, object_id: bytes) -> None:
+        _check(self._lib.store_seal(self._h, object_id),
+               f"seal {object_id.hex()}")
+
+    def get(self, object_id: bytes, timeout_ms: int = -1) -> memoryview:
+        """Read-only zero-copy view of the data section (bumps refcount)."""
+        off = ctypes.c_uint64()
+        dsz = ctypes.c_uint64()
+        msz = ctypes.c_uint64()
+        rc = self._lib.store_get(self._h, object_id, timeout_ms,
+                                 ctypes.byref(off), ctypes.byref(dsz),
+                                 ctypes.byref(msz))
+        _check(rc, f"get {object_id.hex()}")
+        return self._view(off.value, dsz.value, readonly=True)
+
+    def release(self, object_id: bytes) -> None:
+        self._lib.store_release(self._h, object_id)
+
+    def delete(self, object_id: bytes) -> bool:
+        return self._lib.store_delete(self._h, object_id) == TS_OK
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(self._lib.store_contains(self._h, object_id))
+
+    def evict_orphans(self) -> int:
+        return self._lib.store_evict_orphans(self._h)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 6)()
+        self._lib.store_stats(self._h, ctypes.byref(out))
+        return {
+            "capacity": out[0],
+            "bytes_allocated": out[1],
+            "num_objects": out[2],
+            "num_evictions": out[3],
+            "bytes_evicted": out[4],
+            "lru_clock": out[5],
+        }

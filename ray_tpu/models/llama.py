@@ -99,6 +99,13 @@ def param_logical_axes(cfg: LlamaConfig) -> dict:
     return axes
 
 
+def fanin_init(key, shape, fan_in):
+    """Fan-in-scaled normal init in fp32 (cast to param dtype at call sites).
+    Shared by all model families."""
+    scale = fan_in ** -0.5
+    return jax.random.normal(key, shape, dtype=jnp.float32) * scale
+
+
 def init_params(cfg: LlamaConfig, key) -> dict:
     """Initialize the parameter pytree (stacked-block layout)."""
     dt = cfg.param_dtype
@@ -108,8 +115,7 @@ def init_params(cfg: LlamaConfig, key) -> dict:
     kvdim = cfg.n_kv_heads * cfg.head_dim
 
     def dense_init(key, shape, fan_in):
-        scale = fan_in ** -0.5
-        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dt)
+        return fanin_init(key, shape, fan_in).astype(dt)
 
     ks = jax.random.split(k_blocks, 7)
     blocks = {

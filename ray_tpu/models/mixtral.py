@@ -17,7 +17,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.models.llama import attention_sublayer, cross_entropy_loss
+from ray_tpu.models.llama import (
+    attention_sublayer,
+    cross_entropy_loss,
+    fanin_init as _dense_init,
+    num_params,  # noqa: F401 - re-exported for API parity with llama
+)
 from ray_tpu.ops.moe import moe_ffn
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.rope import rope_sin_cos
@@ -87,8 +92,7 @@ def init_params(cfg: MixtralConfig, key) -> dict:
     kvdim = cfg.n_kv_heads * cfg.head_dim
 
     def dense(key, shape, fan_in, dtype=dt):
-        scale = fan_in ** -0.5
-        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+        return _dense_init(key, shape, fan_in).astype(dtype)
 
     ks = jax.random.split(k_blocks, 8)
     blocks = {
@@ -109,10 +113,6 @@ def init_params(cfg: MixtralConfig, key) -> dict:
         "final_norm": jnp.ones((d,), dtype=dt),
         "lm_head": dense(k_head, (d, cfg.vocab_size), d),
     }
-
-
-def num_params(params) -> int:
-    return sum(p.size for p in jax.tree.leaves(params))
 
 
 def _block(cfg: MixtralConfig, x, p, sin, cos, segment_ids, attn_impl):
@@ -149,6 +149,12 @@ def forward(
                    attn_impl=attn_impl)
     if cfg.remat == "full":
         body = jax.checkpoint(body)
+    elif cfg.remat == "dots":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        )
+    elif cfg.remat != "none":
+        raise ValueError(f"unknown remat policy {cfg.remat!r}")
 
     def scan_fn(x, layer_params):
         x, aux = body(x, layer_params)

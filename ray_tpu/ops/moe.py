@@ -58,11 +58,15 @@ def router_topk(
         combine = combine + contrib * gate_vals[:, slot][:, None, None]
         counts = counts + jnp.sum(onehot, axis=0)
 
-    # load-balancing auxiliary loss (Switch Transformer): E * sum(f_i * p_i)
+    # Load-balancing auxiliary loss (GShard/Mixtral): E * sum(f_i * p_i)
+    # where f_i counts ALL top-k assignments, not just slot 0 — an expert
+    # that is systematically every token's second choice must still feel
+    # gradient pressure.
     me = jnp.mean(probs, axis=0)                            # mean router prob
     ce = jnp.mean(
-        jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32), axis=0
-    )                                                       # fraction routed
+        jnp.sum(jax.nn.one_hot(gate_idx, e, dtype=jnp.float32), axis=1),
+        axis=0,
+    ) / top_k                                               # fraction routed
     aux_loss = e * jnp.sum(me * ce)
     return dispatch, combine, aux_loss
 

@@ -110,7 +110,7 @@ def llama_forward_pipelined(
     mesh: Mesh,
     axis: str = "pp",
     n_microbatches: int | None = None,
-    attn_impl: str = "reference",
+    attn_impl: str = "auto",
 ):
     """Llama forward with the layer stack pipelined over ``axis``.
 

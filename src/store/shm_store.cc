@@ -1,0 +1,562 @@
+// shm_store: TPU-host shared-memory object store (reference analog:
+// src/ray/object_manager/plasma/ — PlasmaStore store.h:55,
+// ObjectLifecycleManager object_lifecycle_manager.h:101, LRU eviction
+// eviction_policy.h:105,160, dlmalloc arena dlmalloc.cc).
+//
+// Design departure from the reference: instead of a store daemon serving a
+// UDS protocol, ALL control state (object table, free list, lock, condvar)
+// lives inside the shared segment itself, guarded by a robust process-shared
+// mutex. Every process (node manager, workers) attaches the segment and
+// operates on it directly — zero IPC round-trips on the create/seal/get hot
+// path, which matters on TPU hosts where the store feeds host→HBM transfers.
+//
+// Layout:
+//   [Header][ObjectEntry x table_cap][data arena ............]
+// Free blocks form an offset-sorted singly linked list (offsets relative to
+// arena start) enabling O(n) first-fit alloc with coalescing on free.
+// Objects are created (writable), sealed (immutable, readers may map), and
+// evicted LRU-wise among sealed refcount==0 entries when allocation fails.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x7470755f73746f72ULL;  // "tpu_stor"
+constexpr uint32_t kIdLen = 20;
+constexpr uint64_t kMinBlock = 64;
+constexpr uint64_t kAlign = 64;  // cache-line align objects
+
+enum EntryState : uint32_t {
+  kEmpty = 0,
+  kCreated = 1,   // allocated, writer filling
+  kSealed = 2,    // immutable, readable
+  kTombstone = 3, // deleted slot (probe chains continue through it)
+};
+
+struct ObjectEntry {
+  uint32_t state;
+  uint32_t _pad;
+  uint64_t refcount;
+  uint64_t offset;     // relative to arena start
+  uint64_t data_size;
+  uint64_t meta_size;  // metadata bytes appended after data
+  uint64_t lru_tick;
+  uint8_t id[kIdLen];
+  uint8_t _pad2[4];
+};
+
+struct FreeBlock {
+  uint64_t size;
+  uint64_t next;  // offset of next free block, ~0ull = none
+};
+constexpr uint64_t kNone = ~0ull;
+
+struct Header {
+  uint64_t magic;
+  uint64_t segment_size;
+  uint64_t arena_offset;   // from segment base
+  uint64_t arena_size;
+  uint64_t table_cap;
+  uint64_t free_head;      // offset into arena, kNone if empty
+  uint64_t lru_clock;
+  // stats
+  uint64_t bytes_allocated;
+  uint64_t num_objects;
+  uint64_t num_evictions;
+  uint64_t bytes_evicted;
+  pthread_mutex_t mu;
+  pthread_cond_t cv;       // signalled on seal/delete (waiters: Get blocking)
+};
+
+struct Store {
+  int fd;
+  uint8_t* base;
+  uint64_t size;
+  Header* hdr;
+  ObjectEntry* table;
+  uint8_t* arena;
+  char name[256];
+  bool owner;
+};
+
+uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+uint64_t id_hash(const uint8_t* id) {
+  // FNV-1a over the 20-byte id.
+  uint64_t h = 14695981039346656037ULL;
+  for (uint32_t i = 0; i < kIdLen; i++) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+void lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {
+    // A process died holding the lock. State may be mid-mutation; we accept
+    // the (already-sealed-consistent) table and continue — created-but-
+    // unsealed entries of the dead process are garbage-collected by
+    // store_evict_orphans from the node manager.
+    pthread_mutex_consistent(&h->mu);
+  }
+}
+void unlock(Header* h) { pthread_mutex_unlock(&h->mu); }
+
+// Find entry slot; returns index or table_cap if absent.
+uint64_t find(Store* s, const uint8_t* id) {
+  Header* h = s->hdr;
+  uint64_t cap = h->table_cap;
+  uint64_t i = id_hash(id) % cap;
+  for (uint64_t probes = 0; probes < cap; probes++, i = (i + 1) % cap) {
+    ObjectEntry& e = s->table[i];
+    if (e.state == kEmpty) return cap;
+    if (e.state != kTombstone && memcmp(e.id, id, kIdLen) == 0) return i;
+  }
+  return cap;
+}
+
+// Find slot for insert (first empty/tombstone), or table_cap if full.
+uint64_t find_insert(Store* s, const uint8_t* id) {
+  Header* h = s->hdr;
+  uint64_t cap = h->table_cap;
+  uint64_t i = id_hash(id) % cap;
+  uint64_t first_tomb = cap;
+  for (uint64_t probes = 0; probes < cap; probes++, i = (i + 1) % cap) {
+    ObjectEntry& e = s->table[i];
+    if (e.state == kEmpty)
+      return first_tomb != cap ? first_tomb : i;
+    if (e.state == kTombstone) {
+      if (first_tomb == cap) first_tomb = i;
+    } else if (memcmp(e.id, id, kIdLen) == 0) {
+      return cap;  // already exists
+    }
+  }
+  return first_tomb;
+}
+
+FreeBlock* fb(Store* s, uint64_t off) {
+  return reinterpret_cast<FreeBlock*>(s->arena + off);
+}
+
+// Insert block into offset-sorted free list, coalescing neighbours.
+void free_insert(Store* s, uint64_t off, uint64_t size) {
+  Header* h = s->hdr;
+  uint64_t prev = kNone, cur = h->free_head;
+  while (cur != kNone && cur < off) {
+    prev = cur;
+    cur = fb(s, cur)->next;
+  }
+  // coalesce with next
+  if (cur != kNone && off + size == cur) {
+    size += fb(s, cur)->size;
+    cur = fb(s, cur)->next;
+  }
+  // coalesce with prev
+  if (prev != kNone && prev + fb(s, prev)->size == off) {
+    fb(s, prev)->size += size;
+    fb(s, prev)->next = cur;
+    return;
+  }
+  FreeBlock* nb = fb(s, off);
+  nb->size = size;
+  nb->next = cur;
+  if (prev == kNone)
+    h->free_head = off;
+  else
+    fb(s, prev)->next = off;
+}
+
+// First-fit allocation; returns offset or kNone.
+uint64_t arena_alloc(Store* s, uint64_t size) {
+  Header* h = s->hdr;
+  size = align_up(size < kMinBlock ? kMinBlock : size, kAlign);
+  uint64_t prev = kNone, cur = h->free_head;
+  while (cur != kNone) {
+    FreeBlock* b = fb(s, cur);
+    if (b->size >= size) {
+      uint64_t remain = b->size - size;
+      uint64_t next = b->next;
+      if (remain >= kMinBlock) {
+        uint64_t split = cur + size;
+        FreeBlock* sb = fb(s, split);
+        sb->size = remain;
+        sb->next = next;
+        next = split;
+      } else {
+        size = b->size;  // absorb the tail fragment
+      }
+      if (prev == kNone)
+        h->free_head = next;
+      else
+        fb(s, prev)->next = next;
+      h->bytes_allocated += size;
+      return cur;
+    }
+    prev = cur;
+    cur = b->next;
+  }
+  return kNone;
+}
+
+void entry_free(Store* s, ObjectEntry& e) {
+  uint64_t total = align_up(
+      (e.data_size + e.meta_size) < kMinBlock ? kMinBlock
+                                              : (e.data_size + e.meta_size),
+      kAlign);
+  free_insert(s, e.offset, total);
+  s->hdr->bytes_allocated -= total;
+  e.state = kTombstone;
+  s->hdr->num_objects--;
+}
+
+// Evict LRU sealed refcount-0 objects until `needed` bytes can be allocated.
+// Caller holds the lock. Returns true if an eviction happened.
+bool evict_for(Store* s, uint64_t needed) {
+  Header* h = s->hdr;
+  bool any = false;
+  for (;;) {
+    // try alloc
+    uint64_t off = arena_alloc(s, needed);
+    if (off != kNone) {
+      // put it back; caller will re-alloc (simpler than returning here)
+      uint64_t size =
+          align_up(needed < kMinBlock ? kMinBlock : needed, kAlign);
+      free_insert(s, off, size);
+      h->bytes_allocated -= size;
+      return true;
+    }
+    // find LRU victim
+    uint64_t victim = h->table_cap;
+    uint64_t best = ~0ull;
+    for (uint64_t i = 0; i < h->table_cap; i++) {
+      ObjectEntry& e = s->table[i];
+      if (e.state == kSealed && e.refcount == 0 && e.lru_tick < best) {
+        best = e.lru_tick;
+        victim = i;
+      }
+    }
+    if (victim == h->table_cap) return any;  // nothing evictable
+    h->num_evictions++;
+    h->bytes_evicted += s->table[victim].data_size + s->table[victim].meta_size;
+    entry_free(s, s->table[victim]);
+    any = true;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Status codes (keep in sync with ray_tpu/_private/shm_store.py).
+enum {
+  TS_OK = 0,
+  TS_ERR = -1,
+  TS_EXISTS = -2,
+  TS_NOT_FOUND = -3,
+  TS_OOM = -4,
+  TS_TABLE_FULL = -5,
+  TS_NOT_SEALED = -6,
+  TS_TIMEOUT = -7,
+};
+
+void* store_create(const char* name, uint64_t capacity, uint64_t table_cap) {
+  if (table_cap == 0) table_cap = 1 << 16;
+  shm_unlink(name);  // fresh segment
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t table_bytes = table_cap * sizeof(ObjectEntry);
+  uint64_t arena_off = align_up(sizeof(Header) + table_bytes, kAlign);
+  uint64_t total = arena_off + capacity;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  uint8_t* base = (uint8_t*)mmap(nullptr, total, PROT_READ | PROT_WRITE,
+                                 MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  Header* h = (Header*)base;
+  memset(h, 0, sizeof(Header));
+  h->segment_size = total;
+  h->arena_offset = arena_off;
+  h->arena_size = capacity;
+  h->table_cap = table_cap;
+  memset(base + sizeof(Header), 0, table_bytes);
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+  pthread_cond_init(&h->cv, &ca);
+
+  Store* s = new Store();
+  s->fd = fd;
+  s->base = base;
+  s->size = total;
+  s->hdr = h;
+  s->table = (ObjectEntry*)(base + sizeof(Header));
+  s->arena = base + arena_off;
+  snprintf(s->name, sizeof(s->name), "%s", name);
+  s->owner = true;
+  // one big free block
+  h->free_head = 0;
+  FreeBlock* b = fb(s, 0);
+  b->size = capacity;
+  b->next = kNone;
+  h->magic = kMagic;  // publish last
+  return s;
+}
+
+void* store_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  uint8_t* base = (uint8_t*)mmap(nullptr, (size_t)st.st_size,
+                                 PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* h = (Header*)base;
+  if (h->magic != kMagic) {
+    munmap(base, (size_t)st.st_size);
+    close(fd);
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->fd = fd;
+  s->base = base;
+  s->size = (uint64_t)st.st_size;
+  s->hdr = h;
+  s->table = (ObjectEntry*)(base + sizeof(Header));
+  s->arena = base + h->arena_offset;
+  snprintf(s->name, sizeof(s->name), "%s", name);
+  s->owner = false;
+  return s;
+}
+
+void store_close(void* sp) {
+  Store* s = (Store*)sp;
+  munmap(s->base, s->size);
+  close(s->fd);
+  if (s->owner) shm_unlink(s->name);
+  delete s;
+}
+
+uint8_t* store_base(void* sp) { return ((Store*)sp)->arena; }
+uint64_t store_capacity(void* sp) { return ((Store*)sp)->hdr->arena_size; }
+
+// Allocate an object; on success writes offset (relative to arena base).
+int store_create_object(void* sp, const uint8_t* id, uint64_t data_size,
+                        uint64_t meta_size, uint64_t* offset_out) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  uint64_t total = data_size + meta_size;
+  if (total > h->arena_size) return TS_OOM;
+  lock(h);
+  if (find(s, id) != h->table_cap) {
+    unlock(h);
+    return TS_EXISTS;
+  }
+  uint64_t slot = find_insert(s, id);
+  if (slot == h->table_cap) {
+    unlock(h);
+    return TS_TABLE_FULL;
+  }
+  uint64_t off = arena_alloc(s, total);
+  if (off == kNone) {
+    if (!evict_for(s, total)) {
+      unlock(h);
+      return TS_OOM;
+    }
+    off = arena_alloc(s, total);
+    if (off == kNone) {
+      unlock(h);
+      return TS_OOM;
+    }
+    // eviction may have tombstoned earlier probes; re-find slot
+    slot = find_insert(s, id);
+    if (slot == h->table_cap) {
+      unlock(h);
+      return TS_TABLE_FULL;
+    }
+  }
+  ObjectEntry& e = s->table[slot];
+  memcpy(e.id, id, kIdLen);
+  e.state = kCreated;
+  e.refcount = 1;  // writer holds a ref until seal+release
+  e.offset = off;
+  e.data_size = data_size;
+  e.meta_size = meta_size;
+  e.lru_tick = ++h->lru_clock;
+  h->num_objects++;
+  unlock(h);
+  *offset_out = off;
+  return TS_OK;
+}
+
+int store_seal(void* sp, const uint8_t* id) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  lock(h);
+  uint64_t i = find(s, id);
+  if (i == h->table_cap) {
+    unlock(h);
+    return TS_NOT_FOUND;
+  }
+  ObjectEntry& e = s->table[i];
+  if (e.state != kCreated) {
+    unlock(h);
+    return TS_ERR;
+  }
+  e.state = kSealed;
+  if (e.refcount > 0) e.refcount--;  // drop writer ref
+  pthread_cond_broadcast(&h->cv);
+  unlock(h);
+  return TS_OK;
+}
+
+// Get a sealed object: bumps refcount, returns offset/sizes.
+// timeout_ms < 0: non-blocking. timeout_ms >= 0 waits for seal.
+int store_get(void* sp, const uint8_t* id, int64_t timeout_ms,
+              uint64_t* offset_out, uint64_t* data_size_out,
+              uint64_t* meta_size_out) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  struct timespec deadline;
+  if (timeout_ms >= 0) {
+    clock_gettime(CLOCK_MONOTONIC, &deadline);
+    deadline.tv_sec += timeout_ms / 1000;
+    deadline.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (deadline.tv_nsec >= 1000000000L) {
+      deadline.tv_sec += 1;
+      deadline.tv_nsec -= 1000000000L;
+    }
+  }
+  lock(h);
+  for (;;) {
+    uint64_t i = find(s, id);
+    if (i != h->table_cap && s->table[i].state == kSealed) {
+      ObjectEntry& e = s->table[i];
+      e.refcount++;
+      e.lru_tick = ++h->lru_clock;
+      *offset_out = e.offset;
+      *data_size_out = e.data_size;
+      *meta_size_out = e.meta_size;
+      unlock(h);
+      return TS_OK;
+    }
+    if (timeout_ms < 0) {
+      unlock(h);
+      return TS_NOT_FOUND;
+    }
+    int rc = pthread_cond_timedwait(&h->cv, &h->mu, &deadline);
+    if (rc == ETIMEDOUT) {
+      unlock(h);
+      return TS_TIMEOUT;
+    }
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+  }
+}
+
+int store_release(void* sp, const uint8_t* id) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  lock(h);
+  uint64_t i = find(s, id);
+  if (i == h->table_cap) {
+    unlock(h);
+    return TS_NOT_FOUND;
+  }
+  ObjectEntry& e = s->table[i];
+  if (e.refcount > 0) e.refcount--;
+  unlock(h);
+  return TS_OK;
+}
+
+int store_delete(void* sp, const uint8_t* id) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  lock(h);
+  uint64_t i = find(s, id);
+  if (i == h->table_cap) {
+    unlock(h);
+    return TS_NOT_FOUND;
+  }
+  ObjectEntry& e = s->table[i];
+  if (e.refcount > 0) {
+    unlock(h);
+    return TS_ERR;  // still referenced
+  }
+  entry_free(s, e);
+  pthread_cond_broadcast(&h->cv);
+  unlock(h);
+  return TS_OK;
+}
+
+int store_contains(void* sp, const uint8_t* id) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  lock(h);
+  uint64_t i = find(s, id);
+  int sealed = (i != h->table_cap && s->table[i].state == kSealed) ? 1 : 0;
+  unlock(h);
+  return sealed;
+}
+
+// Drop created-but-never-sealed entries (crashed writers). Returns count.
+int store_evict_orphans(void* sp) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  lock(h);
+  int n = 0;
+  for (uint64_t i = 0; i < h->table_cap; i++) {
+    ObjectEntry& e = s->table[i];
+    if (e.state == kCreated) {
+      e.refcount = 0;
+      entry_free(s, e);
+      n++;
+    }
+  }
+  unlock(h);
+  return n;
+}
+
+void store_stats(void* sp, uint64_t* out6) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  lock(h);
+  out6[0] = h->arena_size;
+  out6[1] = h->bytes_allocated;
+  out6[2] = h->num_objects;
+  out6[3] = h->num_evictions;
+  out6[4] = h->bytes_evicted;
+  out6[5] = h->lru_clock;
+  unlock(h);
+}
+
+}  // extern "C"
