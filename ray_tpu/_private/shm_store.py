@@ -32,6 +32,15 @@ TS_TIMEOUT = -7
 
 _build_lock = threading.Lock()
 
+ID_LEN = 20
+
+
+def _key(object_id: bytes) -> bytes:
+    """Store keys are exactly 20 bytes; shorter ids are zero-padded."""
+    if len(object_id) > ID_LEN:
+        raise ValueError(f"object id longer than {ID_LEN} bytes")
+    return object_id.ljust(ID_LEN, b"\x00")
+
 
 def _ensure_built() -> str:
     if os.path.exists(_LIB_PATH):
@@ -75,7 +84,9 @@ def _load():
     lib.store_contains.restype = ctypes.c_int
     lib.store_contains.argtypes = [p, ctypes.c_char_p]
     lib.store_evict_orphans.restype = ctypes.c_int
-    lib.store_evict_orphans.argtypes = [p]
+    lib.store_evict_orphans.argtypes = [p, u64]
+    lib.store_release_pid.restype = ctypes.c_int
+    lib.store_release_pid.argtypes = [p, u64]
     lib.store_stats.argtypes = [p, ctypes.POINTER(u64 * 6)]
     return lib
 
@@ -130,6 +141,9 @@ class ShmObjectStore:
         self._lib = lib
         self.name = name
         if create:
+            if capacity < (1 << 12):
+                raise ValueError(
+                    f"store capacity must be >= 4 KiB, got {capacity}")
             self._h = lib.store_create(name.encode(), capacity, table_cap)
         else:
             self._h = lib.store_attach(name.encode())
@@ -169,7 +183,7 @@ class ShmObjectStore:
         """Allocate; returns a writable view of data+meta. Call seal() next."""
         off = ctypes.c_uint64()
         rc = self._lib.store_create_object(
-            self._h, object_id, data_size, meta_size, ctypes.byref(off))
+            self._h, _key(object_id), data_size, meta_size, ctypes.byref(off))
         _check(rc, f"create {object_id.hex()}")
         return self._view(off.value, data_size + meta_size, readonly=False)
 
@@ -181,7 +195,7 @@ class ShmObjectStore:
         self.seal(object_id)
 
     def seal(self, object_id: bytes) -> None:
-        _check(self._lib.store_seal(self._h, object_id),
+        _check(self._lib.store_seal(self._h, _key(object_id)),
                f"seal {object_id.hex()}")
 
     def get(self, object_id: bytes, timeout_ms: int = -1) -> memoryview:
@@ -189,23 +203,28 @@ class ShmObjectStore:
         off = ctypes.c_uint64()
         dsz = ctypes.c_uint64()
         msz = ctypes.c_uint64()
-        rc = self._lib.store_get(self._h, object_id, timeout_ms,
+        rc = self._lib.store_get(self._h, _key(object_id), timeout_ms,
                                  ctypes.byref(off), ctypes.byref(dsz),
                                  ctypes.byref(msz))
         _check(rc, f"get {object_id.hex()}")
         return self._view(off.value, dsz.value, readonly=True)
 
     def release(self, object_id: bytes) -> None:
-        self._lib.store_release(self._h, object_id)
+        self._lib.store_release(self._h, _key(object_id))
 
     def delete(self, object_id: bytes) -> bool:
-        return self._lib.store_delete(self._h, object_id) == TS_OK
+        return self._lib.store_delete(self._h, _key(object_id)) == TS_OK
 
     def contains(self, object_id: bytes) -> bool:
-        return bool(self._lib.store_contains(self._h, object_id))
+        return bool(self._lib.store_contains(self._h, _key(object_id)))
 
-    def evict_orphans(self) -> int:
-        return self._lib.store_evict_orphans(self._h)
+    def evict_orphans(self, pid: int = 0) -> int:
+        """Reclaim unsealed entries of a dead writer pid (0 = any writer)."""
+        return self._lib.store_evict_orphans(self._h, pid)
+
+    def release_pid(self, pid: int) -> int:
+        """Drop all read refs held by a dead process (crash cleanup)."""
+        return self._lib.store_release_pid(self._h, pid)
 
     def stats(self) -> dict:
         out = (ctypes.c_uint64 * 6)()

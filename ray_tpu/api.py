@@ -37,6 +37,7 @@ from ray_tpu.utils.ids import ActorID, TaskID
 
 def init(
     *,
+    address=None,
     resources: dict | None = None,
     num_cpus: float | None = None,
     num_tpus: float | None = None,
@@ -46,12 +47,27 @@ def init(
     """Start the runtime (reference: ``ray.init``, ``worker.py:1139``).
 
     In-process local cluster by default; TPU devices visible to JAX are
-    registered as a ``TPU`` resource.
+    registered as a ``TPU`` resource. Pass ``address=(host, port)`` (a GCS
+    address, e.g. ``cluster_utils.Cluster().gcs_address``) or
+    ``"host:port"`` to connect to a running cluster instead.
     """
     if _core.is_initialized():
         if ignore_reinit_error:
             return _core.get_runtime()
         raise RuntimeError("ray_tpu.init() called twice")
+    if address is not None:
+        from ray_tpu.runtime.driver import ClusterRuntime
+
+        if isinstance(address, str):
+            host, sep, port = address.rpartition(":")
+            if not sep or not port.isdigit():
+                raise ValueError(
+                    f"address must be 'host:port' or a (host, port) tuple, "
+                    f"got {address!r}")
+            address = (host or "127.0.0.1", int(port))
+        rt = ClusterRuntime(address)
+        _core.install_runtime(rt)
+        return rt
     reset_config()
     config = get_config().apply_overrides(system_config)
     res = dict(resources or {})
@@ -89,7 +105,22 @@ def is_initialized() -> bool:
 
 def _runtime() -> _core.Runtime:
     if not _core.is_initialized():
-        init()
+        import os
+
+        gcs_host = os.environ.get("RAY_TPU_GCS_HOST")
+        if gcs_host:
+            # inside a cluster worker: connect to this node's raylet
+            # (nested task/actor submission from tasks)
+            from ray_tpu.runtime.driver import ClusterRuntime
+
+            rt = ClusterRuntime(
+                (gcs_host, int(os.environ["RAY_TPU_GCS_PORT"])),
+                raylet_address=(os.environ["RAY_TPU_RAYLET_HOST"],
+                                int(os.environ["RAY_TPU_RAYLET_PORT"])),
+            )
+            _core.install_runtime(rt)
+        else:
+            init()
     return _core.get_runtime()
 
 

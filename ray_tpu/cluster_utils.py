@@ -1,0 +1,123 @@
+"""Multi-node-on-one-host test cluster.
+
+Reference analog: ``python/ray/cluster_utils.py:108`` — the workhorse for
+distributed tests: N raylets (+1 GCS) as local processes sharing one
+machine; node failure = kill the raylet process.
+
+The GCS and the head raylet run in-process (threads); added nodes run as
+separate OS processes so ``remove_node`` is a real process kill.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+from ray_tpu.runtime.gcs import GcsServer
+from ray_tpu.runtime.raylet import Raylet
+from ray_tpu.utils.ids import NodeID
+
+
+class NodeHandle:
+    def __init__(self, node_id: str, *, raylet: Raylet | None = None,
+                 proc: subprocess.Popen | None = None, address=None):
+        self.node_id = node_id
+        self.raylet = raylet
+        self.proc = proc
+        self.address = address
+
+
+class Cluster:
+    """``Cluster()`` → ``add_node(num_cpus=...)`` → drive via ray_tpu.init
+    (address=cluster.gcs_address)."""
+
+    def __init__(self, *, heartbeat_timeout_s: float = 3.0):
+        self.gcs = GcsServer(heartbeat_timeout_s=heartbeat_timeout_s).start()
+        self.gcs_address = self.gcs.address
+        self.nodes: dict[str, NodeHandle] = {}
+        self._head_id: str | None = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+
+    def add_node(self, *, num_cpus: float = 4, num_tpus: float = 0,
+                 resources: dict | None = None, external: bool = False,
+                 store_capacity: int = 256 << 20,
+                 labels: dict | None = None) -> NodeHandle:
+        res = {"CPU": float(num_cpus)}
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        if resources:
+            res.update({k: float(v) for k, v in resources.items()})
+        node_id = NodeID.from_random().hex()
+        labels = dict(labels or {})
+        with self._lock:
+            if self._head_id is None:
+                labels.setdefault("head", True)
+        if external:
+            cfg = {"node_id": node_id, "gcs_address": list(self.gcs_address),
+                   "resources": res, "store_capacity": store_capacity,
+                   "labels": labels}
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.runtime.raylet",
+                 json.dumps(cfg)],
+                stdout=subprocess.PIPE, text=True)
+            line = proc.stdout.readline()
+            info = json.loads(line)
+            handle = NodeHandle(node_id, proc=proc,
+                                address=tuple(info["address"]))
+        else:
+            raylet = Raylet(node_id=node_id, gcs_address=self.gcs_address,
+                            resources=res, store_capacity=store_capacity,
+                            labels=labels).start()
+            handle = NodeHandle(node_id, raylet=raylet,
+                                address=raylet.address)
+        with self._lock:
+            self.nodes[node_id] = handle
+            if self._head_id is None:
+                self._head_id = node_id
+        return handle
+
+    def remove_node(self, handle: NodeHandle, *, graceful: bool = False):
+        """Kill a node (chaos path: non-graceful = SIGKILL, heartbeat
+        timeout detection; reference: NodeKillerActor test_utils.py:1401)."""
+        with self._lock:
+            self.nodes.pop(handle.node_id, None)
+        if handle.proc is not None:
+            if graceful:
+                handle.proc.terminate()
+            else:
+                handle.proc.kill()
+            handle.proc.wait(timeout=10)
+        elif handle.raylet is not None:
+            handle.raylet.stop()
+        if graceful:
+            try:
+                from ray_tpu.runtime.rpc import RpcClient
+                c = RpcClient(self.gcs_address)
+                c.call("drain_node", node_id=handle.node_id)
+                c.close()
+            except OSError:
+                pass
+
+    def wait_for_nodes(self, n: int, timeout: float = 10.0):
+        from ray_tpu.runtime.rpc import RpcClient
+        client = RpcClient(self.gcs_address)
+        deadline = time.monotonic() + timeout
+        try:
+            while time.monotonic() < deadline:
+                nodes = client.call("get_nodes", alive_only=True)
+                if len(nodes) >= n:
+                    return
+                time.sleep(0.05)
+            raise TimeoutError(f"cluster did not reach {n} nodes")
+        finally:
+            client.close()
+
+    def shutdown(self):
+        for handle in list(self.nodes.values()):
+            self.remove_node(handle, graceful=True)
+        self.gcs.stop()

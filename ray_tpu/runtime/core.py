@@ -587,6 +587,16 @@ def init_runtime(config: Config | None = None, resources: dict | None = None) ->
         return _runtime
 
 
+def install_runtime(runtime) -> None:
+    """Install an externally constructed runtime (cluster mode: the
+    ``driver.ClusterRuntime`` duck-types ``Runtime``)."""
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            raise RuntimeError("a runtime is already initialized")
+        _runtime = runtime
+
+
 def shutdown_runtime():
     global _runtime
     with _runtime_lock:

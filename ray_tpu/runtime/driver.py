@@ -1,0 +1,330 @@
+"""Driver/worker-side cluster runtime: the core_worker analog.
+
+Reference analog: ``src/ray/core_worker/core_worker.cc`` (SubmitTask:1878,
+CreateActor:1948, SubmitActorTask:2182, Put:1141, Get:1353, Wait:1509) as
+driven from ``python/ray/_private/worker.py``. Duck-types the same interface
+as the in-process ``runtime.core.Runtime`` so ``ray_tpu.api`` works
+unchanged in both modes.
+
+The driver attaches its local node's shm store directly (same-host zero-copy
+path), submits tasks to the local raylet (which schedules locally or spills
+back through the GCS view), and resolves remote objects through the
+raylet's pull-based object manager.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import cloudpickle
+
+from ray_tpu._private.shm_store import ShmObjectStore
+from ray_tpu.runtime import object_codec
+from ray_tpu.runtime.object_ref import ObjectRef
+from ray_tpu.runtime.rpc import RpcClient
+from ray_tpu.runtime.task_spec import TaskSpec, TaskType
+from ray_tpu.utils import exceptions as exc
+from ray_tpu.utils.ids import ActorID, ObjectID, WorkerID
+
+
+class ClusterRuntime:
+    """Connects ``ray_tpu.api`` to a running cluster (GCS + raylets)."""
+
+    def __init__(self, gcs_address, raylet_address=None):
+        self.gcs_address = tuple(gcs_address)
+        self._gcs = RpcClient(self.gcs_address)
+        self.caller_id = WorkerID.from_random().hex()
+        # choose local raylet: given address, or the head node from GCS
+        if raylet_address is None:
+            nodes = self._gcs.call("get_nodes", alive_only=True)
+            if not nodes:
+                raise RuntimeError("no alive nodes in cluster")
+            head = next((n for n in nodes if n["labels"].get("head")),
+                        nodes[0])
+            raylet_address = head["address"]
+            store_name = head["store_name"]
+            self.node_id = head["node_id"]
+        else:
+            info = RpcClient(tuple(raylet_address)).call("node_info")
+            store_name = info["store_name"]
+            self.node_id = info["node_id"]
+        self._raylet = RpcClient(tuple(raylet_address))
+        self._raylet_lock = threading.Lock()
+        self.store = ShmObjectStore(store_name)
+        self._actor_locations: dict[str, tuple] = {}   # id -> (addr, incarnation)
+        self._actor_seq: dict[str, int] = {}           # id -> next seq
+        self._seq_lock = threading.Lock()
+        self._named_cache: dict[str, str] = {}
+        self.metrics: dict[str, Any] = {}
+
+    # ------------------------------------------------------------------
+    # objects
+    # ------------------------------------------------------------------
+
+    def put(self, value) -> ObjectRef:
+        oid = ObjectID.from_random()
+        size = object_codec.put_value(self.store, oid.binary(), value)
+        self._gcs.call("add_object_location", oid=oid.hex(),
+                       node_id=self.node_id, size=size)
+        return ObjectRef(oid)
+
+    def get(self, refs: list[ObjectRef], timeout: float | None = None):
+        oids = [r.id.hex() for r in refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = [o for o in oids
+                   if not self.store.contains(bytes.fromhex(o))]
+        while pending:
+            step = 5.0
+            if deadline is not None:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for {len(pending)} objects")
+                step = min(step, remain)
+            with self._raylet_lock:
+                pending = self._raylet.call("ensure_local", oids=pending,
+                                            timeout_s=step)
+        out = []
+        for oid_hex in oids:
+            out.append(self._read_local(oid_hex, deadline))
+        return out
+
+    def _read_local(self, oid_hex: str, deadline):
+        """Read a locally-available object; if it was evicted between the
+        ensure_local and the read (LRU pressure), re-pull and retry."""
+        from ray_tpu._private.shm_store import ObjectNotFoundError
+
+        for _ in range(3):
+            try:
+                value, is_error = object_codec.get_value(
+                    self.store, bytes.fromhex(oid_hex), timeout_ms=0)
+            except ObjectNotFoundError:
+                step = 5.0
+                if deadline is not None:
+                    remain = deadline - time.monotonic()
+                    if remain <= 0:
+                        raise exc.GetTimeoutError(
+                            f"object {oid_hex[:8]} evicted and re-pull "
+                            f"timed out") from None
+                    step = min(step, remain)
+                with self._raylet_lock:
+                    self._raylet.call("ensure_local", oids=[oid_hex],
+                                      timeout_s=step)
+                continue
+            if is_error:
+                raise value
+            return value
+        raise exc.ObjectLostError(oid_hex, "evicted repeatedly under "
+                                  "store memory pressure")
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: list = []
+        not_ready = list(refs)
+        while True:
+            still = []
+            for r in not_ready:
+                if self.store.contains(r.id.binary()):
+                    ready.append(r)
+                else:
+                    still.append(r)
+            not_ready = still
+            if len(ready) >= num_returns or not not_ready:
+                break
+            # check remote locations for objects created elsewhere
+            oids = [r.id.hex() for r in not_ready]
+            locs = self._gcs.call("get_object_locations", oids=oids)
+            for r in list(not_ready):
+                if locs.get(r.id.hex()):
+                    ready.append(r)
+                    not_ready.remove(r)
+            if len(ready) >= num_returns or not not_ready:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.01)
+        return ready, not_ready
+
+    def cancel(self, ref: ObjectRef):
+        pass  # best-effort: cluster-mode cancellation lands in round 2
+
+    def note_return_owner(self, spec: TaskSpec):
+        pass  # ownership is tracked centrally (GCS object directory)
+
+    # ------------------------------------------------------------------
+    # tasks
+    # ------------------------------------------------------------------
+
+    def _wire_args(self, spec: TaskSpec):
+        """Replace top-level ObjectRefs with markers (reference semantics:
+        only top-level args are resolved before execution)."""
+        args = [("__objref__", a.id.hex()) if isinstance(a, ObjectRef) else a
+                for a in spec.args]
+        kwargs = {k: ("__objref__", v.id.hex()) if isinstance(v, ObjectRef)
+                  else v for k, v in spec.kwargs.items()}
+        return cloudpickle.dumps((args, kwargs), protocol=5)
+
+    def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
+        spec.return_ids = [ObjectID.from_random()
+                           for _ in range(spec.num_returns)]
+        if spec.task_type == TaskType.ACTOR_TASK:
+            self._submit_actor_task(spec)
+        else:
+            task = {
+                "task_id": spec.task_id.hex(),
+                "name": spec.function_name,
+                "function_blob": cloudpickle.dumps(spec.function, protocol=5),
+                "args_blob": self._wire_args(spec),
+                "return_oids": [o.hex() for o in spec.return_ids],
+                "resources": dict(spec.resources.resources),
+                "strategy": _wire_strategy(spec),
+                "max_retries": spec.max_retries,
+            }
+            with self._raylet_lock:
+                self._raylet.call("submit_task", task=task)
+        return [ObjectRef(oid) for oid in spec.return_ids]
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+
+    def create_actor(self, spec: TaskSpec, name: str | None = None) -> ActorID:
+        actor_id = ActorID.from_random()
+        spec.actor_id = actor_id
+        creation = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.function_name,
+            "function_blob": cloudpickle.dumps(spec.function, protocol=5),
+            "args_blob": self._wire_args(spec),
+            "return_oids": [ObjectID.from_random().hex()],
+            "resources": dict(spec.resources.resources),
+            "max_concurrency": spec.max_concurrency,
+        }
+        strategy = _wire_strategy(spec)
+        self._gcs.call(
+            "register_actor", actor_id=actor_id.hex(), name=name,
+            creation_spec=creation,
+            resources=dict(spec.resources.resources),
+            max_restarts=spec.max_restarts,
+            pg_id=strategy.get("pg_id"))
+        return actor_id
+
+    def _actor_location(self, actor_id_hex: str, timeout: float = 30.0):
+        """(address, incarnation) of an ALIVE actor; caches, and resets the
+        caller-side sequence numbering when a new incarnation is observed
+        (restarted actors start their ordering from 0)."""
+        cached = self._actor_locations.get(actor_id_hex)
+        if cached is not None:
+            return cached
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self._gcs.call("get_actor", actor_id=actor_id_hex)
+            if info is None:
+                raise exc.ActorDiedError(actor_id_hex, "unknown actor")
+            if info["state"] == "ALIVE":
+                entry = (tuple(info["address"]), info.get("num_restarts", 0))
+                with self._seq_lock:
+                    old = self._actor_locations.get(actor_id_hex)
+                    if old is None or old[1] != entry[1]:
+                        self._actor_seq[actor_id_hex] = 0
+                    self._actor_locations[actor_id_hex] = entry
+                return entry
+            if info["state"] == "DEAD":
+                raise exc.ActorDiedError(actor_id_hex,
+                                         info.get("death_reason", "dead"))
+            time.sleep(0.02)
+        raise exc.ActorUnavailableError(
+            f"actor {actor_id_hex[:8]} not ALIVE within {timeout}s")
+
+    def _submit_actor_task(self, spec: TaskSpec):
+        actor_hex = spec.actor_id.hex()
+        task = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.function_name,
+            "actor_id": actor_hex,
+            "method_name": spec.actor_method_name,
+            "args_blob": self._wire_args(spec),
+            "return_oids": [o.hex() for o in spec.return_ids],
+            "caller_id": self.caller_id,
+        }
+        last_err: BaseException | None = None
+        for attempt in range(2):
+            try:
+                addr, incarnation = self._actor_location(actor_hex)
+                # seq is assigned per send attempt so a reset (new
+                # incarnation) renumbers this task too
+                with self._seq_lock:
+                    seq = self._actor_seq.get(actor_hex, 0)
+                    self._actor_seq[actor_hex] = seq + 1
+                task["seq"] = seq
+                task["incarnation"] = incarnation
+                client = RpcClient(addr)
+                client.call("submit_actor_task", task=task)
+                client.close()
+                return
+            except (exc.ActorDiedError, exc.ActorUnavailableError, OSError,
+                    LookupError) as e:
+                last_err = e
+                # the seq was not consumed by the actor — roll it back so
+                # later calls don't leave a gap the actor waits on forever
+                with self._seq_lock:
+                    if self._actor_seq.get(actor_hex) == task.get("seq", -1) + 1:
+                        self._actor_seq[actor_hex] = task["seq"]
+                # refresh location/incarnation and retry once (reference:
+                # client-side resend protocol on actor restart)
+                self._actor_locations.pop(actor_hex, None)
+        err = last_err if isinstance(last_err, exc.RayTpuError) else \
+            exc.ActorDiedError(actor_hex, repr(last_err))
+        for oid in spec.return_ids:
+            if not self.store.contains(oid.binary()):
+                try:
+                    object_codec.put_value(self.store, oid.binary(),
+                                           err, is_error=True)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._gcs.call("kill_actor", actor_id=actor_id.hex(),
+                       no_restart=no_restart)
+        self._actor_locations.pop(actor_id.hex(), None)
+
+    def get_actor(self, name: str) -> ActorID:
+        info = self._gcs.call("get_actor", name=name)
+        if info is None:
+            raise ValueError(f"Failed to look up actor with name {name!r}")
+        return ActorID.from_hex(info["actor_id"])
+
+    def actor_state(self, actor_id: ActorID):
+        return None  # class name not tracked cluster-side (handle shows id)
+
+    # ------------------------------------------------------------------
+    # cluster info / lifecycle
+    # ------------------------------------------------------------------
+
+    def cluster_resources(self) -> dict:
+        return self._gcs.call("cluster_resources")["total"]
+
+    def available_resources_snapshot(self) -> dict:
+        return self._gcs.call("cluster_resources")["available"]
+
+    def shutdown(self):
+        try:
+            self._gcs.close()
+            self._raylet.close()
+            self.store.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _wire_strategy(spec: TaskSpec) -> dict:
+    s = spec.scheduling_strategy
+    out = {"kind": s.kind}
+    if s.node_id is not None:
+        out["node_id"] = s.node_id if isinstance(s.node_id, str) \
+            else s.node_id.hex()
+    if s.placement_group_id is not None:
+        out["pg_id"] = s.placement_group_id.hex()
+        out["bundle_index"] = s.bundle_index
+    return out

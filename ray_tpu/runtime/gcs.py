@@ -1,0 +1,593 @@
+"""GCS: the cluster control plane (head-node service).
+
+Reference analog: ``src/ray/gcs/gcs_server/`` — node registry + health
+(``GcsNodeManager``, ``GcsHealthCheckManager`` gcs_health_check_manager.h:39),
+actor registry and scheduling (``GcsActorManager`` gcs_actor_manager.cc:246,
+632, restart logic :1100), KV store (``GcsKvManager``), object directory
+(owner-based in the reference; centralized here), pubsub
+(``gcs_server/pubsub_handler.cc``), placement groups
+(``GcsPlacementGroupManager`` — 2-phase reserve/commit), and the cluster
+resource view (``GcsResourceManager`` fed by the ray_syncer).
+
+One process/thread, guarded by a single lock — the control plane is
+low-rate; the data plane (objects) never flows through here.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.runtime.rpc import RpcServer, send_msg
+
+# Pubsub channels (reference: pubsub.proto:28 channel enum).
+CH_NODE = "node"            # node added/dead
+CH_ACTOR = "actor"          # actor state transitions
+CH_OBJECT = "object"        # object location added (get() wakeups)
+CH_ERROR = "error"          # error broadcast to drivers
+CH_LOG = "log"              # worker log forwarding
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    address: tuple          # raylet RPC address
+    store_name: str         # shm segment name (same-host attach fast path)
+    resources: dict         # total
+    available: dict
+    labels: dict = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class ActorInfo:
+    actor_id: str
+    name: str | None
+    state: str              # PENDING | ALIVE | RESTARTING | DEAD
+    node_id: str | None = None
+    creation_spec: bytes | None = None   # pickled wire spec (for restart)
+    resources: dict = field(default_factory=dict)
+    max_restarts: int = 0
+    num_restarts: int = 0
+    death_reason: str = ""
+    # placement constraint recorded so restart honors it
+    pg_id: str | None = None
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: str
+    strategy: str                       # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    bundles: list                       # list[dict resource -> amount]
+    state: str = "PENDING"              # PENDING | CREATED | REMOVED
+    bundle_nodes: list = field(default_factory=list)  # node_id per bundle
+
+
+class GcsServer(RpcServer):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_timeout_s: float = 5.0):
+        super().__init__(host, port)
+        self._lock = threading.RLock()
+        self._nodes: dict[str, NodeInfo] = {}
+        self._actors: dict[str, ActorInfo] = {}
+        self._named_actors: dict[str, str] = {}
+        self._kv: dict[str, dict[str, bytes]] = {}
+        self._object_dir: dict[str, set[str]] = {}   # oid -> node ids
+        self._object_meta: dict[str, int] = {}       # oid -> size (for ref)
+        self._pgs: dict[str, PlacementGroupInfo] = {}
+        self._jobs: dict[str, dict] = {}
+        # pubsub: channel -> list of (conn, send_lock)
+        self._subs: dict[str, list] = {}
+        self._hb_timeout = heartbeat_timeout_s
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True)
+        self._task_events: list[dict] = []           # bounded task event sink
+        self._max_task_events = 10000
+
+    def start(self):
+        super().start()
+        self._health_thread.start()
+        return self
+
+    # ------------------------------------------------------------------
+    # pubsub (reference: src/ray/pubsub/ publisher.h)
+    # ------------------------------------------------------------------
+
+    def rpc_subscribe(self, conn, send_lock, *, channels: list):
+        with self._lock:
+            for ch in channels:
+                self._subs.setdefault(ch, []).append((conn, send_lock))
+        send_msg(conn, {"subscribed": channels}, send_lock)
+        return RpcServer.HELD
+
+    def publish(self, channel: str, message: dict):
+        message = {"channel": channel, **message}
+        with self._lock:
+            subs = list(self._subs.get(channel, []))
+        dead = []
+        for conn, send_lock in subs:
+            try:
+                send_msg(conn, message, send_lock)
+            except OSError:
+                dead.append((conn, send_lock))
+        if dead:
+            with self._lock:
+                for item in dead:
+                    try:
+                        self._subs.get(channel, []).remove(item)
+                    except ValueError:
+                        pass
+
+    # ------------------------------------------------------------------
+    # nodes + health (reference: GcsNodeManager / GcsHealthCheckManager)
+    # ------------------------------------------------------------------
+
+    def rpc_register_node(self, conn, send_lock, *, node_id, address,
+                          store_name, resources, labels=None):
+        with self._lock:
+            self._nodes[node_id] = NodeInfo(
+                node_id=node_id, address=tuple(address),
+                store_name=store_name, resources=dict(resources),
+                available=dict(resources), labels=labels or {},
+            )
+        self.publish(CH_NODE, {"event": "added", "node_id": node_id,
+                               "address": tuple(address)})
+        return {"ok": True}
+
+    def rpc_heartbeat(self, conn, send_lock, *, node_id, available,
+                      load=None):
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                return {"ok": False, "reregister": True}
+            node.last_heartbeat = time.monotonic()
+            node.available = dict(available)
+        return {"ok": True}
+
+    def rpc_get_nodes(self, conn, send_lock, *, alive_only: bool = True):
+        with self._lock:
+            return [
+                {"node_id": n.node_id, "address": n.address,
+                 "store_name": n.store_name, "resources": n.resources,
+                 "available": n.available, "alive": n.alive,
+                 "labels": n.labels}
+                for n in self._nodes.values()
+                if n.alive or not alive_only
+            ]
+
+    def rpc_drain_node(self, conn, send_lock, *, node_id):
+        self._mark_node_dead(node_id, reason="drained")
+        return {"ok": True}
+
+    def _health_loop(self):
+        while not self._stopping:
+            time.sleep(self._hb_timeout / 4)
+            now = time.monotonic()
+            with self._lock:
+                dead = [n.node_id for n in self._nodes.values()
+                        if n.alive and now - n.last_heartbeat > self._hb_timeout]
+            for node_id in dead:
+                self._mark_node_dead(node_id, reason="heartbeat timeout")
+
+    def _mark_node_dead(self, node_id: str, reason: str):
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+            node.alive = False
+            # drop object locations on that node
+            for oid, locs in list(self._object_dir.items()):
+                locs.discard(node_id)
+                if not locs:
+                    del self._object_dir[oid]
+            doomed_actors = [a for a in self._actors.values()
+                            if a.node_id == node_id
+                            and a.state in ("ALIVE", "PENDING", "RESTARTING")]
+        self.publish(CH_NODE, {"event": "dead", "node_id": node_id,
+                               "reason": reason})
+        for actor in doomed_actors:
+            self._on_actor_failure(actor, f"node {node_id} died: {reason}")
+
+    # ------------------------------------------------------------------
+    # actors (reference: GcsActorManager + GcsActorScheduler)
+    # ------------------------------------------------------------------
+
+    def rpc_register_actor(self, conn, send_lock, *, actor_id, name,
+                           creation_spec, resources, max_restarts,
+                           pg_id=None):
+        with self._lock:
+            if name is not None:
+                if name in self._named_actors:
+                    raise ValueError(f"Actor name {name!r} already taken")
+                self._named_actors[name] = actor_id
+            self._actors[actor_id] = ActorInfo(
+                actor_id=actor_id, name=name, state="PENDING",
+                creation_spec=creation_spec, resources=dict(resources),
+                max_restarts=max_restarts, pg_id=pg_id,
+            )
+        node_id = self._schedule_actor(actor_id)
+        return {"ok": True, "node_id": node_id}
+
+    def _schedule_actor(self, actor_id: str) -> str | None:
+        """Pick a node for the actor and ask its raylet to host it
+        (reference: GcsActorScheduler::Schedule, ScheduleByGcs)."""
+        from ray_tpu.runtime.rpc import RpcClient
+        with self._lock:
+            actor = self._actors.get(actor_id)
+            if actor is None or actor.state == "DEAD":
+                return None
+            pg = self._pgs.get(actor.pg_id) if actor.pg_id else None
+            node_id = self._pick_node(actor.resources, pg=pg)
+            if node_id is None:
+                actor.state = "DEAD"
+                actor.death_reason = (
+                    f"no node can host actor resources {actor.resources}"
+                )
+                name = actor.name
+                spec = None
+            else:
+                actor.node_id = node_id
+                node = self._nodes[node_id]
+                spec = actor.creation_spec
+        if node_id is None:
+            self.publish(CH_ACTOR, {"event": "dead", "actor_id": actor_id,
+                                    "reason": "unschedulable"})
+            return None
+        # Ask the raylet to host the actor (fire on a thread: raylet may
+        # itself call back into GCS during creation).
+        incarnation = actor.num_restarts
+
+        def _place():
+            try:
+                client = RpcClient(node.address)
+                client.call("host_actor", actor_id=actor_id, spec=spec,
+                            incarnation=incarnation)
+                client.close()
+            except Exception as e:  # noqa: BLE001
+                self._on_actor_failure_id(actor_id, f"placement failed: {e!r}")
+        threading.Thread(target=_place, daemon=True).start()
+        return node_id
+
+    def rpc_actor_ready(self, conn, send_lock, *, actor_id, node_id):
+        with self._lock:
+            actor = self._actors.get(actor_id)
+            if actor is None:
+                return {"ok": False}
+            actor.state = "ALIVE"
+            actor.node_id = node_id
+        self.publish(CH_ACTOR, {"event": "alive", "actor_id": actor_id,
+                                "node_id": node_id})
+        return {"ok": True}
+
+    def rpc_actor_failed(self, conn, send_lock, *, actor_id, reason):
+        with self._lock:
+            actor = self._actors.get(actor_id)
+        if actor is not None:
+            self._on_actor_failure(actor, reason)
+        return {"ok": True}
+
+    def _on_actor_failure_id(self, actor_id: str, reason: str):
+        with self._lock:
+            actor = self._actors.get(actor_id)
+        if actor is not None:
+            self._on_actor_failure(actor, reason)
+
+    def _on_actor_failure(self, actor: ActorInfo, reason: str):
+        """Restart (reference: GcsActorManager::ReconstructActor,
+        gcs_actor_manager.cc:1100, max_restarts budget :1117) or kill."""
+        with self._lock:
+            if actor.state == "DEAD":
+                return
+            if actor.num_restarts < actor.max_restarts:
+                actor.num_restarts += 1
+                actor.state = "RESTARTING"
+                actor.node_id = None
+                restarting = True
+            else:
+                actor.state = "DEAD"
+                actor.death_reason = reason
+                if actor.name:
+                    self._named_actors.pop(actor.name, None)
+                restarting = False
+        if restarting:
+            self.publish(CH_ACTOR, {"event": "restarting",
+                                    "actor_id": actor.actor_id,
+                                    "reason": reason})
+            self._schedule_actor(actor.actor_id)
+        else:
+            self.publish(CH_ACTOR, {"event": "dead",
+                                    "actor_id": actor.actor_id,
+                                    "reason": reason})
+
+    def rpc_get_actor(self, conn, send_lock, *, actor_id=None, name=None):
+        with self._lock:
+            if actor_id is None:
+                actor_id = self._named_actors.get(name)
+                if actor_id is None:
+                    return None
+            actor = self._actors.get(actor_id)
+            if actor is None:
+                return None
+            node = self._nodes.get(actor.node_id) if actor.node_id else None
+            return {
+                "actor_id": actor.actor_id, "name": actor.name,
+                "state": actor.state, "node_id": actor.node_id,
+                "address": node.address if node else None,
+                "death_reason": actor.death_reason,
+                "num_restarts": actor.num_restarts,
+            }
+
+    def rpc_kill_actor(self, conn, send_lock, *, actor_id, no_restart=True):
+        from ray_tpu.runtime.rpc import RpcClient
+        with self._lock:
+            actor = self._actors.get(actor_id)
+            if actor is None:
+                return {"ok": False}
+            if no_restart:
+                actor.max_restarts = actor.num_restarts  # exhaust budget
+            node = self._nodes.get(actor.node_id) if actor.node_id else None
+        if node is not None:
+            try:
+                client = RpcClient(node.address)
+                client.call("kill_actor_worker", actor_id=actor_id)
+                client.close()
+            except Exception:  # noqa: BLE001 - node may be gone already
+                pass
+        self._on_actor_failure_id(actor_id, "killed via ray_tpu.kill()")
+        return {"ok": True}
+
+    def rpc_list_actors(self, conn, send_lock):
+        with self._lock:
+            return [
+                {"actor_id": a.actor_id, "name": a.name, "state": a.state,
+                 "node_id": a.node_id, "num_restarts": a.num_restarts}
+                for a in self._actors.values()
+            ]
+
+    # ------------------------------------------------------------------
+    # scheduling helpers (reference: HybridSchedulingPolicy — filter
+    # feasible, prefer available, score by critical resource utilization)
+    # ------------------------------------------------------------------
+
+    def _pick_node(self, demand: dict, pg: PlacementGroupInfo | None = None,
+                   exclude: set | None = None) -> str | None:
+        if pg is not None and pg.bundle_nodes:
+            for nid in pg.bundle_nodes:
+                n = self._nodes.get(nid)
+                if n and n.alive and _fits(demand, n.available):
+                    return nid
+            for nid in pg.bundle_nodes:
+                n = self._nodes.get(nid)
+                if n and n.alive and _fits(demand, n.resources):
+                    return nid
+            return None
+        best, best_score = None, None
+        feasible_busy = None
+        for n in self._nodes.values():
+            if not n.alive or (exclude and n.node_id in exclude):
+                continue
+            if not _fits(demand, n.resources):
+                continue
+            if _fits(demand, n.available):
+                score = _critical_utilization(demand, n)
+                if best_score is None or score < best_score:
+                    best, best_score = n.node_id, score
+            elif feasible_busy is None:
+                feasible_busy = n.node_id
+        return best if best is not None else feasible_busy
+
+    def rpc_pick_node(self, conn, send_lock, *, demand, exclude=None,
+                      pg_id=None):
+        with self._lock:
+            pg = self._pgs.get(pg_id) if pg_id else None
+            return self._pick_node(demand, pg=pg,
+                                   exclude=set(exclude or ()))
+
+    # ------------------------------------------------------------------
+    # placement groups (reference: GcsPlacementGroupManager; bundle
+    # placement is 2-phase prepare/commit — simplified to reserve-on-GCS
+    # because the GCS resource view is authoritative here)
+    # ------------------------------------------------------------------
+
+    def rpc_create_placement_group(self, conn, send_lock, *, pg_id, bundles,
+                                   strategy="PACK"):
+        with self._lock:
+            alive = [n for n in self._nodes.values() if n.alive]
+            assignment = _place_bundles(bundles, strategy, alive)
+            if assignment is None:
+                self._pgs[pg_id] = PlacementGroupInfo(
+                    pg_id=pg_id, strategy=strategy, bundles=bundles,
+                    state="PENDING")
+                return {"ok": False, "state": "PENDING"}
+            # reserve: deduct from the GCS view AND the node totals so
+            # regular tasks do not oversubscribe reserved capacity
+            for bundle, nid in zip(bundles, assignment):
+                node = self._nodes[nid]
+                for k, v in bundle.items():
+                    node.available[k] = node.available.get(k, 0.0) - v
+            self._pgs[pg_id] = PlacementGroupInfo(
+                pg_id=pg_id, strategy=strategy, bundles=bundles,
+                state="CREATED", bundle_nodes=assignment)
+        return {"ok": True, "state": "CREATED", "bundle_nodes": assignment}
+
+    def rpc_get_placement_group(self, conn, send_lock, *, pg_id):
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None:
+                return None
+            return {"pg_id": pg.pg_id, "state": pg.state,
+                    "strategy": pg.strategy, "bundles": pg.bundles,
+                    "bundle_nodes": pg.bundle_nodes}
+
+    def rpc_remove_placement_group(self, conn, send_lock, *, pg_id):
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+            if pg is not None and pg.state == "CREATED":
+                for bundle, nid in zip(pg.bundles, pg.bundle_nodes):
+                    node = self._nodes.get(nid)
+                    if node is not None:
+                        for k, v in bundle.items():
+                            node.available[k] = node.available.get(k, 0) + v
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # object directory (centralized; reference is owner-based
+    # OwnershipBasedObjectDirectory — see SURVEY §2a N7)
+    # ------------------------------------------------------------------
+
+    def rpc_add_object_location(self, conn, send_lock, *, oid, node_id,
+                                size=0):
+        with self._lock:
+            self._object_dir.setdefault(oid, set()).add(node_id)
+            if size:
+                self._object_meta[oid] = size
+        self.publish(CH_OBJECT, {"event": "added", "oid": oid,
+                                 "node_id": node_id})
+        return {"ok": True}
+
+    def rpc_get_object_locations(self, conn, send_lock, *, oids):
+        with self._lock:
+            return {oid: sorted(self._object_dir.get(oid, ()))
+                    for oid in oids}
+
+    def rpc_remove_object_location(self, conn, send_lock, *, oid, node_id):
+        with self._lock:
+            locs = self._object_dir.get(oid)
+            if locs:
+                locs.discard(node_id)
+                if not locs:
+                    del self._object_dir[oid]
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # KV (reference: GcsKvManager / internal_kv)
+    # ------------------------------------------------------------------
+
+    def rpc_kv_put(self, conn, send_lock, *, ns, key, value,
+                   overwrite=True):
+        with self._lock:
+            table = self._kv.setdefault(ns, {})
+            if not overwrite and key in table:
+                return {"ok": False}
+            table[key] = value
+        return {"ok": True}
+
+    def rpc_kv_get(self, conn, send_lock, *, ns, key):
+        with self._lock:
+            return self._kv.get(ns, {}).get(key)
+
+    def rpc_kv_del(self, conn, send_lock, *, ns, key):
+        with self._lock:
+            return {"ok": self._kv.get(ns, {}).pop(key, None) is not None}
+
+    def rpc_kv_keys(self, conn, send_lock, *, ns, prefix=""):
+        with self._lock:
+            return [k for k in self._kv.get(ns, {}) if k.startswith(prefix)]
+
+    # ------------------------------------------------------------------
+    # jobs + task events (reference: GcsJobManager, GcsTaskManager)
+    # ------------------------------------------------------------------
+
+    def rpc_register_job(self, conn, send_lock, *, job_id, metadata=None):
+        with self._lock:
+            self._jobs[job_id] = {"job_id": job_id, "state": "RUNNING",
+                                  "start_time": time.time(),
+                                  "metadata": metadata or {}}
+        return {"ok": True}
+
+    def rpc_list_jobs(self, conn, send_lock):
+        with self._lock:
+            return list(self._jobs.values())
+
+    def rpc_add_task_events(self, conn, send_lock, *, events):
+        with self._lock:
+            self._task_events.extend(events)
+            if len(self._task_events) > self._max_task_events:
+                del self._task_events[:-self._max_task_events]
+        return {"ok": True}
+
+    def rpc_get_task_events(self, conn, send_lock, *, limit=1000):
+        with self._lock:
+            return self._task_events[-limit:]
+
+    # ------------------------------------------------------------------
+    # cluster summary
+    # ------------------------------------------------------------------
+
+    def rpc_cluster_resources(self, conn, send_lock):
+        total: dict[str, float] = {}
+        avail: dict[str, float] = {}
+        with self._lock:
+            for n in self._nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.resources.items():
+                    total[k] = total.get(k, 0.0) + v
+                for k, v in n.available.items():
+                    avail[k] = avail.get(k, 0.0) + v
+        return {"total": total, "available": avail}
+
+
+def _fits(demand: dict, supply: dict) -> bool:
+    return all(supply.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+
+def _critical_utilization(demand: dict, node: NodeInfo) -> float:
+    """Score = max over demanded resources of (used+demand)/total; lower is
+    better (reference: hybrid_scheduling_policy.cc:99-186)."""
+    score = 0.0
+    for k, v in demand.items():
+        total = node.resources.get(k, 0.0)
+        if total <= 0:
+            continue
+        used = total - node.available.get(k, 0.0)
+        score = max(score, (used + v) / total)
+    return score
+
+
+def _place_bundles(bundles: list, strategy: str, nodes: list):
+    """Greedy bundle placement. Returns node_id per bundle or None."""
+    avail = {n.node_id: dict(n.available) for n in nodes}
+    order = sorted(avail, key=lambda nid: -sum(avail[nid].values()))
+    assignment = []
+    if strategy in ("STRICT_PACK", "PACK"):
+        # try single node first
+        for nid in order:
+            trial = dict(avail[nid])
+            ok = True
+            for b in bundles:
+                if _fits(b, trial):
+                    for k, v in b.items():
+                        trial[k] -= v
+                else:
+                    ok = False
+                    break
+            if ok:
+                return [nid] * len(bundles)
+        if strategy == "STRICT_PACK":
+            return None
+    if strategy == "STRICT_SPREAD" and len(bundles) > len(nodes):
+        return None
+    used_nodes: set[str] = set()
+    for b in bundles:
+        placed = None
+        # spread: prefer unused nodes; pack fallback: any feasible
+        candidates = ([nid for nid in order if nid not in used_nodes]
+                      + [nid for nid in order if nid in used_nodes])
+        if strategy == "STRICT_SPREAD":
+            candidates = [nid for nid in order if nid not in used_nodes]
+        for nid in candidates:
+            if _fits(b, avail[nid]):
+                for k, v in b.items():
+                    avail[nid][k] -= v
+                placed = nid
+                used_nodes.add(nid)
+                break
+        if placed is None:
+            return None
+        assignment.append(placed)
+    return assignment

@@ -1,0 +1,123 @@
+"""Binary layout for objects in the shared-memory store.
+
+Reference analog: plasma's data+metadata split (``plasma.fbs``) combined
+with Ray's Pickle5 out-of-band serialization
+(``python/ray/_private/serialization.py``). Layout:
+
+    [u8 flags][u64 n_sections][u64 len_0 .. len_{n-1}][section bytes ...]
+
+Section 0 is the pickle meta stream; sections 1..n-1 are out-of-band
+buffers. Reads are zero-copy: sections are sliced views of the shm mapping
+handed to pickle as PickleBuffers.
+
+flags bit 0: error object (deserialized value is an exception to raise).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+
+from ray_tpu.runtime.serialization import SerializedObject, deserialize, serialize
+
+_U64 = struct.Struct("<Q")
+FLAG_ERROR = 1
+
+
+def encoded_size(obj: SerializedObject) -> int:
+    n = 1 + len(obj.buffers)
+    return 1 + 8 + 8 * n + len(obj.meta) + sum(
+        memoryview(b).nbytes for b in obj.buffers)
+
+
+def encode_into(buf: memoryview, obj: SerializedObject, *, is_error: bool = False):
+    """Write the object into a writable view (from ShmObjectStore.create)."""
+    sections = [obj.meta] + [memoryview(b).cast("B") for b in obj.buffers]
+    buf[0] = FLAG_ERROR if is_error else 0
+    off = 1
+    buf[off:off + 8] = _U64.pack(len(sections))
+    off += 8
+    for s in sections:
+        buf[off:off + 8] = _U64.pack(memoryview(s).nbytes)
+        off += 8
+    for s in sections:
+        s = memoryview(s).cast("B")
+        buf[off:off + s.nbytes] = s
+        off += s.nbytes
+
+
+def decode_view(view: memoryview):
+    """(value, is_error) from a read-only store view — zero-copy buffers."""
+    flags = view[0]
+    off = 1
+    (n,) = _U64.unpack(view[off:off + 8])
+    off += 8
+    lens = []
+    for _ in range(n):
+        (ln,) = _U64.unpack(view[off:off + 8])
+        off += 8
+        lens.append(ln)
+    sections = []
+    for ln in lens:
+        sections.append(view[off:off + ln])
+        off += ln
+    meta = bytes(sections[0])
+    value = deserialize(SerializedObject(meta=meta, buffers=sections[1:]))
+    return value, bool(flags & FLAG_ERROR)
+
+
+def put_value(store, object_id: bytes, value, *, is_error: bool = False) -> int:
+    """Serialize + write + seal into a ShmObjectStore. Returns byte size.
+
+    First-write-wins: if the object already exists (e.g. a restarted actor
+    re-running its creation task, or racing error/result writers), the put
+    is a no-op returning 0 — consumers observe whichever write sealed first,
+    matching the local-mode store's semantics."""
+    from ray_tpu._private.shm_store import ObjectExistsError
+
+    obj = serialize(value)
+    size = encoded_size(obj)
+    try:
+        buf = store.create(object_id, size)
+    except ObjectExistsError:
+        return 0
+    try:
+        encode_into(buf, obj, is_error=is_error)
+    finally:
+        del buf
+    store.seal(object_id)
+    return size
+
+
+def get_value(store, object_id: bytes, timeout_ms: int = -1):
+    """Read + deserialize. Returns (value, is_error).
+
+    NOTE: the materialized value may alias shm (zero-copy numpy); the store
+    refcount is dropped after deserialization, which copies for small
+    objects; large arrays keep the view alive via the buffer protocol."""
+    view = store.get(object_id, timeout_ms=timeout_ms)
+    try:
+        return decode_view(view)
+    finally:
+        del view
+        store.release(object_id)
+
+
+def raw_bytes(store, object_id: bytes, timeout_ms: int = -1) -> bytes:
+    """Copy the full encoded object (for node-to-node transfer)."""
+    view = store.get(object_id, timeout_ms=timeout_ms)
+    try:
+        return bytes(view)
+    finally:
+        del view
+        store.release(object_id)
+
+
+def put_raw(store, object_id: bytes, payload: bytes):
+    """Write pre-encoded bytes (receiving side of a transfer)."""
+    buf = store.create(object_id, len(payload))
+    try:
+        buf[:] = payload
+    finally:
+        del buf
+    store.seal(object_id)

@@ -1,0 +1,609 @@
+"""Raylet: per-node manager — scheduler, worker pool, object manager.
+
+Reference analog: ``src/ray/raylet/`` — ``NodeManager`` (node_manager.h:125)
+on one event loop hosting the local scheduler (``ClusterTaskManager`` /
+``LocalTaskManager``), the worker pool (``worker_pool.cc``), and the object
+manager (``src/ray/object_manager/`` — pull/push of objects between nodes).
+
+Differences by design (TPU-host build, single-controller Python services):
+- workers attach the node's C++ shm store directly (no UDS protocol hop);
+- spillback consults the GCS resource view instead of gossiped snapshots
+  (the ray_syncer analog is the heartbeat's available-resources report);
+- node-to-node object transfer is a pull-only fetch RPC (the reference's
+  PushManager handles proactive pushes; pull covers get()/dependency flow).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu._private.shm_store import ObjectNotFoundError, ShmObjectStore
+from ray_tpu.runtime import object_codec
+from ray_tpu.runtime.gcs import _fits
+from ray_tpu.runtime.rpc import RpcClient, RpcServer, recv_msg, send_msg
+from ray_tpu.utils.ids import WorkerID
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: str
+    proc: subprocess.Popen | None = None
+    conn: Any = None            # held task-channel socket
+    send_lock: Any = None
+    state: str = "starting"     # starting | idle | busy | actor | dead
+    actor_id: str | None = None
+    incarnation: int = 0
+    current_task: dict | None = None
+    acquired: dict = field(default_factory=dict)
+
+
+class Raylet(RpcServer):
+    def __init__(self, *, node_id: str, gcs_address, resources: dict,
+                 store_capacity: int = 1 << 30, host: str = "127.0.0.1",
+                 labels: dict | None = None, heartbeat_interval_s: float = 0.5):
+        super().__init__(host, 0)
+        self.node_id = node_id
+        self.gcs_address = tuple(gcs_address)
+        self.store_name = f"/raytpu_{os.getpid()}_{node_id[:8]}"
+        self.store = ShmObjectStore(self.store_name, capacity=store_capacity,
+                                    create=True)
+        self.total_resources = dict(resources)
+        self.available = dict(resources)
+        self.labels = labels or {}
+        self._res_lock = threading.Lock()
+
+        self._gcs = RpcClient(self.gcs_address)
+        self._gcs_lock = threading.Lock()   # RpcClient is thread-safe; lock
+                                            # keeps call+interpret atomic
+        self._peers: dict[str, RpcClient] = {}
+        self._peer_addrs: dict[str, tuple] = {}
+        self._peers_lock = threading.Lock()
+
+        self._workers: dict[str, WorkerHandle] = {}
+        self._workers_lock = threading.Lock()
+        self._max_workers = max(1, int(resources.get("CPU", 1)))
+        self._ready: deque[dict] = deque()
+        self._ready_cv = threading.Condition()
+        self._hb_interval = heartbeat_interval_s
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self):
+        super().start()
+        with self._gcs_lock:
+            self._gcs.call(
+                "register_node", node_id=self.node_id, address=self.address,
+                store_name=self.store_name, resources=self.total_resources,
+                labels=self.labels)
+        for target in (self._dispatch_loop, self._heartbeat_loop,
+                       self._monitor_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        super().stop()
+        with self._workers_lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.terminate()
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=2)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+        self.store.close()
+
+    # ------------------------------------------------------------------
+    # worker pool (reference: worker_pool.cc — spawn, registration
+    # handshake, idle caching)
+    # ------------------------------------------------------------------
+
+    def _spawn_worker(self) -> WorkerHandle:
+        worker_id = WorkerID.from_random().hex()
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
+        env.update({
+            "RAY_TPU_RAYLET_HOST": self.address[0],
+            "RAY_TPU_RAYLET_PORT": str(self.address[1]),
+            "RAY_TPU_GCS_HOST": self.gcs_address[0],
+            "RAY_TPU_GCS_PORT": str(self.gcs_address[1]),
+            "RAY_TPU_STORE_NAME": self.store_name,
+            "RAY_TPU_WORKER_ID": worker_id,
+            "RAY_TPU_NODE_ID": self.node_id,
+            # workers never touch the TPU tunnel unless told to
+            "JAX_PLATFORMS": env_get_default("JAX_PLATFORMS", "cpu"),
+        })
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.runtime.worker_main"],
+            env=env, cwd=os.getcwd(),
+        )
+        handle = WorkerHandle(worker_id=worker_id, proc=proc)
+        with self._workers_lock:
+            self._workers[worker_id] = handle
+        return handle
+
+    def rpc_register_worker(self, conn, send_lock, *, worker_id):
+        """Registration handshake; the connection becomes the raylet→worker
+        task channel and worker→raylet completion stream."""
+        with self._workers_lock:
+            handle = self._workers.get(worker_id)
+            if handle is None:   # externally started worker (tests)
+                handle = WorkerHandle(worker_id=worker_id)
+                self._workers[worker_id] = handle
+            handle.conn = conn
+            handle.send_lock = send_lock
+            if handle.state == "starting":
+                # actor-designated workers keep their "actor" state — the
+                # dispatcher must never hand them normal tasks
+                handle.state = "idle"
+        send_msg(conn, {"registered": True}, send_lock)
+        self._kick_dispatch()
+        try:
+            while not self._stopping:
+                try:
+                    msg = recv_msg(conn)
+                except (OSError, EOFError, Exception):
+                    break
+                self._on_worker_msg(handle, msg)
+        finally:
+            self._on_worker_gone(handle)
+        return RpcServer.HELD
+
+    def _on_worker_msg(self, w: WorkerHandle, msg: dict):
+        kind = msg.get("type")
+        if kind == "task_done":
+            self._finish_task(w, msg)
+        elif kind == "actor_ready":
+            with self._gcs_lock:
+                self._gcs.call("actor_ready", actor_id=msg["actor_id"],
+                               node_id=self.node_id)
+        elif kind == "actor_creation_failed":
+            with self._gcs_lock:
+                self._gcs.call("actor_failed", actor_id=msg["actor_id"],
+                               reason=msg.get("reason", "creation failed"))
+        elif kind == "object_put":
+            with self._gcs_lock:
+                self._gcs.call("add_object_location", oid=msg["oid"],
+                               node_id=self.node_id,
+                               size=msg.get("size", 0))
+
+    def _finish_task(self, w: WorkerHandle, msg: dict):
+        self._release(w.acquired)
+        w.acquired = {}
+        w.current_task = None
+        if w.state == "busy":
+            w.state = "idle"
+        self._kick_dispatch()
+
+    def _on_worker_gone(self, w: WorkerHandle):
+        """Worker process/channel died (reference: NodeManager worker failure
+        path — in-flight task gets retried or an error object)."""
+        if self._stopping:
+            return
+        with self._workers_lock:
+            self._workers.pop(w.worker_id, None)
+        # reclaim created-but-unsealed allocations and pinned read refs of
+        # the dead worker only (live writers/readers are untouched)
+        if w.proc is not None and w.proc.pid:
+            self.store.evict_orphans(w.proc.pid)
+            self.store.release_pid(w.proc.pid)
+        task = w.current_task
+        self._release(w.acquired)
+        w.acquired = {}
+        if w.state == "actor" and w.actor_id is not None:
+            try:
+                with self._gcs_lock:
+                    self._gcs.call(
+                        "actor_failed", actor_id=w.actor_id,
+                        reason=f"actor worker {w.worker_id[:8]} died")
+            except Exception:  # noqa: BLE001 - gcs may be shutting down
+                pass
+        elif task is not None:
+            if task.get("max_retries", 0) > 0:
+                task["max_retries"] -= 1
+                self._enqueue(task)
+            else:
+                self._store_task_error(
+                    task, RuntimeError(
+                        f"worker died executing {task.get('name')}"))
+        w.state = "dead"
+
+    def _store_task_error(self, task: dict, error: BaseException):
+        from ray_tpu.utils import exceptions as exc
+        err = exc.WorkerCrashedError(str(error))
+        for oid_hex in task.get("return_oids", ()):
+            oid = bytes.fromhex(oid_hex)
+            if not self.store.contains(oid):
+                try:
+                    size = object_codec.put_value(self.store, oid, err,
+                                                  is_error=True)
+                except Exception:  # noqa: BLE001 - already created etc.
+                    continue
+                with self._gcs_lock:
+                    self._gcs.call("add_object_location", oid=oid_hex,
+                                   node_id=self.node_id, size=size)
+
+    # ------------------------------------------------------------------
+    # scheduling (reference: ClusterTaskManager::QueueAndScheduleTask +
+    # LocalTaskManager dispatch; spillback via GCS view)
+    # ------------------------------------------------------------------
+
+    def rpc_submit_task(self, conn, send_lock, *, task: dict,
+                        spill_count: int = 0):
+        demand = task.get("resources", {})
+        strategy = task.get("strategy", {})
+        if strategy.get("kind") == "NODE_AFFINITY":
+            target = strategy.get("node_id")
+            if target and target != self.node_id:
+                if self._forward(task, target, spill_count):
+                    return {"ok": True, "node_id": target}
+        if not _fits(demand, self.total_resources) or (
+                strategy.get("kind") == "SPREAD" and spill_count == 0):
+            # infeasible here (or spread): ask GCS for a placement
+            with self._gcs_lock:
+                target = self._gcs.call(
+                    "pick_node", demand=demand,
+                    exclude=[] if _fits(demand, self.total_resources)
+                    else [self.node_id],
+                    pg_id=strategy.get("pg_id"))
+            if target is not None and target != self.node_id:
+                if self._forward(task, target, spill_count):
+                    return {"ok": True, "node_id": target}
+            if not _fits(demand, self.total_resources):
+                self._store_task_error(task, ValueError(
+                    f"task {task.get('name')} demands {demand}: infeasible"))
+                return {"ok": False, "reason": "infeasible"}
+        elif spill_count < 2 and not _fits(demand, self._avail_snapshot()):
+            # busy here: one spillback attempt through the GCS view
+            with self._gcs_lock:
+                target = self._gcs.call("pick_node", demand=demand,
+                                        exclude=[self.node_id],
+                                        pg_id=strategy.get("pg_id"))
+            if target is not None and target != self.node_id:
+                if self._forward(task, target, spill_count + 1):
+                    return {"ok": True, "node_id": target}
+        self._enqueue(task)
+        return {"ok": True, "node_id": self.node_id}
+
+    def _forward(self, task: dict, node_id: str, spill_count: int) -> bool:
+        peer = self._peer(node_id)
+        if peer is None:
+            return False
+        try:
+            peer.call("submit_task", task=task, spill_count=spill_count + 1)
+            return True
+        except Exception:  # noqa: BLE001 - peer died; fall back local
+            return False
+
+    def _peer(self, node_id: str) -> RpcClient | None:
+        with self._peers_lock:
+            client = self._peers.get(node_id)
+        if client is not None:
+            return client
+        with self._gcs_lock:
+            nodes = self._gcs.call("get_nodes", alive_only=True)
+        for n in nodes:
+            if n["node_id"] == node_id:
+                try:
+                    client = RpcClient(n["address"])
+                except OSError:
+                    return None
+                with self._peers_lock:
+                    self._peers[node_id] = client
+                    self._peer_addrs[node_id] = tuple(n["address"])
+                return client
+        return None
+
+    def _enqueue(self, task: dict):
+        with self._ready_cv:
+            self._ready.append(task)
+            self._ready_cv.notify()
+
+    def _kick_dispatch(self):
+        with self._ready_cv:
+            self._ready_cv.notify()
+
+    def _avail_snapshot(self) -> dict:
+        with self._res_lock:
+            return dict(self.available)
+
+    def _try_acquire(self, demand: dict) -> bool:
+        with self._res_lock:
+            if not _fits(demand, self.available):
+                return False
+            for k, v in demand.items():
+                self.available[k] = self.available.get(k, 0.0) - v
+            return True
+
+    def _release(self, demand: dict):
+        if not demand:
+            return
+        with self._res_lock:
+            for k, v in demand.items():
+                self.available[k] = self.available.get(k, 0.0) + v
+
+    def _dispatch_loop(self):
+        while not self._stopping:
+            with self._ready_cv:
+                while not self._ready and not self._stopping:
+                    self._ready_cv.wait(timeout=0.2)
+                if self._stopping:
+                    return
+                task = None
+                # first task whose resources fit (avoid head-of-line block)
+                for i, t in enumerate(self._ready):
+                    if _fits(t.get("resources", {}), self._avail_snapshot()):
+                        task = t
+                        del self._ready[i]
+                        break
+                if task is None:
+                    self._ready_cv.wait(timeout=0.1)
+                    continue
+            worker = self._idle_worker()
+            if worker is None:
+                self._enqueue(task)
+                time.sleep(0.01)
+                continue
+            if not self._try_acquire(task.get("resources", {})):
+                worker.state = "idle"
+                self._enqueue(task)
+                continue
+            worker.acquired = dict(task.get("resources", {}))
+            worker.current_task = task
+            try:
+                send_msg(worker.conn, {"type": "task", "task": task},
+                         worker.send_lock)
+            except OSError:
+                self._on_worker_gone(worker)
+                self._enqueue(task)
+
+    def _idle_worker(self) -> WorkerHandle | None:
+        """Grab an idle registered worker; spawn when under the cap."""
+        with self._workers_lock:
+            n_alive = 0
+            for w in self._workers.values():
+                if w.state in ("idle", "busy", "starting", "actor"):
+                    n_alive += 1
+                if w.state == "idle" and w.conn is not None:
+                    w.state = "busy"
+                    return w
+            if n_alive < self._max_workers:
+                spawn = True
+            else:
+                spawn = False
+        if spawn:
+            self._spawn_worker()
+        return None
+
+    # ------------------------------------------------------------------
+    # actors (GCS calls host_actor; raylet dedicates a worker)
+    # ------------------------------------------------------------------
+
+    def rpc_host_actor(self, conn, send_lock, *, actor_id, spec,
+                       incarnation=0):
+        """Dedicate a fresh worker to the actor and hand it the creation
+        task (reference: GcsActorScheduler::LeaseWorkerFromNode + the
+        worker-lease machinery in node_manager.cc:1778)."""
+        demand = spec.get("resources", {})
+        if not self._try_acquire(demand):
+            raise RuntimeError(
+                f"node {self.node_id} cannot host actor: {demand} unavailable")
+        handle = self._spawn_worker()
+        handle.state = "actor"
+        handle.actor_id = actor_id
+        handle.incarnation = incarnation
+        handle.acquired = dict(demand)
+
+        def _deliver():
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not self._stopping:
+                if handle.conn is not None:
+                    try:
+                        send_msg(handle.conn,
+                                 {"type": "create_actor", "actor_id": actor_id,
+                                  "task": spec}, handle.send_lock)
+                    except OSError:
+                        self._on_worker_gone(handle)
+                    return
+                if handle.proc is not None and handle.proc.poll() is not None:
+                    break
+                time.sleep(0.01)
+            with self._gcs_lock:
+                self._gcs.call("actor_failed", actor_id=actor_id,
+                               reason="actor worker failed to register")
+        threading.Thread(target=_deliver, daemon=True).start()
+        return {"ok": True}
+
+    def rpc_submit_actor_task(self, conn, send_lock, *, task: dict):
+        actor_id = task["actor_id"]
+        with self._workers_lock:
+            target = None
+            for w in self._workers.values():
+                if w.actor_id == actor_id and w.state == "actor":
+                    target = w
+                    break
+        if target is None or target.conn is None:
+            raise LookupError(f"actor {actor_id} not hosted here")
+        if task.get("incarnation", 0) != target.incarnation:
+            # caller's seq numbering belongs to a previous incarnation —
+            # reject so it refreshes (reference: client resend protocol)
+            raise LookupError(
+                f"actor {actor_id} incarnation mismatch "
+                f"(task {task.get('incarnation')} != {target.incarnation})")
+        send_msg(target.conn, {"type": "actor_task", "task": task},
+                 target.send_lock)
+        return {"ok": True}
+
+    def rpc_kill_actor_worker(self, conn, send_lock, *, actor_id):
+        with self._workers_lock:
+            target = None
+            for w in self._workers.values():
+                if w.actor_id == actor_id:
+                    target = w
+                    break
+        if target is not None and target.proc is not None:
+            target.proc.terminate()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # object manager (reference: object_manager.cc Push/HandlePush +
+    # PullManager; pull-only here)
+    # ------------------------------------------------------------------
+
+    def rpc_fetch_object(self, conn, send_lock, *, oid: str):
+        """Return the encoded object bytes from the local store."""
+        return object_codec.raw_bytes(self.store, bytes.fromhex(oid),
+                                      timeout_ms=0)
+
+    def rpc_ensure_local(self, conn, send_lock, *, oids: list,
+                         timeout_s: float = 30.0):
+        """Make objects locally readable, pulling from peers as needed.
+        Returns the list of oids that could NOT be made local in time."""
+        deadline = time.monotonic() + timeout_s
+        missing = [o for o in oids
+                   if not self.store.contains(bytes.fromhex(o))]
+        while missing and time.monotonic() < deadline:
+            still = []
+            for oid_hex in missing:
+                oid = bytes.fromhex(oid_hex)
+                if self.store.contains(oid):
+                    continue
+                if not self._pull(oid_hex):
+                    still.append(oid_hex)
+            missing = still
+            if missing:
+                time.sleep(0.02)
+        return missing
+
+    def _pull(self, oid_hex: str) -> bool:
+        with self._gcs_lock:
+            locs = self._gcs.call("get_object_locations",
+                                  oids=[oid_hex])[oid_hex]
+        for node_id in locs:
+            if node_id == self.node_id:
+                return self.store.contains(bytes.fromhex(oid_hex))
+            peer = self._peer(node_id)
+            if peer is None:
+                continue
+            try:
+                payload = peer.call("fetch_object", oid=oid_hex)
+            except Exception:  # noqa: BLE001 - peer busy/dead; try next
+                continue
+            oid = bytes.fromhex(oid_hex)
+            if not self.store.contains(oid):
+                try:
+                    object_codec.put_raw(self.store, oid, payload)
+                except Exception:  # noqa: BLE001 - racing pull
+                    pass
+            with self._gcs_lock:
+                self._gcs.call("add_object_location", oid=oid_hex,
+                               node_id=self.node_id, size=len(payload))
+            return True
+        return False
+
+    def rpc_node_info(self, conn, send_lock):
+        return {"node_id": self.node_id, "store_name": self.store_name,
+                "address": self.address, "resources": self.total_resources,
+                "available": self._avail_snapshot(),
+                "num_workers": len(self._workers)}
+
+    # ------------------------------------------------------------------
+    # background loops
+    # ------------------------------------------------------------------
+
+    def _heartbeat_loop(self):
+        while not self._stopping:
+            time.sleep(self._hb_interval)
+            try:
+                with self._gcs_lock:
+                    reply = self._gcs.call("heartbeat", node_id=self.node_id,
+                                           available=self._avail_snapshot())
+                if reply.get("reregister"):
+                    with self._gcs_lock:
+                        self._gcs.call(
+                            "register_node", node_id=self.node_id,
+                            address=self.address, store_name=self.store_name,
+                            resources=self.total_resources,
+                            labels=self.labels)
+            except Exception:  # noqa: BLE001 - gcs down; keep trying
+                pass
+
+    def _monitor_loop(self):
+        """Reap dead worker processes (reference: worker failure detection
+        via socket + SIGCHLD in NodeManager)."""
+        while not self._stopping:
+            time.sleep(0.1)
+            with self._workers_lock:
+                dead = [w for w in self._workers.values()
+                        if w.proc is not None and w.proc.poll() is not None
+                        and w.state != "dead"]
+            for w in dead:
+                self._on_worker_gone(w)
+
+
+def env_get_default(key: str, default: str) -> str:
+    v = os.environ.get(key)
+    return v if v else default
+
+
+def _worker_pythonpath(current: str) -> str:
+    """PYTHONPATH for spawned workers: the ray_tpu package root plus the
+    inherited entries, minus directories that install a ``sitecustomize``
+    hook — such hooks (e.g. a driver-side TPU tunnel plugin) eagerly import
+    heavyweight runtimes and add seconds to EVERY worker spawn. Set
+    RAY_TPU_WORKER_KEEP_SITE=1 to keep them (workers that must dial the
+    TPU backend through the site hook)."""
+    import ray_tpu
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        ray_tpu.__file__)))
+    entries = [pkg_root]
+    keep_site = os.environ.get("RAY_TPU_WORKER_KEEP_SITE") == "1"
+    for p in current.split(os.pathsep):
+        if not p or p == pkg_root:
+            continue
+        if not keep_site and os.path.exists(
+                os.path.join(p, "sitecustomize.py")):
+            continue
+        entries.append(p)
+    return os.pathsep.join(entries)
+
+
+def main():  # runs a raylet as a standalone process (cluster_utils spawns it)
+    import json
+    cfg = json.loads(sys.argv[1])
+    raylet = Raylet(
+        node_id=cfg["node_id"],
+        gcs_address=tuple(cfg["gcs_address"]),
+        resources=cfg["resources"],
+        store_capacity=cfg.get("store_capacity", 1 << 30),
+        labels=cfg.get("labels"),
+    )
+    raylet.start()
+    # signal readiness to the parent via stdout
+    print(json.dumps({"address": raylet.address,
+                      "store_name": raylet.store_name}), flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        raylet.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,246 @@
+"""Minimal threaded RPC over TCP: length-prefixed pickled messages.
+
+Reference analog: ``src/ray/rpc/`` (async gRPC server/client templates).
+Wire format: 8-byte big-endian length + pickled payload. Two interaction
+shapes, mirroring the reference's usage:
+
+- request/response: ``RpcClient.call(method, **kwargs)`` — blocking, safe
+  from many threads (per-call matching via request ids).
+- server push: a connection can be promoted to a push channel (pubsub long
+  poll analog, ``src/ray/pubsub/``) — the server holds it and writes
+  messages; the client runs a reader thread delivering to a callback.
+
+All services in the cluster plane (GCS, raylet) are ``RpcServer`` subclasses
+exposing ``rpc_<method>`` handlers; handlers run on a thread per connection.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable
+
+_LEN = struct.Struct(">Q")
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+def send_msg(sock: socket.socket, obj: Any, lock: threading.Lock | None = None):
+    data = pickle.dumps(obj, protocol=5)
+    frame = _LEN.pack(len(data)) + data
+    if lock:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    return pickle.loads(_recv_exact(sock, length))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionLost("peer closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class RpcServer:
+    """Threaded TCP server; dispatches ``{"method": m, ...}`` requests to
+    ``self.rpc_<m>(conn, **payload)``. A handler may return
+    ``HELD`` to take ownership of the connection (push channels)."""
+
+    HELD = object()
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(256)
+        self.address = self._sock.getsockname()
+        self._stopping = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"{type(self).__name__}-accept",
+            daemon=True,
+        )
+
+    def start(self):
+        self._accept_thread.start()
+        return self
+
+    def stop(self):
+        self._stopping = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self):
+        while not self._stopping:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        send_lock = threading.Lock()
+        try:
+            while not self._stopping:
+                try:
+                    req = recv_msg(conn)
+                except (ConnectionLost, OSError, EOFError):
+                    return
+                req_id = req.pop("_id", None)
+                method = req.pop("method")
+                handler = getattr(self, f"rpc_{method}", None)
+                try:
+                    if handler is None:
+                        raise AttributeError(f"no rpc method {method!r}")
+                    result = handler(conn, send_lock, **req)
+                except BaseException as e:  # noqa: BLE001 - ship to caller
+                    try:
+                        send_msg(conn, {"_id": req_id, "error": e}, send_lock)
+                    except (OSError, pickle.PicklingError):
+                        send_msg(conn,
+                                 {"_id": req_id,
+                                  "error": RuntimeError(repr(e))},
+                                 send_lock)
+                    continue
+                if result is RpcServer.HELD:
+                    return  # handler owns the connection now
+                send_msg(conn, {"_id": req_id, "result": result}, send_lock)
+        finally:
+            if not self._stopping:
+                self.on_disconnect(conn)
+
+    def on_disconnect(self, conn: socket.socket):
+        """Override: called when a non-held connection drops."""
+
+
+class RpcClient:
+    """Blocking request/response client, thread-safe, auto-reconnect off."""
+
+    def __init__(self, address: tuple[str, int], timeout: float | None = None):
+        self.address = tuple(address)
+        self._sock = socket.create_connection(self.address, timeout=30)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(timeout)
+        self._send_lock = threading.Lock()
+        self._pending: dict[int, list] = {}  # id -> [event, reply]
+        self._pending_lock = threading.Lock()
+        self._next_id = 0
+        self._reader_started = False
+        self._closed = False
+
+    def _ensure_reader(self):
+        if not self._reader_started:
+            self._reader_started = True
+            threading.Thread(target=self._read_loop, daemon=True).start()
+
+    def _read_loop(self):
+        while not self._closed:
+            try:
+                msg = recv_msg(self._sock)
+            except (ConnectionLost, OSError, EOFError):
+                with self._pending_lock:
+                    pending = list(self._pending.values())
+                    self._pending.clear()
+                    self._closed = True
+                for ev_reply in pending:
+                    ev_reply[1] = {"error": ConnectionLost(
+                        f"connection to {self.address} lost")}
+                    ev_reply[0].set()
+                return
+            msg_id = msg.get("_id")
+            with self._pending_lock:
+                ev_reply = self._pending.pop(msg_id, None)
+            if ev_reply is not None:
+                ev_reply[1] = msg
+                ev_reply[0].set()
+
+    def call(self, method: str, timeout: float | None = None, **kwargs):
+        if self._closed:
+            raise ConnectionLost(f"client to {self.address} closed")
+        self._ensure_reader()
+        with self._pending_lock:
+            msg_id = self._next_id
+            self._next_id += 1
+            ev_reply = [threading.Event(), None]
+            self._pending[msg_id] = ev_reply
+        kwargs["method"] = method
+        kwargs["_id"] = msg_id
+        send_msg(self._sock, kwargs, self._send_lock)
+        if not ev_reply[0].wait(timeout=timeout):
+            with self._pending_lock:
+                self._pending.pop(msg_id, None)
+            raise TimeoutError(f"rpc {method} timed out after {timeout}s")
+        reply = ev_reply[1]
+        if "error" in reply:
+            raise reply["error"]
+        return reply["result"]
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PushSubscriber:
+    """Client side of a server-push channel (pubsub subscribe)."""
+
+    def __init__(self, address: tuple[str, int], subscribe_msg: dict,
+                 callback: Callable[[Any], None]):
+        self._sock = socket.create_connection(tuple(address), timeout=30)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._callback = callback
+        self._closed = False
+        send_msg(self._sock, subscribe_msg)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._closed:
+            try:
+                msg = recv_msg(self._sock)
+            except (ConnectionLost, OSError, EOFError):
+                return
+            try:
+                self._callback(msg)
+            except Exception:  # noqa: BLE001 - subscriber errors are isolated
+                pass
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def wait_for_port(address: tuple[str, int], timeout: float = 10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(tuple(address), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.05)
+    raise TimeoutError(f"server at {address} not reachable")

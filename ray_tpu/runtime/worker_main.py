@@ -1,0 +1,230 @@
+"""Worker process main loop.
+
+Reference analog: ``python/ray/_private/workers/default_worker.py`` +
+the execution callback ``execute_task`` in ``_raylet.pyx:1457``. The worker
+registers with its raylet (handshake: ``worker_pool.cc``), then serves tasks
+pushed over the registration channel:
+
+- ``task``: a normal task — resolve args, run, store returns in shm.
+- ``create_actor``: instantiate and pin the actor instance; subsequent
+  ``actor_task`` messages run methods in per-caller submission order
+  (reference: SequentialActorSubmitQueue + ActorSchedulingQueue).
+
+Objects are read/written via direct shm attach (zero-copy); the raylet is
+informed of each put so it can register locations with the GCS.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from collections import defaultdict
+
+import cloudpickle
+
+from ray_tpu._private.shm_store import ShmObjectStore
+from ray_tpu.runtime import object_codec
+from ray_tpu.runtime.rpc import RpcClient, recv_msg, send_msg
+from ray_tpu.utils import exceptions as exc
+
+
+class Worker:
+    def __init__(self):
+        host = os.environ["RAY_TPU_RAYLET_HOST"]
+        port = int(os.environ["RAY_TPU_RAYLET_PORT"])
+        self.worker_id = os.environ["RAY_TPU_WORKER_ID"]
+        self.node_id = os.environ["RAY_TPU_NODE_ID"]
+        self.raylet_addr = (host, port)
+        self.store = ShmObjectStore(os.environ["RAY_TPU_STORE_NAME"])
+        # control client: request/response to the raylet (ensure_local etc.)
+        self.ctrl = RpcClient(self.raylet_addr)
+        # task channel: registered held connection
+        import socket as _socket
+        self.chan = _socket.create_connection(self.raylet_addr)
+        self.chan.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        self.chan_lock = threading.Lock()
+        send_msg(self.chan, {"method": "register_worker",
+                             "worker_id": self.worker_id})
+        reply = recv_msg(self.chan)
+        assert reply.get("registered"), reply
+        # actor state
+        self.actor_instance = None
+        self.actor_id = None
+        self._seq_lock = threading.Lock()
+        self._next_seq = defaultdict(int)       # caller -> next seq
+        self._seq_buffer = defaultdict(dict)    # caller -> {seq: task}
+
+    # ------------------------------------------------------------------
+
+    def run(self):
+        while True:
+            try:
+                msg = recv_msg(self.chan)
+            except Exception:  # raylet gone -> exit
+                return
+            kind = msg.get("type")
+            if kind == "task":
+                self._execute(msg["task"])
+                self._send({"type": "task_done",
+                            "task_id": msg["task"].get("task_id")})
+            elif kind == "create_actor":
+                self._create_actor(msg["actor_id"], msg["task"])
+            elif kind == "actor_task":
+                self._enqueue_actor_task(msg["task"])
+            elif kind == "exit":
+                return
+
+    def _send(self, msg: dict):
+        try:
+            send_msg(self.chan, msg, self.chan_lock)
+        except OSError:
+            os._exit(1)
+
+    # ------------------------------------------------------------------
+    # argument / result plumbing
+    # ------------------------------------------------------------------
+
+    def _resolve_args(self, task: dict):
+        args, kwargs = cloudpickle.loads(task["args_blob"])
+        dep_oids = [a[1] for a in _iter_markers(args, kwargs)]
+        if dep_oids:
+            missing = self.ctrl.call("ensure_local", oids=dep_oids,
+                                     timeout_s=60.0)
+            if missing:
+                raise exc.ObjectLostError(missing[0], "dependency not found")
+        values = {}
+        for _, oid_hex in _iter_markers(args, kwargs):
+            value, is_error = object_codec.get_value(
+                self.store, bytes.fromhex(oid_hex), timeout_ms=0)
+            if is_error:
+                raise value
+            values[oid_hex] = value
+        args = [values[a[1]] if _is_marker(a) else a for a in args]
+        kwargs = {k: values[v[1]] if _is_marker(v) else v
+                  for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _store_returns(self, task: dict, result):
+        return_oids = task["return_oids"]
+        if len(return_oids) == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != len(return_oids):
+                raise ValueError(
+                    f"task declared {len(return_oids)} returns, got "
+                    f"{len(values)}")
+        for oid_hex, value in zip(return_oids, values):
+            size = object_codec.put_value(
+                self.store, bytes.fromhex(oid_hex), value)
+            self._send({"type": "object_put", "oid": oid_hex, "size": size})
+
+    def _store_error(self, task: dict, error: BaseException):
+        for oid_hex in task["return_oids"]:
+            oid = bytes.fromhex(oid_hex)
+            if self.store.contains(oid):
+                continue
+            try:
+                size = object_codec.put_value(self.store, oid, error,
+                                              is_error=True)
+            except Exception:  # noqa: BLE001 - unpicklable exception
+                size = object_codec.put_value(
+                    self.store, oid,
+                    exc.TaskError(task.get("name", "?"),
+                                  RuntimeError(repr(error))),
+                    is_error=True)
+            self._send({"type": "object_put", "oid": oid_hex, "size": size})
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+
+    def _execute(self, task: dict):
+        try:
+            fn = cloudpickle.loads(task["function_blob"])
+            args, kwargs = self._resolve_args(task)
+        except BaseException as e:  # noqa: BLE001
+            self._store_error(task, e)
+            return
+        try:
+            result = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            self._store_error(
+                task, exc.TaskError(task.get("name", "?"), e,
+                                    tb=traceback.format_exc()))
+            return
+        try:
+            self._store_returns(task, result)
+        except BaseException as e:  # noqa: BLE001
+            self._store_error(task, e)
+
+    def _create_actor(self, actor_id: str, task: dict):
+        try:
+            cls = cloudpickle.loads(task["function_blob"])
+            args, kwargs = self._resolve_args(task)
+            self.actor_instance = cls(*args, **kwargs)
+            self.actor_id = actor_id
+        except BaseException as e:  # noqa: BLE001
+            self._send({"type": "actor_creation_failed",
+                        "actor_id": actor_id,
+                        "reason": f"{type(e).__name__}: {e}"})
+            self._store_error(task, exc.ActorDiedError(
+                actor_id, f"__init__ failed: {e!r}"))
+            self._send({"type": "task_done", "task_id": task.get("task_id")})
+            os._exit(1)
+        self._store_returns(task, None)
+        self._send({"type": "actor_ready", "actor_id": actor_id})
+        self._send({"type": "task_done", "task_id": task.get("task_id")})
+
+    def _enqueue_actor_task(self, task: dict):
+        """Per-caller submission-order execution (sequence buffering)."""
+        caller = task.get("caller_id", "?")
+        seq = task.get("seq", 0)
+        runnable = []
+        with self._seq_lock:
+            self._seq_buffer[caller][seq] = task
+            while self._next_seq[caller] in self._seq_buffer[caller]:
+                t = self._seq_buffer[caller].pop(self._next_seq[caller])
+                self._next_seq[caller] += 1
+                runnable.append(t)
+        for t in runnable:
+            self._run_actor_task(t)
+
+    def _run_actor_task(self, task: dict):
+        try:
+            args, kwargs = self._resolve_args(task)
+            method = getattr(self.actor_instance, task["method_name"])
+            result = method(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            self._store_error(
+                task, exc.TaskError(task.get("name", "?"), e,
+                                    tb=traceback.format_exc()))
+            self._send({"type": "task_done", "task_id": task.get("task_id")})
+            return
+        try:
+            self._store_returns(task, result)
+        except BaseException as e:  # noqa: BLE001
+            self._store_error(task, e)
+        self._send({"type": "task_done", "task_id": task.get("task_id")})
+
+
+def _is_marker(x) -> bool:
+    return (isinstance(x, tuple) and len(x) == 2 and x[0] == "__objref__")
+
+
+def _iter_markers(args, kwargs):
+    for a in args:
+        if _is_marker(a):
+            yield a
+    for v in kwargs.values():
+        if _is_marker(v):
+            yield v
+
+
+def main():
+    Worker().run()
+
+
+if __name__ == "__main__":
+    main()

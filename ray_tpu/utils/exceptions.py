@@ -29,6 +29,21 @@ class TaskError(RayTpuError):
             f"--- remote traceback ---\n{self.remote_traceback}"
         )
 
+    def __reduce__(self):
+        # Exception's default reduce passes the formatted message as *args,
+        # which does not match this __init__ — rebuild from fields (the
+        # cause may itself be unpicklable; degrade to its repr).
+        try:
+            import pickle
+
+            # round-trip: exceptions commonly fail at LOAD time (custom
+            # __init__ signatures break the default args-based reduce)
+            pickle.loads(pickle.dumps(self.cause))
+            cause = self.cause
+        except Exception:  # noqa: BLE001
+            cause = RuntimeError(repr(self.cause))
+        return (TaskError, (self.function_name, cause, self.remote_traceback))
+
 
 class ActorError(RayTpuError):
     """Base for actor failures."""
@@ -72,6 +87,11 @@ class TaskCancelledError(RayTpuError):
     def __init__(self, task_id=None):
         self.task_id = task_id
         super().__init__(f"Task {task_id} was cancelled")
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly
+    (reference: ``WorkerCrashedError``)."""
 
 
 class OutOfMemoryError(RayTpuError):

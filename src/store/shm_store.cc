@@ -17,9 +17,12 @@
 // Objects are created (writable), sealed (immutable, readers may map), and
 // evicted LRU-wise among sealed refcount==0 entries when allocation fails.
 
+#include <algorithm>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
+#include <utility>
+#include <vector>
 #include <cstring>
 #include <ctime>
 
@@ -43,6 +46,8 @@ enum EntryState : uint32_t {
   kTombstone = 3, // deleted slot (probe chains continue through it)
 };
 
+constexpr uint32_t kReaderSlots = 4;
+
 struct ObjectEntry {
   uint32_t state;
   uint32_t _pad;
@@ -51,6 +56,14 @@ struct ObjectEntry {
   uint64_t data_size;
   uint64_t meta_size;  // metadata bytes appended after data
   uint64_t lru_tick;
+  uint64_t writer_pid;  // creator process; orphan GC is scoped to dead pids
+  // Per-pid reader accounting so refs held by crashed readers can be
+  // reclaimed (reference: plasma's per-client disconnect cleanup). Refs
+  // beyond kReaderSlots distinct pids land in untracked_refs (permanent
+  // until released normally).
+  uint64_t reader_pids[kReaderSlots];
+  uint32_t reader_counts[kReaderSlots];
+  uint64_t untracked_refs;
   uint8_t id[kIdLen];
   uint8_t _pad2[4];
 };
@@ -69,6 +82,7 @@ struct Header {
   uint64_t table_cap;
   uint64_t free_head;      // offset into arena, kNone if empty
   uint64_t lru_clock;
+  uint64_t num_tombstones;
   // stats
   uint64_t bytes_allocated;
   uint64_t num_objects;
@@ -218,40 +232,60 @@ void entry_free(Store* s, ObjectEntry& e) {
   s->hdr->bytes_allocated -= total;
   e.state = kTombstone;
   s->hdr->num_objects--;
+  s->hdr->num_tombstones++;
+}
+
+// Once tombstones dominate, probe chains never hit kEmpty and every lookup
+// degrades to a full-table scan. Rebuild the table in place: copy live
+// entries aside, clear, reinsert. Caller holds the lock.
+void maybe_rehash(Store* s) {
+  Header* h = s->hdr;
+  if (h->num_tombstones < h->table_cap / 4) return;
+  std::vector<ObjectEntry> live;
+  live.reserve(h->num_objects);
+  for (uint64_t i = 0; i < h->table_cap; i++) {
+    if (s->table[i].state == kCreated || s->table[i].state == kSealed)
+      live.push_back(s->table[i]);
+  }
+  memset(s->table, 0, h->table_cap * sizeof(ObjectEntry));
+  for (ObjectEntry& e : live) {
+    uint64_t i = id_hash(e.id) % h->table_cap;
+    while (s->table[i].state != kEmpty) i = (i + 1) % h->table_cap;
+    s->table[i] = e;
+  }
+  h->num_tombstones = 0;
 }
 
 // Evict LRU sealed refcount-0 objects until `needed` bytes can be allocated.
-// Caller holds the lock. Returns true if an eviction happened.
+// Caller holds the lock. Returns true if an eviction happened. One table
+// scan collects candidates LRU-first; victims are freed in order until the
+// allocation fits (avoids rescanning the table per victim).
 bool evict_for(Store* s, uint64_t needed) {
   Header* h = s->hdr;
+  std::vector<std::pair<uint64_t, uint64_t>> candidates;  // (tick, idx)
+  for (uint64_t i = 0; i < h->table_cap; i++) {
+    ObjectEntry& e = s->table[i];
+    if (e.state == kSealed && e.refcount == 0)
+      candidates.emplace_back(e.lru_tick, i);
+  }
+  std::sort(candidates.begin(), candidates.end());
   bool any = false;
-  for (;;) {
-    // try alloc
+  for (auto& [tick, idx] : candidates) {
     uint64_t off = arena_alloc(s, needed);
     if (off != kNone) {
-      // put it back; caller will re-alloc (simpler than returning here)
       uint64_t size =
           align_up(needed < kMinBlock ? kMinBlock : needed, kAlign);
       free_insert(s, off, size);
       h->bytes_allocated -= size;
       return true;
     }
-    // find LRU victim
-    uint64_t victim = h->table_cap;
-    uint64_t best = ~0ull;
-    for (uint64_t i = 0; i < h->table_cap; i++) {
-      ObjectEntry& e = s->table[i];
-      if (e.state == kSealed && e.refcount == 0 && e.lru_tick < best) {
-        best = e.lru_tick;
-        victim = i;
-      }
-    }
-    if (victim == h->table_cap) return any;  // nothing evictable
+    ObjectEntry& e = s->table[idx];
     h->num_evictions++;
-    h->bytes_evicted += s->table[victim].data_size + s->table[victim].meta_size;
-    entry_free(s, s->table[victim]);
+    h->bytes_evicted += e.data_size + e.meta_size;
+    entry_free(s, e);
     any = true;
   }
+  return any;
 }
 
 }  // namespace
@@ -272,6 +306,7 @@ enum {
 
 void* store_create(const char* name, uint64_t capacity, uint64_t table_cap) {
   if (table_cap == 0) table_cap = 1 << 16;
+  if (capacity < (1 << 12)) return nullptr;  // degenerate arena
   shm_unlink(name);  // fresh segment
   int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) return nullptr;
@@ -378,6 +413,7 @@ int store_create_object(void* sp, const uint8_t* id, uint64_t data_size,
   uint64_t total = data_size + meta_size;
   if (total > h->arena_size) return TS_OOM;
   lock(h);
+  maybe_rehash(s);
   if (find(s, id) != h->table_cap) {
     unlock(h);
     return TS_EXISTS;
@@ -406,6 +442,7 @@ int store_create_object(void* sp, const uint8_t* id, uint64_t data_size,
     }
   }
   ObjectEntry& e = s->table[slot];
+  memset(&e, 0, sizeof(e));
   memcpy(e.id, id, kIdLen);
   e.state = kCreated;
   e.refcount = 1;  // writer holds a ref until seal+release
@@ -413,6 +450,7 @@ int store_create_object(void* sp, const uint8_t* id, uint64_t data_size,
   e.data_size = data_size;
   e.meta_size = meta_size;
   e.lru_tick = ++h->lru_clock;
+  e.writer_pid = (uint64_t)getpid();
   h->num_objects++;
   unlock(h);
   *offset_out = off;
@@ -463,6 +501,19 @@ int store_get(void* sp, const uint8_t* id, int64_t timeout_ms,
     if (i != h->table_cap && s->table[i].state == kSealed) {
       ObjectEntry& e = s->table[i];
       e.refcount++;
+      // record this reader's pid so a crash can be cleaned up
+      uint64_t pid = (uint64_t)getpid();
+      bool tracked = false;
+      for (uint32_t k = 0; k < kReaderSlots; k++) {
+        if (e.reader_pids[k] == pid ||
+            (e.reader_pids[k] == 0 && e.reader_counts[k] == 0)) {
+          e.reader_pids[k] = pid;
+          e.reader_counts[k]++;
+          tracked = true;
+          break;
+        }
+      }
+      if (!tracked) e.untracked_refs++;
       e.lru_tick = ++h->lru_clock;
       *offset_out = e.offset;
       *data_size_out = e.data_size;
@@ -494,8 +545,43 @@ int store_release(void* sp, const uint8_t* id) {
   }
   ObjectEntry& e = s->table[i];
   if (e.refcount > 0) e.refcount--;
+  uint64_t pid = (uint64_t)getpid();
+  bool tracked = false;
+  for (uint32_t k = 0; k < kReaderSlots; k++) {
+    if (e.reader_pids[k] == pid && e.reader_counts[k] > 0) {
+      if (--e.reader_counts[k] == 0) e.reader_pids[k] = 0;
+      tracked = true;
+      break;
+    }
+  }
+  if (!tracked && e.untracked_refs > 0) e.untracked_refs--;
   unlock(h);
   return TS_OK;
+}
+
+// Drop all refs held by a (dead) process on every entry. The raylet calls
+// this when a worker dies, so crashed readers cannot pin objects forever
+// (reference: plasma per-client disconnect cleanup).
+int store_release_pid(void* sp, uint64_t pid) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  lock(h);
+  int n = 0;
+  for (uint64_t i = 0; i < h->table_cap; i++) {
+    ObjectEntry& e = s->table[i];
+    if (e.state != kCreated && e.state != kSealed) continue;
+    for (uint32_t k = 0; k < kReaderSlots; k++) {
+      if (e.reader_pids[k] == pid && e.reader_counts[k] > 0) {
+        uint64_t drop = e.reader_counts[k];
+        e.refcount = e.refcount >= drop ? e.refcount - drop : 0;
+        e.reader_counts[k] = 0;
+        e.reader_pids[k] = 0;
+        n += (int)drop;
+      }
+    }
+  }
+  unlock(h);
+  return n;
 }
 
 int store_delete(void* sp, const uint8_t* id) {
@@ -528,20 +614,23 @@ int store_contains(void* sp, const uint8_t* id) {
   return sealed;
 }
 
-// Drop created-but-never-sealed entries (crashed writers). Returns count.
-int store_evict_orphans(void* sp) {
+// Drop created-but-never-sealed entries of crashed writers. pid == 0 means
+// "any writer" (store-owner cleanup); otherwise only entries created by
+// that (now dead) pid are reclaimed, so live writers mid-put are safe.
+int store_evict_orphans(void* sp, uint64_t pid) {
   Store* s = (Store*)sp;
   Header* h = s->hdr;
   lock(h);
   int n = 0;
   for (uint64_t i = 0; i < h->table_cap; i++) {
     ObjectEntry& e = s->table[i];
-    if (e.state == kCreated) {
+    if (e.state == kCreated && (pid == 0 || e.writer_pid == pid)) {
       e.refcount = 0;
       entry_free(s, e);
       n++;
     }
   }
+  pthread_cond_broadcast(&h->cv);
   unlock(h);
   return n;
 }

@@ -151,6 +151,28 @@ def test_orphan_eviction(store):
         store.get(oid(10), timeout_ms=-1)
 
 
+def _child_reader_crash(name: str, object_id: bytes, q):
+    s = ShmObjectStore(name)
+    s.get(object_id, timeout_ms=5000)  # take a ref, never release
+    q.put(os.getpid())
+    q.close()
+    q.join_thread()  # flush the feeder before crashing
+    os._exit(1)  # crash while holding the ref
+
+
+def test_crashed_reader_refs_reclaimed(store):
+    store.put(oid(11), b"pinned by crasher")
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_reader_crash, args=(store.name, oid(11), q))
+    p.start()
+    pid = q.get(timeout=30)
+    p.join(timeout=10)
+    assert not store.delete(oid(11))      # ref still pinned
+    assert store.release_pid(pid) == 1    # crash cleanup drops it
+    assert store.delete(oid(11))
+
+
 def test_many_objects_fragmentation(store):
     # Alternating alloc/free exercises free-list coalescing.
     for round_ in range(3):
