@@ -43,15 +43,24 @@ def _key(object_id: bytes) -> bytes:
 
 
 def _ensure_built() -> str:
-    if os.path.exists(_LIB_PATH):
-        return _LIB_PATH
-    with _build_lock:
+    src = os.path.join(_SRC, "store", "shm_store.cc")
+
+    def stale() -> bool:
         if not os.path.exists(_LIB_PATH):
-            subprocess.run(
-                ["make", "-C", os.path.abspath(_SRC)],
-                check=True,
-                capture_output=True,
-            )
+            return True
+        # ABI/layout changes in the source must force a rebuild — a stale
+        # library would miss symbols or silently corrupt the segment
+        return (os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH))
+
+    if stale():
+        with _build_lock:
+            if stale():
+                subprocess.run(
+                    ["make", "-C", os.path.abspath(_SRC)],
+                    check=True,
+                    capture_output=True,
+                )
     return _LIB_PATH
 
 

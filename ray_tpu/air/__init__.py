@@ -1,0 +1,16 @@
+"""ray_tpu.air: shared configs + execution glue (reference: SURVEY P17,
+``python/ray/air/``)."""
+
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+
+__all__ = [
+    "CheckpointConfig",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
+]

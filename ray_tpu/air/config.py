@@ -1,0 +1,55 @@
+"""Shared run/scale configs (reference: ``python/ray/air/config.py`` —
+ScalingConfig/RunConfig/FailureConfig/CheckpointConfig)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ScalingConfig:
+    """How many rank workers and what each needs.
+
+    ``topology`` optionally names a TPU slice shape (e.g. "v5p-16") so
+    slice-aware placement can keep ranks ICI-adjacent (reference analog:
+    TPU autodetect + PG-backed WorkerGroup; SURVEY §2c elastic row)."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: dict = field(default_factory=dict)
+    topology: str | None = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> dict:
+        r = dict(self.resources_per_worker)
+        r.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            r.setdefault("TPU", 1.0)
+        return r
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0   # trial-level retries (reference semantics)
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: int | None = None           # top-k retention
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"      # "max" | "min"
+
+
+@dataclass
+class RunConfig:
+    name: str | None = None
+    storage_path: str | None = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 0
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        return os.path.join(base, self.name) if self.name else base

@@ -1,0 +1,26 @@
+"""ray_tpu.data: streaming datasets (reference: Ray Data, SURVEY P13)."""
+
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.dataset import (
+    Dataset,
+    from_items,
+    from_numpy,
+    range,  # noqa: A004 - mirrors the reference's ray.data.range
+    read_csv,
+    read_json,
+)
+from ray_tpu.data.execution import ExecutionOptions, StreamingExecutor
+from ray_tpu.data.iterator import DataIterator
+
+__all__ = [
+    "BlockAccessor",
+    "Dataset",
+    "DataIterator",
+    "ExecutionOptions",
+    "StreamingExecutor",
+    "from_items",
+    "from_numpy",
+    "range",
+    "read_csv",
+    "read_json",
+]

@@ -1,0 +1,329 @@
+"""Dataset: lazy logical plan over blocks, executed by the streaming
+executor.
+
+Reference analog: ``python/ray/data/dataset.py`` (``Dataset`` :178,
+``map_batches:397``, ``iter_batches:3499``, ``streaming_split:1149``) with
+the logical-plan → physical-operator structure of
+``_internal/logical/``/`_internal/planner/`` collapsed into one layer:
+each transform appends an operator factory; ``_build_ops`` instantiates
+the physical topology at iteration time. Blocks are column-dict numpy
+batches (TPU host format — feeds device transfer directly).
+"""
+
+from __future__ import annotations
+
+import builtins
+import queue as _queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor, concat_blocks
+from ray_tpu.data.execution import (
+    AllToAllOperator,
+    ExecutionOptions,
+    InputDataOperator,
+    LimitOperator,
+    MapOperator,
+    PhysicalOperator,
+    RefBundle,
+    StreamingExecutor,
+)
+
+
+class Dataset:
+    def __init__(self, source_fn: Callable[[], list[RefBundle]],
+                 ops: tuple = (), options: ExecutionOptions | None = None):
+        self._source_fn = source_fn
+        self._ops = ops          # tuple of factories () -> PhysicalOperator
+        self._options = options or ExecutionOptions()
+
+    # ------------------------------------------------------------------
+    # transforms (lazy)
+    # ------------------------------------------------------------------
+
+    def _with(self, factory) -> "Dataset":
+        return Dataset(self._source_fn, self._ops + (factory,), self._options)
+
+    def map_batches(self, fn, *, compute: str = "tasks", num_cpus: float = 1,
+                    actor_pool_size: int = 2) -> "Dataset":
+        """Apply ``fn(batch_dict) -> batch_dict`` per block.
+        ``compute="actors"`` keeps fn state resident (pass a zero-arg
+        factory as ``fn`` to build per-actor state once)."""
+        return self._with(lambda: MapOperator(
+            "MapBatches", "batches", fn, compute=compute, num_cpus=num_cpus,
+            actor_pool_size=actor_pool_size))
+
+    def map(self, fn, **kw) -> "Dataset":
+        return self._with(lambda: MapOperator("Map", "rows", fn, **kw))
+
+    def flat_map(self, fn, **kw) -> "Dataset":
+        return self._with(lambda: MapOperator("FlatMap", "flat", fn, **kw))
+
+    def filter(self, fn, **kw) -> "Dataset":
+        return self._with(lambda: MapOperator("Filter", "filter", fn, **kw))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(lambda: LimitOperator(n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(lambda: AllToAllOperator(
+            f"Repartition[{num_blocks}]",
+            lambda bundles: _repartition(bundles, num_blocks)))
+
+    def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
+        return self._with(lambda: AllToAllOperator(
+            "RandomShuffle", lambda bundles: _shuffle(bundles, seed)))
+
+    def sort(self, key: str) -> "Dataset":
+        return self._with(lambda: AllToAllOperator(
+            f"Sort[{key}]", lambda bundles: _sort(bundles, key)))
+
+    def union(self, other: "Dataset") -> "Dataset":
+        left_src, right_src = self._source_fn, other._source_fn
+        left_ops, right_ops = self._ops, other._ops
+
+        def source():
+            return (_drain(left_src, left_ops, self._options)
+                    + _drain(right_src, right_ops, other._options))
+        return Dataset(source, (), self._options)
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+
+    def _build_ops(self) -> list[PhysicalOperator]:
+        ops: list[PhysicalOperator] = [InputDataOperator(self._source_fn())]
+        for factory in self._ops:
+            ops.append(factory())
+        return ops
+
+    def iter_bundles(self) -> Iterator[RefBundle]:
+        yield from StreamingExecutor(self._build_ops(),
+                                     self._options).execute()
+
+    def iter_batches(self) -> Iterator[dict]:
+        for bundle in self.iter_bundles():
+            for ref in bundle.refs:
+                block = ray_tpu.get(ref)
+                yield BlockAccessor.for_block(block).to_batch()
+
+    def iter_rows(self) -> Iterator[Any]:
+        for bundle in self.iter_bundles():
+            for ref in bundle.refs:
+                yield from BlockAccessor.for_block(
+                    ray_tpu.get(ref)).iter_rows()
+
+    def take(self, n: int = 20) -> list:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> list:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(b.num_rows for b in self.iter_bundles())
+
+    def materialize(self) -> "Dataset":
+        bundles = list(self.iter_bundles())
+        return Dataset(lambda: bundles, (), self._options)
+
+    def stats(self) -> dict:
+        ops = self._build_ops()
+        list(StreamingExecutor(ops, self._options).execute())
+        return {op.name: dict(op.metrics) for op in ops}
+
+    # ------------------------------------------------------------------
+    # consumption for training (reference: streaming_split:1149)
+    # ------------------------------------------------------------------
+
+    def streaming_split(self, n: int) -> list["DataIterator"]:
+        """n iterators fed round-robin from ONE shared streaming execution
+        (per-rank ingest; each bundle goes to exactly one split)."""
+        from ray_tpu.data.iterator import DataIterator
+
+        queues = [_queue.Queue(maxsize=4) for _ in builtins.range(n)]
+
+        def pump():
+            i = 0
+            try:
+                for bundle in self.iter_bundles():
+                    queues[i % n].put(bundle)
+                    i += 1
+            finally:
+                for q in queues:
+                    q.put(None)
+
+        threading.Thread(target=pump, daemon=True).start()
+        return [DataIterator(_queue_iter(q)) for q in queues]
+
+    def iterator(self) -> "DataIterator":
+        from ray_tpu.data.iterator import DataIterator
+
+        return DataIterator(self.iter_bundles())
+
+    def __repr__(self):
+        return f"Dataset(ops={len(self._ops)})"
+
+
+def _queue_iter(q: "_queue.Queue"):
+    while True:
+        item = q.get()
+        if item is None:
+            return
+        yield item
+
+
+def _drain(source_fn, ops, options) -> list[RefBundle]:
+    ds = Dataset(source_fn, ops, options)
+    return list(ds.iter_bundles())
+
+
+# ---------------------------------------------------------------------------
+# all-to-all transforms (centralized v1; push-based shuffle is a planned
+# upgrade — reference toggles via DataContext.use_push_based_shuffle)
+# ---------------------------------------------------------------------------
+
+def _gather_rows(bundles: list[RefBundle]):
+    blocks = []
+    for b in bundles:
+        blocks.extend(ray_tpu.get(list(b.refs)))
+    return concat_blocks(blocks)
+
+
+def _emit_blocks(block, num_blocks: int) -> list[RefBundle]:
+    acc = BlockAccessor.for_block(block)
+    n = acc.num_rows()
+    num_blocks = max(1, min(num_blocks, n) if n else 1)
+    out = []
+    for i in builtins.range(num_blocks):
+        start = i * n // num_blocks
+        end = (i + 1) * n // num_blocks
+        part = acc.slice(start, end)
+        pacc = BlockAccessor.for_block(part)
+        out.append(RefBundle([ray_tpu.put(part)],
+                             num_rows=pacc.num_rows(),
+                             size_bytes=pacc.size_bytes()))
+    return out
+
+
+def _repartition(bundles, num_blocks):
+    return _emit_blocks(_gather_rows(bundles), num_blocks)
+
+
+def _shuffle(bundles, seed):
+    merged = _gather_rows(bundles)
+    acc = BlockAccessor.for_block(merged)
+    n = acc.num_rows()
+    perm = np.random.default_rng(seed).permutation(n)
+    if isinstance(merged, dict):
+        shuffled = {k: np.asarray(v)[perm] for k, v in merged.items()}
+    else:
+        shuffled = [merged[i] for i in perm]
+    return _emit_blocks(shuffled, max(1, len(bundles)))
+
+
+def _sort(bundles, key):
+    merged = _gather_rows(bundles)
+    if isinstance(merged, dict):
+        order = np.argsort(np.asarray(merged[key]), kind="stable")
+        out = {k: np.asarray(v)[order] for k, v in merged.items()}
+    else:
+        out = sorted(merged, key=lambda r: r[key])
+    return _emit_blocks(out, max(1, len(bundles)))
+
+
+# ---------------------------------------------------------------------------
+# sources (reference: data/read_api.py + datasource/)
+# ---------------------------------------------------------------------------
+
+def _bundle_of(block) -> RefBundle:
+    acc = BlockAccessor.for_block(block)
+    return RefBundle([ray_tpu.put(block)], num_rows=acc.num_rows(),
+                     size_bytes=acc.size_bytes())
+
+
+def range(n: int, *, num_blocks: int = 8) -> Dataset:  # noqa: A001
+    def source():
+        out = []
+        for i in builtins.range(num_blocks):
+            start = i * n // num_blocks
+            end = (i + 1) * n // num_blocks
+            if end > start:
+                out.append(_bundle_of(
+                    {"id": np.arange(start, end, dtype=np.int64)}))
+        return out
+    return Dataset(source)
+
+
+def from_items(items: list, *, num_blocks: int = 8) -> Dataset:
+    items = list(items)
+
+    def source():
+        out = []
+        nb = max(1, min(num_blocks, len(items)))
+        for i in builtins.range(nb):
+            start = i * len(items) // nb
+            end = (i + 1) * len(items) // nb
+            if end > start:
+                out.append(_bundle_of(items[start:end]))
+        return out
+    return Dataset(source)
+
+
+def from_numpy(arrays: dict, *, num_blocks: int = 8) -> Dataset:
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    n = len(next(iter(arrays.values())))
+
+    def source():
+        out = []
+        nb = max(1, min(num_blocks, n))
+        for i in builtins.range(nb):
+            start = i * n // nb
+            end = (i + 1) * n // nb
+            if end > start:
+                out.append(_bundle_of(
+                    {k: v[start:end] for k, v in arrays.items()}))
+        return out
+    return Dataset(source)
+
+
+def read_json(paths, *, num_blocks: int = 8) -> Dataset:
+    """Line-delimited JSON files → row datasets."""
+    import json as _json
+
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def source():
+        rows = []
+        for p in paths:
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(_json.loads(line))
+        return [b for ds_b in [from_items(rows, num_blocks=num_blocks)
+                               ._source_fn()] for b in ds_b]
+    return Dataset(source)
+
+
+def read_csv(paths, *, num_blocks: int = 8) -> Dataset:
+    import csv as _csv
+
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def source():
+        rows = []
+        for p in paths:
+            with open(p, newline="") as f:
+                rows.extend(dict(r) for r in _csv.DictReader(f))
+        return from_items(rows, num_blocks=num_blocks)._source_fn()
+    return Dataset(source)

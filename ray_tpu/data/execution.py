@@ -1,0 +1,304 @@
+"""Streaming executor: pull-based operator pipeline with backpressure.
+
+Reference analog: ``python/ray/data/_internal/execution/`` —
+``StreamingExecutor`` (streaming_executor.py:49) driving a topology of
+physical operators; the scheduling loop is ``select_operator_to_run``
+(streaming_executor_state.py:376) choosing, each tick, the runnable
+operator with available inputs and budget, preferring operators furthest
+downstream (drains the pipeline, bounds memory). Backpressure is a
+per-topology cap on in-flight task output bytes (the reference budgets 25%
+of the object store — streaming_executor_state.py:39).
+
+Operators launch ray_tpu tasks (``TaskPoolMapOperator``) or use a pool of
+reusable actors (``ActorPoolMapOperator`` — map_operator.py:39 analog) so
+expensive per-batch state (a jitted function, a loaded model) is paid once.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor, batch_to_block, concat_blocks
+
+
+@dataclass
+class RefBundle:
+    """A unit of streamed data: object refs + size metadata."""
+
+    refs: list                      # list[ObjectRef] of blocks
+    num_rows: int = 0
+    size_bytes: int = 0
+
+
+@dataclass
+class ExecutionOptions:
+    max_in_flight_tasks: int = 8        # per operator
+    max_buffered_bundles: int = 16      # per operator output queue
+    actor_pool_size: int = 2
+
+
+class PhysicalOperator:
+    """Base: pull input bundles, produce output bundles."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.input_queue: deque[RefBundle] = deque()
+        self.output_queue: deque[RefBundle] = deque()
+        self.inputs_done = False
+        self.metrics = {"bundles_in": 0, "bundles_out": 0, "tasks": 0}
+
+    # -- scheduling interface -------------------------------------------
+    def can_accept_work(self, options: ExecutionOptions) -> bool:
+        return (bool(self.input_queue)
+                and len(self.output_queue) < options.max_buffered_bundles
+                and self.num_active_tasks() < options.max_in_flight_tasks)
+
+    def num_active_tasks(self) -> int:
+        return 0
+
+    def dispatch(self, options: ExecutionOptions):
+        raise NotImplementedError
+
+    def poll(self):
+        """Move finished task results to the output queue."""
+
+    def is_done(self) -> bool:
+        return (self.inputs_done and not self.input_queue
+                and not self.output_queue and self.num_active_tasks() == 0)
+
+    def all_dispatched(self) -> bool:
+        return self.inputs_done and not self.input_queue
+
+    def shutdown(self):
+        pass
+
+
+class InputDataOperator(PhysicalOperator):
+    """Source: emits pre-materialized bundles (read tasks already refs)."""
+
+    def __init__(self, bundles: list[RefBundle]):
+        super().__init__("Input")
+        self.output_queue.extend(bundles)
+        self.inputs_done = True
+
+    def can_accept_work(self, options):
+        return False
+
+    def dispatch(self, options):
+        pass
+
+
+def _apply_map(map_kind: str, fn, blocks: list):
+    """Runs inside a ray_tpu task/actor: apply fn to the blocks."""
+    out_blocks = []
+    for block in blocks:
+        acc = BlockAccessor.for_block(block)
+        if map_kind == "batches":
+            out = fn(acc.to_batch())
+            out_blocks.append(batch_to_block(out))
+        elif map_kind == "rows":
+            out_blocks.append([fn(r) for r in acc.iter_rows()])
+        elif map_kind == "flat":
+            rows = []
+            for r in acc.iter_rows():
+                rows.extend(fn(r))
+            out_blocks.append(rows)
+        elif map_kind == "filter":
+            out_blocks.append([r for r in acc.iter_rows() if fn(r)])
+        else:
+            raise ValueError(map_kind)
+    merged = concat_blocks(out_blocks)
+    acc = BlockAccessor.for_block(merged)
+    return merged, acc.num_rows(), acc.size_bytes()
+
+
+class _MapWorker:
+    """Actor holding the map fn (jit caches, models survive across calls)."""
+
+    def __init__(self, map_kind: str, fn_factory):
+        self._kind = map_kind
+        self._fn = fn_factory() if callable(fn_factory) else fn_factory
+
+    def apply(self, *blocks):
+        return _apply_map(self._kind, self._fn, list(blocks))
+
+
+class MapOperator(PhysicalOperator):
+    """Task- or actor-pool map over blocks (MapOperator/TaskPool/ActorPool
+    analogs). compute="tasks" | "actors"."""
+
+    def __init__(self, name: str, map_kind: str, fn,
+                 compute: str = "tasks", num_cpus: float = 1,
+                 actor_pool_size: int = 2):
+        super().__init__(name)
+        self.map_kind = map_kind
+        self.fn = fn
+        self.compute = compute
+        self.num_cpus = num_cpus
+        self.actor_pool_size = actor_pool_size
+        self._active: list[tuple] = []      # (result_ref, bundle)
+        self._pool: list = []               # actor handles
+        self._pool_idx = 0
+
+    def num_active_tasks(self) -> int:
+        return len(self._active)
+
+    def _ensure_pool(self):
+        if self._pool or self.compute != "actors":
+            return
+        worker_cls = ray_tpu.remote(_MapWorker)
+        self._pool = [
+            worker_cls.options(num_cpus=self.num_cpus).remote(
+                self.map_kind, self.fn)
+            for _ in range(self.actor_pool_size)
+        ]
+
+    def dispatch(self, options: ExecutionOptions):
+        if not self.input_queue:
+            return
+        bundle = self.input_queue.popleft()
+        self.metrics["bundles_in"] += 1
+        self.metrics["tasks"] += 1
+        if self.compute == "actors":
+            self._ensure_pool()
+            actor = self._pool[self._pool_idx % len(self._pool)]
+            self._pool_idx += 1
+            ref = actor.apply.remote(*bundle.refs)
+        else:
+            kind, fn = self.map_kind, self.fn
+            apply_remote = ray_tpu.remote(
+                lambda *blocks: _apply_map(kind, fn, list(blocks))
+            ).options(num_cpus=self.num_cpus)
+            ref = apply_remote.remote(*bundle.refs)
+        self._active.append((ref, bundle))
+
+    def poll(self):
+        still = []
+        for ref, bundle in self._active:
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+            if ready:
+                block, rows, nbytes = ray_tpu.get(ref)
+                out_ref = ray_tpu.put(block)
+                self.output_queue.append(
+                    RefBundle([out_ref], num_rows=rows, size_bytes=nbytes))
+                self.metrics["bundles_out"] += 1
+            else:
+                still.append((ref, bundle))
+        self._active = still
+
+    def shutdown(self):
+        for actor in self._pool:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:  # noqa: BLE001
+                pass
+        self._pool = []
+
+
+class AllToAllOperator(PhysicalOperator):
+    """Barrier operator (shuffle/sort/repartition): consumes ALL input
+    bundles, then emits transformed bundles. Reference: push-based shuffle
+    scheduler (_internal/planner/exchange/)."""
+
+    def __init__(self, name: str,
+                 transform: Callable[[list[RefBundle]], list[RefBundle]]):
+        super().__init__(name)
+        self.transform = transform
+        self._collected: list[RefBundle] = []
+        self._emitted = False
+
+    def can_accept_work(self, options) -> bool:
+        # collection is cheap — always drain inputs; the barrier fires when
+        # upstream is done
+        return bool(self.input_queue) or (
+            self.inputs_done and not self._emitted)
+
+    def dispatch(self, options: ExecutionOptions):
+        while self.input_queue:
+            self._collected.append(self.input_queue.popleft())
+            self.metrics["bundles_in"] += 1
+        if self.inputs_done and not self._emitted:
+            self._emitted = True
+            for b in self.transform(self._collected):
+                self.output_queue.append(b)
+                self.metrics["bundles_out"] += 1
+
+    def is_done(self) -> bool:
+        return self._emitted and not self.output_queue
+
+
+class LimitOperator(PhysicalOperator):
+    def __init__(self, limit: int):
+        super().__init__(f"Limit[{limit}]")
+        self.remaining = limit
+
+    def can_accept_work(self, options) -> bool:
+        return bool(self.input_queue)
+
+    def dispatch(self, options: ExecutionOptions):
+        while self.input_queue:
+            bundle = self.input_queue.popleft()
+            if self.remaining <= 0:
+                continue
+            if bundle.num_rows <= self.remaining:
+                self.remaining -= bundle.num_rows
+                self.output_queue.append(bundle)
+            else:
+                block = concat_blocks(ray_tpu.get(list(bundle.refs)))
+                acc = BlockAccessor.for_block(block)
+                sliced = acc.slice(0, self.remaining)
+                self.remaining = 0
+                sacc = BlockAccessor.for_block(sliced)
+                self.output_queue.append(RefBundle(
+                    [ray_tpu.put(sliced)], num_rows=sacc.num_rows(),
+                    size_bytes=sacc.size_bytes()))
+
+
+class StreamingExecutor:
+    """Drives a linear operator topology to completion, yielding output
+    bundles as they materialize (results stream while upstream still runs).
+    """
+
+    def __init__(self, operators: list[PhysicalOperator],
+                 options: ExecutionOptions | None = None):
+        self.operators = operators
+        self.options = options or ExecutionOptions()
+
+    def execute(self) -> Iterator[RefBundle]:
+        ops = self.operators
+        try:
+            while True:
+                progressed = False
+                # propagate bundles + doneness downstream
+                for i in range(len(ops) - 1):
+                    up, down = ops[i], ops[i + 1]
+                    while up.output_queue:
+                        down.input_queue.append(up.output_queue.popleft())
+                        progressed = True
+                    if up.is_done() and not down.inputs_done:
+                        down.inputs_done = True
+                        progressed = True
+                # stream final outputs
+                tail = ops[-1]
+                while tail.output_queue:
+                    progressed = True
+                    yield tail.output_queue.popleft()
+                if tail.is_done():
+                    return
+                # pick operators to run: furthest-downstream first
+                # (select_operator_to_run analog)
+                for op in reversed(ops):
+                    op.poll()
+                    while op.can_accept_work(self.options):
+                        op.dispatch(self.options)
+                        progressed = True
+                if not progressed:
+                    time.sleep(0.002)
+        finally:
+            for op in ops:
+                op.shutdown()

@@ -51,11 +51,14 @@ class ClusterRuntime:
             store_name = info["store_name"]
             self.node_id = info["node_id"]
         self._raylet = RpcClient(tuple(raylet_address))
-        self._raylet_lock = threading.Lock()
         self.store = ShmObjectStore(store_name)
         self._actor_locations: dict[str, tuple] = {}   # id -> (addr, incarnation)
         self._actor_seq: dict[str, int] = {}           # id -> next seq
         self._seq_lock = threading.Lock()
+        # per-actor submission locks: seq assignment + send must be atomic
+        # per actor or concurrent senders can interleave/retry into
+        # permanent sequence gaps
+        self._actor_send_locks: dict[str, threading.Lock] = {}
         self._named_cache: dict[str, str] = {}
         self.metrics: dict[str, Any] = {}
 
@@ -83,9 +86,10 @@ class ClusterRuntime:
                     raise exc.GetTimeoutError(
                         f"get() timed out waiting for {len(pending)} objects")
                 step = min(step, remain)
-            with self._raylet_lock:
-                pending = self._raylet.call("ensure_local", oids=pending,
-                                            timeout_s=step)
+            # RpcClient multiplexes by request id — no lock needed, and
+            # holding one across the blocking poll would stall submits
+            pending = self._raylet.call("ensure_local", oids=pending,
+                                        timeout_s=step)
         out = []
         for oid_hex in oids:
             out.append(self._read_local(oid_hex, deadline))
@@ -109,9 +113,8 @@ class ClusterRuntime:
                             f"object {oid_hex[:8]} evicted and re-pull "
                             f"timed out") from None
                     step = min(step, remain)
-                with self._raylet_lock:
-                    self._raylet.call("ensure_local", oids=[oid_hex],
-                                      timeout_s=step)
+                self._raylet.call("ensure_local", oids=[oid_hex],
+                                  timeout_s=step)
                 continue
             if is_error:
                 raise value
@@ -182,8 +185,7 @@ class ClusterRuntime:
                 "strategy": _wire_strategy(spec),
                 "max_retries": spec.max_retries,
             }
-            with self._raylet_lock:
-                self._raylet.call("submit_task", task=task)
+            self._raylet.call("submit_task", task=task)
         return [ObjectRef(oid) for oid in spec.return_ids]
 
     # ------------------------------------------------------------------
@@ -240,6 +242,13 @@ class ClusterRuntime:
 
     def _submit_actor_task(self, spec: TaskSpec):
         actor_hex = spec.actor_id.hex()
+        with self._seq_lock:
+            send_lock = self._actor_send_locks.setdefault(
+                actor_hex, threading.Lock())
+        with send_lock:
+            self._submit_actor_task_locked(spec, actor_hex)
+
+    def _submit_actor_task_locked(self, spec: TaskSpec, actor_hex: str):
         task = {
             "task_id": spec.task_id.hex(),
             "name": spec.function_name,

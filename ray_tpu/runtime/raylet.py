@@ -182,10 +182,12 @@ class Raylet(RpcServer):
                                size=msg.get("size", 0))
 
     def _finish_task(self, w: WorkerHandle, msg: dict):
-        self._release(w.acquired)
-        w.acquired = {}
         w.current_task = None
         if w.state == "busy":
+            # actor workers keep their acquisition for their LIFETIME
+            # (released on death/kill); only per-task resources return here
+            self._release(w.acquired)
+            w.acquired = {}
             w.state = "idle"
         self._kick_dispatch()
 
@@ -195,6 +197,10 @@ class Raylet(RpcServer):
         if self._stopping:
             return
         with self._workers_lock:
+            if w.state == "dead":
+                return  # channel reader and monitor both report deaths
+            prior_state = w.state
+            w.state = "dead"
             self._workers.pop(w.worker_id, None)
         # reclaim created-but-unsealed allocations and pinned read refs of
         # the dead worker only (live writers/readers are untouched)
@@ -204,7 +210,7 @@ class Raylet(RpcServer):
         task = w.current_task
         self._release(w.acquired)
         w.acquired = {}
-        if w.state == "actor" and w.actor_id is not None:
+        if prior_state == "actor" and w.actor_id is not None:
             try:
                 with self._gcs_lock:
                     self._gcs.call(
@@ -220,7 +226,6 @@ class Raylet(RpcServer):
                 self._store_task_error(
                     task, RuntimeError(
                         f"worker died executing {task.get('name')}"))
-        w.state = "dead"
 
     def _store_task_error(self, task: dict, error: BaseException):
         from ray_tpu.utils import exceptions as exc
@@ -584,6 +589,7 @@ def _worker_pythonpath(current: str) -> str:
 
 def main():  # runs a raylet as a standalone process (cluster_utils spawns it)
     import json
+    import signal
     cfg = json.loads(sys.argv[1])
     raylet = Raylet(
         node_id=cfg["node_id"],
@@ -592,15 +598,17 @@ def main():  # runs a raylet as a standalone process (cluster_utils spawns it)
         store_capacity=cfg.get("store_capacity", 1 << 30),
         labels=cfg.get("labels"),
     )
+    stop_ev = threading.Event()
+    # graceful shutdown must run on SIGTERM too (Cluster.remove_node uses
+    # terminate()); otherwise the shm segment leaks in /dev/shm
+    signal.signal(signal.SIGTERM, lambda *_: stop_ev.set())
+    signal.signal(signal.SIGINT, lambda *_: stop_ev.set())
     raylet.start()
     # signal readiness to the parent via stdout
     print(json.dumps({"address": raylet.address,
                       "store_name": raylet.store_name}), flush=True)
     try:
-        while True:
-            time.sleep(1)
-    except KeyboardInterrupt:
-        pass
+        stop_ev.wait()
     finally:
         raylet.stop()
 

@@ -116,11 +116,16 @@ class RpcServer:
                 except BaseException as e:  # noqa: BLE001 - ship to caller
                     try:
                         send_msg(conn, {"_id": req_id, "error": e}, send_lock)
-                    except (OSError, pickle.PicklingError):
-                        send_msg(conn,
-                                 {"_id": req_id,
-                                  "error": RuntimeError(repr(e))},
-                                 send_lock)
+                    except OSError:
+                        return  # peer gone; nothing to reply to
+                    except Exception:  # unpicklable exception payload
+                        try:
+                            send_msg(conn,
+                                     {"_id": req_id,
+                                      "error": RuntimeError(repr(e))},
+                                     send_lock)
+                        except OSError:
+                            return
                     continue
                 if result is RpcServer.HELD:
                     return  # handler owns the connection now

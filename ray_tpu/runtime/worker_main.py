@@ -183,6 +183,11 @@ class Worker:
         seq = task.get("seq", 0)
         runnable = []
         with self._seq_lock:
+            if seq < self._next_seq[caller]:
+                # duplicate delivery (caller retried after a lost reply):
+                # the original execution already sealed the return objects
+                # (first-write-wins), so drop instead of re-running
+                return
             self._seq_buffer[caller][seq] = task
             while self._next_seq[caller] in self._seq_buffer[caller]:
                 t = self._seq_buffer[caller].pop(self._next_seq[caller])

@@ -1,0 +1,34 @@
+"""ray_tpu.train: training harness + mesh trainer (reference: Ray Train,
+SURVEY P14)."""
+
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.data_parallel_trainer import (
+    DataParallelTrainer,
+    JaxMeshTrainer,
+    Result,
+)
+from ray_tpu.train.session import get_checkpoint_dir, get_context, report
+from ray_tpu.train.trainer import JaxTrainer, TrainConfig
+from ray_tpu.train.worker_group import BackendExecutor, WorkerGroup
+
+__all__ = [
+    "BackendExecutor",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxMeshTrainer",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainConfig",
+    "WorkerGroup",
+    "get_checkpoint_dir",
+    "get_context",
+    "report",
+]

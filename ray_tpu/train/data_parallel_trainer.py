@@ -1,0 +1,204 @@
+"""DataParallelTrainer: the fit() harness over rank actors.
+
+Reference analog: ``python/ray/train/data_parallel_trainer.py``
+(``DataParallelTrainer:28``, ``training_loop:418``) + ``BaseTrainer.fit``
+(``base_trainer.py:571``). fit() launches the worker group, streams
+rank reports, applies FailureConfig retries (restart-from-checkpoint), and
+tracks top-k checkpoints per CheckpointConfig.
+
+Result/checkpoint model: rank workers call ``ray_tpu.train.report(metrics,
+checkpoint_dir=...)``; rank-0 metrics become the canonical stream. Data
+ingest: pass ``datasets={"train": ds}``; each rank receives a streaming
+split iterator via ``session.get_dataset_shard`` equivalent (exposed in
+the config as ``config["train_shard"]``).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import BackendExecutor
+
+
+@dataclass
+class Result:
+    metrics: dict = field(default_factory=dict)
+    checkpoint_dir: str | None = None
+    error: str | None = None
+    metrics_history: list = field(default_factory=list)
+
+
+class _TopKCheckpoints:
+    """Retention per CheckpointConfig (reference: CheckpointManager top-k,
+    ``train/_internal/checkpoint_manager.py``)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.entries: list[tuple[float, str]] = []  # (score, dir)
+
+    def add(self, checkpoint_dir: str, metrics: dict):
+        if self.cfg.num_to_keep is None:
+            self.entries.append((0.0, checkpoint_dir))
+            return
+        attr = self.cfg.checkpoint_score_attribute
+        score = float(metrics.get(attr, 0.0)) if attr else float(
+            len(self.entries))
+        if self.cfg.checkpoint_score_order == "min":
+            score = -score
+        self.entries.append((score, checkpoint_dir))
+        self.entries.sort(key=lambda e: e[0], reverse=True)
+        while len(self.entries) > self.cfg.num_to_keep:
+            _, victim = self.entries.pop()
+            if victim != checkpoint_dir and os.path.isdir(victim):
+                shutil.rmtree(victim, ignore_errors=True)
+
+    def best(self) -> str | None:
+        return self.entries[0][1] if self.entries else None
+
+    def latest(self) -> str | None:
+        return self.entries[-1][1] if self.entries else None
+
+
+class DataParallelTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: dict | None = None,
+        scaling_config: ScalingConfig | None = None,
+        run_config: RunConfig | None = None,
+        datasets: dict | None = None,
+    ):
+        self.train_fn = train_loop_per_worker
+        self.config = dict(train_loop_config or {})
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        attempts = self.run_config.failure_config.max_failures + 1
+        restore_dir = None
+        last_error = None
+        for attempt in range(attempts):
+            result = self._run_once(restore_dir, attempt)
+            if result.error is None:
+                return result
+            last_error = result.error
+            restore_dir = result.checkpoint_dir  # resume from last ckpt
+        result = Result(error=last_error, checkpoint_dir=restore_dir)
+        return result
+
+    def _run_once(self, restore_dir: str | None, attempt: int) -> Result:
+        trial_dir = os.path.join(
+            self.run_config.resolved_storage_path(),
+            f"attempt_{attempt}_{int(time.time())}")
+        os.makedirs(trial_dir, exist_ok=True)
+        env = {}
+        if restore_dir:
+            env["RAY_TPU_RESTORE_CHECKPOINT"] = restore_dir
+        executor = BackendExecutor(self.scaling, env=env)
+        manager = _TopKCheckpoints(self.run_config.checkpoint_config)
+        config = dict(self.config)
+        if self.datasets:
+            splits = {}
+            for name, ds in self.datasets.items():
+                splits[name] = ds.streaming_split(self.scaling.num_workers)
+            # each rank picks its shard by rank index inside the worker
+            config["_dataset_splits"] = splits
+        result = Result()
+        try:
+            run_refs = executor.start_training(
+                _wrap_with_shard(self.train_fn), config, trial_dir)
+            done = False
+            while not done:
+                reports, done = executor.poll_reports()
+                for rep in reports:
+                    if "error" in rep:
+                        result.error = rep["error"]
+                        continue
+                    if rep["rank"] == 0:
+                        result.metrics = rep["metrics"]
+                        result.metrics_history.append(rep["metrics"])
+                    if rep.get("checkpoint") and rep["rank"] == 0:
+                        manager.add(rep["checkpoint"], rep["metrics"])
+                if not done:
+                    time.sleep(0.02)
+            # surface worker exceptions not routed through the bus
+            try:
+                ray_tpu.get(run_refs, timeout=30)
+            except Exception as e:  # noqa: BLE001
+                if result.error is None:
+                    result.error = str(e)
+        finally:
+            executor.shutdown()
+        result.checkpoint_dir = manager.best() or manager.latest()
+        return result
+
+
+def _wrap_with_shard(train_fn):
+    """Give each rank its dataset shard via the session context."""
+
+    def wrapped(config):
+        # copy, never mutate: in local mode all ranks share one dict object
+        splits = config.get("_dataset_splits")
+        config = {k: v for k, v in config.items() if k != "_dataset_splits"}
+        if splits:
+            from ray_tpu.train.session import get_context
+
+            rank = get_context().rank
+            for name, split_list in splits.items():
+                config[f"{name}_shard"] = split_list[rank]
+        import inspect
+
+        try:
+            nparams = len(inspect.signature(train_fn).parameters)
+        except (TypeError, ValueError):
+            nparams = 1
+        return train_fn(config) if nparams >= 1 else train_fn()
+
+    return wrapped
+
+
+class JaxMeshTrainer(DataParallelTrainer):
+    """Convenience trainer: one rank per TPU host, each running the
+    mesh-sharded ``JaxTrainer`` step (reference analog: TorchTrainer whose
+    backend replaces init_process_group with mesh formation)."""
+
+    def __init__(self, model_config, train_config, **kw):
+        def loop(config):
+            import jax
+
+            from ray_tpu.parallel.mesh import create_mesh
+            from ray_tpu.train import session
+            from ray_tpu.train.trainer import JaxTrainer
+
+            trainer = JaxTrainer(
+                model_config, train_config,
+                mesh=create_mesh(dict(train_config.mesh_axes)))
+            state = trainer.init_state(jax.random.key(config.get("seed", 0)))
+            shard = config.get("train_shard")
+            steps = config.get("steps", 10)
+            batch_iter = (shard.iter_jax_batches(
+                batch_size=config.get("batch_size", 8))
+                if shard is not None else None)
+            for step in range(steps):
+                if batch_iter is not None:
+                    try:
+                        batch = next(batch_iter)["tokens"]
+                    except StopIteration:
+                        break
+                else:
+                    batch = jax.random.randint(
+                        jax.random.key(step), (config.get("batch_size", 8),
+                                               config.get("seq_len", 128)),
+                        0, model_config.vocab_size, dtype="int32")
+                state, metrics = trainer.train_step(state, batch)
+                session.report({k: float(v) for k, v in metrics.items()})
+
+        super().__init__(loop, **kw)

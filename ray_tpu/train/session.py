@@ -1,0 +1,98 @@
+"""Per-rank training session: report/checkpoint/context.
+
+Reference analog: ``python/ray/train/_internal/session.py`` —
+``_TrainSession`` (:110) with ``report`` (:399,659) streaming metrics +
+checkpoints from rank workers back to the driver, and
+``ray.train.get_context()`` exposing rank/world size.
+
+The session is process-local state inside each rank actor; reports flow
+through a shared ``_ReportBus`` actor the driver polls (the reference uses
+an in-actor queue polled by the trainable)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class _ReportBus:
+    """Collects (rank, payload) reports; driver drains in arrival order."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.reports: list = []
+        self.done_ranks: set = set()
+
+    def push(self, rank: int, metrics: dict, checkpoint_dir=None):
+        self.reports.append(
+            {"rank": rank, "metrics": metrics, "checkpoint": checkpoint_dir})
+        return len(self.reports)
+
+    def mark_done(self, rank: int, error: str | None = None):
+        self.done_ranks.add(rank)
+        if error is not None:
+            self.reports.append({"rank": rank, "error": error})
+        return True
+
+    def drain(self):
+        out, self.reports = self.reports, []
+        return out, len(self.done_ranks) >= self.world_size
+
+
+@dataclass
+class TrainContext:
+    rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    node_rank: int = 0
+    trial_dir: str = ""
+    experiment_name: str = ""
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+
+_session = threading.local()
+
+
+def _init_session(context: TrainContext, bus=None):
+    _session.context = context
+    _session.bus = bus
+    _session.iteration = 0
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_session, "context", None)
+    if ctx is None:
+        return TrainContext()  # outside a worker: defaults (like reference)
+    return ctx
+
+
+def report(metrics: dict, *, checkpoint_dir: str | None = None):
+    """Stream metrics (and optionally a checkpoint directory) to the
+    driver. Rank 0's checkpoint is the one retained (reference: rank-0
+    upload via StorageContext)."""
+    ctx = get_context()
+    bus = getattr(_session, "bus", None)
+    _session.iteration = getattr(_session, "iteration", 0) + 1
+    if bus is not None:
+        ray_tpu.get(bus.push.remote(ctx.rank, dict(metrics), checkpoint_dir))
+
+
+def get_checkpoint_dir() -> str | None:
+    """Restore path for resumed runs (set by the trainer before launch)."""
+    return os.environ.get("RAY_TPU_RESTORE_CHECKPOINT") or None

@@ -1,0 +1,141 @@
+"""Trial schedulers: early stopping + population-based training.
+
+Reference analog: ``python/ray/tune/schedulers/`` —
+``AsyncHyperBandScheduler`` (async_hyperband.py:19, ASHA rung-based
+promotion/halting), ``MedianStoppingRule``, and ``PopulationBasedTraining``
+(pbt.py:222, exploit bottom quantile from top quantile + perturb)."""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial, result: dict) -> str:
+        return CONTINUE
+
+
+class AsyncHyperBandScheduler:
+    """ASHA: rungs at grace_period * reduction_factor^k; a trial reaching a
+    rung halts unless its metric is in the top 1/reduction_factor of
+    completions at that rung."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 grace_period: int = 1, max_t: int = 100,
+                 reduction_factor: int = 3, time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.rungs: list[tuple[int, list]] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append((t, []))
+            t *= reduction_factor
+        self.rf = reduction_factor
+
+    def _val(self, result):
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial, result: dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t >= self.max_t:
+            return STOP
+        for rung_t, recorded in self.rungs:
+            if t == rung_t:
+                value = self._val(result)
+                recorded.append(value)
+                k = max(1, len(recorded) // self.rf)
+                threshold = sorted(recorded, reverse=True)[k - 1]
+                if value < threshold:
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule:
+    """Stop a trial whose best metric is below the median of running means
+    of completed/ongoing trials at the same step."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 grace_period: int = 3,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.time_attr = time_attr
+        self._means: dict[Any, tuple[float, int]] = {}
+
+    def _val(self, result):
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial, result: dict) -> str:
+        value = self._val(result)
+        total, n = self._means.get(trial.trial_id, (0.0, 0))
+        self._means[trial.trial_id] = (total + value, n + 1)
+        t = int(result.get(self.time_attr, 0))
+        if t < self.grace or len(self._means) < 3:
+            return CONTINUE
+        means = [s / max(1, c) for s, c in self._means.values()]
+        means.sort()
+        median = means[len(means) // 2]
+        my_total, my_n = self._means[trial.trial_id]
+        if my_total / my_n < median:
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining:
+    """PBT: every perturbation_interval, bottom-quantile trials exploit a
+    top-quantile donor (copy config + checkpoint) and explore (perturb
+    hyperparams). The controller applies the returned action."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: dict | None = None,
+                 quantile_fraction: float = 0.25, seed: int | None = None,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.time_attr = time_attr
+        self.rng = random.Random(seed)
+        self._latest: dict[Any, float] = {}
+
+    def _val(self, result):
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial, result: dict) -> str:
+        self._latest[trial.trial_id] = self._val(result)
+        t = int(result.get(self.time_attr, 0))
+        if t == 0 or t % self.interval or len(self._latest) < 4:
+            return CONTINUE
+        ranked = sorted(self._latest.items(), key=lambda kv: kv[1])
+        k = max(1, int(len(ranked) * self.quantile))
+        bottom = {tid for tid, _ in ranked[:k]}
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id in bottom:
+            donor = self.rng.choice(top)
+            return ("EXPLOIT", donor)
+        return CONTINUE
+
+    def explore(self, config: dict) -> dict:
+        out = dict(config)
+        for key, mutation in self.mutations.items():
+            if callable(mutation):
+                out[key] = mutation()
+            elif isinstance(mutation, list):
+                out[key] = self.rng.choice(mutation)
+            elif key in out and isinstance(out[key], (int, float)):
+                factor = self.rng.choice([0.8, 1.2])
+                out[key] = out[key] * factor
+        return out

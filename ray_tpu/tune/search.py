@@ -1,0 +1,101 @@
+"""Search spaces + searchers.
+
+Reference analog: ``python/ray/tune/search/`` — the sampling primitives
+(``tune.uniform/choice/...``), ``grid_search``, and
+``BasicVariantGenerator`` (grid expansion × num_samples random sampling).
+External searcher integrations (optuna/hyperopt/...) plug in behind the
+same ``suggest`` interface."""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass
+class Domain:
+    sampler: Callable[[random.Random], Any]
+
+    def sample(self, rng: random.Random):
+        return self.sampler(rng)
+
+
+def uniform(low: float, high: float) -> Domain:
+    return Domain(lambda rng: rng.uniform(low, high))
+
+
+def loguniform(low: float, high: float) -> Domain:
+    import math
+
+    return Domain(lambda rng: math.exp(
+        rng.uniform(math.log(low), math.log(high))))
+
+
+def randint(low: int, high: int) -> Domain:
+    return Domain(lambda rng: rng.randrange(low, high))
+
+
+def choice(options: list) -> Domain:
+    options = list(options)
+    return Domain(lambda rng: rng.choice(options))
+
+
+def grid_search(values: list) -> dict:
+    return {"grid_search": list(values)}
+
+
+class BasicVariantGenerator:
+    """Expands grid_search axes (cartesian product) and samples Domains;
+    ``num_samples`` repeats the whole expansion (reference semantics)."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1,
+                 seed: int | None = None):
+        self.param_space = param_space
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+
+    def variants(self) -> list[dict]:
+        grid_keys = []
+        grid_values = []
+
+        def walk(prefix, node):
+            for k, v in node.items():
+                path = prefix + (k,)
+                if isinstance(v, dict) and "grid_search" in v:
+                    grid_keys.append(path)
+                    grid_values.append(v["grid_search"])
+                elif isinstance(v, dict):
+                    walk(path, v)
+
+        walk((), self.param_space)
+        combos = list(itertools.product(*grid_values)) if grid_values else [()]
+        out = []
+        for _ in range(self.num_samples):
+            for combo in combos:
+                cfg = self._sample(self.param_space)
+                for path, value in zip(grid_keys, combo):
+                    _set_path(cfg, path, value)
+                out.append(cfg)
+        return out
+
+    def _sample(self, node: dict) -> dict:
+        cfg = {}
+        for k, v in node.items():
+            if isinstance(v, Domain):
+                cfg[k] = v.sample(self.rng)
+            elif isinstance(v, dict) and "grid_search" in v:
+                cfg[k] = None  # filled by the grid combo
+            elif isinstance(v, dict):
+                cfg[k] = self._sample(v)
+            else:
+                cfg[k] = v
+        return cfg
+
+
+def _set_path(cfg: dict, path: tuple, value):
+    node = cfg
+    for k in path[:-1]:
+        node = node[k]
+    node[path[-1]] = value

@@ -1,0 +1,191 @@
+"""Tuner + trial-driving controller.
+
+Reference analog: ``python/ray/tune/tuner.py`` (``Tuner:59``) and
+``tune/execution/tune_controller.py`` (``TuneController:80`` — the event
+loop owning trial actors through the AIR actor manager). Here each trial
+is one rank-actor group (``BackendExecutor`` with 1 worker unless the
+trainable is itself a DataParallelTrainer config); the controller polls
+report buses, applies scheduler decisions (ASHA halting, PBT exploit), and
+persists experiment state for ``Tuner.restore``-style resume.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.worker_group import BackendExecutor
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.search import BasicVariantGenerator
+
+
+@dataclass
+class TuneConfig:
+    metric: str | None = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None
+    seed: int | None = None
+    time_attr: str = "training_iteration"
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: dict
+    status: str = "PENDING"   # PENDING | RUNNING | TERMINATED | STOPPED | ERROR
+    last_result: dict = field(default_factory=dict)
+    results: list = field(default_factory=list)
+    iteration: int = 0
+    executor: Any = None
+    error: str | None = None
+    checkpoint_dir: str | None = None
+
+
+@dataclass
+class ResultGrid:
+    trials: list[Trial]
+
+    def get_best_result(self, metric: str, mode: str = "max") -> Trial:
+        scored = [t for t in self.trials if metric in t.last_result]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda t: float(t.last_result[metric])  # noqa: E731
+        return max(scored, key=key) if mode == "max" else min(scored, key=key)
+
+    def __iter__(self):
+        return iter(self.trials)
+
+    def __len__(self):
+        return len(self.trials)
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *, param_space: dict | None = None,
+                 tune_config: TuneConfig | None = None,
+                 run_config: RunConfig | None = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        variants = BasicVariantGenerator(
+            self.param_space, num_samples=self.tune_config.num_samples,
+            seed=self.tune_config.seed).variants()
+        trials = [Trial(trial_id=f"trial_{i:05d}", config=cfg)
+                  for i, cfg in enumerate(variants)]
+        controller = TuneController(
+            self.trainable, trials, self.tune_config, self.run_config)
+        controller.run()
+        return ResultGrid(trials)
+
+
+class TuneController:
+    """Event loop: start trials up to the concurrency cap, drain reports,
+    ask the scheduler about each result, stop/exploit accordingly."""
+
+    def __init__(self, trainable, trials, tune_config: TuneConfig,
+                 run_config: RunConfig):
+        self.trainable = trainable
+        self.trials = trials
+        self.cfg = tune_config
+        self.run_config = run_config
+        self.scheduler = tune_config.scheduler or FIFOScheduler()
+        self.exp_dir = run_config.resolved_storage_path()
+        os.makedirs(self.exp_dir, exist_ok=True)
+
+    # -- trial lifecycle -------------------------------------------------
+    def _start(self, trial: Trial):
+        trial.executor = BackendExecutor(ScalingConfig(num_workers=1))
+        trial_dir = os.path.join(self.exp_dir, trial.trial_id)
+        os.makedirs(trial_dir, exist_ok=True)
+        trial.executor.start_training(self.trainable, dict(trial.config),
+                                      trial_dir)
+        trial.status = "RUNNING"
+
+    def _stop(self, trial: Trial, status: str):
+        if trial.executor is not None:
+            trial.executor.shutdown()
+            trial.executor = None
+        trial.status = status
+
+    def _exploit(self, trial: Trial, donor: Trial):
+        """PBT exploit: adopt donor's (explored) config + checkpoint and
+        restart (reference: pbt.py _exploit)."""
+        explored = self.scheduler.explore(dict(donor.config))
+        self._stop(trial, "PENDING")
+        trial.config = explored
+        trial.checkpoint_dir = donor.checkpoint_dir
+        trial.iteration = 0
+
+    # -- event loop ------------------------------------------------------
+    def run(self):
+        pending = list(self.trials)
+        running: list[Trial] = []
+        while pending or running:
+            while pending and len(running) < self.cfg.max_concurrent_trials:
+                trial = pending.pop(0)
+                self._start(trial)
+                running.append(trial)
+            time.sleep(0.02)
+            for trial in list(running):
+                reports, done = trial.executor.poll_reports()
+                for rep in reports:
+                    if "error" in rep:
+                        trial.error = rep["error"]
+                        continue
+                    trial.iteration += 1
+                    result = dict(rep["metrics"])
+                    result.setdefault(self.cfg.time_attr, trial.iteration)
+                    trial.last_result = result
+                    trial.results.append(result)
+                    if rep.get("checkpoint"):
+                        trial.checkpoint_dir = rep["checkpoint"]
+                    decision = self.scheduler.on_result(trial, result)
+                    if decision == STOP:
+                        self._stop(trial, "STOPPED")
+                        running.remove(trial)
+                        break
+                    if isinstance(decision, tuple) and decision[0] == "EXPLOIT":
+                        donor = next((t for t in self.trials
+                                      if t.trial_id == decision[1]), None)
+                        if donor is not None and donor is not trial:
+                            self._exploit(trial, donor)
+                            running.remove(trial)
+                            pending.append(trial)
+                            break
+                else:
+                    if done:
+                        self._stop(trial,
+                                   "ERROR" if trial.error else "TERMINATED")
+                        running.remove(trial)
+            self._save_state()
+        self._save_state()
+
+    def _save_state(self):
+        state = [{"trial_id": t.trial_id, "status": t.status,
+                  "config": _jsonable(t.config),
+                  "last_result": _jsonable(t.last_result),
+                  "checkpoint_dir": t.checkpoint_dir}
+                 for t in self.trials]
+        with open(os.path.join(self.exp_dir, "experiment_state.json"),
+                  "w") as f:
+            json.dump(state, f)
+
+
+def _jsonable(d: dict) -> dict:
+    out = {}
+    for k, v in d.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except TypeError:
+            out[k] = repr(v)
+    return out

@@ -1,0 +1,199 @@
+"""Train harness + Tune tests (reference: train/tests/, tune/tests/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train as rtrain
+from ray_tpu import tune as rtune
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture
+def rt(ray_tpu_start):
+    return ray_tpu_start
+
+
+def test_data_parallel_fit_reports(rt, tmp_path):
+    def loop(config):
+        ctx = rtrain.get_context()
+        for i in range(3):
+            rtrain.report({"loss": 1.0 / (i + 1), "rank": ctx.rank})
+
+    trainer = rtrain.DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["rank"] == 0
+    assert len(result.metrics_history) == 3
+    assert result.metrics["loss"] == pytest.approx(1 / 3)
+
+
+def test_worker_ranks_distinct(rt, tmp_path):
+    def loop(config):
+        ctx = rtrain.get_context()
+        rtrain.report({"rank": ctx.rank, "world": ctx.world_size})
+
+    trainer = rtrain.DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=3),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["world"] == 3
+
+
+def test_failure_config_retries(rt, tmp_path):
+    marker = tmp_path / "failed_once"
+
+    def flaky(config):
+        if not os.path.exists(str(marker)):
+            open(str(marker), "w").close()
+            raise RuntimeError("transient-failure")
+        rtrain.report({"ok": 1})
+
+    trainer = rtrain.DataParallelTrainer(
+        flaky, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path / "exp"),
+                             failure_config=FailureConfig(max_failures=1)))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["ok"] == 1
+
+
+def test_checkpoint_topk(rt, tmp_path):
+    def loop(config):
+        ctx = rtrain.get_context()
+        for i in range(4):
+            ckpt = os.path.join(ctx.trial_dir, f"ckpt_{i}")
+            os.makedirs(ckpt, exist_ok=True)
+            with open(os.path.join(ckpt, "score"), "w") as f:
+                f.write(str(i))
+            rtrain.report({"score": float(i)}, checkpoint_dir=ckpt)
+
+    trainer = rtrain.DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="score")))
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint_dir.endswith("ckpt_3")  # best retained
+    assert open(os.path.join(result.checkpoint_dir, "score")).read() == "3"
+
+
+def test_dataset_ingest(rt, tmp_path):
+    from ray_tpu import data as rdata
+
+    ds = rdata.range(64, num_blocks=8)
+
+    def loop(config):
+        shard = config["train_shard"]
+        total = sum(int(b["id"].sum())
+                    for b in shard.iter_batches(batch_size=8))
+        rtrain.report({"total": total})
+
+    trainer = rtrain.DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+        datasets={"train": ds})
+    result = trainer.fit()
+    assert result.error is None
+
+
+def test_error_surfaces(rt, tmp_path):
+    def bad(config):
+        raise ValueError("broken loop")
+
+    trainer = rtrain.DataParallelTrainer(
+        bad, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is not None
+    assert "broken loop" in result.error
+
+
+# ---------------------------------------------------------------------------
+# Tune
+# ---------------------------------------------------------------------------
+
+def test_tuner_grid_search(rt, tmp_path):
+    def trainable(config):
+        rtrain.report({"score": config["x"] * 10})
+
+    tuner = rtune.Tuner(
+        trainable,
+        param_space={"x": rtune.grid_search([1, 2, 3])},
+        tune_config=rtune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result("score", "max")
+    assert best.config["x"] == 3
+    assert best.last_result["score"] == 30
+
+
+def test_tuner_random_sampling(rt, tmp_path):
+    def trainable(config):
+        rtrain.report({"y": config["lr"]})
+
+    tuner = rtune.Tuner(
+        trainable,
+        param_space={"lr": rtune.loguniform(1e-5, 1e-1)},
+        tune_config=rtune.TuneConfig(num_samples=4, seed=0),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    lrs = [t.last_result["y"] for t in grid]
+    assert all(1e-5 <= lr <= 1e-1 for lr in lrs)
+    assert len(set(lrs)) == 4
+
+
+def test_asha_stops_bad_trials(rt, tmp_path):
+    def trainable(config):
+        for i in range(1, 10):
+            rtrain.report({"acc": config["quality"] * i})
+
+    sched = rtune.AsyncHyperBandScheduler(
+        metric="acc", mode="max", grace_period=2, max_t=9,
+        reduction_factor=2)
+    tuner = rtune.Tuner(
+        trainable,
+        param_space={"quality": rtune.grid_search([0.1, 0.2, 0.9, 1.0])},
+        tune_config=rtune.TuneConfig(scheduler=sched,
+                                     max_concurrent_trials=4),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    stopped = [t for t in grid if t.status == "STOPPED"]
+    assert stopped, "ASHA should halt at least one low-quality trial"
+    best = grid.get_best_result("acc", "max")
+    assert best.config["quality"] in (0.9, 1.0)
+
+
+def test_experiment_state_persisted(rt, tmp_path):
+    import json
+
+    def trainable(config):
+        rtrain.report({"v": 1})
+
+    rtune.Tuner(
+        trainable, param_space={"x": rtune.grid_search([1, 2])},
+        run_config=RunConfig(storage_path=str(tmp_path), name="exp1"),
+    ).fit()
+    state_file = tmp_path / "exp1" / "experiment_state.json"
+    state = json.loads(state_file.read_text())
+    assert len(state) == 2
+    assert all(t["status"] == "TERMINATED" for t in state)
