@@ -7,6 +7,7 @@ plane: meshes, GSPMD shardings, Pallas kernels, and the AI library surface
 
 from ray_tpu.api import (
     available_resources,
+    timeline,
     cancel,
     cluster_resources,
     get,
@@ -37,6 +38,7 @@ __all__ = [
     "get_actor",
     "cluster_resources",
     "available_resources",
+    "timeline",
     "ObjectRef",
     "exceptions",
     "__version__",

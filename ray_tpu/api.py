@@ -216,6 +216,12 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: ``dag_node.py`` .bind)."""
+        from ray_tpu.dag import DAGNode
+
+        return DAGNode(self._fn, args, kwargs, options=self._options)
+
     @property
     def underlying_function(self):
         return self._fn
@@ -409,6 +415,32 @@ def remote(*args, **kwargs):
 # ---------------------------------------------------------------------------
 # Introspection
 # ---------------------------------------------------------------------------
+
+def timeline(filename: str | None = None) -> list:
+    """Task timeline in chrome://tracing format (reference:
+    ``ray.timeline()`` from ``_private/profiling.py:84``)."""
+    rt = _runtime()
+    events = rt.task_events() if hasattr(rt, "task_events") else []
+    trace = [
+        {
+            "name": e["name"],
+            "cat": "task",
+            "ph": "X",
+            "ts": e["start"] * 1e6,
+            "dur": (e["end"] - e["start"]) * 1e6,
+            "pid": 0,
+            "tid": e.get("thread", "worker"),
+            "args": {"task_id": e["task_id"], "state": e["state"]},
+        }
+        for e in events
+    ]
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
+
 
 def cluster_resources() -> dict:
     return _runtime().cluster_resources()

@@ -126,6 +126,24 @@ class Runtime:
             "tasks_retried": _Counter(),
             "actors_created": _Counter(),
         }
+        # bounded task timeline (reference: task_event_buffer.cc →
+        # ray.timeline() chrome-trace export)
+        self._task_events: deque = deque(maxlen=10000)
+
+    def record_task_event(self, spec: TaskSpec, start: float, end: float,
+                          ok: bool):
+        self._task_events.append({
+            "task_id": spec.task_id.hex(),
+            "name": spec.function_name,
+            "start": start,
+            "end": end,
+            "state": "FINISHED" if ok else "FAILED",
+            "thread": threading.current_thread().name,
+        })
+
+    def task_events(self, limit: int = 1000) -> list:
+        events = list(self._task_events)
+        return events[-limit:]
 
     # ------------------------------------------------------------------
     # Public object API
@@ -344,6 +362,7 @@ class Runtime:
             # happens in kill_actor / creation-failure, not here.
             self._execute_actor_creation(spec)
             return
+        started = time.monotonic()
         try:
             try:
                 args, kwargs = self._materialize_args(spec)
@@ -360,10 +379,12 @@ class Runtime:
                     self._resolve_or_queue(spec)
                     return
                 self.metrics["tasks_failed"].next()
+                self.record_task_event(spec, started, time.monotonic(), False)
                 self._store_error(spec, exc.TaskError(spec.function_name, e))
                 return
             self._store_results(spec, result)
             self.metrics["tasks_finished"].next()
+            self.record_task_event(spec, started, time.monotonic(), True)
         finally:
             self._release_resources(spec.resources)
 

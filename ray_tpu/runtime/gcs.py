@@ -423,6 +423,13 @@ class GcsServer(RpcServer):
                     "strategy": pg.strategy, "bundles": pg.bundles,
                     "bundle_nodes": pg.bundle_nodes}
 
+    def rpc_list_placement_groups(self, conn, send_lock):
+        with self._lock:
+            return [{"pg_id": pg.pg_id, "state": pg.state,
+                     "strategy": pg.strategy,
+                     "bundle_nodes": pg.bundle_nodes}
+                    for pg in self._pgs.values()]
+
     def rpc_remove_placement_group(self, conn, send_lock, *, pg_id):
         with self._lock:
             pg = self._pgs.pop(pg_id, None)

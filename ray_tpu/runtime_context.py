@@ -1,0 +1,45 @@
+"""Runtime context (reference: ``python/ray/runtime_context.py:444,16`` —
+``ray.get_runtime_context()``)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass
+class RuntimeContext:
+    node_id: str
+    worker_id: str
+    job_id: str
+    gcs_address: str | None
+
+    def get_node_id(self) -> str:
+        return self.node_id
+
+    def get_worker_id(self) -> str:
+        return self.worker_id
+
+    def get_job_id(self) -> str:
+        return self.job_id
+
+
+def get_runtime_context() -> RuntimeContext:
+    from ray_tpu.runtime import core as _core
+
+    node_id = os.environ.get("RAY_TPU_NODE_ID", "")
+    worker_id = os.environ.get("RAY_TPU_WORKER_ID", "driver")
+    gcs = None
+    if os.environ.get("RAY_TPU_GCS_HOST"):
+        gcs = (f"{os.environ['RAY_TPU_GCS_HOST']}:"
+               f"{os.environ['RAY_TPU_GCS_PORT']}")
+    job_id = ""
+    if _core.is_initialized():
+        rt = _core.get_runtime()
+        node_id = node_id or getattr(rt, "node_id", "")
+        if hasattr(node_id, "hex"):
+            node_id = node_id.hex()
+        job = getattr(rt, "job_id", None)
+        job_id = job.hex() if hasattr(job, "hex") else str(job or "")
+    return RuntimeContext(node_id=str(node_id), worker_id=worker_id,
+                          job_id=job_id, gcs_address=gcs)

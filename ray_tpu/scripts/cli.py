@@ -1,0 +1,143 @@
+"""ray-tpu CLI (reference: ``python/ray/scripts/scripts.py`` — start/stop/
+status/memory/… and the state CLI ``util/state/state_cli.py``)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def cmd_start(args):
+    """Start a head node (GCS + raylet) or join an existing cluster."""
+    from ray_tpu.runtime.gcs import GcsServer
+    from ray_tpu.runtime.raylet import Raylet
+    from ray_tpu.utils.ids import NodeID
+
+    resources = {"CPU": float(args.num_cpus)}
+    if args.num_tpus:
+        resources["TPU"] = float(args.num_tpus)
+    if args.head:
+        gcs = GcsServer(host=args.host, port=args.port).start()
+        print(f"GCS listening on {gcs.address[0]}:{gcs.address[1]}")
+        gcs_address = gcs.address
+        labels = {"head": True}
+    else:
+        if not args.address:
+            sys.exit("--address required for non-head nodes")
+        host, _, port = args.address.rpartition(":")
+        gcs_address = (host, int(port))
+        labels = {}
+    raylet = Raylet(
+        node_id=NodeID.from_random().hex(), gcs_address=gcs_address,
+        resources=resources,
+        store_capacity=args.object_store_memory, labels=labels).start()
+    print(f"raylet on {raylet.address[0]}:{raylet.address[1]} "
+          f"(store {raylet.store_name})")
+    print(f"connect with: ray_tpu.init(address="
+          f"'{gcs_address[0]}:{gcs_address[1]}')")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        raylet.stop()
+
+
+def _gcs_client(args):
+    from ray_tpu.runtime.rpc import RpcClient
+
+    host, _, port = args.address.rpartition(":")
+    return RpcClient((host or "127.0.0.1", int(port)))
+
+
+def cmd_status(args):
+    client = _gcs_client(args)
+    nodes = client.call("get_nodes", alive_only=False)
+    res = client.call("cluster_resources")
+    print(f"Nodes: {sum(1 for n in nodes if n['alive'])} alive / "
+          f"{len(nodes)} total")
+    print(f"Resources: {json.dumps(res['available'])} available of "
+          f"{json.dumps(res['total'])}")
+    for n in nodes:
+        mark = "+" if n["alive"] else "-"
+        print(f"  [{mark}] {n['node_id'][:12]} @ "
+              f"{n['address'][0]}:{n['address'][1]} {n['resources']}")
+
+
+def cmd_list(args):
+    client = _gcs_client(args)
+    method = {"nodes": "get_nodes", "actors": "list_actors",
+              "jobs": "list_jobs", "pgs": "list_placement_groups",
+              "tasks": "get_task_events"}[args.resource]
+    rows = client.call(method)
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_submit(args):
+    """Run a driver script against a cluster (reference: job submit)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = args.address
+    sys.exit(subprocess.call([sys.executable, args.script] + args.args,
+                             env=env))
+
+
+def cmd_memory(args):
+    client = _gcs_client(args)
+    nodes = client.call("get_nodes", alive_only=True)
+    from ray_tpu.runtime.rpc import RpcClient
+
+    for n in nodes:
+        try:
+            info = RpcClient(tuple(n["address"])).call("node_info")
+            print(f"{n['node_id'][:12]}: workers={info['num_workers']} "
+                  f"available={info['available']}")
+        except OSError:
+            print(f"{n['node_id'][:12]}: unreachable")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ray-tpu", description="ray_tpu cluster CLI")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", help="GCS host:port (non-head)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=6379)
+    p.add_argument("--num-cpus", type=float,
+                   default=float(os.cpu_count() or 1))
+    p.add_argument("--num-tpus", type=float, default=0)
+    p.add_argument("--object-store-memory", type=int, default=1 << 30)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("status", help="cluster status")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("resource",
+                   choices=["nodes", "actors", "jobs", "pgs", "tasks"])
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("submit", help="run a script against the cluster")
+    p.add_argument("--address", required=True)
+    p.add_argument("script")
+    p.add_argument("args", nargs="*")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("memory", help="per-node store/worker stats")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_memory)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

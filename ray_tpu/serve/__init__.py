@@ -1,0 +1,26 @@
+"""ray_tpu.serve: model serving (reference: Ray Serve, SURVEY P15)."""
+
+from ray_tpu.serve.api import (
+    batch,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start_http_proxy,
+)
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.handle import DeploymentHandle
+
+__all__ = [
+    "AutoscalingConfig",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "batch",
+    "delete",
+    "deployment",
+    "get_deployment_handle",
+    "run",
+    "shutdown",
+    "start_http_proxy",
+]

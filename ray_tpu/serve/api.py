@@ -1,0 +1,259 @@
+"""Serve public API: @deployment / run / batch / HTTP proxy.
+
+Reference analog: ``serve/api.py`` (``@serve.deployment:256``,
+``serve.run:463``), ``serve/batching.py`` (``@serve.batch:65`` dynamic
+batching), and the per-node HTTP proxy (``_private/proxy.py:759`` — here a
+threaded stdlib HTTP server routing JSON bodies to deployment handles,
+keeping the data path dependency-free)."""
+
+from __future__ import annotations
+
+import functools
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.handle import DeploymentHandle
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+_local = threading.local()
+
+
+def _get_or_start_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    controller_cls = ray_tpu.remote(ServeController)
+    try:
+        return controller_cls.options(name=CONTROLLER_NAME,
+                                      max_concurrency=16).remote()
+    except ValueError:  # raced another starter
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+class Deployment:
+    """Bound result of @serve.deployment on a class."""
+
+    def __init__(self, cls, name: str, config: DeploymentConfig,
+                 init_args=(), init_kwargs=None):
+        self._cls = cls
+        self.name = name
+        self.config = config
+        self._init_args = init_args
+        self._init_kwargs = init_kwargs or {}
+
+    def options(self, *, name=None, num_replicas=None,
+                max_concurrent_queries=None, autoscaling_config=None,
+                user_config=None, resources_per_replica=None) -> "Deployment":
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas or self.config.num_replicas,
+            max_concurrent_queries=(max_concurrent_queries
+                                    or self.config.max_concurrent_queries),
+            autoscaling=autoscaling_config or self.config.autoscaling,
+            user_config=user_config or self.config.user_config,
+            resources_per_replica=(resources_per_replica
+                                   or self.config.resources_per_replica),
+        )
+        return Deployment(self._cls, name or self.name, cfg,
+                          self._init_args, self._init_kwargs)
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        """Bind constructor args (reference: DAG .bind())."""
+        return Deployment(self._cls, self.name, self.config, args, kwargs)
+
+
+def deployment(cls=None, *, name=None, num_replicas=1,
+               max_concurrent_queries=8, autoscaling_config=None,
+               user_config=None, resources_per_replica=None):
+    def wrap(c):
+        auto = autoscaling_config
+        if isinstance(auto, dict):
+            auto = AutoscalingConfig(**auto)
+        return Deployment(
+            c, name or c.__name__,
+            DeploymentConfig(
+                num_replicas=num_replicas,
+                max_concurrent_queries=max_concurrent_queries,
+                autoscaling=auto,
+                user_config=user_config or {},
+                resources_per_replica=resources_per_replica or {},
+            ))
+    return wrap(cls) if cls is not None else wrap
+
+
+def run(dep: Deployment, *, name: str | None = None) -> DeploymentHandle:
+    """Deploy (or redeploy) and return a handle (reference: serve.run:463).
+    """
+    controller = _get_or_start_controller()
+    auto = dep.config.autoscaling
+    cfg = {
+        "num_replicas": dep.config.num_replicas,
+        "max_concurrent_queries": dep.config.max_concurrent_queries,
+        "autoscaling": vars(auto) if auto else None,
+        "user_config": dep.config.user_config,
+        "resources_per_replica": dep.config.resources_per_replica,
+    }
+    dep_name = name or dep.name
+    ray_tpu.get(controller.deploy.remote(
+        dep_name, cloudpickle.dumps(dep._cls, protocol=5),
+        dep._init_args, dep._init_kwargs, cfg))
+    handle = DeploymentHandle(dep_name, controller)
+    # wait for at least one replica
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        version, replicas = ray_tpu.get(
+            controller.get_replicas.remote(dep_name))
+        if replicas:
+            return handle
+        time.sleep(0.05)
+    raise TimeoutError(f"deployment {dep_name!r} has no replicas after 30s")
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name, _get_or_start_controller())
+
+
+def delete(name: str):
+    controller = _get_or_start_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name))
+
+
+def shutdown():
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=10)
+    except Exception:  # noqa: BLE001
+        pass
+    ray_tpu.kill(controller)
+
+
+# ---------------------------------------------------------------------------
+# dynamic batching (reference: serve/batching.py:65)
+# ---------------------------------------------------------------------------
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: ``fn(self, items: list) -> list`` is invoked with batches
+    accumulated across concurrent callers (requires the deployment's
+    max_concurrent_queries > 1 so callers overlap)."""
+
+    def wrap(fn):
+        # batching state lives on the replica INSTANCE, created lazily —
+        # the decorator closure must stay pickle-clean (the deployment
+        # class ships to replicas via cloudpickle)
+        attr = f"__serve_batch_state_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(self, item):
+            # dict.setdefault is atomic under the GIL — both racing
+            # creators observe the same winning state dict
+            state = self.__dict__.setdefault(
+                attr, {"queue": [], "cv": threading.Condition()})
+            entry = {"item": item, "done": threading.Event(),
+                     "result": None, "error": None}
+            with state["cv"]:
+                state["queue"].append(entry)
+                if len(state["queue"]) >= max_batch_size:
+                    state["cv"].notify_all()
+            entry["done"].wait(timeout=batch_wait_timeout_s)  # accumulate
+            # Flush until OUR entry completes: a caller may flush batches
+            # that don't contain its own entry (they were queued first);
+            # it then loops and flushes the next batch rather than
+            # stranding itself.
+            while not entry["done"].is_set():
+                with state["cv"]:
+                    batch_entries = state["queue"][:max_batch_size]
+                    state["queue"] = state["queue"][max_batch_size:]
+                if not batch_entries:
+                    entry["done"].wait(timeout=0.01)
+                    continue
+                try:
+                    results = fn(self, [e["item"] for e in batch_entries])
+                    for e, r in zip(batch_entries, results):
+                        e["result"] = r
+                        e["done"].set()
+                except BaseException as err:  # noqa: BLE001
+                    for e in batch_entries:
+                        e["error"] = err
+                        e["done"].set()
+            if entry["error"] is not None:
+                raise entry["error"]
+            return entry["result"]
+
+        wrapper.__wrapped_batch__ = fn
+        return wrapper
+
+    return wrap if _fn is None else wrap(_fn)
+
+
+# ---------------------------------------------------------------------------
+# HTTP proxy (reference: _private/proxy.py — uvicorn HTTP; stdlib here)
+# ---------------------------------------------------------------------------
+
+class _ProxyHandler(BaseHTTPRequestHandler):
+    # handle cache is per proxy server: start_http_proxy subclasses this
+    # with a fresh dict (a class-level cache would leak stale controller
+    # references across serve.shutdown()/restart cycles)
+    handles: dict[str, DeploymentHandle]
+
+    def log_message(self, *args):  # silence request logging
+        pass
+
+    def do_POST(self):
+        name = self.path.strip("/").split("/")[0]
+        handle = self.handles.get(name)
+        if handle is None:
+            try:
+                handle = get_deployment_handle(name)
+                handle._refresh(ttl=0)  # raises KeyError if unknown
+                self.handles[name] = handle
+            except Exception:  # noqa: BLE001
+                self.send_error(404, f"no deployment {name!r}")
+                return
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b"{}"
+        try:
+            payload = json.loads(body) if body else {}
+            result = handle.call(payload)
+            out = json.dumps({"result": result}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+        except Exception as e:  # noqa: BLE001
+            msg = json.dumps({"error": repr(e)}).encode()
+            self.send_response(500)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(msg)))
+            self.end_headers()
+            self.wfile.write(msg)
+
+    def do_GET(self):
+        if self.path in ("/-/healthz", "/healthz"):
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+        else:
+            self.send_error(404)
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 0):
+    """Start the HTTP ingress; returns (server, (host, port)). POST
+    /<deployment> with a JSON body routes to the deployment's __call__."""
+    handler = type("_ProxyHandlerInstance", (_ProxyHandler,),
+                   {"handles": {}})
+    server = ThreadingHTTPServer((host, port), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, server.server_address

@@ -1,0 +1,24 @@
+"""Deployment configuration (reference: ``serve/config.py`` +
+``serve/schema.py`` pydantic models, collapsed to dataclasses)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_concurrent_queries: int = 8
+    autoscaling: AutoscalingConfig | None = None
+    user_config: dict = field(default_factory=dict)
+    resources_per_replica: dict = field(default_factory=dict)

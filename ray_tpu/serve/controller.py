@@ -1,0 +1,216 @@
+"""Serve controller: declarative deployment state reconciliation.
+
+Reference analog: ``serve/_private/controller.py`` (``ServeController:87``,
+``run_control_loop:312``) + ``deployment_state.py`` (``DeploymentState
+:1149`` — diff target vs actual replica sets) + autoscaling policy
+(``_private/autoscaling_policy.py``). The controller is a named actor; a
+background thread reconciles desired replica counts and drives
+autoscaling from replica queue metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import ray_tpu
+
+
+class _Replica:
+    """Replica actor body: wraps the user's deployment class.
+
+    Reference analog: ``serve/_private/replica.py`` — handle_request:227.
+    Requests run on the actor's concurrency pool; ``num_ongoing`` feeds
+    both the router's p2c choice and controller autoscaling."""
+
+    def __init__(self, cls_blob, init_args, init_kwargs, user_config):
+        import cloudpickle
+
+        cls = cloudpickle.loads(cls_blob)
+        self._instance = cls(*init_args, **init_kwargs)
+        if user_config and hasattr(self._instance, "reconfigure"):
+            self._instance.reconfigure(user_config)
+        self._ongoing = 0
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def handle_request(self, method_name, args, kwargs):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = (self._instance if method_name == "__call__"
+                      else getattr(self._instance, method_name))
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def reconfigure(self, user_config):
+        if hasattr(self._instance, "reconfigure"):
+            self._instance.reconfigure(user_config)
+        return True
+
+    def metrics(self):
+        with self._lock:
+            return {"ongoing": self._ongoing, "total": self._total}
+
+    def ping(self):
+        return True
+
+
+class ServeController:
+    """Named actor ('SERVE_CONTROLLER'). Deployment lifecycle + replica
+    sets + autoscaling."""
+
+    def __init__(self):
+        self._deployments: dict[str, dict] = {}
+        self._lock = threading.RLock()
+        self._stop = False
+        self._version = 0
+        self._loop = threading.Thread(target=self._control_loop, daemon=True)
+        self._loop.start()
+
+    # -- deployment API --------------------------------------------------
+    def deploy(self, name: str, cls_blob: bytes, init_args, init_kwargs,
+               config: dict):
+        with self._lock:
+            prev = self._deployments.get(name)
+            self._deployments[name] = {
+                "cls_blob": cls_blob,
+                "init_args": init_args,
+                "init_kwargs": init_kwargs,
+                "config": config,
+                "replicas": prev["replicas"] if prev else [],
+                "target": (config.get("autoscaling") or {}).get(
+                    "min_replicas", config.get("num_replicas", 1))
+                if config.get("autoscaling")
+                else config.get("num_replicas", 1),
+                "last_scale": time.monotonic(),
+                "redeploy": prev is not None,
+            }
+            self._version += 1
+        return True
+
+    def delete_deployment(self, name: str):
+        with self._lock:
+            dep = self._deployments.pop(name, None)
+            self._version += 1
+        if dep:
+            for r in dep["replicas"]:
+                _kill_quietly(r)
+        return True
+
+    def get_replicas(self, name: str):
+        """(version, [replica handles]) — handles are routable actor refs."""
+        with self._lock:
+            dep = self._deployments.get(name)
+            if dep is None:
+                return self._version, None
+            return self._version, list(dep["replicas"])
+
+    def version(self) -> int:
+        return self._version
+
+    def list_deployments(self):
+        with self._lock:
+            return {
+                name: {"target": dep["target"],
+                       "running": len(dep["replicas"]),
+                       "config": dep["config"]}
+                for name, dep in self._deployments.items()
+            }
+
+    def shutdown(self):
+        self._stop = True
+        with self._lock:
+            deps = list(self._deployments.values())
+            self._deployments.clear()
+        for dep in deps:
+            for r in dep["replicas"]:
+                _kill_quietly(r)
+        return True
+
+    # -- reconciliation --------------------------------------------------
+    def _control_loop(self):
+        while not self._stop:
+            try:
+                self._reconcile_once()
+                self._autoscale_once()
+            except Exception:  # noqa: BLE001 - keep the loop alive
+                pass
+            time.sleep(0.1)
+
+    def _reconcile_once(self):
+        with self._lock:
+            items = list(self._deployments.items())
+        for name, dep in items:
+            if dep.get("redeploy"):
+                # config/code changed: replace replica set (reference:
+                # rolling update; v1 does stop-then-start)
+                old = dep["replicas"]
+                dep["replicas"] = []
+                dep["redeploy"] = False
+                for r in old:
+                    _kill_quietly(r)
+                with self._lock:
+                    self._version += 1
+            target = dep["target"]
+            replicas = dep["replicas"]
+            while len(replicas) < target:
+                replica_cls = ray_tpu.remote(_Replica)
+                res = dep["config"].get("resources_per_replica") or {}
+                opts = {"max_concurrency":
+                        dep["config"].get("max_concurrent_queries", 8)}
+                if res.get("CPU"):
+                    opts["num_cpus"] = res["CPU"]
+                if res.get("TPU"):
+                    opts["num_tpus"] = res["TPU"]
+                handle = replica_cls.options(**opts).remote(
+                    dep["cls_blob"], dep["init_args"], dep["init_kwargs"],
+                    dep["config"].get("user_config") or {})
+                replicas.append(handle)
+                with self._lock:
+                    self._version += 1
+            while len(replicas) > target:
+                victim = replicas.pop()
+                _kill_quietly(victim)
+                with self._lock:
+                    self._version += 1
+
+    def _autoscale_once(self):
+        now = time.monotonic()
+        with self._lock:
+            items = list(self._deployments.items())
+        for name, dep in items:
+            auto = dep["config"].get("autoscaling")
+            if not auto or not dep["replicas"]:
+                continue
+            try:
+                metrics = ray_tpu.get(
+                    [r.metrics.remote() for r in dep["replicas"]],
+                    timeout=5)
+            except Exception:  # noqa: BLE001
+                continue
+            ongoing = sum(m["ongoing"] for m in metrics)
+            per_replica = ongoing / max(1, len(dep["replicas"]))
+            target_per = auto.get("target_ongoing_requests", 2.0)
+            if (per_replica > target_per
+                    and dep["target"] < auto.get("max_replicas", 4)
+                    and now - dep["last_scale"] > auto.get(
+                        "upscale_delay_s", 0.5)):
+                dep["target"] += 1
+                dep["last_scale"] = now
+            elif (per_replica < target_per / 2
+                    and dep["target"] > auto.get("min_replicas", 1)
+                    and now - dep["last_scale"] > auto.get(
+                        "downscale_delay_s", 2.0)):
+                dep["target"] -= 1
+                dep["last_scale"] = now
+
+
+def _kill_quietly(handle):
+    try:
+        ray_tpu.kill(handle)
+    except Exception:  # noqa: BLE001
+        pass

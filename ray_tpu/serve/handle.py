@@ -1,0 +1,121 @@
+"""DeploymentHandle + router: replica selection per request.
+
+Reference analog: ``serve/handle.py`` (``DeploymentHandle:804``) and
+``serve/_private/router.py`` — ``PowerOfTwoChoicesReplicaScheduler:290``:
+pick two random replicas, route to the one with fewer in-flight requests.
+In-flight counts are tracked client-side (each handle knows what it sent
+and what completed), so the hot path makes zero control-plane calls; the
+replica set refreshes when the controller version changes (long-poll
+analog: cheap version check with TTL)."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import ray_tpu
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller, method_name="__call__"):
+        self.deployment_name = deployment_name
+        self._controller = controller
+        self._method = method_name
+        self._replicas: list = []
+        self._version = -1
+        self._checked_at = 0.0
+        self._lock = threading.Lock()
+        self._inflight: dict = {}   # replica -> count
+
+    def options(self, *, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self._controller,
+                             method_name)
+        h._replicas, h._version = self._replicas, self._version
+        h._inflight = self._inflight
+        return h
+
+    # -- replica set refresh (long-poll analog) -------------------------
+    def _refresh(self, ttl: float = 0.2):
+        now = time.monotonic()
+        with self._lock:
+            if self._replicas and now - self._checked_at < ttl:
+                return
+        version = ray_tpu.get(self._controller.version.remote())
+        with self._lock:
+            if version == self._version and self._replicas:
+                self._checked_at = now
+                return
+        version, replicas = ray_tpu.get(
+            self._controller.get_replicas.remote(self.deployment_name))
+        if replicas is None:
+            raise KeyError(
+                f"deployment {self.deployment_name!r} does not exist")
+        with self._lock:
+            self._replicas = replicas
+            self._version = version
+            self._checked_at = now
+            self._inflight = {r: self._inflight.get(r, []) for r in replicas}
+
+    def _prune(self, replica):
+        """Drop completed refs from a replica's outstanding list (non-
+        blocking); returns the remaining in-flight count."""
+        refs = self._inflight.get(replica, [])
+        if refs:
+            ready, not_ready = ray_tpu.wait(refs, num_returns=len(refs),
+                                            timeout=0)
+            self._inflight[replica] = not_ready
+            return len(not_ready)
+        return 0
+
+    def _pick(self):
+        """Power-of-two-choices on client-side outstanding-request counts
+        (pruned at pick time — no background bookkeeping threads)."""
+        with self._lock:
+            replicas = self._replicas
+            if not replicas:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no replicas")
+            if len(replicas) == 1:
+                return replicas[0]
+            a, b = random.sample(replicas, 2)
+            return a if self._prune(a) <= self._prune(b) else b
+
+    # -- request path ----------------------------------------------------
+    def remote(self, *args, **kwargs):
+        """Async call → ObjectRef (resolve with ray_tpu.get)."""
+        self._refresh()
+        last = None
+        for attempt in range(5):
+            try:
+                replica = self._pick()  # raises during redeploy gap
+                ref = replica.handle_request.remote(self._method, args,
+                                                    kwargs)
+                with self._lock:
+                    self._inflight.setdefault(replica, []).append(ref)
+                return ref
+            except Exception as e:  # noqa: BLE001 - dead replica / empty set
+                last = e
+                with self._lock:
+                    self._version = -1
+                time.sleep(0.05 * attempt)
+                self._refresh(ttl=0)
+        raise RuntimeError(
+            f"could not route request to {self.deployment_name!r}: {last!r}")
+
+    def call(self, *args, **kwargs):
+        """Sync convenience: remote + get. A replica torn down mid-request
+        (redeploy/downscale) surfaces at get(); retry against the
+        refreshed replica set (reference: router resend on replica death)."""
+        from ray_tpu.utils.exceptions import ActorError
+
+        last = None
+        for attempt in range(3):
+            try:
+                return ray_tpu.get(self.remote(*args, **kwargs))
+            except ActorError as e:
+                last = e
+                with self._lock:
+                    self._version = -1
+                time.sleep(0.05 * (attempt + 1))
+        raise last

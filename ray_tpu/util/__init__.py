@@ -1,0 +1,6 @@
+"""ray_tpu.util: ecosystem utilities (reference: ray.util, SURVEY P22)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Queue
+
+__all__ = ["ActorPool", "Queue"]

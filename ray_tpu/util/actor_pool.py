@@ -1,0 +1,89 @@
+"""ActorPool (reference: ``python/ray/util/actor_pool.py:13``)."""
+
+from __future__ import annotations
+
+import ray_tpu
+
+
+class ActorPool:
+    """Round-robin work distribution over a fixed set of actors with
+    in-order (``map``) and completion-order (``map_unordered``) result
+    iteration."""
+
+    def __init__(self, actors: list):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: list = []
+
+    def submit(self, fn, value):
+        """fn(actor, value) -> ObjectRef; queued if all actors busy."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def get_next(self, timeout=None):
+        """Next result in SUBMISSION order."""
+        if self._next_return_index not in self._index_to_future:
+            raise StopIteration("no pending results")
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        try:
+            return ray_tpu.get(ref, timeout=timeout)
+        finally:
+            # even when the task errored, the actor itself is healthy —
+            # return it so queued submits aren't stranded
+            self._return_actor(ref)
+
+    def get_next_unordered(self, timeout=None):
+        """Next result in COMPLETION order."""
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        index, _ = self._future_to_actor[ref]
+        self._index_to_future.pop(index, None)
+        try:
+            return ray_tpu.get(ref)
+        finally:
+            self._return_actor(ref)
+
+    def _return_actor(self, ref):
+        _, actor = self._future_to_actor.pop(ref)
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def map(self, fn, values):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn, values):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None
+
+    def push(self, actor):
+        self._idle.append(actor)

@@ -1,0 +1,211 @@
+"""Host-level collective communication between actors/tasks.
+
+Reference analog: ``python/ray/util/collective/`` (P-COLL —
+``GroupManager:40``, ``init_collective_group:120``, ``allreduce:258``,
+``send:531``) which wraps NCCL/Gloo. The TPU device plane does NOT use
+this — ICI collectives are XLA ops inside jit (``ray_tpu.parallel``); this
+module is the Gloo analog for host (CPU/numpy) tensors: rendezvous through
+a named coordinator actor per group, with numpy reductions.
+
+API parity: init_collective_group, allreduce, allgather, reducescatter,
+broadcast, barrier, send/recv (point-to-point through the coordinator).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+import ray_tpu
+
+_REDUCERS = {
+    "sum": lambda arrs: np.sum(arrs, axis=0),
+    "prod": lambda arrs: np.prod(arrs, axis=0),
+    "max": lambda arrs: np.max(arrs, axis=0),
+    "min": lambda arrs: np.min(arrs, axis=0),
+}
+
+
+class _Coordinator:
+    """Rendezvous actor: collects per-rank contributions round by round,
+    computes the collective, and hands each rank its share."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.rounds: dict = {}     # (op_name, round_id) -> {rank: array}
+        self.results: dict = {}    # (op_name, round_id) -> result
+        self.mailbox: dict = {}    # (src, dst, tag) -> value
+        # contribute() runs on concurrent actor threads (max_concurrency>1)
+        self._lock = threading.Lock()
+
+    def contribute(self, op_name, round_id, rank, value, spec=None):
+        key = (op_name, round_id)
+        with self._lock:
+            slot = self.rounds.setdefault(key, {})
+            slot[rank] = value
+            if len(slot) == self.world_size and key in self.rounds:
+                self.results[key] = self._compute(op_name, slot, spec)
+                del self.rounds[key]
+        return True
+
+    def fetch(self, op_name, round_id, rank):
+        key = (op_name, round_id)
+        with self._lock:
+            if key not in self.results:
+                return False, None
+            result = self.results[key]
+        if op_name.startswith("reducescatter"):
+            out = result[rank]
+        elif op_name.startswith("broadcast"):
+            out = result
+        else:
+            out = result
+        return True, out
+
+    def gc_round(self, op_name, round_id):
+        with self._lock:
+            self.results.pop((op_name, round_id), None)
+        return True
+
+    def _compute(self, op_name, slot, spec):
+        values = [slot[r] for r in sorted(slot)]
+        if op_name.startswith("allreduce"):
+            return _REDUCERS[spec or "sum"](
+                [np.asarray(v) for v in values])
+        if op_name.startswith("allgather"):
+            return list(values)
+        if op_name.startswith("reducescatter"):
+            reduced = _REDUCERS[spec or "sum"](
+                [np.asarray(v) for v in values])
+            return np.array_split(reduced, self.world_size)
+        if op_name.startswith("broadcast"):
+            return values[int(spec or 0)]
+        if op_name.startswith("barrier"):
+            return True
+        raise ValueError(op_name)
+
+    def post(self, src, dst, tag, value):
+        with self._lock:
+            self.mailbox[(src, dst, tag)] = value
+        return True
+
+    def take(self, src, dst, tag):
+        with self._lock:
+            if (src, dst, tag) in self.mailbox:
+                return True, self.mailbox.pop((src, dst, tag))
+        return False, None
+
+
+class CollectiveGroup:
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._round = 0
+        name = f"__collective_{group_name}"
+        try:
+            self.coord = ray_tpu.get_actor(name)
+        except ValueError:
+            cls = ray_tpu.remote(_Coordinator)
+            try:
+                self.coord = cls.options(name=name,
+                                         max_concurrency=max(
+                                             4, world_size)).remote(world_size)
+            except ValueError:
+                self.coord = ray_tpu.get_actor(name)
+
+    def _collective(self, op: str, value, spec=None, timeout=60.0):
+        round_id = self._round
+        self._round += 1
+        ray_tpu.get(self.coord.contribute.remote(
+            op, round_id, self.rank, value, spec))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            ok, out = ray_tpu.get(self.coord.fetch.remote(
+                op, round_id, self.rank))
+            if ok:
+                if self.rank == 0:
+                    # rank 0 GCs the round after a grace period; cheap and
+                    # avoids unbounded result growth
+                    self._maybe_gc(op, round_id)
+                return out
+            time.sleep(0.002)
+        raise TimeoutError(
+            f"collective {op} round {round_id} timed out in "
+            f"group {self.group_name!r}")
+
+    def _maybe_gc(self, op, round_id, keep: int = 8):
+        if round_id >= keep:
+            self.coord.gc_round.remote(op, round_id - keep)
+
+    # -- the API (numpy in, numpy out) ----------------------------------
+    def allreduce(self, array, op: str = "sum"):
+        return self._collective("allreduce", np.asarray(array), op)
+
+    def allgather(self, array) -> list:
+        return self._collective("allgather", np.asarray(array))
+
+    def reducescatter(self, array, op: str = "sum"):
+        return self._collective("reducescatter", np.asarray(array), op)
+
+    def broadcast(self, array, src_rank: int = 0):
+        return self._collective("broadcast", np.asarray(array),
+                                str(src_rank))
+
+    def barrier(self):
+        return self._collective("barrier", self.rank)
+
+    def send(self, array, dst_rank: int, tag: int = 0):
+        ray_tpu.get(self.coord.post.remote(
+            self.rank, dst_rank, tag, np.asarray(array)))
+
+    def recv(self, src_rank: int, tag: int = 0, timeout: float = 60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            ok, value = ray_tpu.get(self.coord.take.remote(
+                src_rank, self.rank, tag))
+            if ok:
+                return value
+            time.sleep(0.002)
+        raise TimeoutError(f"recv from rank {src_rank} timed out")
+
+
+_groups = threading.local()
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> CollectiveGroup:
+    group = CollectiveGroup(group_name, world_size, rank)
+    if not hasattr(_groups, "groups"):
+        _groups.groups = {}
+    _groups.groups[group_name] = group
+    return group
+
+
+def get_group(group_name: str = "default") -> CollectiveGroup:
+    groups = getattr(_groups, "groups", {})
+    if group_name not in groups:
+        raise ValueError(f"collective group {group_name!r} not initialized")
+    return groups[group_name]
+
+
+def allreduce(array, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).allreduce(array, op)
+
+
+def allgather(array, group_name: str = "default"):
+    return get_group(group_name).allgather(array)
+
+
+def reducescatter(array, group_name: str = "default", op: str = "sum"):
+    return get_group(group_name).reducescatter(array, op)
+
+
+def broadcast(array, src_rank: int = 0, group_name: str = "default"):
+    return get_group(group_name).broadcast(array, src_rank)
+
+
+def barrier(group_name: str = "default"):
+    return get_group(group_name).barrier()

@@ -1,0 +1,128 @@
+"""Application metrics API (reference: ``python/ray/util/metrics.py`` over
+``includes/metric.pxi``; C++ registry N11 ``src/ray/stats/``).
+
+Counter/Gauge/Histogram with tag support; the process-local registry
+exports Prometheus text format (the reference pushes to a per-node metrics
+agent scraped by Prometheus — here ``export_prometheus()`` serves the same
+wire format for any scraper)."""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import defaultdict
+
+_registry_lock = threading.Lock()
+_registry: dict[str, "Metric"] = {}
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: tuple = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: dict = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+
+    def set_default_tags(self, tags: dict):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: dict | None) -> tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        return tuple(sorted(merged.items()))
+
+
+class Counter(Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: dict = defaultdict(float)
+
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        with self._lock:
+            self._values[self._key(tags)] += value
+
+    def series(self):
+        with self._lock:
+            return dict(self._values)
+
+
+class Gauge(Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: dict = {}
+
+    def set(self, value: float, tags: dict | None = None):
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+    def series(self):
+        with self._lock:
+            return dict(self._values)
+
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
+
+
+class Histogram(Metric):
+    def __init__(self, name, description="", boundaries=DEFAULT_BUCKETS,
+                 tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(boundaries)
+        self._counts: dict = defaultdict(
+            lambda: [0] * (len(self.boundaries) + 1))
+        self._sums: dict = defaultdict(float)
+        self._totals: dict = defaultdict(int)
+
+    def observe(self, value: float, tags: dict | None = None):
+        key = self._key(tags)
+        idx = bisect.bisect_left(self.boundaries, value)
+        with self._lock:
+            self._counts[key][idx] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def series(self):
+        with self._lock:
+            return {k: {"buckets": list(v), "sum": self._sums[k],
+                        "count": self._totals[k]}
+                    for k, v in self._counts.items()}
+
+
+def _fmt_tags(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def export_prometheus() -> str:
+    """All registered metrics in Prometheus text exposition format."""
+    lines = []
+    with _registry_lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        if m.description:
+            lines.append(f"# HELP {m.name} {m.description}")
+        kind = {"Counter": "counter", "Gauge": "gauge",
+                "Histogram": "histogram"}[type(m).__name__]
+        lines.append(f"# TYPE {m.name} {kind}")
+        if isinstance(m, Histogram):
+            for key, data in m.series().items():
+                cumulative = 0
+                bounds = [str(b) for b in m.boundaries] + ["+Inf"]
+                for bound, count in zip(bounds, data["buckets"]):
+                    cumulative += count
+                    tag = dict(key)
+                    tag["le"] = bound
+                    lines.append(
+                        f"{m.name}_bucket{_fmt_tags(tuple(sorted(tag.items())))} {cumulative}")
+                lines.append(f"{m.name}_sum{_fmt_tags(key)} {data['sum']}")
+                lines.append(f"{m.name}_count{_fmt_tags(key)} {data['count']}")
+        else:
+            for key, value in m.series().items():
+                lines.append(f"{m.name}{_fmt_tags(key)} {value}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,111 @@
+"""State API: list/get/summarize cluster entities.
+
+Reference analog: ``python/ray/util/state/`` (StateResource enum
+``common.py:71-87``) backed by the GCS + task events
+(``dashboard/state_aggregator.py``, ``gcs_task_manager.cc``). Works in
+both modes: local (in-process runtime introspection) and cluster (GCS
+queries)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.runtime import core as _core
+
+
+def _mode():
+    if not _core.is_initialized():
+        return None, None
+    rt = _core.get_runtime()
+    if hasattr(rt, "_gcs"):  # ClusterRuntime
+        return "cluster", rt
+    return "local", rt
+
+
+def list_nodes() -> list[dict]:
+    mode, rt = _mode()
+    if mode == "cluster":
+        return rt._gcs.call("get_nodes", alive_only=False)
+    if mode == "local":
+        return [{"node_id": rt.node_id.hex(), "alive": True,
+                 "resources": rt.total_resources,
+                 "available": rt.available_resources_snapshot()}]
+    return []
+
+
+def list_actors() -> list[dict]:
+    mode, rt = _mode()
+    if mode == "cluster":
+        return rt._gcs.call("list_actors")
+    if mode == "local":
+        return [{"actor_id": a.actor_id.hex(), "name": a.name,
+                 "state": "DEAD" if a.dead else "ALIVE",
+                 "num_restarts": a.num_restarts}
+                for a in rt._actors.values()]
+    return []
+
+
+def list_jobs() -> list[dict]:
+    mode, rt = _mode()
+    if mode == "cluster":
+        return rt._gcs.call("list_jobs")
+    if mode == "local":
+        return [{"job_id": rt.job_id.hex(), "state": "RUNNING"}]
+    return []
+
+
+def list_placement_groups() -> list[dict]:
+    mode, rt = _mode()
+    if mode == "cluster":
+        return rt._gcs.call("list_placement_groups")
+    return []
+
+
+def list_tasks(limit: int = 1000) -> list[dict]:
+    mode, rt = _mode()
+    if mode == "cluster":
+        return rt._gcs.call("get_task_events", limit=limit)
+    if mode == "local":
+        return rt.task_events(limit) if hasattr(rt, "task_events") else []
+    return []
+
+
+def list_objects() -> list[dict]:
+    mode, rt = _mode()
+    if mode == "local":
+        return [{"object_id": k.hex() if hasattr(k, "hex") else str(k)}
+                for k in getattr(rt.store, "_objects", {})]
+    if mode == "cluster":
+        stats = rt.store.stats()
+        return [{"local_store": stats}]
+    return []
+
+
+def summarize_actors() -> dict:
+    counts: dict[str, int] = {}
+    for a in list_actors():
+        counts[a["state"]] = counts.get(a["state"], 0) + 1
+    return counts
+
+
+def summarize_tasks() -> dict:
+    counts: dict[str, int] = {}
+    for t in list_tasks():
+        state = t.get("state", "UNKNOWN")
+        counts[state] = counts.get(state, 0) + 1
+    return counts
+
+
+def cluster_summary() -> dict:
+    mode, rt = _mode()
+    if rt is None:
+        return {"initialized": False}
+    return {
+        "initialized": True,
+        "mode": mode,
+        "nodes": len([n for n in list_nodes()
+                      if n.get("alive", True)]),
+        "actors": summarize_actors(),
+        "resources_total": rt.cluster_resources(),
+        "resources_available": rt.available_resources_snapshot(),
+    }

@@ -1,0 +1,80 @@
+"""Durable workflow execution (reference: ``python/ray/workflow/``, P19).
+
+``workflow.run(dag_node, workflow_id=...)`` executes a ``ray_tpu.dag``
+graph with per-step checkpointing: each node's result is persisted under
+the workflow's storage directory keyed by a deterministic step id
+(topological index + function name). ``resume`` re-runs the DAG, skipping
+every step whose checkpoint exists — the saga-style recovery of the
+reference (``workflow_state_from_storage.py``) specialized to DAGs.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import ray_tpu
+from ray_tpu.dag import DAGNode
+
+_STORAGE = os.path.join(os.path.expanduser("~"), "ray_tpu_workflows")
+
+
+def _step_id(index: int, node: DAGNode) -> str:
+    return f"{index:04d}_{getattr(node._fn, '__name__', 'step')}"
+
+
+def run(dag: DAGNode, *, workflow_id: str,
+        storage: str | None = None):
+    """Execute with checkpointing; returns the final result (sync)."""
+    root = os.path.join(storage or _STORAGE, workflow_id)
+    os.makedirs(root, exist_ok=True)
+    order = dag.topo_order()
+    results: dict[int, object] = {}
+    for index, node in enumerate(order):
+        path = os.path.join(root, _step_id(index, node) + ".pkl")
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                results[id(node)] = pickle.load(f)
+            continue
+        args = [results[id(a)] if isinstance(a, DAGNode) else a
+                for a in node._args]
+        kwargs = {k: results[id(v)] if isinstance(v, DAGNode) else v
+                  for k, v in node._kwargs.items()}
+        value = ray_tpu.get(ray_tpu.remote(node._fn).remote(*args, **kwargs))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)  # atomic: a crash never leaves half a step
+        results[id(node)] = value
+    _mark(root, "SUCCESS")
+    return results[id(dag)]
+
+
+def resume(dag: DAGNode, *, workflow_id: str, storage: str | None = None):
+    """Re-run, skipping checkpointed steps (crash recovery)."""
+    return run(dag, workflow_id=workflow_id, storage=storage)
+
+
+def status(workflow_id: str, *, storage: str | None = None) -> str:
+    root = os.path.join(storage or _STORAGE, workflow_id)
+    if not os.path.isdir(root):
+        return "NOT_FOUND"
+    if os.path.exists(os.path.join(root, "_STATUS_SUCCESS")):
+        return "SUCCESS"
+    return "RUNNING" if os.listdir(root) else "PENDING"
+
+
+def list_all(*, storage: str | None = None) -> list[str]:
+    base = storage or _STORAGE
+    return sorted(os.listdir(base)) if os.path.isdir(base) else []
+
+
+def delete(workflow_id: str, *, storage: str | None = None):
+    import shutil
+
+    shutil.rmtree(os.path.join(storage or _STORAGE, workflow_id),
+                  ignore_errors=True)
+
+
+def _mark(root: str, state: str):
+    open(os.path.join(root, f"_STATUS_{state}"), "w").close()

@@ -1,0 +1,187 @@
+"""Serve tests (reference analog: serve/tests/ incl. the no-cluster unit
+layer serve/tests/unit/)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def rt(ray_tpu_start):
+    yield ray_tpu_start
+    serve.shutdown()
+
+
+def test_deploy_and_call(rt):
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return {"echo": x}
+
+    handle = serve.run(Echo.bind())
+    assert handle.call("hi") == {"echo": "hi"}
+
+
+def test_constructor_args_and_methods(rt):
+    @serve.deployment
+    class Adder:
+        def __init__(self, base):
+            self.base = base
+
+        def __call__(self, x):
+            return self.base + x
+
+        def double(self, x):
+            return 2 * x
+
+    handle = serve.run(Adder.bind(100))
+    assert handle.call(5) == 105
+    assert handle.options(method_name="double").call(21) == 42
+
+
+def test_multiple_replicas_route(rt):
+    import os
+
+    @serve.deployment(num_replicas=3)
+    class WhoAmI:
+        def __init__(self):
+            self.id = id(self)
+
+        def __call__(self, _):
+            return self.id
+
+    handle = serve.run(WhoAmI.bind())
+    seen = {handle.call(None) for _ in range(30)}
+    assert len(seen) >= 2, "p2c routing should hit multiple replicas"
+
+
+def test_redeploy_updates(rt):
+    @serve.deployment
+    class V:
+        def __call__(self, _):
+            return "v1"
+
+    serve.run(V.bind())
+
+    @serve.deployment(name="V")
+    class V2:
+        def __call__(self, _):
+            return "v2"
+
+    handle = serve.run(V2.bind())
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if handle.call(None) == "v2":
+            return
+        time.sleep(0.1)
+    pytest.fail("redeploy did not take effect")
+
+
+def test_user_config_reconfigure(rt):
+    @serve.deployment(user_config={"threshold": 7})
+    class Conf:
+        def __init__(self):
+            self.threshold = 0
+
+        def reconfigure(self, cfg):
+            self.threshold = cfg["threshold"]
+
+        def __call__(self, _):
+            return self.threshold
+
+    handle = serve.run(Conf.bind())
+    assert handle.call(None) == 7
+
+
+def test_dynamic_batching(rt):
+    @serve.deployment(max_concurrent_queries=16)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+        def handle(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 2 for i in items]
+
+        def __call__(self, x):
+            return self.handle(x)
+
+        def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind())
+    refs = [handle.remote(i) for i in range(16)]
+    out = sorted(ray_tpu.get(refs))
+    assert out == [i * 2 for i in range(16)]
+    sizes = handle.options(method_name="sizes").call()
+    assert max(sizes) > 1, f"no batching happened: {sizes}"
+
+
+def test_autoscaling_up(rt):
+    @serve.deployment(
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_ongoing_requests": 1.0,
+                            "upscale_delay_s": 0.1},
+        max_concurrent_queries=4)
+    class Slow:
+        def __call__(self, _):
+            time.sleep(0.4)
+            return 1
+
+    handle = serve.run(Slow.bind())
+    refs = [handle.remote(None) for _ in range(12)]
+    deadline = time.monotonic() + 15
+    scaled = False
+    controller = ray_tpu.get_actor(serve.api.CONTROLLER_NAME)
+    while time.monotonic() < deadline:
+        deps = ray_tpu.get(controller.list_deployments.remote())
+        if deps["Slow"]["running"] > 1:
+            scaled = True
+            break
+        time.sleep(0.2)
+    ray_tpu.get(refs)
+    assert scaled, "autoscaler did not add replicas under load"
+
+
+def test_http_proxy(rt):
+    @serve.deployment
+    class Api:
+        def __call__(self, payload):
+            return {"sum": payload["a"] + payload["b"]}
+
+    serve.run(Api.bind())
+    server, (host, port) = serve.start_http_proxy()
+    try:
+        req = urllib.request.Request(
+            f"http://{host}:{port}/Api",
+            data=json.dumps({"a": 2, "b": 3}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert body["result"]["sum"] == 5
+        health = urllib.request.urlopen(
+            f"http://{host}:{port}/healthz", timeout=10)
+        assert health.status == 200
+    finally:
+        server.shutdown()
+
+
+def test_delete_deployment(rt):
+    @serve.deployment
+    class Gone:
+        def __call__(self, _):
+            return 1
+
+    handle = serve.run(Gone.bind())
+    assert handle.call(None) == 1
+    serve.delete("Gone")
+    time.sleep(0.3)
+    with pytest.raises(Exception):
+        fresh = serve.get_deployment_handle("Gone")
+        fresh.call(None)

@@ -1,0 +1,188 @@
+"""Utility tests: ActorPool, Queue, collectives, DAG, workflow.
+(reference analogs: ray.util tests, dag tests, workflow/tests/)"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Queue
+from ray_tpu.util import collective as col
+
+
+@pytest.fixture
+def rt(ray_tpu_start):
+    return ray_tpu_start
+
+
+def test_actor_pool_ordered(rt):
+    @ray_tpu.remote
+    class W:
+        def work(self, x):
+            return x * x
+
+    pool = ActorPool([W.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.work.remote(v), range(8)))
+    assert out == [v * v for v in range(8)]
+
+
+def test_actor_pool_unordered(rt):
+    import time
+
+    @ray_tpu.remote
+    class W:
+        def work(self, x):
+            time.sleep(0.01 * (5 - x))
+            return x
+
+    pool = ActorPool([W.remote() for _ in range(3)])
+    out = list(pool.map_unordered(lambda a, v: a.work.remote(v), range(5)))
+    assert sorted(out) == list(range(5))
+
+
+def test_queue_basic(rt):
+    q = Queue(maxsize=3)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+
+
+def test_queue_full_and_empty(rt):
+    from ray_tpu.util.queue import Empty, Full
+
+    q = Queue(maxsize=1)
+    q.put("a")
+    with pytest.raises(Full):
+        q.put("b", block=False)
+    assert q.get() == "a"
+    with pytest.raises(Empty):
+        q.get(block=False)
+
+
+def test_queue_cross_task(rt):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    assert ray_tpu.get(producer.remote(q, 5))
+    assert [q.get(timeout=10) for _ in range(5)] == list(range(5))
+
+
+def test_collective_allreduce(rt):
+    @ray_tpu.remote
+    def rank_fn(rank, world):
+        g = col.init_collective_group(world, rank, "g1")
+        out = g.allreduce(np.full(4, float(rank + 1)))
+        return out.tolist()
+
+    world = 3
+    outs = ray_tpu.get([rank_fn.remote(r, world) for r in range(world)])
+    assert all(o == [6.0] * 4 for o in outs)  # 1+2+3
+
+
+def test_collective_allgather_broadcast(rt):
+    @ray_tpu.remote
+    def rank_fn(rank, world):
+        g = col.init_collective_group(world, rank, "g2")
+        gathered = g.allgather(np.array([rank]))
+        bcast = g.broadcast(np.array([rank * 10]), src_rank=1)
+        return [int(a[0]) for a in gathered], int(bcast[0])
+
+    outs = ray_tpu.get([rank_fn.remote(r, 2) for r in range(2)])
+    for gathered, bcast in outs:
+        assert gathered == [0, 1]
+        assert bcast == 10
+
+
+def test_collective_reducescatter_sendrecv(rt):
+    @ray_tpu.remote
+    def rank_fn(rank, world):
+        g = col.init_collective_group(world, rank, "g3")
+        shard = g.reducescatter(np.arange(4, dtype=np.float64))
+        if rank == 0:
+            g.send(np.array([42.0]), dst_rank=1)
+            return shard.tolist(), None
+        got = g.recv(src_rank=0)
+        return shard.tolist(), got.tolist()
+
+    outs = ray_tpu.get([rank_fn.remote(r, 2) for r in range(2)])
+    assert outs[0][0] == [0.0, 2.0]   # doubled (2 ranks) halves
+    assert outs[1][0] == [4.0, 6.0]
+    assert outs[1][1] == [42.0]
+
+
+def test_dag_bind_execute(rt):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    dag = mul.bind(add.bind(1, 2), add.bind(3, 4))  # (1+2)*(3+4)
+    assert ray_tpu.get(dag.execute()) == 21
+
+
+def test_dag_diamond(rt):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    base = one.bind()
+    dag = add.bind(inc.bind(base), inc.bind(base))
+    assert ray_tpu.get(dag.execute()) == 4
+
+
+def test_workflow_run_and_resume(rt, tmp_path):
+    from ray_tpu import workflow
+
+    calls = {"n": 0}
+    log = tmp_path / "calls.txt"
+
+    def count_calls(x):
+        with open(log, "a") as f:
+            f.write("x")
+        return x * 2
+
+    @ray_tpu.remote
+    def double(x):
+        with open(str(log), "a") as f:
+            f.write("c")
+        return x * 2
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    dag = add.bind(double.bind(10), double.bind(20))
+    out = workflow.run(dag, workflow_id="wf1", storage=str(tmp_path))
+    assert out == 60
+    assert workflow.status("wf1", storage=str(tmp_path)) == "SUCCESS"
+    calls_before = log.read_text().count("c")
+    # resume skips all checkpointed steps: no new executions
+    out2 = workflow.resume(dag, workflow_id="wf1", storage=str(tmp_path))
+    assert out2 == 60
+    assert log.read_text().count("c") == calls_before
+
+
+def test_runtime_context(rt):
+    from ray_tpu.runtime_context import get_runtime_context
+
+    ctx = get_runtime_context()
+    assert ctx.get_worker_id() == "driver"
+    assert ctx.get_job_id()
