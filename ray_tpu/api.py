@@ -237,6 +237,13 @@ def _parse_strategy(opts: dict) -> SchedulingStrategy:
         return SchedulingStrategy(kind="SPREAD")
     if s == "DEFAULT":
         return SchedulingStrategy()
+    # PlacementGroupSchedulingStrategy (duck-typed to avoid an import cycle
+    # with ray_tpu.util.placement_group)
+    if hasattr(s, "placement_group"):
+        return SchedulingStrategy(
+            kind="PLACEMENT_GROUP",
+            placement_group_id=s.placement_group.id,
+            bundle_index=getattr(s, "bundle_index", -1))
     raise ValueError(f"Unknown scheduling strategy: {s!r}")
 
 

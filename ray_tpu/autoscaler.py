@@ -1,0 +1,123 @@
+"""Autoscaler: demand-driven node scaling over a NodeProvider.
+
+Reference analog: ``autoscaler/_private/autoscaler.py``
+(``StandardAutoscaler:171``) driven by ``Monitor`` (monitor.py:126), with
+cloud ``NodeProvider`` plugins; tests use ``FakeMultiNodeProvider``
+(fake_multi_node/node_provider.py:237). Here the demand signal is the
+GCS resource view (pending infeasible demand + utilization) and the
+provider contract is create/terminate of raylet-bearing nodes; the
+``LocalNodeProvider`` spawns real raylet processes on this host (the
+GKE TPU-pool provider slots in behind the same interface)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ray_tpu.runtime.rpc import RpcClient
+
+
+class NodeProvider:
+    """Provider contract (reference: ``autoscaler/node_provider.py``)."""
+
+    def create_node(self, resources: dict) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> list[str]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Spawns raylet processes on this host (FakeMultiNodeProvider
+    analog — 'multi-node' without a cloud)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster  # cluster_utils.Cluster
+        self.created: dict[str, object] = {}
+
+    def create_node(self, resources: dict) -> str:
+        res = dict(resources)
+        num_cpus = res.pop("CPU", 1)
+        num_tpus = res.pop("TPU", 0)
+        handle = self.cluster.add_node(
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=res,
+            external=True)
+        self.created[handle.node_id] = handle
+        return handle.node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        handle = self.created.pop(node_id, None)
+        if handle is not None:
+            self.cluster.remove_node(handle, graceful=True)
+
+    def non_terminated_nodes(self) -> list[str]:
+        return list(self.created)
+
+
+class StandardAutoscaler:
+    """Scale up when the cluster cannot satisfy demand; scale down idle
+    provider nodes after ``idle_timeout_s``."""
+
+    def __init__(self, gcs_address, provider: NodeProvider, *,
+                 node_resources: dict | None = None,
+                 max_nodes: int = 4, idle_timeout_s: float = 5.0,
+                 poll_interval_s: float = 0.5,
+                 utilization_threshold: float = 0.9):
+        self.gcs = RpcClient(tuple(gcs_address))
+        self.provider = provider
+        self.node_resources = node_resources or {"CPU": 2}
+        self.max_nodes = max_nodes
+        self.idle_timeout_s = idle_timeout_s
+        self.poll_interval_s = poll_interval_s
+        self.utilization_threshold = utilization_threshold
+        self._idle_since: dict[str, float] = {}
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop = True
+
+    def _run(self):
+        while not self._stop:
+            try:
+                self.update()
+            except Exception:  # noqa: BLE001 - keep monitoring
+                pass
+            time.sleep(self.poll_interval_s)
+
+    def update(self):
+        res = self.gcs.call("cluster_resources")
+        total, avail = res["total"], res["available"]
+        # scale up: demanded resource classes nearly exhausted
+        busy = any(
+            total.get(k, 0) > 0
+            and (total[k] - avail.get(k, 0)) / total[k]
+            >= self.utilization_threshold
+            for k in ("CPU", "TPU") if total.get(k))
+        if busy and len(self.provider.non_terminated_nodes()) < self.max_nodes:
+            self.provider.create_node(dict(self.node_resources))
+            return
+        # scale down: provider nodes fully idle past the timeout
+        nodes = {n["node_id"]: n
+                 for n in self.gcs.call("get_nodes", alive_only=True)}
+        now = time.monotonic()
+        for node_id in self.provider.non_terminated_nodes():
+            info = nodes.get(node_id)
+            if info is None:
+                continue
+            idle = info["available"] == info["resources"]
+            if not idle:
+                self._idle_since.pop(node_id, None)
+                continue
+            since = self._idle_since.setdefault(node_id, now)
+            if now - since > self.idle_timeout_s:
+                self.provider.terminate_node(node_id)
+                self._idle_since.pop(node_id, None)
+                return

@@ -1,0 +1,99 @@
+"""Job submission: run driver scripts as supervised subprocesses.
+
+Reference analog: ``dashboard/modules/job/job_manager.py`` — per-job
+``JobSupervisor`` actor (:140) runs the entrypoint as a subprocess;
+``JobManager`` (:525) tracks state in the GCS KV; plus the SDK surface
+``python/ray/job_submission/`` (``JobSubmissionClient``)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+import uuid
+
+import ray_tpu
+
+
+class _JobSupervisor:
+    """Actor supervising one job subprocess (stdout/stderr captured)."""
+
+    def __init__(self, job_id: str, entrypoint: str, env: dict,
+                 working_dir: str | None):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.status = "PENDING"
+        self.returncode = None
+        self.logs = ""
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        self._proc = subprocess.Popen(
+            entrypoint, shell=True, env=full_env,
+            cwd=working_dir or os.getcwd(),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        self.status = "RUNNING"
+        self._waiter = threading.Thread(target=self._wait, daemon=True)
+        self._waiter.start()
+
+    def _wait(self):
+        out, _ = self._proc.communicate()
+        self.logs = out or ""
+        self.returncode = self._proc.returncode
+        self.status = "SUCCEEDED" if self.returncode == 0 else "FAILED"
+
+    def get_status(self):
+        return {"job_id": self.job_id, "status": self.status,
+                "returncode": self.returncode,
+                "entrypoint": self.entrypoint}
+
+    def get_logs(self):
+        return self.logs
+
+    def stop(self):
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            self.status = "STOPPED"
+        return True
+
+
+class JobSubmissionClient:
+    """Submit/inspect/stop jobs (reference: job_submission SDK)."""
+
+    def submit_job(self, *, entrypoint: str, env: dict | None = None,
+                   working_dir: str | None = None,
+                   submission_id: str | None = None) -> str:
+        job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:8]}"
+        supervisor_cls = ray_tpu.remote(_JobSupervisor)
+        supervisor = supervisor_cls.options(
+            name=f"_job_{job_id}").remote(
+            job_id, entrypoint, env or {}, working_dir)
+        # materialize the actor (surfaces spawn errors early)
+        ray_tpu.get(supervisor.get_status.remote())
+        return job_id
+
+    def _supervisor(self, job_id: str):
+        return ray_tpu.get_actor(f"_job_{job_id}")
+
+    def get_job_status(self, job_id: str) -> str:
+        return ray_tpu.get(
+            self._supervisor(job_id).get_status.remote())["status"]
+
+    def get_job_info(self, job_id: str) -> dict:
+        return ray_tpu.get(self._supervisor(job_id).get_status.remote())
+
+    def get_job_logs(self, job_id: str) -> str:
+        return ray_tpu.get(self._supervisor(job_id).get_logs.remote())
+
+    def stop_job(self, job_id: str) -> bool:
+        return ray_tpu.get(self._supervisor(job_id).stop.remote())
+
+    def wait_until_finish(self, job_id: str, timeout: float = 120.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+                return status
+            time.sleep(0.1)
+        raise TimeoutError(f"job {job_id} still {status} after {timeout}s")

@@ -1,0 +1,6 @@
+"""ray_tpu.rllib: RL training subset (reference: RLlib, SURVEY P18)."""
+
+from ray_tpu.rllib.env import BanditEnv, CartPole, make_env
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+__all__ = ["BanditEnv", "CartPole", "PPO", "PPOConfig", "make_env"]

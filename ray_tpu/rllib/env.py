@@ -1,0 +1,92 @@
+"""Environment API + builtin envs.
+
+Reference analog: ``rllib/env/env_runner.py:9`` ``EnvRunner`` environments
+(gym API). Numpy-only (no gym dependency): ``reset() -> obs``,
+``step(action) -> (obs, reward, done, info)``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class CartPole:
+    """Classic control CartPole-v1 dynamics (numpy re-implementation of
+    the standard equations; episode cap 500)."""
+
+    obs_dim = 4
+    n_actions = 2
+
+    def __init__(self, seed: int | None = None):
+        self.rng = np.random.default_rng(seed)
+        self.gravity = 9.8
+        self.masscart = 1.0
+        self.masspole = 0.1
+        self.total_mass = self.masspole + self.masscart
+        self.length = 0.5
+        self.polemass_length = self.masspole * self.length
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+        self.max_steps = 500
+        self.state = None
+        self.steps = 0
+
+    def reset(self):
+        self.state = self.rng.uniform(-0.05, 0.05, size=4)
+        self.steps = 0
+        return self.state.astype(np.float32)
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self.state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        temp = (force + self.polemass_length * theta_dot**2 * sintheta
+                ) / self.total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta**2
+                           / self.total_mass))
+        xacc = temp - self.polemass_length * thetaacc * costheta \
+            / self.total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        self.state = np.array([x, x_dot, theta, theta_dot])
+        self.steps += 1
+        done = bool(
+            abs(x) > self.x_threshold
+            or abs(theta) > self.theta_threshold
+            or self.steps >= self.max_steps)
+        return self.state.astype(np.float32), 1.0, done, {}
+
+
+class BanditEnv:
+    """One-step contextual bandit (deterministic learning signal for
+    tests): obs in {-1,+1}^dim; action matching sign of obs[0] pays 1."""
+
+    obs_dim = 2
+    n_actions = 2
+
+    def __init__(self, seed: int | None = None):
+        self.rng = np.random.default_rng(seed)
+        self.obs = None
+
+    def reset(self):
+        self.obs = self.rng.choice([-1.0, 1.0], size=2).astype(np.float32)
+        return self.obs
+
+    def step(self, action: int):
+        reward = 1.0 if (self.obs[0] > 0) == (action == 1) else 0.0
+        obs = self.reset()
+        return obs, reward, True, {}
+
+
+ENV_REGISTRY = {"CartPole-v1": CartPole, "Bandit-v0": BanditEnv}
+
+
+def make_env(name_or_cls, seed=None):
+    if isinstance(name_or_cls, str):
+        return ENV_REGISTRY[name_or_cls](seed=seed)
+    return name_or_cls(seed=seed)

@@ -1,0 +1,302 @@
+"""PPO on JAX: rollout-worker actors + jitted learner.
+
+Reference analog: the new-stack triad — ``RLModule``
+(rllib/core/rl_module/rl_module.py:229) → here a functional MLP
+policy+value; ``EnvRunner``/``RolloutWorker`` (rollout_worker.py:159,
+sample:660) → ``_RolloutWorker`` actors collecting episodes with broadcast
+params; ``Learner`` (rllib/core/learner/learner.py:229, update:1230) →
+one jitted GAE + clipped-surrogate update (shardable over a mesh: batch
+axis is data-parallel; the MXU sees fused MLP matmuls).
+
+Config follows the ``AlgorithmConfig`` builder style
+(``PPOConfig().environment(...).training(...)``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from functools import partial
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+
+
+# ---------------------------------------------------------------------------
+# RLModule: functional MLP policy + value heads
+# ---------------------------------------------------------------------------
+
+def init_module(key, obs_dim: int, n_actions: int, hidden: int = 64):
+    import jax
+
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+
+    def dense(k, fan_in, fan_out):
+        scale = (2.0 / fan_in) ** 0.5
+        return {"w": jax.random.normal(k, (fan_in, fan_out)) * scale,
+                "b": jax.numpy.zeros((fan_out,))}
+
+    return {
+        "torso1": dense(k1, obs_dim, hidden),
+        "torso2": dense(k2, hidden, hidden),
+        "pi": dense(k3, hidden, n_actions),
+        "vf": dense(k4, hidden, 1),
+    }
+
+
+def forward_module(params, obs):
+    import jax.numpy as jnp
+
+    h = jnp.tanh(obs @ params["torso1"]["w"] + params["torso1"]["b"])
+    h = jnp.tanh(h @ params["torso2"]["w"] + params["torso2"]["b"])
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"]).squeeze(-1)
+    return logits, value
+
+
+# ---------------------------------------------------------------------------
+# Rollout workers (actors)
+# ---------------------------------------------------------------------------
+
+class _RolloutWorker:
+    def __init__(self, env_name, seed: int):
+        self.env = make_env(env_name, seed=seed)
+        self.rng = np.random.default_rng(seed)
+
+    def sample(self, params_np: dict, num_steps: int, gamma: float,
+               lam: float):
+        """Collect ~num_steps transitions; returns numpy batch with GAE
+        advantages computed env-side (cheap, host-bound anyway)."""
+        obs_list, act_list, logp_list, rew_list, val_list, done_list = \
+            [], [], [], [], [], []
+        obs = self.env.reset()
+        episode_returns = []
+        ep_ret = 0.0
+        for _ in range(num_steps):
+            logits, value = _np_forward(params_np, obs[None])
+            probs = _softmax(logits[0])
+            action = int(self.rng.choice(len(probs), p=probs))
+            next_obs, reward, done, _ = self.env.step(action)
+            obs_list.append(obs)
+            act_list.append(action)
+            logp_list.append(np.log(probs[action] + 1e-8))
+            rew_list.append(reward)
+            val_list.append(value[0])
+            done_list.append(done)
+            ep_ret += reward
+            obs = self.env.reset() if done else next_obs
+            if done:
+                episode_returns.append(ep_ret)
+                ep_ret = 0.0
+        # bootstrap value for the final state
+        _, last_val = _np_forward(params_np, obs[None])
+        adv, ret = _gae(np.asarray(rew_list), np.asarray(val_list),
+                        np.asarray(done_list), float(last_val[0]),
+                        gamma, lam)
+        return {
+            "obs": np.asarray(obs_list, dtype=np.float32),
+            "actions": np.asarray(act_list, dtype=np.int32),
+            "logp": np.asarray(logp_list, dtype=np.float32),
+            "advantages": adv.astype(np.float32),
+            "returns": ret.astype(np.float32),
+            "episode_returns": episode_returns,
+        }
+
+
+def _np_forward(params, obs):
+    h = np.tanh(obs @ params["torso1"]["w"] + params["torso1"]["b"])
+    h = np.tanh(h @ params["torso2"]["w"] + params["torso2"]["b"])
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"]).squeeze(-1)
+    return logits, value
+
+
+def _softmax(x):
+    e = np.exp(x - x.max())
+    return e / e.sum()
+
+
+def _gae(rewards, values, dones, last_value, gamma, lam):
+    n = len(rewards)
+    adv = np.zeros(n)
+    last_gae = 0.0
+    next_value = last_value
+    for t in range(n - 1, -1, -1):
+        nonterminal = 1.0 - float(dones[t])
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    return adv, adv + values
+
+
+# ---------------------------------------------------------------------------
+# Config + Algorithm
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PPOConfig:
+    env: str = "CartPole-v1"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 256
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lam: float = 0.95
+    clip_eps: float = 0.2
+    entropy_coeff: float = 0.01
+    vf_coeff: float = 0.5
+    num_sgd_iter: int = 4
+    minibatch_size: int = 128
+    hidden: int = 64
+    seed: int = 0
+
+    def environment(self, env) -> "PPOConfig":
+        return replace(self, env=env)
+
+    def rollouts(self, *, num_rollout_workers=None,
+                 rollout_fragment_length=None) -> "PPOConfig":
+        cfg = self
+        if num_rollout_workers is not None:
+            cfg = replace(cfg, num_rollout_workers=num_rollout_workers)
+        if rollout_fragment_length is not None:
+            cfg = replace(cfg,
+                          rollout_fragment_length=rollout_fragment_length)
+        return cfg
+
+    def training(self, **kw) -> "PPOConfig":
+        return replace(self, **kw)
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    """Algorithm driver (reference: ``Algorithm.step:815`` →
+    ``training_step:1402`` = sample from rollout workers + learner
+    update)."""
+
+    def __init__(self, config: PPOConfig):
+        import jax
+        import optax
+
+        self.config = config
+        env = make_env(config.env, seed=config.seed)
+        self.obs_dim = env.obs_dim
+        self.n_actions = env.n_actions
+        self.params = init_module(jax.random.key(config.seed),
+                                  self.obs_dim, self.n_actions,
+                                  config.hidden)
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.iteration = 0
+        worker_cls = ray_tpu.remote(_RolloutWorker)
+        self.workers = [
+            worker_cls.remote(config.env, config.seed + 1000 * (i + 1))
+            for i in range(config.num_rollout_workers)
+        ]
+        self._update = jax.jit(partial(
+            _ppo_update, tx=self.tx, clip_eps=config.clip_eps,
+            entropy_coeff=config.entropy_coeff, vf_coeff=config.vf_coeff))
+
+    def train(self) -> dict:
+        import jax
+        import numpy as np
+
+        cfg = self.config
+        params_np = jax.tree.map(np.asarray, self.params)
+        batches = ray_tpu.get([
+            w.sample.remote(params_np, cfg.rollout_fragment_length,
+                            cfg.gamma, cfg.lam)
+            for w in self.workers
+        ])
+        batch = {k: np.concatenate([b[k] for b in batches])
+                 for k in ("obs", "actions", "logp", "advantages",
+                           "returns")}
+        episode_returns = [r for b in batches for r in b["episode_returns"]]
+        # advantage normalization (standard PPO practice)
+        adv = batch["advantages"]
+        batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        n = len(batch["obs"])
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        losses = []
+        for _ in range(cfg.num_sgd_iter):
+            perm = rng.permutation(n)
+            for start in range(0, n, cfg.minibatch_size):
+                idx = perm[start:start + cfg.minibatch_size]
+                mb = {k: v[idx] for k, v in batch.items()}
+                self.params, self.opt_state, stats = self._update(
+                    self.params, self.opt_state, mb)
+                losses.append(stats)
+        self.iteration += 1
+        mean = lambda key: float(np.mean([float(s[key]) for s in losses]))  # noqa: E731
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (float(np.mean(episode_returns))
+                                    if episode_returns else 0.0),
+            "num_episodes": len(episode_returns),
+            "policy_loss": mean("policy_loss"),
+            "vf_loss": mean("vf_loss"),
+            "entropy": mean("entropy"),
+            "num_env_steps_sampled": n,
+        }
+
+    def save(self, path: str):
+        import pickle
+
+        import jax
+        import numpy as np
+
+        with open(path, "wb") as f:
+            pickle.dump(jax.tree.map(np.asarray, self.params), f)
+
+    def restore(self, path: str):
+        import pickle
+
+        with open(path, "rb") as f:
+            self.params = pickle.load(f)
+
+    def compute_action(self, obs) -> int:
+        import numpy as np
+
+        logits, _ = _np_forward(
+            {k: {kk: np.asarray(vv) for kk, vv in v.items()}
+             for k, v in self.params.items()}, np.asarray(obs)[None])
+        return int(np.argmax(logits[0]))
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _ppo_update(params, opt_state, batch, *, tx, clip_eps, entropy_coeff,
+                vf_coeff):
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(p):
+        logits, values = forward_module(p, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1).squeeze(-1)
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        unclipped = ratio * adv
+        clipped = jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * adv
+        policy_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+        vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = (policy_loss + vf_coeff * vf_loss
+                 - entropy_coeff * entropy)
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return params, opt_state, stats

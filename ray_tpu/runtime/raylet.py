@@ -256,6 +256,14 @@ class Raylet(RpcServer):
             if target and target != self.node_id:
                 if self._forward(task, target, spill_count):
                     return {"ok": True, "node_id": target}
+        if strategy.get("pg_id") and spill_count == 0:
+            # placement-group tasks run on the bundle's reserved node
+            with self._gcs_lock:
+                target = self._gcs.call("pick_node", demand=demand,
+                                        pg_id=strategy["pg_id"])
+            if target is not None and target != self.node_id:
+                if self._forward(task, target, spill_count + 1):
+                    return {"ok": True, "node_id": target}
         if not _fits(demand, self.total_resources) or (
                 strategy.get("kind") == "SPREAD" and spill_count == 0):
             # infeasible here (or spread): ask GCS for a placement

@@ -1,0 +1,131 @@
+"""Placement groups, jobs, autoscaler, chaos (reference analogs:
+test_placement_group*.py, job tests, autoscaler/v2/tests, chaos suite)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=4)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_placement_group_api_and_strategy(cluster):
+    from ray_tpu.util.placement_group import (
+        PlacementGroupSchedulingStrategy,
+        placement_group,
+        placement_group_table,
+        remove_placement_group,
+    )
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=10)
+    table = placement_group_table(pg)
+    assert table["state"] == "CREATED"
+
+    @ray_tpu.remote(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg))
+    def in_pg():
+        import os
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    node = ray_tpu.get(in_pg.remote())
+    assert node in table["bundle_nodes"]
+    remove_placement_group(pg)
+
+
+def test_job_submission(cluster, tmp_path):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    script = tmp_path / "job.py"
+    script.write_text("print('job ran ok')\n")
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"python {script}")
+    status = client.wait_until_finish(job_id, timeout=60)
+    assert status == "SUCCEEDED"
+    assert "job ran ok" in client.get_job_logs(job_id)
+
+
+def test_job_failure_status(cluster, tmp_path):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    script = tmp_path / "bad.py"
+    script.write_text("raise SystemExit(3)\n")
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"python {script}")
+    assert client.wait_until_finish(job_id, timeout=60) == "FAILED"
+    assert client.get_job_info(job_id)["returncode"] == 3
+
+
+def test_autoscaler_scales_up_and_down():
+    from ray_tpu.autoscaler import LocalNodeProvider, StandardAutoscaler
+
+    ray_tpu.shutdown()
+    c = Cluster(heartbeat_timeout_s=3.0)
+    c.add_node(num_cpus=1)
+    ray_tpu.init(address=c.gcs_address)
+    provider = LocalNodeProvider(c)
+    scaler = StandardAutoscaler(
+        c.gcs_address, provider, node_resources={"CPU": 2},
+        max_nodes=2, idle_timeout_s=1.5, poll_interval_s=0.2).start()
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def busy():
+            time.sleep(4)
+            return 1
+
+        refs = [busy.remote() for _ in range(4)]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if provider.non_terminated_nodes():
+                break
+            time.sleep(0.2)
+        assert provider.non_terminated_nodes(), "no scale-up under load"
+        ray_tpu.get(refs, timeout=60)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if not provider.non_terminated_nodes():
+                break
+            time.sleep(0.3)
+        assert not provider.non_terminated_nodes(), "no idle scale-down"
+    finally:
+        scaler.stop()
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_chaos_node_killer():
+    """NodeKiller chaos (reference: _private/test_utils.py:1401): kill a
+    worker node mid-workload; retriable tasks must still complete."""
+    ray_tpu.shutdown()
+    c = Cluster(heartbeat_timeout_s=1.5)
+    c.add_node(num_cpus=2)          # head (in-process, survives)
+    victim = c.add_node(num_cpus=2, resources={"victim": 2}, external=True)
+    c.wait_for_nodes(2)
+    ray_tpu.init(address=c.gcs_address)
+    try:
+        @ray_tpu.remote(num_cpus=1, max_retries=2)
+        def slow_task(i):
+            time.sleep(0.5)
+            return i
+
+        refs = [slow_task.remote(i) for i in range(8)]
+        time.sleep(0.3)
+        c.remove_node(victim)  # SIGKILL mid-workload
+        out = sorted(ray_tpu.get(refs, timeout=120))
+        assert out == list(range(8))
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
