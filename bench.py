@@ -6,8 +6,10 @@ vs_baseline is reported against the North-star target proxy of 1.0 until a
 measured reference exists.
 
 Env knobs:
+    BENCH_MODE=train|serve    (default train)
     BENCH_PRESET=small|base   (default base; small for CPU smoke runs)
     BENCH_STEPS=N             (timed steps, default 10)
+    BENCH_REQUESTS=N          (serve mode: requests, default 16)
 """
 
 from __future__ import annotations
@@ -122,5 +124,77 @@ def main():
     print(json.dumps(result))
 
 
+def bench_serve():
+    """Continuous-batching decode throughput + TTFT on the LLM engine."""
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMEngine
+
+    preset = os.environ.get("BENCH_PRESET", "base")
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "16"))
+    platform = jax.devices()[0].platform
+
+    if preset == "small":
+        model_cfg = llama.llama_tiny()
+        max_batch, max_len, prompt_len, new_tokens = 4, 256, 32, 32
+    else:
+        model_cfg = llama.LlamaConfig(
+            vocab_size=32768, d_model=1536, n_layers=12, n_heads=12,
+            n_kv_heads=4, head_dim=128, d_ff=6144, remat="none",
+        )
+        max_batch, max_len, prompt_len, new_tokens = 8, 2048, 128, 128
+
+    params = llama.init_params(model_cfg, jax.random.key(0))
+    n_params = llama.num_params(params)
+    eng = LLMEngine(params=params, cfg=model_cfg, max_batch=max_batch,
+                    max_len=max_len)
+    eng.start()
+    rng = np.random.default_rng(0)
+
+    # warmup: compile prefill bucket + decode step
+    w = eng.submit(rng.integers(1, model_cfg.vocab_size, prompt_len),
+                   max_new_tokens=4)
+    list(w.tokens())
+
+    t0 = time.perf_counter()
+    reqs = [
+        eng.submit(rng.integers(1, model_cfg.vocab_size, prompt_len),
+                   max_new_tokens=new_tokens)
+        for _ in range(n_requests)
+    ]
+    done = [list(r.tokens()) for r in reqs]
+    elapsed = time.perf_counter() - t0
+    eng.stop()
+
+    generated = sum(len(d) for d in done)
+    tokens_per_sec = generated / elapsed
+    ttfts = [r.ttft for r in reqs if r.ttft is not None]
+
+    # end-to-end engine throughput: the window covers prefill + queueing +
+    # decode for the whole request set (what a serving client experiences)
+    result = {
+        "metric": "llama_serve_engine_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,  # reference publishes no serving numbers
+        "detail": {
+            "platform": platform,
+            "params": n_params,
+            "requests": n_requests,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "max_batch": max_batch,
+            "mean_ttft_s": round(float(np.mean(ttfts)), 4) if ttfts else None,
+            "p50_ttft_s": round(float(np.median(ttfts)), 4) if ttfts else None,
+            "requests_per_sec": round(n_requests / elapsed, 2),
+        },
+    }
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
+    if os.environ.get("BENCH_MODE", "train") == "serve":
+        sys.exit(bench_serve())
     sys.exit(main())

@@ -1,0 +1,327 @@
+"""Continuous-batching LLM serving engine on TPU.
+
+The reference serves models via user code inside Serve replicas
+(`python/ray/serve/_private/replica.py`, SURVEY.md P15) — it has no model
+engine. This module is the TPU-native engine a Serve deployment wraps:
+
+- **Continuous batching**: a fixed-shape decode program runs every step over
+  all `max_batch` cache slots; which slots are live is a mask, so admitting
+  or retiring a request never recompiles. New requests are prefilled into a
+  free slot (prompt padded to a power-of-two bucket — a handful of compiled
+  prefill variants total) while decode keeps streaming for everyone else.
+- **Static shapes everywhere**: the only compiled programs are
+  one decode step + one prefill per bucket size.
+- Tokens stream back to callers through per-request queues; TTFT and
+  throughput are measured at the engine so Serve autoscaling can act on
+  queue depth and latency.
+
+Threading: one engine thread owns the device loop (prefill/decode); callers
+enqueue requests and read token queues — no JAX calls on caller threads.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import decoding
+from ray_tpu.models.decoding import KVCache, SamplingParams
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class Request:
+    request_id: int
+    prompt: np.ndarray                    # [P] int32
+    max_new_tokens: int = 128
+    temperature: float = 0.0
+    eos_id: int | None = None
+    # filled by the engine:
+    out: "queue.Queue[int | None]" = field(default_factory=queue.Queue)
+    submit_t: float = field(default_factory=time.monotonic)
+    first_token_t: float | None = None
+    generated: int = 0
+    slot: int = -1
+
+    @property
+    def ttft(self) -> float | None:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    engine: "LLMEngine | None" = None
+
+    def tokens(self) -> Iterator[int]:
+        """Blocking stream of generated token ids (ends on None sentinel).
+        Raises the engine's error if its device loop died."""
+        while True:
+            tok = self.out.get()
+            if tok is None:
+                if self.engine is not None and self.engine.error is not None:
+                    raise RuntimeError(
+                        "LLM engine loop failed"
+                    ) from self.engine.error
+                return
+            yield tok
+
+
+class LLMEngine:
+    """Slot-based continuous batching over `ray_tpu.models.decoding`."""
+
+    def __init__(self, cfg, params, *, max_batch: int = 8,
+                 max_len: int = 2048, prefill_chunk: int = 1024):
+        self.cfg = cfg
+        self.params = params
+        self.max_batch = max_batch
+        self.max_len = max_len
+        self.prefill_chunk = prefill_chunk
+        self._cache = decoding.init_cache(cfg, max_batch, max_len)
+        # host-side slot state (mirrors cache.lengths but trusted copy)
+        self._lengths = np.zeros((max_batch,), np.int32)
+        self._last_tok = np.zeros((max_batch,), np.int32)
+        self._active: list[Request | None] = [None] * max_batch
+        self._waiting: "queue.Queue[Request]" = queue.Queue()
+        self._req_ids = itertools.count()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._key = jax.random.key(0)
+        self.error: BaseException | None = None
+        # metrics
+        self.total_generated = 0
+        self.total_finished = 0
+        self.ttfts: list[float] = []
+
+        self._decode_fn = jax.jit(
+            partial(self._decode_impl, cfg), donate_argnums=(1,)
+        )
+        self._prefill_fn = jax.jit(
+            partial(self._prefill_impl, cfg),
+            static_argnames=("bucket",), donate_argnums=(1,),
+        )
+
+    # -- jitted programs ---------------------------------------------------
+
+    @staticmethod
+    def _decode_impl(cfg, params, cache: KVCache, tokens, lengths, active,
+                     temps, key):
+        """One decode step over every slot. Inactive slots are computed but
+        masked (position 0 write is harmless: a later prefill overwrites)."""
+        start = jnp.where(active, lengths, 0)
+        logits, cache = decoding.cached_forward(
+            cfg, params, tokens[:, None], cache, start=start,
+            logits_mode="last",
+        )
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(temps > 0.0, sampled, greedy)
+        return cache, nxt
+
+    @staticmethod
+    def _prefill_impl(cfg, params, cache: KVCache, tokens, plen, slot, *,
+                      bucket):
+        """Prefill one prompt (padded to `bucket`) into cache row `slot`.
+        Operates on a sliced single-row cache so cost is independent of
+        max_batch."""
+        row_k = lax_slice_row(cache.k, slot)
+        row_v = lax_slice_row(cache.v, slot)
+        row = KVCache(k=row_k, v=row_v,
+                      lengths=jnp.zeros((1,), jnp.int32))
+        logits, row = decoding.cached_forward(
+            cfg, params, tokens[None, :], row,
+            start=jnp.zeros((1,), jnp.int32),
+            logits_mode="index", logits_idx=plen[None] - 1,
+        )
+        k = lax_update_row(cache.k, row.k, slot)
+        v = lax_update_row(cache.v, row.v, slot)
+        return KVCache(k=k, v=v, lengths=cache.lengths), logits[0]
+
+    # -- engine loop -------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    def submit(self, prompt, *, max_new_tokens: int = 128,
+               temperature: float = 0.0, eos_id: int | None = None) -> Request:
+        req = Request(
+            request_id=next(self._req_ids),
+            prompt=np.asarray(prompt, np.int32),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            eos_id=eos_id,
+        )
+        req.engine = self
+        if self.error is not None:
+            req.out.put(None)  # engine is dead: fail fast at tokens()
+        else:
+            self._waiting.put(req)
+        return req
+
+    def _free_slots(self) -> list[int]:
+        return [i for i, r in enumerate(self._active) if r is None]
+
+    def _admit(self):
+        """Prefill waiting requests into free slots."""
+        for slot in self._free_slots():
+            try:
+                req = self._waiting.get_nowait()
+            except queue.Empty:
+                return
+            plen = len(req.prompt)
+            if plen >= self.max_len:
+                req.out.put(None)  # reject oversized
+                continue
+            bucket = min(_bucket(plen), self.max_len)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:plen] = req.prompt
+            self._cache, logits = self._prefill_fn(
+                self.params, self._cache, jnp.asarray(padded),
+                jnp.int32(plen), jnp.int32(slot), bucket=bucket,
+            )
+            first = int(jnp.argmax(logits)) if req.temperature == 0.0 else \
+                int(jax.random.categorical(self._next_key(),
+                                           logits / req.temperature))
+            req.slot = slot
+            req.first_token_t = time.monotonic()
+            self.ttfts.append(req.ttft)
+            self._active[slot] = req
+            self._lengths[slot] = plen
+            self._emit(req, first)
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _emit(self, req: Request, tok: int):
+        req.generated += 1
+        self.total_generated += 1
+        self._last_tok[req.slot] = tok
+        done = (req.eos_id is not None and tok == req.eos_id) or \
+            req.generated >= req.max_new_tokens or \
+            self._lengths[req.slot] + 1 >= self.max_len
+        req.out.put(tok)
+        if done:
+            req.out.put(None)
+            self._active[req.slot] = None
+            self.total_finished += 1
+        else:
+            # the emitted token occupies position lengths[slot] next step
+            pass
+
+    def _loop(self):
+        try:
+            self._run_loop()
+        except BaseException as e:  # noqa: BLE001 — propagate to callers
+            self.error = e
+            # unblock every caller: finish live streams and reject waiters
+            for req in self._active:
+                if req is not None:
+                    req.out.put(None)
+            while True:
+                try:
+                    self._waiting.get_nowait().out.put(None)
+                except queue.Empty:
+                    break
+
+    def _run_loop(self):
+        while not self._stop.is_set():
+            self._admit()
+            active_idx = [i for i, r in enumerate(self._active)
+                          if r is not None]
+            if not active_idx:
+                time.sleep(0.001)
+                continue
+            active = np.zeros((self.max_batch,), bool)
+            active[active_idx] = True
+            temps = np.array(
+                [r.temperature if r is not None else 0.0
+                 for r in self._active], np.float32)
+            self._cache, nxt = self._decode_fn(
+                self.params, self._cache, jnp.asarray(self._last_tok),
+                jnp.asarray(self._lengths), jnp.asarray(active),
+                jnp.asarray(temps), self._next_key(),
+            )
+            nxt = np.asarray(nxt)
+            for i in active_idx:
+                self._lengths[i] += 1  # the token just consumed is now cached
+                req = self._active[i]
+                self._emit(req, int(nxt[i]))
+
+    # -- metrics -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        live = sum(r is not None for r in self._active)
+        return {
+            "active_slots": live,
+            "waiting": self._waiting.qsize(),
+            "total_generated": self.total_generated,
+            "total_finished": self.total_finished,
+            "mean_ttft_s": float(np.mean(self.ttfts)) if self.ttfts else None,
+        }
+
+
+class LLMDeployment:
+    """Serve deployment body hosting an LLMEngine in the replica process.
+
+    Use with ``@serve.deployment``/`serve.run`; each replica owns its own
+    engine (and TPU chip(s)). `model_builder` is a picklable zero-arg
+    callable returning (cfg, params) — keeps weights out of the deploy RPC.
+
+        dep = serve.deployment(LLMDeployment).bind(model_builder=build)
+        handle = serve.run(dep)
+        tokens = handle.remote([1, 2, 3], max_new_tokens=16).result()
+    """
+
+    def __init__(self, model_builder, *, max_batch: int = 8,
+                 max_len: int = 2048):
+        cfg, params = model_builder()
+        self._engine = LLMEngine(cfg, params, max_batch=max_batch,
+                                 max_len=max_len)
+        self._engine.start()
+
+    def __call__(self, prompt, max_new_tokens: int = 128,
+                 temperature: float = 0.0, eos_id: int | None = None):
+        req = self._engine.submit(
+            prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature, eos_id=eos_id)
+        return list(req.tokens())
+
+    def stats(self) -> dict:
+        return self._engine.stats()
+
+
+def lax_slice_row(arr, slot):
+    """arr [L, B, ...] -> [L, 1, ...] at dynamic row `slot`."""
+    import jax.lax as lax
+
+    start = (0, slot) + (0,) * (arr.ndim - 2)
+    sizes = (arr.shape[0], 1) + arr.shape[2:]
+    return lax.dynamic_slice(arr, start, sizes)
+
+
+def lax_update_row(arr, row, slot):
+    import jax.lax as lax
+
+    start = (0, slot) + (0,) * (arr.ndim - 2)
+    return lax.dynamic_update_slice(arr, row.astype(arr.dtype), start)
