@@ -69,6 +69,20 @@ def init_cache(cfg, max_batch: int, max_len: int, dtype=None) -> KVCache:
     )
 
 
+def lax_slice_row(arr, slot):
+    """arr [L, B, ...] -> [L, 1, ...] at dynamic row `slot` (one cache
+    slot's KV across all layers)."""
+    start = (0, slot) + (0,) * (arr.ndim - 2)
+    sizes = (arr.shape[0], 1) + arr.shape[2:]
+    return lax.dynamic_slice(arr, start, sizes)
+
+
+def lax_update_row(arr, row, slot):
+    """Inverse of lax_slice_row: write row [L, 1, ...] back at `slot`."""
+    start = (0, slot) + (0,) * (arr.ndim - 2)
+    return lax.dynamic_update_slice(arr, row.astype(arr.dtype), start)
+
+
 def _write_cache(cache_kv, new_kv, start):
     """Write new_kv [B, T, ...] into cache_kv [B, S, ...] at per-row offsets
     start [B]. vmapped dynamic_update_slice keeps shapes static."""
@@ -89,20 +103,22 @@ def _cached_attention(q, k_cache, v_cache, start, *, scale):
     s = k_cache.shape[1]
     nkv = k_cache.shape[2]
     n_rep = nh // nkv
-    k = jnp.repeat(k_cache, n_rep, axis=2) if n_rep > 1 else k_cache
-    v = jnp.repeat(v_cache, n_rep, axis=2) if n_rep > 1 else v_cache
-
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    # Grouped attention without materializing repeated KV: fold the
+    # query heads as [B, T, nkv, n_rep, hd] and contract against the
+    # cache directly — repeating K/V would multiply HBM traffic on the
+    # hottest decode-step tensor by n_rep.
+    qg = q.reshape(b, t, nkv, n_rep, hd)
+    logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k_cache,
                         preferred_element_type=jnp.float32) * scale
     qpos = start[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]  # [B,T]
     kpos = jnp.arange(s, dtype=jnp.int32)                            # [S]
     mask = kpos[None, None, :] <= qpos[:, :, None]                   # [B,T,S]
-    logits = jnp.where(mask[:, None, :, :], logits,
+    logits = jnp.where(mask[:, None, None, :, :], logits,
                        jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
-                     preferred_element_type=jnp.float32)
-    return out.astype(q.dtype)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs.astype(v_cache.dtype),
+                     v_cache, preferred_element_type=jnp.float32)
+    return out.reshape(b, t, nh, hd).astype(q.dtype)
 
 
 def cached_forward(cfg, params, tokens, cache: KVCache, *,
@@ -218,7 +234,11 @@ def generate(cfg, params, prompts, *, key=None,
     sampling = sampling or SamplingParams()
     key = key if key is not None else jax.random.key(0)
     b, p = prompts.shape
-    prompt_lens = jnp.sum((prompts != pad_id).astype(jnp.int32), axis=1)
+    # length = 1 + last non-pad POSITION (not a count): a valid interior
+    # token equal to pad_id must not shorten the prompt
+    positions = jnp.arange(p, dtype=jnp.int32)[None, :]
+    prompt_lens = jnp.max(
+        jnp.where(prompts != pad_id, positions + 1, 0), axis=1)
     prompt_lens = jnp.maximum(prompt_lens, 1)
     max_len = p + sampling.max_new_tokens
     cache = init_cache(cfg, b, max_len)

@@ -92,7 +92,12 @@ class Runtime:
         self.store.subscribe_put(self._on_object_available)
 
         # --- dispatch queue + worker pool ---
-        nworkers = self.config.num_workers or int(ncpu)
+        # Size from the CPU *resource* (the logical cluster), not just host
+        # cores: init(num_cpus=8) on a 4-core host must still run 8
+        # concurrent tasks (reference: worker pool scales with resource
+        # demand, not cores — worker_pool.cc prestart).
+        nworkers = self.config.num_workers or int(
+            max(ncpu, self.total_resources.get("CPU", 0.0)))
         self._ready: deque[TaskSpec] = deque()
         self._ready_cv = threading.Condition()
         # Feasible-but-busy tasks parked until resources free up (reference:
@@ -100,9 +105,25 @@ class Runtime:
         self._blocked: deque[TaskSpec] = deque()
         # Future waiters keyed by object id (as_future resolution, threadless).
         self._future_waiters: dict[ObjectID, list[Future]] = {}
+        self._base_workers = max(4, nworkers)
         self._pool = ThreadPoolExecutor(
-            max_workers=max(4, nworkers), thread_name_prefix="ray_tpu-worker"
+            max_workers=self._base_workers,
+            thread_name_prefix="ray_tpu-worker",
         )
+        # Blocked-worker relief (reference: a worker blocked in ray.get
+        # releases its slot so the raylet can lease new workers —
+        # worker_pool prestart on blocked leases). Pool threads blocked in
+        # get() keep occupying their thread, so the dispatcher runs tasks
+        # on overflow threads whenever every pool thread is taken, up to a
+        # cap. Without this, N tasks that all wait on a child task/actor
+        # deadlock an N-thread pool.
+        self._pool_cap = max(64, 4 * self._base_workers)
+        self._thread_acct = threading.Lock()
+        self._inflight_pool = 0      # submitted to pool, not yet finished
+        self._overflow_threads = 0   # live overflow threads
+        # Per-worker-thread execution state (current spec, block depth)
+        # used by the blocked-worker protocol above.
+        self._exec_tl = threading.local()
         self._shutdown = False
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="ray_tpu-dispatcher", daemon=True
@@ -155,12 +176,104 @@ class Runtime:
         return ObjectRef(oid)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list[Any]:
-        return self.store.get([r.id for r in refs], timeout=timeout)
+        ids = [r.id for r in refs]
+        blocked = any(not self.store.contains(i) for i in ids)
+        if blocked:
+            self._note_worker_blocked()
+        try:
+            return self.store.get(ids, timeout=timeout)
+        finally:
+            if blocked:
+                self._note_worker_unblocked()
+
+    def _submit_to_workers(self, spec: TaskSpec):
+        """Run a ready task on the pool, or on an overflow thread when
+        every pool thread is taken (busy OR parked in a blocking get —
+        either way the thread is occupied). Uses only public executor
+        API; overflow is bounded by _pool_cap."""
+        with self._thread_acct:
+            overflow = (
+                self._inflight_pool >= self._base_workers
+                and (self._base_workers + self._overflow_threads)
+                < self._pool_cap
+            )
+            if overflow:
+                self._overflow_threads += 1
+            else:
+                self._inflight_pool += 1
+        if overflow:
+            threading.Thread(
+                target=self._execute_overflow, args=(spec,),
+                name="ray_tpu-worker-overflow", daemon=True,
+            ).start()
+        else:
+            self._pool.submit(self._execute_pooled, spec)
+
+    def _execute_pooled(self, spec: TaskSpec):
+        try:
+            self._execute_task(spec)
+        finally:
+            with self._thread_acct:
+                self._inflight_pool -= 1
+
+    def _execute_overflow(self, spec: TaskSpec):
+        try:
+            self._execute_task(spec)
+        finally:
+            with self._thread_acct:
+                self._overflow_threads -= 1
+
+    def _note_worker_blocked(self):
+        """A worker thread is about to block on objects produced by other
+        tasks (reference analog: a worker blocked in ray.get releases its
+        lease so the raylet can run other work): release the blocked
+        task's acquired resources so children with resource demands can
+        be admitted. Thread availability is handled at dispatch time by
+        _submit_to_workers' overflow threads."""
+        if not threading.current_thread().name.startswith("ray_tpu-worker"):
+            return
+        tl = self._exec_tl
+        depth = getattr(tl, "block_depth", 0)
+        tl.block_depth = depth + 1
+        spec = getattr(tl, "spec", None)
+        if (depth == 0 and spec is not None
+                and not spec.resources.is_empty()):
+            tl.released_resources = True
+            self._release_resources(spec.resources)
+
+    def _note_worker_unblocked(self):
+        """Re-acquire the task's resources on wake. May transiently
+        oversubscribe (available goes negative) — same trade the
+        reference makes when a blocked worker resumes; it self-corrects
+        when the task finishes and releases."""
+        if not threading.current_thread().name.startswith("ray_tpu-worker"):
+            return
+        tl = self._exec_tl
+        depth = getattr(tl, "block_depth", 1) - 1
+        tl.block_depth = depth
+        spec = getattr(tl, "spec", None)
+        if (depth == 0 and getattr(tl, "released_resources", False)
+                and spec is not None):
+            tl.released_resources = False
+            with self._res_cv:
+                for k, v in spec.resources.resources.items():
+                    self.available_resources[k] = (
+                        self.available_resources.get(k, 0.0) - v)
 
     def wait(self, refs: list[ObjectRef], num_returns=1, timeout=None):
-        ready_ids, not_ready_ids = self.store.wait(
-            [r.id for r in refs], num_returns, timeout
-        )
+        # Same blocked-worker protocol as get(): a worker parked in
+        # wait() must release its resources or children deadlock.
+        present = sum(self.store.contains(r.id) for r in refs)
+        blocked = present < num_returns
+        if blocked:
+            self._note_worker_blocked()
+        try:
+            ready_ids, not_ready_ids = self.store.wait(
+                [r.id for r in refs], num_returns, timeout
+            )
+        finally:
+            if blocked:
+                self._note_worker_unblocked()
         by_id = {r.id: r for r in refs}
         return [by_id[i] for i in ready_ids], [by_id[i] for i in not_ready_ids]
 
@@ -273,7 +386,19 @@ class Runtime:
                     return
                 spec = self._ready.popleft()
             if self._try_acquire(spec.resources):
-                self._pool.submit(self._execute_task, spec)
+                if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                    # Dedicated thread: creation is on the critical path of
+                    # every queued method call (callers block on it), so it
+                    # must never starve behind long tasks in the pool. The
+                    # "ray_tpu-worker" prefix opts it into the
+                    # blocked-worker protocol (a blocking __init__ must
+                    # release its resources too).
+                    threading.Thread(
+                        target=self._execute_task, args=(spec,),
+                        name="ray_tpu-worker-actor-creation", daemon=True,
+                    ).start()
+                else:
+                    self._submit_to_workers(spec)
             else:
                 with self._res_cv:
                     self._blocked.append(spec)
@@ -363,6 +488,7 @@ class Runtime:
             self._execute_actor_creation(spec)
             return
         started = time.monotonic()
+        self._exec_tl.spec = spec
         try:
             try:
                 args, kwargs = self._materialize_args(spec)
@@ -386,6 +512,7 @@ class Runtime:
             self.metrics["tasks_finished"].next()
             self.record_task_event(spec, started, time.monotonic(), True)
         finally:
+            self._exec_tl.spec = None
             self._release_resources(spec.resources)
 
     # ------------------------------------------------------------------
@@ -420,6 +547,9 @@ class Runtime:
         # kill_actor/shutdown), matching the reference's lease semantics — not
         # released when __init__ returns.
         state = self._actors[spec.actor_id]
+        # Opt into the blocked-worker protocol: a __init__ that blocks in
+        # get() must release the actor's held resources while it waits.
+        self._exec_tl.spec = spec
         try:
             args, kwargs = self._materialize_args(spec)
             cls = spec.function
@@ -433,6 +563,8 @@ class Runtime:
             )
             self._fail_pending_actor_tasks(state)
             return
+        finally:
+            self._exec_tl.spec = None
         with state.lock:
             state.instance = instance
         # Creation "return" marks readiness (reference: actor creation task

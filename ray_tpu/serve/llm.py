@@ -25,6 +25,7 @@ import itertools
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Iterator
@@ -34,7 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.models import decoding
-from ray_tpu.models.decoding import KVCache, SamplingParams
+from ray_tpu.models.decoding import (KVCache, SamplingParams, lax_slice_row,
+                                     lax_update_row)
 
 
 def _bucket(n: int, minimum: int = 16) -> int:
@@ -57,6 +59,10 @@ class Request:
     first_token_t: float | None = None
     generated: int = 0
     slot: int = -1
+    # set before the None sentinel when the request itself failed
+    # (e.g. prompt longer than the cache) — distinguishes rejection from
+    # a legitimate empty/EOS completion
+    error: BaseException | None = None
 
     @property
     def ttft(self) -> float | None:
@@ -72,6 +78,8 @@ class Request:
         while True:
             tok = self.out.get()
             if tok is None:
+                if self.error is not None:
+                    raise self.error
                 if self.engine is not None and self.engine.error is not None:
                     raise RuntimeError(
                         "LLM engine loop failed"
@@ -101,10 +109,13 @@ class LLMEngine:
         self._thread: threading.Thread | None = None
         self._key = jax.random.key(0)
         self.error: BaseException | None = None
-        # metrics
+        self._submit_lock = threading.Lock()
+        # metrics (TTFT window is bounded: a long-lived replica must not
+        # grow memory per request, and a recent window tracks current
+        # latency better than an all-time mean)
         self.total_generated = 0
         self.total_finished = 0
-        self.ttfts: list[float] = []
+        self.ttfts: "deque[float]" = deque(maxlen=1024)
 
         self._decode_fn = jax.jit(
             partial(self._decode_impl, cfg), donate_argnums=(1,)
@@ -172,10 +183,14 @@ class LLMEngine:
             eos_id=eos_id,
         )
         req.engine = self
-        if self.error is not None:
-            req.out.put(None)  # engine is dead: fail fast at tokens()
-        else:
-            self._waiting.put(req)
+        # Lock pairs with the drain in _loop's finally: a request either
+        # lands in _waiting before the drain (and gets its sentinel
+        # there) or observes the dead/stopped engine here — never neither.
+        with self._submit_lock:
+            if self.error is not None or self._stop.is_set():
+                req.out.put(None)  # engine is dead: fail fast at tokens()
+            else:
+                self._waiting.put(req)
         return req
 
     def _free_slots(self) -> list[int]:
@@ -190,7 +205,10 @@ class LLMEngine:
                 return
             plen = len(req.prompt)
             if plen >= self.max_len:
-                req.out.put(None)  # reject oversized
+                req.error = ValueError(
+                    f"prompt length {plen} >= engine max_len "
+                    f"{self.max_len}")
+                req.out.put(None)
                 continue
             bucket = min(_bucket(plen), self.max_len)
             padded = np.zeros((bucket,), np.int32)
@@ -234,15 +252,21 @@ class LLMEngine:
             self._run_loop()
         except BaseException as e:  # noqa: BLE001 — propagate to callers
             self.error = e
-            # unblock every caller: finish live streams and reject waiters
-            for req in self._active:
-                if req is not None:
-                    req.out.put(None)
-            while True:
-                try:
-                    self._waiting.get_nowait().out.put(None)
-                except queue.Empty:
-                    break
+        finally:
+            # Runs on BOTH error and clean stop(): every live stream and
+            # every waiter gets its sentinel, so no tokens() consumer can
+            # hang. Under _submit_lock so no request slips in after the
+            # drain (see submit()).
+            with self._submit_lock:
+                self._stop.set()
+                for req in self._active:
+                    if req is not None:
+                        req.out.put(None)
+                while True:
+                    try:
+                        self._waiting.get_nowait().out.put(None)
+                    except queue.Empty:
+                        break
 
     def _run_loop(self):
         while not self._stop.is_set():
@@ -311,17 +335,3 @@ class LLMDeployment:
         return self._engine.stats()
 
 
-def lax_slice_row(arr, slot):
-    """arr [L, B, ...] -> [L, 1, ...] at dynamic row `slot`."""
-    import jax.lax as lax
-
-    start = (0, slot) + (0,) * (arr.ndim - 2)
-    sizes = (arr.shape[0], 1) + arr.shape[2:]
-    return lax.dynamic_slice(arr, start, sizes)
-
-
-def lax_update_row(arr, row, slot):
-    import jax.lax as lax
-
-    start = (0, slot) + (0,) * (arr.ndim - 2)
-    return lax.dynamic_update_slice(arr, row.astype(arr.dtype), start)

@@ -97,6 +97,46 @@ class _Coordinator:
                 return True, self.mailbox.pop((src, dst, tag))
         return False, None
 
+    # -- address rendezvous (epoch-based, safe across group re-init) ----
+    # A plain collective round would be wrong here: this named actor
+    # outlives group incarnations, and a re-init with the same group name
+    # must not see a previous incarnation's frozen round-0 result. Each
+    # caller posts (rank, addr, uid); an epoch freezes when every rank
+    # has one queued entry, and results are keyed by the per-incarnation
+    # uid, so overlapping incarnations pair up FIFO per rank.
+
+    def rdv_post(self, rank, addr, uid):
+        with self._lock:
+            pending = self.__dict__.setdefault("rdv_pending", {})
+            done = self.__dict__.setdefault("rdv_done", {})
+            pending.setdefault(rank, []).append((uid, addr))
+            if all(pending.get(r) for r in range(self.world_size)):
+                entries = [pending[r].pop(0)
+                           for r in range(self.world_size)]
+                peers = [a for _, a in entries]
+                for u, _ in entries:
+                    done[u] = peers
+        return True
+
+    def rdv_fetch(self, uid):
+        with self._lock:
+            done = self.__dict__.setdefault("rdv_done", {})
+            if uid in done:
+                return True, done.pop(uid)
+        return False, None
+
+    def rdv_abandon(self, rank, uid):
+        """Withdraw a posted-but-unpaired entry (caller timed out). This
+        keeps a crashed/given-up incarnation from sitting at the head of
+        the rank's FIFO and poisoning every later epoch with a dead
+        address."""
+        with self._lock:
+            pending = self.__dict__.setdefault("rdv_pending", {})
+            q = pending.get(rank, [])
+            pending[rank] = [(u, a) for (u, a) in q if u != uid]
+            self.__dict__.setdefault("rdv_done", {}).pop(uid, None)
+        return True
+
 
 class CollectiveGroup:
     def __init__(self, group_name: str, world_size: int, rank: int):
@@ -171,13 +211,88 @@ class CollectiveGroup:
             time.sleep(0.002)
         raise TimeoutError(f"recv from rank {src_rank} timed out")
 
+    def destroy(self):
+        """Release backend resources (no-op for the actor backend; the
+        named coordinator outlives incarnations by design)."""
+
+
+class TcpCollectiveGroup(CollectiveGroup):
+    """Direct rank-to-rank data plane over the C++ TCP backend
+    (src/collective/tcp_collective.cc): ring allreduce etc. without the
+    coordinator-actor hop. The actor is used ONCE, for address
+    rendezvous; all tensor bytes then move peer-to-peer.
+
+    Analog of the reference's gloo collective group
+    (``collective_group/gloo_collective_group.py``) with the rendezvous
+    store replaced by the named coordinator actor.
+    """
+
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        super().__init__(group_name, world_size, rank)
+        import uuid
+
+        from ray_tpu._private.tcp_collective import TcpGroup
+
+        # Bind the listener FIRST (ephemeral port), then advertise the
+        # actually-bound address — no reserve/close/rebind race.
+        tcp = TcpGroup.listen(rank, world_size)
+        host = "127.0.0.1"
+        uid = uuid.uuid4().hex
+        ray_tpu.get(self.coord.rdv_post.remote(
+            rank, f"{host}:{tcp.port}", uid))
+        deadline = time.monotonic() + 60.0
+        while True:
+            ok, peers = ray_tpu.get(self.coord.rdv_fetch.remote(uid))
+            if ok:
+                break
+            if time.monotonic() > deadline:
+                # withdraw our entry so this incarnation can't poison
+                # later epochs with a dead listener address
+                ray_tpu.get(self.coord.rdv_abandon.remote(rank, uid))
+                raise TimeoutError(
+                    f"collective group {group_name!r} rendezvous timed out")
+            time.sleep(0.002)
+        self._tcp = tcp.connect([str(a) for a in peers])
+
+    def allreduce(self, array, op: str = "sum"):
+        return self._tcp.allreduce(array, op)
+
+    def allgather(self, array) -> list:
+        return self._tcp.allgather(array)
+
+    def reducescatter(self, array, op: str = "sum"):
+        return self._tcp.reducescatter(array, op)
+
+    def broadcast(self, array, src_rank: int = 0):
+        return self._tcp.broadcast(array, src_rank)
+
+    def barrier(self):
+        self._tcp.barrier()
+        return True
+
+    def send(self, array, dst_rank: int, tag: int = 0):
+        self._tcp.send(array, dst_rank, tag)
+
+    def recv(self, src_rank: int, tag: int = 0, timeout: float = 60.0):
+        return self._tcp.recv(src_rank, tag, timeout=timeout)
+
+    def destroy(self):
+        self._tcp.destroy()
+
 
 _groups = threading.local()
 
 
 def init_collective_group(world_size: int, rank: int,
-                          group_name: str = "default") -> CollectiveGroup:
-    group = CollectiveGroup(group_name, world_size, rank)
+                          group_name: str = "default",
+                          backend: str = "actor") -> CollectiveGroup:
+    """``backend="actor"``: rendezvous-actor star (works anywhere, object
+    path). ``backend="tcp"``: C++ ring collectives over direct sockets —
+    the high-bandwidth host data plane."""
+    if backend == "tcp":
+        group = TcpCollectiveGroup(group_name, world_size, rank)
+    else:
+        group = CollectiveGroup(group_name, world_size, rank)
     if not hasattr(_groups, "groups"):
         _groups.groups = {}
     _groups.groups[group_name] = group

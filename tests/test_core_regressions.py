@@ -161,3 +161,84 @@ def test_as_future_threadless(ray_tpu_start):
     futs = [f.remote().future() for _ in range(20)]
     assert threading.active_count() - before < 10  # no thread-per-future
     assert [x.result(timeout=5) for x in futs] == [42] * 20
+
+
+def test_pool_saturation_actor_creation_no_deadlock(ray_tpu_start):
+    """Tasks that fill every worker thread and then block on a named actor
+    they create on-demand must not deadlock: actor creation runs on a
+    dedicated thread and blocked workers grow the pool (reference analog:
+    blocked ray.get releases the worker lease so new workers spawn)."""
+
+    class Rendezvous:
+        def __init__(self, n):
+            self.n = n
+            self.seen = set()
+
+        def join(self, r):
+            self.seen.add(r)
+            return len(self.seen)
+
+        def full(self):
+            return len(self.seen) == self.n
+
+    world = ray_tpu_start._pool._max_workers  # saturate exactly
+
+    @ray_tpu.remote
+    def rank_fn(rank, world):
+        cls = ray_tpu.remote(Rendezvous)
+        try:
+            coord = cls.options(name="rdv", max_concurrency=4).remote(world)
+        except ValueError:
+            coord = ray_tpu.get_actor("rdv")
+        ray_tpu.get(coord.join.remote(rank))
+        deadline = time.monotonic() + 30
+        while not ray_tpu.get(coord.full.remote()):
+            if time.monotonic() > deadline:
+                raise TimeoutError("rendezvous never completed")
+            time.sleep(0.005)
+        return rank
+
+    outs = ray_tpu.get([rank_fn.remote(r, world) for r in range(world)],
+                       timeout=60)
+    assert sorted(outs) == list(range(world))
+
+
+def test_nested_task_chain_no_pool_deadlock(ray_tpu_start):
+    """Every worker blocks on a child task; pool growth must let the
+    children run."""
+
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent(x):
+        return ray_tpu.get(child.remote(x))
+
+    n = ray_tpu_start._pool._max_workers
+    assert ray_tpu.get([parent.remote(i) for i in range(n)],
+                       timeout=60) == [i + 1 for i in range(n)]
+
+
+def test_blocked_parent_releases_cpu_for_child():
+    """Parent tasks holding every CPU block on children that also need
+    CPUs: the blocked-worker protocol must release the parents' resources
+    so the children can be admitted (reference: blocked ray.get releases
+    the worker lease)."""
+    import ray_tpu as rt_mod
+
+    rt_mod.shutdown()
+    rt_mod.init(num_cpus=2, num_tpus=0)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def child(x):
+            return x * 2
+
+        @ray_tpu.remote(num_cpus=1)
+        def parent(x):
+            return ray_tpu.get(child.remote(x))
+
+        assert ray_tpu.get([parent.remote(i) for i in range(2)],
+                           timeout=30) == [0, 2]
+    finally:
+        rt_mod.shutdown()
