@@ -1,6 +1,8 @@
 """ray_tpu.data: streaming datasets (reference: Ray Data, SURVEY P13)."""
 
+from ray_tpu.data import aggregate, preprocessors
 from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import (
     Dataset,
     from_items,
@@ -8,19 +10,26 @@ from ray_tpu.data.dataset import (
     range,  # noqa: A004 - mirrors the reference's ray.data.range
     read_csv,
     read_json,
+    read_parquet,
 )
 from ray_tpu.data.execution import ExecutionOptions, StreamingExecutor
+from ray_tpu.data.grouped import GroupedData
 from ray_tpu.data.iterator import DataIterator
 
 __all__ = [
     "BlockAccessor",
+    "DataContext",
     "Dataset",
     "DataIterator",
     "ExecutionOptions",
+    "GroupedData",
     "StreamingExecutor",
+    "aggregate",
     "from_items",
     "from_numpy",
+    "preprocessors",
     "range",
     "read_csv",
     "read_json",
+    "read_parquet",
 ]

@@ -1,0 +1,37 @@
+"""Execution context / config for ray_tpu.data.
+
+Reference analog: ``python/ray/data/context.py`` (``DataContext``,
+``use_push_based_shuffle`` toggle at ``context.py:156-187``). Holds
+dataset-level knobs consulted at plan/execution time; one context per
+process, overridable per dataset via ``Dataset.with_context`` if needed.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import ClassVar
+
+
+@dataclass
+class DataContext:
+    # Distributed two-stage shuffle (map partitions -> reduce concat) vs
+    # the centralized gather shuffle. The push-based path keeps every
+    # partition in the object store as its own task output, so no single
+    # process materializes the whole dataset.
+    use_push_based_shuffle: bool = False
+    # default parallelism for shuffle reduce tasks (None = #input blocks)
+    shuffle_partitions: int | None = None
+    # target rows per block for sources that chunk data
+    target_num_blocks: int = 8
+    extra: dict = field(default_factory=dict)
+
+    _lock: ClassVar[threading.Lock] = threading.Lock()
+    _current: ClassVar["DataContext | None"] = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._current is None:
+                cls._current = DataContext()
+            return cls._current

@@ -74,8 +74,15 @@ class Dataset:
             lambda bundles: _repartition(bundles, num_blocks)))
 
     def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
+        from ray_tpu.data.context import DataContext
+
+        def do_shuffle(bundles):
+            if DataContext.get_current().use_push_based_shuffle:
+                return _push_shuffle(bundles, seed)
+            return _shuffle(bundles, seed)
+
         return self._with(lambda: AllToAllOperator(
-            "RandomShuffle", lambda bundles: _shuffle(bundles, seed)))
+            "RandomShuffle", do_shuffle))
 
     def sort(self, key: str) -> "Dataset":
         return self._with(lambda: AllToAllOperator(
@@ -89,6 +96,204 @@ class Dataset:
             return (_drain(left_src, left_ops, self._options)
                     + _drain(right_src, right_ops, other._options))
         return Dataset(source, (), self._options)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise join of two row-aligned datasets (reference:
+        Dataset.zip). Right columns that collide get a ``_1`` suffix."""
+        left, right = self, other
+
+        def source():
+            lb = _gather_rows(list(left.iter_bundles()))
+            rb = _gather_rows(list(right.iter_bundles()))
+            la = BlockAccessor.for_block(lb).to_batch()
+            ra = BlockAccessor.for_block(rb).to_batch()
+            n_l = BlockAccessor.for_block(lb).num_rows()
+            n_r = BlockAccessor.for_block(rb).num_rows()
+            if n_l != n_r:
+                raise ValueError(
+                    f"zip requires equal row counts, got {n_l} vs {n_r}")
+            out = dict(la)
+            for k, v in ra.items():
+                name = k
+                suffix = 1
+                while name in out:  # probe until unique; never overwrite
+                    name = f"{k}_{suffix}"
+                    suffix += 1
+                out[name] = v
+            return _emit_blocks(out, 8)
+        return Dataset(source, (), self._options)
+
+    # -- column ops (reference: dataset.py add_column/drop_columns/...) --
+
+    def add_column(self, name: str, fn) -> "Dataset":
+        def add(batch):
+            batch = dict(batch)
+            batch[name] = np.asarray(fn(batch))
+            return batch
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        drop = set(cols)
+        return self.map_batches(
+            lambda b: {k: v for k, v in b.items() if k not in drop})
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        keep = list(cols)
+        return self.map_batches(lambda b: {k: b[k] for k in keep})
+
+    def rename_columns(self, mapping: dict) -> "Dataset":
+        return self.map_batches(
+            lambda b: {mapping.get(k, k): v for k, v in b.items()})
+
+    def random_sample(self, fraction: float, *, seed=None) -> "Dataset":
+        """Uniform row sample. Runs as an exchange so each block draws
+        from a distinct per-block-index stream — a per-batch rng seeded
+        identically would repeat the same keep-mask in every block (a
+        positionally biased sample)."""
+        def do_sample(bundles):
+            out = []
+            for i, b in enumerate(bundles):
+                block = _gather_rows([b])
+                acc = BlockAccessor.for_block(block)
+                batch = acc.to_batch()
+                n = acc.num_rows()
+                rng = np.random.default_rng(
+                    None if seed is None else [seed, i])
+                keep = rng.random(n) < fraction
+                sampled = {k: np.asarray(v)[keep] for k, v in batch.items()}
+                sacc = BlockAccessor.for_block(sampled)
+                if sacc.num_rows():
+                    out.append(RefBundle([ray_tpu.put(sampled)],
+                                         num_rows=sacc.num_rows(),
+                                         size_bytes=sacc.size_bytes()))
+            return out
+        return self._with(lambda: AllToAllOperator("RandomSample",
+                                                   do_sample))
+
+    # -- grouped / global aggregates ------------------------------------
+
+    def groupby(self, key: str):
+        from ray_tpu.data.grouped import GroupedData
+
+        return GroupedData(self, key)
+
+    def aggregate(self, *aggs):
+        """Whole-dataset aggregation → single result dict."""
+        merged = [None] * len(aggs)
+        for batch in self.iter_batches():
+            for i, agg in enumerate(aggs):
+                col = np.asarray(batch[agg.on]) if agg.on else \
+                    np.arange(len(next(iter(batch.values()))))
+                p = agg.partial(col)
+                merged[i] = p if merged[i] is None else agg.merge(
+                    merged[i], p)
+        return {agg.output_name: agg.finalize(p)
+                for agg, p in builtins.zip(aggs, merged) if p is not None}
+
+    def groupby_all(self, *aggs):
+        return self.aggregate(*aggs)
+
+    def sum(self, on: str):
+        from ray_tpu.data.aggregate import Sum
+
+        return self.aggregate(Sum(on))[f"sum({on})"]
+
+    def min(self, on: str):
+        from ray_tpu.data.aggregate import Min
+
+        return self.aggregate(Min(on))[f"min({on})"]
+
+    def max(self, on: str):
+        from ray_tpu.data.aggregate import Max
+
+        return self.aggregate(Max(on))[f"max({on})"]
+
+    def mean(self, on: str):
+        from ray_tpu.data.aggregate import Mean
+
+        return self.aggregate(Mean(on))[f"mean({on})"]
+
+    def std(self, on: str, ddof: int = 1):
+        from ray_tpu.data.aggregate import Std
+
+        return self.aggregate(Std(on, ddof=ddof))[f"std({on})"]
+
+    def unique(self, column: str) -> list:
+        vals = set()
+        for batch in self.iter_batches():
+            vals.update(np.unique(np.asarray(batch[column])).tolist())
+        return sorted(vals)
+
+    def schema(self) -> dict:
+        """Column name -> dtype of the first non-empty block."""
+        for batch in self.iter_batches():
+            if batch:
+                return {k: np.asarray(v).dtype for k, v in batch.items()}
+        return {}
+
+    def split(self, n: int) -> list["Dataset"]:
+        """Materialize into EXACTLY n row-balanced datasets (some may be
+        empty when rows < n — callers index one per rank). For streaming
+        per-rank ingest use streaming_split."""
+        merged = _gather_rows(list(self.iter_bundles()))
+        acc = BlockAccessor.for_block(merged)
+        total = acc.num_rows()
+        out = []
+        for i in builtins.range(n):
+            start = i * total // n
+            end = (i + 1) * total // n
+            part = acc.slice(start, end)
+            pacc = BlockAccessor.for_block(part)
+            bundles = ([RefBundle([ray_tpu.put(part)],
+                                  num_rows=pacc.num_rows(),
+                                  size_bytes=pacc.size_bytes())]
+                       if pacc.num_rows() else [])
+            out.append(Dataset((lambda bb=bundles: list(bb)), (),
+                               self._options))
+        return out
+
+    # -- writes (reference: data/datasource write paths) -----------------
+
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, batch in enumerate(self.iter_batches()):
+            table = pa.table({k: np.asarray(v) for k, v in batch.items()})
+            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str) -> None:
+        import csv as _csv
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, batch in enumerate(self.iter_batches()):
+            keys = list(batch)
+            with open(os.path.join(path, f"part-{i:05d}.csv"), "w",
+                      newline="") as f:
+                w = _csv.writer(f)
+                w.writerow(keys)
+                n = len(batch[keys[0]]) if keys else 0
+                for r in builtins.range(n):
+                    w.writerow([batch[k][r] for k in keys])
+
+    def write_json(self, path: str) -> None:
+        import json as _json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, batch in enumerate(self.iter_batches()):
+            with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
+                keys = list(batch)
+                n = len(batch[keys[0]]) if keys else 0
+                for r in builtins.range(n):
+                    row = {k: np.asarray(batch[k][r]).item()
+                           if hasattr(batch[k][r], "item") else batch[k][r]
+                           for k in keys}
+                    f.write(_json.dumps(row) + "\n")
 
     # ------------------------------------------------------------------
     # execution
@@ -326,4 +531,102 @@ def read_csv(paths, *, num_blocks: int = 8) -> Dataset:
             with open(p, newline="") as f:
                 rows.extend(dict(r) for r in _csv.DictReader(f))
         return from_items(rows, num_blocks=num_blocks)._source_fn()
+    return Dataset(source)
+
+
+# ---------------------------------------------------------------------------
+# push-based shuffle (reference: push_based_shuffle_task_scheduler.py,
+# toggled by DataContext.use_push_based_shuffle)
+# ---------------------------------------------------------------------------
+
+def _shuffle_map_partition(block, n_parts: int, seed):
+    """Map stage task: split one block's rows uniformly at random into
+    n_parts partition blocks."""
+    acc = BlockAccessor.for_block(block)
+    n = acc.num_rows()
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, n_parts, size=n)
+    batch = acc.to_batch()
+    parts = []
+    for p in builtins.range(n_parts):
+        idx = np.flatnonzero(assign == p)
+        parts.append({k: np.asarray(v)[idx] for k, v in batch.items()})
+    return parts
+
+
+def _shuffle_reduce(seed, *part_blocks):
+    """Reduce stage task: concat this partition's pieces and shuffle
+    within the partition. Returns (block, (rows, bytes)) as two objects
+    so the driver can build a RefBundle from the tiny metadata object
+    without pulling the block."""
+    merged = concat_blocks(list(part_blocks))
+    acc = BlockAccessor.for_block(merged)
+    n = acc.num_rows()
+    perm = np.random.default_rng(seed).permutation(n) if n else []
+    if isinstance(merged, dict):
+        block = {k: np.asarray(v)[perm] for k, v in merged.items()}
+    else:
+        block = [merged[i] for i in perm]
+    bacc = BlockAccessor.for_block(block)
+    return block, (bacc.num_rows(), bacc.size_bytes())
+
+
+def _push_shuffle(bundles, seed):
+    """Two-stage distributed shuffle: every map task emits one piece per
+    reduce partition; reduce tasks concat+shuffle their pieces. Blocks
+    move by ObjectRef end to end (task args auto-deref), so the driver
+    only ever touches per-partition metadata tuples."""
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    n_parts = ctx.shuffle_partitions or max(1, len(bundles))
+    map_task = ray_tpu.remote(_shuffle_map_partition)
+    reduce_task = ray_tpu.remote(_shuffle_reduce)
+
+    piece_refs = []  # piece_refs[map_idx][part] -> ObjectRef of one piece
+    i = 0
+    for b in bundles:
+        for ref in b.refs:
+            sub = seed + i if seed is not None else None
+            refs = map_task.options(num_returns=n_parts).remote(
+                ref, n_parts, sub)
+            piece_refs.append([refs] if n_parts == 1 else refs)
+            i += 1
+    block_refs, meta_refs = [], []
+    for p in builtins.range(n_parts):
+        pieces = [plist[p] for plist in piece_refs]
+        rseed = None if seed is None else seed + 100_003 + p
+        bref, mref = reduce_task.options(num_returns=2).remote(
+            rseed, *pieces)
+        block_refs.append(bref)
+        meta_refs.append(mref)
+    out = []
+    for bref, (n, nbytes) in builtins.zip(block_refs,
+                                          ray_tpu.get(meta_refs)):
+        if n:
+            out.append(RefBundle([bref], num_rows=n, size_bytes=nbytes))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# parquet IO (reference: data/datasource/parquet_datasource.py; pyarrow)
+# ---------------------------------------------------------------------------
+
+def read_parquet(paths, *, num_blocks: int = 8, columns=None) -> Dataset:
+    """Parquet files → column-dict blocks (one or more blocks per file's
+    row groups)."""
+    import pyarrow.parquet as pq
+
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def source():
+        out = []
+        per_file = max(1, num_blocks // len(paths))
+        for p in paths:
+            table = pq.read_table(p, columns=columns)
+            cols = {name: table.column(name).to_numpy(zero_copy_only=False)
+                    for name in table.column_names}
+            out.extend(_emit_blocks(cols, per_file))
+        return out
     return Dataset(source)

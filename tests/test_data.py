@@ -71,7 +71,8 @@ def test_streaming_actually_streams(rt):
 
     seen_at = []
 
-    ds = rdata.range(40, num_blocks=8).map_batches(
+    # more blocks than worker threads so completion comes in waves
+    ds = rdata.range(80, num_blocks=32).map_batches(
         lambda b: (time.sleep(0.05), b)[1])
     for _ in ds.iter_batches():
         seen_at.append(time.monotonic())

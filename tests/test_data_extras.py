@@ -1,0 +1,220 @@
+"""Tests for the widened data layer: groupby/aggregates, zip, column ops,
+parquet IO, push-based shuffle, preprocessors.
+(reference analogs: python/ray/data/tests/test_all_to_all.py,
+test_parquet.py, preprocessors/)"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.context import DataContext
+from ray_tpu.data import preprocessors as pp
+
+
+@pytest.fixture
+def rt(ray_tpu_start):
+    return ray_tpu_start
+
+
+def _table(n=20):
+    return rd.from_numpy({
+        "k": np.arange(n) % 3,
+        "x": np.arange(n, dtype=np.float64),
+    })
+
+
+def test_groupby_aggregates(rt):
+    rows = _table(9).groupby("k").sum("x").take_all()
+    # k=0: 0+3+6=9, k=1: 1+4+7=12, k=2: 2+5+8=15
+    got = {int(r["k"]): r["sum(x)"] for r in rows}
+    assert got == {0: 9.0, 1: 12.0, 2: 15.0}
+
+    rows = _table(9).groupby("k").count().take_all()
+    assert all(r["count"] == 3 for r in rows)
+
+    rows = _table(9).groupby("k").mean("x").take_all()
+    assert {int(r["k"]): r["mean(x)"] for r in rows} == {
+        0: 3.0, 1: 4.0, 2: 5.0}
+
+
+def test_groupby_multi_agg_and_std(rt):
+    out = _table(10).groupby("k").aggregate(Min("x"), Max("x"),
+                                            Std("x", ddof=0)).take_all()
+    r0 = next(r for r in out if int(r["k"]) == 0)
+    vals = np.array([0.0, 3.0, 6.0, 9.0])
+    assert r0["min(x)"] == 0.0 and r0["max(x)"] == 9.0
+    assert abs(r0["std(x)"] - vals.std()) < 1e-9
+
+
+def test_global_aggregates(rt):
+    ds = _table(10)
+    assert ds.sum("x") == 45.0
+    assert ds.min("x") == 0.0
+    assert ds.max("x") == 9.0
+    assert ds.mean("x") == 4.5
+    assert abs(ds.std("x") - np.arange(10, dtype=float).std(ddof=1)) < 1e-9
+    out = ds.aggregate(Count(), Sum("x"))
+    assert out["count"] == 10 and out["sum(x)"] == 45.0
+
+
+def test_map_groups(rt):
+    out = _table(9).groupby("k").map_groups(
+        lambda g: {"k": g["k"][:1], "total": np.array([g["x"].sum()])}
+    ).take_all()
+    assert {int(r["k"]): float(r["total"]) for r in out} == {
+        0: 9.0, 1: 12.0, 2: 15.0}
+
+
+def test_zip_and_column_ops(rt):
+    a = rd.from_numpy({"x": np.arange(6)})
+    b = rd.from_numpy({"y": np.arange(6) * 10})
+    z = a.zip(b)
+    rows = z.take_all()
+    assert all(r["y"] == 10 * r["x"] for r in rows)
+
+    ds = rd.from_numpy({"x": np.arange(4, dtype=np.float64)})
+    ds2 = ds.add_column("sq", lambda b: b["x"] ** 2)
+    assert [r["sq"] for r in ds2.take_all()] == [0.0, 1.0, 4.0, 9.0]
+    assert set(ds2.select_columns(["sq"]).schema()) == {"sq"}
+    assert set(ds2.drop_columns(["sq"]).schema()) == {"x"}
+    assert set(ds2.rename_columns({"sq": "square"}).schema()) == {
+        "x", "square"}
+
+
+def test_unique_schema_split(rt):
+    ds = _table(12)
+    assert ds.unique("k") == [0, 1, 2]
+    sch = ds.schema()
+    assert sch["x"] == np.float64
+    parts = ds.split(3)
+    assert sum(p.count() for p in parts) == 12
+
+
+def test_parquet_roundtrip(rt, tmp_path):
+    ds = _table(16)
+    out = str(tmp_path / "pq")
+    ds.write_parquet(out)
+    back = rd.read_parquet(out)
+    assert back.count() == 16
+    assert back.sum("x") == ds.sum("x")
+    # column projection
+    only_k = rd.read_parquet(out, columns=["k"])
+    assert set(only_k.schema()) == {"k"}
+
+
+def test_csv_json_write(rt, tmp_path):
+    ds = _table(6)
+    ds.write_csv(str(tmp_path / "csv"))
+    ds.write_json(str(tmp_path / "json"))
+    back_csv = rd.read_csv(
+        [str(p) for p in sorted((tmp_path / "csv").glob("*.csv"))])
+    assert back_csv.count() == 6
+    back_json = rd.read_json(
+        [str(p) for p in sorted((tmp_path / "json").glob("*.json"))])
+    assert back_json.count() == 6
+    assert sum(float(r["x"]) for r in back_json.take_all()) == 15.0
+
+
+def test_push_based_shuffle(rt):
+    ctx = DataContext.get_current()
+    ctx.use_push_based_shuffle = True
+    try:
+        ds = rd.range(100).random_shuffle(seed=7)
+        vals = sorted(int(r["id"]) for r in ds.take_all())
+        assert vals == list(range(100))
+        # actually permuted (probability of identity is ~0)
+        first = [int(r["id"]) for r in
+                 rd.range(100).random_shuffle(seed=7).take(10)]
+        assert first != list(range(10))
+    finally:
+        ctx.use_push_based_shuffle = False
+
+
+def test_preprocessor_standard_scaler(rt):
+    ds = rd.from_numpy({"a": np.array([1.0, 2.0, 3.0, 4.0]),
+                        "b": np.array([10.0, 10.0, 10.0, 10.0])})
+    sc = pp.StandardScaler(["a", "b"]).fit(ds)
+    out = sc.transform(ds).take_all()
+    a = np.array([r["a"] for r in out])
+    assert abs(a.mean()) < 1e-9 and abs(a.std() - 1.0) < 1e-9
+    assert all(r["b"] == 0.0 for r in out)  # zero-variance column
+
+
+def test_preprocessor_minmax_label_onehot(rt):
+    ds = rd.from_items([
+        {"x": 0.0, "cat": "a"}, {"x": 5.0, "cat": "b"},
+        {"x": 10.0, "cat": "a"},
+    ])
+    mm = pp.MinMaxScaler(["x"]).fit(ds)
+    xs = [r["x"] for r in mm.transform(ds).take_all()]
+    assert xs == [0.0, 0.5, 1.0]
+
+    le = pp.LabelEncoder("cat").fit(ds)
+    cats = [int(r["cat"]) for r in le.transform(ds).take_all()]
+    assert cats == [0, 1, 0]
+
+    oh = pp.OneHotEncoder(["cat"]).fit(ds)
+    row = oh.transform(ds).take_all()[1]
+    assert row["cat_a"] == 0 and row["cat_b"] == 1
+
+
+def test_preprocessor_concat_chain_batchmapper(rt):
+    ds = rd.from_numpy({"f1": np.arange(4, dtype=np.float64),
+                        "f2": np.arange(4, dtype=np.float64) * 2})
+    chain = pp.Chain(
+        pp.StandardScaler(["f1"]),
+        pp.BatchMapper(lambda b: {**b, "f2": b["f2"] + 1}),
+        pp.Concatenator(["f1", "f2"], "features"),
+    ).fit(ds)
+    out = chain.transform(ds).take_all()
+    assert out[0]["features"].shape == (2,)
+    # serving-time single batch path
+    batch = chain.transform_batch(
+        {"f1": np.array([0.0, 3.0]), "f2": np.array([1.0, 1.0])})
+    assert batch["features"].shape == (2, 2)
+
+
+def test_unfit_preprocessor_raises(rt):
+    with pytest.raises(RuntimeError):
+        pp.StandardScaler(["x"]).transform(rd.range(3))
+
+
+def test_random_sample_not_positionally_biased(rt):
+    ds = rd.from_numpy({"x": np.arange(80)}, num_blocks=8)
+    kept = [int(r["x"]) for r in ds.random_sample(0.5, seed=1).take_all()]
+    # with per-block identical masks, kept positions mod 10 would form a
+    # fixed subset; distinct streams make that astronomically unlikely
+    mods = {k % 10 for k in kept}
+    assert len(mods) > 5
+    # reproducible
+    kept2 = [int(r["x"]) for r in ds.random_sample(0.5, seed=1).take_all()]
+    assert kept == kept2
+
+
+def test_split_exact_count_with_few_rows(rt):
+    parts = rd.from_numpy({"x": np.arange(2)}).split(4)
+    assert len(parts) == 4
+    assert sum(p.count() for p in parts) == 2
+
+
+def test_push_shuffle_reproducible(rt):
+    ctx = DataContext.get_current()
+    ctx.use_push_based_shuffle = True
+    try:
+        a = [int(r["id"]) for r in
+             rd.range(60).random_shuffle(seed=5).take_all()]
+        b = [int(r["id"]) for r in
+             rd.range(60).random_shuffle(seed=5).take_all()]
+        assert a == b
+        assert sorted(a) == list(range(60))
+    finally:
+        ctx.use_push_based_shuffle = False
+
+
+def test_zip_no_silent_overwrite(rt):
+    a = rd.from_numpy({"k": np.arange(3), "k_1": np.arange(3) * 2})
+    b = rd.from_numpy({"k": np.arange(3) * 5})
+    cols = set(a.zip(b).schema())
+    assert cols == {"k", "k_1", "k_2"}
