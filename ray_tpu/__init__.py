@@ -21,6 +21,7 @@ from ray_tpu.api import (
     wait,
 )
 from ray_tpu.runtime.object_ref import ObjectRef
+from ray_tpu.runtime_env import RuntimeEnv
 from ray_tpu.utils import exceptions
 
 __version__ = "0.1.0"
@@ -40,6 +41,7 @@ __all__ = [
     "available_resources",
     "timeline",
     "ObjectRef",
+    "RuntimeEnv",
     "exceptions",
     "__version__",
 ]

@@ -209,6 +209,7 @@ class RemoteFunction:
             scheduling_strategy=_parse_strategy(opts),
             max_retries=opts.get("max_retries", 0),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            runtime_env=_normalize_runtime_env(opts.get("runtime_env")),
         )
         refs = rt.submit_task(spec)
         rt.note_return_owner(spec)
@@ -363,6 +364,7 @@ class ActorClass:
             ),
             max_concurrency=opts.get("max_concurrency", 1),
             max_restarts=opts.get("max_restarts", 0),
+            runtime_env=_normalize_runtime_env(opts.get("runtime_env")),
         )
         actor_id = rt.create_actor(spec, name=opts.get("name"))
         return ActorHandle(actor_id, self._cls.__name__)
@@ -388,12 +390,25 @@ def get_actor(name: str) -> ActorHandle:
 
 _ACTOR_OPTION_KEYS = {
     "name", "max_concurrency", "max_restarts", "num_cpus", "num_tpus",
-    "memory", "resources", "lifetime",
+    "memory", "resources", "lifetime", "runtime_env",
 }
 _TASK_OPTION_KEYS = {
     "num_returns", "num_cpus", "num_tpus", "memory", "resources",
-    "max_retries", "retry_exceptions", "scheduling_strategy",
+    "max_retries", "retry_exceptions", "scheduling_strategy", "runtime_env",
 }
+
+
+def _normalize_runtime_env(env):
+    """Accept RuntimeEnv or plain dict; validate dicts through RuntimeEnv
+    so unsupported fields (pip/conda) fail at submission, not on the
+    worker."""
+    if env is None:
+        return None
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    if isinstance(env, RuntimeEnv):
+        return env.to_dict()
+    return RuntimeEnv(**env).to_dict()
 
 
 def remote(*args, **kwargs):

@@ -64,6 +64,42 @@ class ActorState:
 # Runtime
 # ---------------------------------------------------------------------------
 
+# Serializes tasks that declare env_vars (reference: each runtime_env
+# gets its own worker PROCESS — worker_pool.cc env-keyed caching; the
+# in-process local runtime approximates that by scoping os.environ
+# mutations under one lock so concurrent tasks never see each other's
+# vars half-applied). The lock is SUSPENDED while its holder blocks in
+# get()/wait() (see _note_worker_blocked) — otherwise a task with
+# env_vars waiting on a child that also has env_vars deadlocks.
+_runtime_env_lock = threading.Lock()
+
+
+class _EnvVarSession:
+    """One task execution's os.environ overlay; suspendable."""
+
+    def __init__(self, env_vars: dict):
+        self.env_vars = env_vars
+        self.old: dict | None = None
+        self.held = False
+
+    def acquire(self):
+        _runtime_env_lock.acquire()
+        self.held = True
+        self.old = {k: os.environ.get(k) for k in self.env_vars}
+        os.environ.update(self.env_vars)
+
+    def release(self):
+        if not self.held:
+            return
+        for k, v in (self.old or {}).items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        self.held = False
+        _runtime_env_lock.release()
+
+
 class Runtime:
     """Singleton runtime: object store + scheduler + actor registry."""
 
@@ -186,6 +222,26 @@ class Runtime:
             if blocked:
                 self._note_worker_unblocked()
 
+    def _call_in_runtime_env(self, runtime_env, fn, args, kwargs):
+        if not runtime_env:
+            return fn(*args, **kwargs)
+        from ray_tpu.runtime_env import apply_paths
+
+        apply_paths(runtime_env)
+        env_vars = runtime_env.get("env_vars")
+        if not env_vars:
+            return fn(*args, **kwargs)
+        tl = self._exec_tl
+        session = _EnvVarSession(env_vars)
+        prev = getattr(tl, "env_session", None)
+        tl.env_session = session
+        session.acquire()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            session.release()
+            tl.env_session = prev
+
     def _submit_to_workers(self, spec: TaskSpec):
         """Run a ready task on the pool, or on an overflow thread when
         every pool thread is taken (busy OR parked in a blocking get —
@@ -224,17 +280,23 @@ class Runtime:
                 self._overflow_threads -= 1
 
     def _note_worker_blocked(self):
-        """A worker thread is about to block on objects produced by other
+        """This thread is about to block on objects produced by other
         tasks (reference analog: a worker blocked in ray.get releases its
-        lease so the raylet can run other work): release the blocked
-        task's acquired resources so children with resource demands can
-        be admitted. Thread availability is handled at dispatch time by
-        _submit_to_workers' overflow threads."""
-        if not threading.current_thread().name.startswith("ray_tpu-worker"):
-            return
+        lease so the raylet can run other work). Suspends the thread's
+        env-var session (any thread) and releases the blocked task's
+        acquired resources (pool worker threads). Thread availability is
+        handled at dispatch time by _submit_to_workers' overflow
+        threads."""
         tl = self._exec_tl
         depth = getattr(tl, "block_depth", 0)
         tl.block_depth = depth + 1
+        if depth == 0:
+            sess = getattr(tl, "env_session", None)
+            if sess is not None and sess.held:
+                sess.release()
+                tl.env_suspended = True
+        if not threading.current_thread().name.startswith("ray_tpu-worker"):
+            return
         spec = getattr(tl, "spec", None)
         if (depth == 0 and spec is not None
                 and not spec.resources.is_empty()):
@@ -242,15 +304,20 @@ class Runtime:
             self._release_resources(spec.resources)
 
     def _note_worker_unblocked(self):
-        """Re-acquire the task's resources on wake. May transiently
-        oversubscribe (available goes negative) — same trade the
-        reference makes when a blocked worker resumes; it self-corrects
-        when the task finishes and releases."""
-        if not threading.current_thread().name.startswith("ray_tpu-worker"):
-            return
+        """Re-acquire the task's resources and env session on wake. May
+        transiently oversubscribe (available goes negative) — same trade
+        the reference makes when a blocked worker resumes; it
+        self-corrects when the task finishes and releases."""
         tl = self._exec_tl
         depth = getattr(tl, "block_depth", 1) - 1
         tl.block_depth = depth
+        if depth == 0 and getattr(tl, "env_suspended", False):
+            tl.env_suspended = False
+            sess = getattr(tl, "env_session", None)
+            if sess is not None:
+                sess.acquire()
+        if not threading.current_thread().name.startswith("ray_tpu-worker"):
+            return
         spec = getattr(tl, "spec", None)
         if (depth == 0 and getattr(tl, "released_resources", False)
                 and spec is not None):
@@ -497,7 +564,8 @@ class Runtime:
                 self._store_error(spec, e)
                 return
             try:
-                result = spec.function(*args, **kwargs)
+                result = self._call_in_runtime_env(
+                    spec.runtime_env, spec.function, args, kwargs)
             except BaseException as e:  # noqa: BLE001
                 if spec.max_retries > 0 and spec.retry_exceptions:
                     spec.max_retries -= 1
@@ -553,7 +621,8 @@ class Runtime:
         try:
             args, kwargs = self._materialize_args(spec)
             cls = spec.function
-            instance = cls(*args, **kwargs)
+            instance = self._call_in_runtime_env(
+                spec.runtime_env, cls, args, kwargs)
         except BaseException as e:  # noqa: BLE001
             state.dead = True
             state.death_reason = f"__init__ failed: {e!r}"
@@ -627,7 +696,9 @@ class Runtime:
         try:
             args, kwargs = self._materialize_args(spec)
             method = getattr(state.instance, spec.actor_method_name)
-            result = method(*args, **kwargs)
+            renv = (state.creation_spec.runtime_env
+                    if state.creation_spec is not None else None)
+            result = self._call_in_runtime_env(renv, method, args, kwargs)
         except BaseException as e:  # noqa: BLE001
             self.metrics["tasks_failed"].next()
             self._store_error(

@@ -184,6 +184,7 @@ class ClusterRuntime:
                 "resources": dict(spec.resources.resources),
                 "strategy": _wire_strategy(spec),
                 "max_retries": spec.max_retries,
+                "runtime_env": spec.runtime_env,
             }
             self._raylet.call("submit_task", task=task)
         return [ObjectRef(oid) for oid in spec.return_ids]
@@ -203,6 +204,7 @@ class ClusterRuntime:
             "return_oids": [ObjectID.from_random().hex()],
             "resources": dict(spec.resources.resources),
             "max_concurrency": spec.max_concurrency,
+            "runtime_env": spec.runtime_env,
         }
         strategy = _wire_strategy(spec)
         self._gcs.call(

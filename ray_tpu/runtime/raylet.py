@@ -15,6 +15,7 @@ Differences by design (TPU-host build, single-controller Python services):
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -42,6 +43,10 @@ class WorkerHandle:
     incarnation: int = 0
     current_task: dict | None = None
     acquired: dict = field(default_factory=dict)
+    # runtime-env identity this worker booted with; tasks only run on a
+    # worker with a matching key (reference: (language, runtime_env)-
+    # keyed worker caching in worker_pool.cc)
+    env_key: str = ""
 
 
 class Raylet(RpcServer):
@@ -112,10 +117,14 @@ class Raylet(RpcServer):
     # handshake, idle caching)
     # ------------------------------------------------------------------
 
-    def _spawn_worker(self) -> WorkerHandle:
+    def _spawn_worker(self, runtime_env: dict | None = None) -> WorkerHandle:
+        from ray_tpu.runtime_env import env_key as _env_key
+
         worker_id = WorkerID.from_random().hex()
         env = dict(os.environ)
         env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
+        if runtime_env:
+            env["RAY_TPU_RUNTIME_ENV"] = json.dumps(runtime_env)
         env.update({
             "RAY_TPU_RAYLET_HOST": self.address[0],
             "RAY_TPU_RAYLET_PORT": str(self.address[1]),
@@ -131,7 +140,8 @@ class Raylet(RpcServer):
             [sys.executable, "-m", "ray_tpu.runtime.worker_main"],
             env=env, cwd=os.getcwd(),
         )
-        handle = WorkerHandle(worker_id=worker_id, proc=proc)
+        handle = WorkerHandle(worker_id=worker_id, proc=proc,
+                              env_key=_env_key(runtime_env))
         with self._workers_lock:
             self._workers[worker_id] = handle
         return handle
@@ -366,7 +376,7 @@ class Raylet(RpcServer):
                 if task is None:
                     self._ready_cv.wait(timeout=0.1)
                     continue
-            worker = self._idle_worker()
+            worker = self._idle_worker(task.get("runtime_env"))
             if worker is None:
                 self._enqueue(task)
                 time.sleep(0.01)
@@ -384,22 +394,25 @@ class Raylet(RpcServer):
                 self._on_worker_gone(worker)
                 self._enqueue(task)
 
-    def _idle_worker(self) -> WorkerHandle | None:
-        """Grab an idle registered worker; spawn when under the cap."""
+    def _idle_worker(self, runtime_env: dict | None = None
+                     ) -> WorkerHandle | None:
+        """Grab an idle registered worker WITH a matching runtime-env
+        key; spawn one for this env when under the cap."""
+        from ray_tpu.runtime_env import env_key as _env_key
+
+        key = _env_key(runtime_env)
         with self._workers_lock:
             n_alive = 0
             for w in self._workers.values():
                 if w.state in ("idle", "busy", "starting", "actor"):
                     n_alive += 1
-                if w.state == "idle" and w.conn is not None:
+                if (w.state == "idle" and w.conn is not None
+                        and w.env_key == key):
                     w.state = "busy"
                     return w
-            if n_alive < self._max_workers:
-                spawn = True
-            else:
-                spawn = False
+            spawn = n_alive < self._max_workers
         if spawn:
-            self._spawn_worker()
+            self._spawn_worker(runtime_env)
         return None
 
     # ------------------------------------------------------------------
@@ -415,7 +428,7 @@ class Raylet(RpcServer):
         if not self._try_acquire(demand):
             raise RuntimeError(
                 f"node {self.node_id} cannot host actor: {demand} unavailable")
-        handle = self._spawn_worker()
+        handle = self._spawn_worker(spec.get("runtime_env"))
         handle.state = "actor"
         handle.actor_id = actor_id
         handle.incarnation = incarnation

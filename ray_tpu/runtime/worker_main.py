@@ -31,6 +31,17 @@ from ray_tpu.utils import exceptions as exc
 
 class Worker:
     def __init__(self):
+        # Apply this worker's runtime env BEFORE anything else: env_vars,
+        # cached working_dir (chdir), py_modules on sys.path (reference:
+        # the runtime-env agent prepares the context applied at worker
+        # start — _private/runtime_env/agent/runtime_env_agent.py:281).
+        renv_raw = os.environ.get("RAY_TPU_RUNTIME_ENV")
+        if renv_raw:
+            import json as _json
+
+            from ray_tpu.runtime_env import apply_runtime_env
+
+            apply_runtime_env(_json.loads(renv_raw))
         host = os.environ["RAY_TPU_RAYLET_HOST"]
         port = int(os.environ["RAY_TPU_RAYLET_PORT"])
         self.worker_id = os.environ["RAY_TPU_WORKER_ID"]

@@ -1,0 +1,205 @@
+"""RuntimeEnv: per-task/actor execution environments.
+
+Reference analog: ``python/ray/runtime_env/`` (public RuntimeEnv class +
+schema) and ``python/ray/_private/runtime_env/`` (P4: plugins, URI cache,
+per-node agent). Supported fields:
+
+- ``env_vars``: dict of environment variables visible to the task/actor.
+- ``working_dir``: a local directory, snapshotted by content hash into a
+  shared cache (the URI-cache analog); workers chdir into the snapshot
+  and put it on ``sys.path``.
+- ``py_modules``: list of module directories/files added to ``sys.path``
+  (cached the same way).
+- ``config``: opaque dict passed through (reference parity; e.g.
+  ``{"setup_timeout_seconds": ...}``).
+
+``pip``/``conda`` are intentionally rejected here: this image forbids
+package installation, so the field is validated out loudly rather than
+silently ignored (reference behavior is to build an env — see
+``_private/runtime_env/pip.py``).
+
+Workers are cached per runtime-env key exactly like the reference's
+(language, runtime_env)-keyed worker pool (``worker_pool.cc``): tasks
+with the same env reuse a warm worker; a different env gets its own.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import Any
+
+_UNSUPPORTED = ("pip", "conda", "container")
+
+
+class RuntimeEnv(dict):
+    """Dict-like (wire-serializable as plain JSON)."""
+
+    def __init__(self, *, env_vars: dict | None = None,
+                 working_dir: str | None = None,
+                 py_modules: list | None = None,
+                 config: dict | None = None, **kwargs):
+        for k in _UNSUPPORTED:
+            if k in kwargs:
+                raise ValueError(
+                    f"runtime_env field {k!r} is not supported in this "
+                    "environment (package installation is disabled); "
+                    "pre-bake dependencies into the image instead")
+        if kwargs:
+            raise ValueError(f"unknown runtime_env fields: {list(kwargs)}")
+        body: dict[str, Any] = {}
+        if env_vars:
+            if not all(isinstance(k, str) and isinstance(v, str)
+                       for k, v in env_vars.items()):
+                raise TypeError("env_vars must be str -> str")
+            body["env_vars"] = dict(env_vars)
+        if working_dir:
+            if not os.path.isdir(working_dir):
+                raise ValueError(
+                    f"working_dir {working_dir!r} is not a directory")
+            body["working_dir"] = os.path.abspath(working_dir)
+        if py_modules:
+            body["py_modules"] = [os.path.abspath(p) for p in py_modules]
+        if config:
+            body["config"] = dict(config)
+        super().__init__(body)
+
+    def to_dict(self) -> dict:
+        return dict(self)
+
+
+def env_key(runtime_env: dict | None) -> str:
+    """Stable identity of a runtime env — the worker-cache key."""
+    if not runtime_env:
+        return ""
+    return hashlib.sha256(
+        json.dumps(runtime_env, sort_keys=True).encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# URI cache (reference: _private/runtime_env/packaging.py — content-hash
+# addressed snapshots shared across workers)
+# ---------------------------------------------------------------------------
+
+def _cache_root() -> str:
+    root = os.environ.get(
+        "RAY_TPU_RUNTIME_ENV_CACHE",
+        os.path.join(os.path.expanduser("~"), ".ray_tpu",
+                     "runtime_env_cache"))
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def _dir_content_hash(path: str) -> str:
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(path)):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            fp = os.path.join(dirpath, fn)
+            h.update(os.path.relpath(fp, path).encode())
+            try:
+                with open(fp, "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                continue
+    return h.hexdigest()[:16]
+
+
+def snapshot_dir(path: str) -> str:
+    """Copy `path` into the content-addressed cache; returns the cached
+    location. Idempotent AND concurrency-safe: each process stages into
+    its own unique tmp dir, and a racing winner is tolerated (same
+    content, same key — either copy is correct)."""
+    import uuid
+
+    path = os.path.abspath(path)
+    digest = _dir_content_hash(path)
+    dest = os.path.join(_cache_root(), digest)
+    if not os.path.isdir(dest):
+        tmp = f"{dest}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        shutil.copytree(path, tmp)
+        try:
+            os.replace(tmp, dest)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            if not os.path.isdir(dest):  # lost the race some OTHER way
+                raise
+    return dest
+
+
+def apply_runtime_env(runtime_env: dict | None) -> None:
+    """Apply an env in-place to THIS process (worker boot path —
+    reference: runtime-env agent's GetOrCreateRuntimeEnv result applied
+    as the worker's startup context)."""
+    import uuid
+
+    if not runtime_env:
+        return
+    for k, v in (runtime_env.get("env_vars") or {}).items():
+        os.environ[k] = v
+    wd = runtime_env.get("working_dir")
+    if wd:
+        snap = snapshot_dir(wd)
+        # Per-worker COPY of the snapshot: the worker may write to its
+        # cwd, and writes must not mutate the shared content-addressed
+        # cache entry (reference: per-job working_dir copies).
+        workdir = os.path.join(
+            _cache_root(), f"work-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        shutil.copytree(snap, workdir)
+        os.chdir(workdir)
+        import sys
+
+        if workdir not in sys.path:
+            sys.path.insert(0, workdir)
+    for mod in runtime_env.get("py_modules") or []:
+        _add_module_path(mod)
+
+
+_applied_path_keys: set[str] = set()
+
+
+def apply_paths(runtime_env: dict | None) -> None:
+    """sys.path half of apply_runtime_env: safe for the in-process local
+    runtime too (additive and idempotent — no chdir, no env mutation,
+    which would be process-global and racy across worker threads).
+    Memoized per env key: re-hashing/copying the working_dir tree on
+    every task execution would put a full directory read on the task hot
+    path."""
+    import sys
+
+    if not runtime_env:
+        return
+    key = env_key(runtime_env)
+    if key in _applied_path_keys:
+        return
+    wd = runtime_env.get("working_dir")
+    if wd:
+        snap = snapshot_dir(wd)
+        if snap not in sys.path:
+            sys.path.insert(0, snap)
+    for mod in runtime_env.get("py_modules") or []:
+        _add_module_path(mod)
+    _applied_path_keys.add(key)
+
+
+def _add_module_path(mod: str) -> None:
+    import sys
+
+    if os.path.isdir(mod):
+        snap = snapshot_dir(mod)
+        # a module dir's PARENT goes on sys.path so `import <name>`
+        # resolves; cached copy keeps the original name via a child
+        parent = os.path.join(_cache_root(),
+                              "mods-" + _dir_content_hash(mod))
+        target = os.path.join(parent, os.path.basename(mod))
+        if not os.path.isdir(target):
+            os.makedirs(parent, exist_ok=True)
+            shutil.copytree(snap, target, dirs_exist_ok=True)
+        if parent not in sys.path:
+            sys.path.insert(0, parent)
+    else:
+        d = os.path.dirname(mod)
+        if d not in sys.path:
+            sys.path.insert(0, d)

@@ -1,0 +1,171 @@
+"""Runtime-env tests (reference analog: python/ray/tests/test_runtime_env*
+— P4: env_vars / working_dir / py_modules, env-keyed worker caching)."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env import RuntimeEnv, env_key, snapshot_dir
+
+
+def test_runtime_env_validation(tmp_path):
+    with pytest.raises(ValueError):
+        RuntimeEnv(pip=["requests"])
+    with pytest.raises(ValueError):
+        RuntimeEnv(conda="env.yaml")
+    with pytest.raises(ValueError):
+        RuntimeEnv(working_dir=str(tmp_path / "missing"))
+    with pytest.raises(TypeError):
+        RuntimeEnv(env_vars={"A": 1})
+    e = RuntimeEnv(env_vars={"A": "1"}, config={"x": 2})
+    assert e.to_dict()["env_vars"] == {"A": "1"}
+
+
+def test_env_key_stability():
+    a = env_key({"env_vars": {"A": "1", "B": "2"}})
+    b = env_key({"env_vars": {"B": "2", "A": "1"}})
+    assert a == b
+    assert env_key(None) == "" == env_key({})
+    assert a != env_key({"env_vars": {"A": "x"}})
+
+
+def test_snapshot_dir_content_addressed(tmp_path):
+    d = tmp_path / "wd"
+    d.mkdir()
+    (d / "f.txt").write_text("hello")
+    s1 = snapshot_dir(str(d))
+    s2 = snapshot_dir(str(d))
+    assert s1 == s2
+    assert open(os.path.join(s1, "f.txt")).read() == "hello"
+    (d / "f.txt").write_text("changed")
+    s3 = snapshot_dir(str(d))
+    assert s3 != s1
+
+
+def test_env_vars_local_mode(ray_tpu_start):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_TEST_VAR": "abc"}})
+    def f():
+        return os.environ.get("MY_TEST_VAR")
+
+    @ray_tpu.remote
+    def g():
+        return os.environ.get("MY_TEST_VAR")
+
+    assert ray_tpu.get(f.remote()) == "abc"
+    assert ray_tpu.get(g.remote()) is None  # restored after f
+
+
+def test_env_vars_actor_local_mode(ray_tpu_start):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_VAR": "zzz"}})
+    class A:
+        def peek(self):
+            return os.environ.get("ACTOR_VAR")
+
+    a = A.remote()
+    assert ray_tpu.get(a.peek.remote()) == "zzz"
+
+
+def test_py_modules_local_mode(ray_tpu_start, tmp_path):
+    mod = tmp_path / "my_test_module_rtenv"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("VALUE = 41\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)]})
+    def f():
+        import my_test_module_rtenv
+
+        return my_test_module_rtenv.VALUE + 1
+
+    try:
+        assert ray_tpu.get(f.remote()) == 42
+    finally:
+        sys.modules.pop("my_test_module_rtenv", None)
+
+
+def test_unsupported_field_fails_at_submit(ray_tpu_start):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ValueError):
+        f.options(runtime_env={"pip": ["x"]}).remote()
+
+
+def test_cluster_worker_env_isolation(tmp_path):
+    """Cluster mode: workers are cached per env key; env_vars land in the
+    worker PROCESS env and different envs get different workers."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=4)
+    try:
+        ray_tpu.shutdown()
+        rt = ray_tpu.init(address=cluster.gcs_address)
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"WORKER_FLAVOR": "a"}})
+        def fa():
+            return os.environ.get("WORKER_FLAVOR"), os.getpid()
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"WORKER_FLAVOR": "b"}})
+        def fb():
+            return os.environ.get("WORKER_FLAVOR"), os.getpid()
+
+        (va, pa), (vb, pb) = ray_tpu.get([fa.remote(), fb.remote()])
+        assert va == "a" and vb == "b"
+        assert pa != pb  # different env -> different worker process
+        # same env reuses the cached worker
+        va2, pa2 = ray_tpu.get(fa.remote())
+        assert va2 == "a" and pa2 == pa
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_cluster_working_dir(tmp_path):
+    from ray_tpu.cluster_utils import Cluster
+
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload")
+    (wd / "helper_mod_rtenv.py").write_text(
+        textwrap.dedent("""
+        def read():
+            return open("data.txt").read()
+        """))
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    try:
+        ray_tpu.shutdown()
+        ray_tpu.init(address=cluster.gcs_address)
+
+        @ray_tpu.remote(runtime_env={"working_dir": str(wd)})
+        def f():
+            import helper_mod_rtenv
+
+            return helper_mod_rtenv.read()
+
+        assert ray_tpu.get(f.remote()) == "payload"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_nested_env_var_tasks_no_deadlock(ray_tpu_start):
+    """A task with env_vars that blocks on a child with env_vars must not
+    deadlock: the env session suspends while blocked in get()."""
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"CHILD_V": "c"}})
+    def child():
+        return os.environ.get("CHILD_V")
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"PARENT_V": "p"}})
+    def parent():
+        inner = ray_tpu.get(child.remote())
+        # parent's overlay must be restored after the blocked get
+        return inner, os.environ.get("PARENT_V")
+
+    assert ray_tpu.get(parent.remote(), timeout=30) == ("c", "p")
