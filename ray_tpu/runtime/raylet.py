@@ -397,10 +397,15 @@ class Raylet(RpcServer):
     def _idle_worker(self, runtime_env: dict | None = None
                      ) -> WorkerHandle | None:
         """Grab an idle registered worker WITH a matching runtime-env
-        key; spawn one for this env when under the cap."""
+        key; spawn one for this env when under the cap. At the cap, an
+        idle worker with a DIFFERENT env key is evicted to make room —
+        otherwise a full pool of mismatched-env workers starves the task
+        forever (reference: worker_pool.cc kills idle workers beyond the
+        cached-soft-limit when a lease needs a different runtime_env)."""
         from ray_tpu.runtime_env import env_key as _env_key
 
         key = _env_key(runtime_env)
+        evict = None
         with self._workers_lock:
             n_alive = 0
             for w in self._workers.values():
@@ -411,6 +416,22 @@ class Raylet(RpcServer):
                     w.state = "busy"
                     return w
             spawn = n_alive < self._max_workers
+            if not spawn:
+                for w in self._workers.values():
+                    if (w.state == "idle" and w.conn is not None
+                            and w.env_key != key):
+                        w.state = "dead"
+                        evict = w
+                        spawn = True
+                        break
+        if evict is not None:
+            try:
+                if evict.proc is not None:
+                    evict.proc.terminate()
+                if evict.conn is not None:
+                    evict.conn.close()
+            except OSError:
+                pass
         if spawn:
             self._spawn_worker(runtime_env)
         return None

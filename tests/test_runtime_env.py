@@ -169,3 +169,32 @@ def test_nested_env_var_tasks_no_deadlock(ray_tpu_start):
         return inner, os.environ.get("PARENT_V")
 
     assert ray_tpu.get(parent.remote(), timeout=30) == ("c", "p")
+
+
+def test_cluster_env_eviction_at_worker_cap():
+    """A node at its worker cap with only mismatched-env idle workers
+    must evict one to run a task with a new env, not starve forever."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)  # cap = 1 worker
+    try:
+        ray_tpu.shutdown()
+        ray_tpu.init(address=cluster.gcs_address)
+
+        @ray_tpu.remote
+        def plain():
+            return "plain"
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"EV": "1"}})
+        def with_env():
+            return os.environ.get("EV")
+
+        assert ray_tpu.get(plain.remote(), timeout=30) == "plain"
+        # pool is now one idle worker with env_key="" — must be evicted
+        assert ray_tpu.get(with_env.remote(), timeout=30) == "1"
+        # and back again the other way
+        assert ray_tpu.get(plain.remote(), timeout=30) == "plain"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
