@@ -1,0 +1,60 @@
+"""Usage stats: opt-out local usage recording.
+
+Reference analog: ``python/ray/_private/usage/usage_lib.py`` (P11). The
+reference phones home unless ``RAY_USAGE_STATS_ENABLED=0``; this
+environment has zero egress, so the report is only ever written to a
+local JSON file (same schema spirit: library usage flags + counters),
+and the same opt-out env var convention applies
+(``RAY_TPU_USAGE_STATS_ENABLED=0``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+_usage: dict[str, int] = {}
+_features: set[str] = set()
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") != "0"
+
+
+def record_library_usage(name: str) -> None:
+    """Called by libraries at import/first use (train/tune/serve/...)."""
+    if not enabled():
+        return
+    with _lock:
+        _features.add(name)
+
+
+def record_extra_usage_tag(key: str, value: int = 1) -> None:
+    if not enabled():
+        return
+    with _lock:
+        _usage[key] = _usage.get(key, 0) + value
+
+
+def usage_report() -> dict:
+    with _lock:
+        return {
+            "timestamp": time.time(),
+            "libraries": sorted(_features),
+            "counters": dict(_usage),
+            "enabled": enabled(),
+        }
+
+
+def write_report(path: str | None = None) -> str:
+    path = path or os.path.join(
+        os.path.expanduser("~"), ".ray_tpu", "usage_stats.json")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(usage_report(), f, indent=2)
+    return path

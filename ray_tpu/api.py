@@ -210,6 +210,7 @@ class RemoteFunction:
             max_retries=opts.get("max_retries", 0),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             runtime_env=_normalize_runtime_env(opts.get("runtime_env")),
+            trace_ctx=_trace_ctx(self._fn.__qualname__),
         )
         refs = rt.submit_task(spec)
         rt.note_return_owner(spec)
@@ -312,6 +313,7 @@ class ActorHandle:
             num_returns=num_returns,
             actor_id=self._actor_id,
             actor_method_name=method_name,
+            trace_ctx=_trace_ctx(f"{self._class_name}.{method_name}"),
         )
         refs = rt.submit_task(spec)
         rt.note_return_owner(spec)
@@ -396,6 +398,16 @@ _TASK_OPTION_KEYS = {
     "num_returns", "num_cpus", "num_tpus", "memory", "resources",
     "max_retries", "retry_exceptions", "scheduling_strategy", "runtime_env",
 }
+
+
+def _trace_ctx(function_name: str):
+    """Capture the tracing context at submission time (None when tracing
+    is disabled — zero overhead on the default path)."""
+    from ray_tpu.util import tracing
+
+    if not tracing.is_enabled():
+        return None
+    return tracing.submission_context(function_name)
 
 
 def _normalize_runtime_env(env):

@@ -1,0 +1,145 @@
+"""Dashboard head: HTTP observability endpoint for a running cluster.
+
+Reference analog: ``dashboard/head.py:81`` + REST modules under
+``dashboard/modules/`` (P7). The reference runs an aiohttp app with a
+React frontend; here a dependency-free threaded http.server exposes the
+same information surface:
+
+- ``GET /``                       tiny HTML overview (live summary)
+- ``GET /api/cluster_status``     cluster summary (nodes/actors/resources)
+- ``GET /api/nodes|actors|tasks|jobs|placement_groups|objects``
+- ``GET /api/timeline``           chrome://tracing JSON of task events
+- ``GET /metrics``                Prometheus text (``ray.util.metrics``
+                                  analog + runtime counters)
+- ``GET /api/version``
+
+Data comes from ``ray_tpu.util.state`` (GCS-backed in cluster mode,
+runtime introspection locally) so the dashboard works in both modes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import ray_tpu
+from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import state as _state
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body {{ font-family: monospace; margin: 2em; }}
+ pre {{ background: #f4f4f4; padding: 1em; }}
+ a {{ margin-right: 1em; }}
+</style></head>
+<body>
+<h2>ray_tpu dashboard</h2>
+<div>
+<a href="/api/cluster_status">cluster_status</a>
+<a href="/api/nodes">nodes</a>
+<a href="/api/actors">actors</a>
+<a href="/api/tasks">tasks</a>
+<a href="/api/jobs">jobs</a>
+<a href="/api/placement_groups">placement_groups</a>
+<a href="/api/timeline">timeline</a>
+<a href="/metrics">metrics</a>
+</div>
+<h3>summary</h3>
+<pre>{summary}</pre>
+</body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # silence request logging
+        pass
+
+    def _send(self, body: bytes, content_type: str, status: int = 200):
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, obj, status: int = 200):
+        self._send(json.dumps(obj, indent=2, default=str).encode(),
+                   "application/json", status)
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/":
+                summary = json.dumps(_state.cluster_summary(), indent=2,
+                                     default=str)
+                self._send(_INDEX_HTML.format(summary=summary).encode(),
+                           "text/html")
+            elif path == "/api/cluster_status":
+                self._send_json(_state.cluster_summary())
+            elif path == "/api/nodes":
+                self._send_json(_state.list_nodes())
+            elif path == "/api/actors":
+                self._send_json(_state.list_actors())
+            elif path == "/api/tasks":
+                self._send_json(_state.list_tasks())
+            elif path == "/api/jobs":
+                self._send_json(_state.list_jobs())
+            elif path == "/api/placement_groups":
+                self._send_json(_state.list_placement_groups())
+            elif path == "/api/objects":
+                self._send_json(_state.list_objects())
+            elif path == "/api/timeline":
+                self._send_json(ray_tpu.timeline())
+            elif path == "/api/version":
+                self._send_json({"version": ray_tpu.__version__})
+            elif path == "/metrics":
+                self._send(_metrics.export_prometheus().encode(),
+                           "text/plain; version=0.0.4")
+            else:
+                self._send_json({"error": f"unknown path {path}"}, 404)
+        except Exception as e:  # noqa: BLE001 - surface as 500, keep serving
+            self._send_json({"error": repr(e)}, 500)
+
+
+class Dashboard:
+    """Threaded dashboard server bound to (host, port)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "Dashboard":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ray_tpu-dashboard",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+_dashboard: Dashboard | None = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> Dashboard:
+    """Start (or return) the process-wide dashboard."""
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port).start()
+    return _dashboard
+
+
+def stop_dashboard():
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.stop()
+        _dashboard = None

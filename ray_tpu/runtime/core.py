@@ -564,8 +564,11 @@ class Runtime:
                 self._store_error(spec, e)
                 return
             try:
-                result = self._call_in_runtime_env(
-                    spec.runtime_env, spec.function, args, kwargs)
+                from ray_tpu.util.tracing import execution_span
+
+                with execution_span(spec.function_name, spec.trace_ctx):
+                    result = self._call_in_runtime_env(
+                        spec.runtime_env, spec.function, args, kwargs)
             except BaseException as e:  # noqa: BLE001
                 if spec.max_retries > 0 and spec.retry_exceptions:
                     spec.max_retries -= 1
@@ -698,7 +701,11 @@ class Runtime:
             method = getattr(state.instance, spec.actor_method_name)
             renv = (state.creation_spec.runtime_env
                     if state.creation_spec is not None else None)
-            result = self._call_in_runtime_env(renv, method, args, kwargs)
+            from ray_tpu.util.tracing import execution_span
+
+            with execution_span(spec.function_name, spec.trace_ctx):
+                result = self._call_in_runtime_env(renv, method, args,
+                                                   kwargs)
         except BaseException as e:  # noqa: BLE001
             self.metrics["tasks_failed"].next()
             self._store_error(

@@ -185,6 +185,7 @@ class ClusterRuntime:
                 "strategy": _wire_strategy(spec),
                 "max_retries": spec.max_retries,
                 "runtime_env": spec.runtime_env,
+                "trace_ctx": spec.trace_ctx,
             }
             self._raylet.call("submit_task", task=task)
         return [ObjectRef(oid) for oid in spec.return_ids]
@@ -259,6 +260,7 @@ class ClusterRuntime:
             "args_blob": self._wire_args(spec),
             "return_oids": [o.hex() for o in spec.return_ids],
             "caller_id": self.caller_id,
+            "trace_ctx": spec.trace_ctx,
         }
         last_err: BaseException | None = None
         for attempt in range(2):

@@ -408,19 +408,30 @@ class Raylet(RpcServer):
         evict = None
         with self._workers_lock:
             n_alive = 0
+            incoming = False  # replacement with this env already booting?
             for w in self._workers.values():
                 if w.state in ("idle", "busy", "starting", "actor"):
                     n_alive += 1
+                if w.state == "starting" and w.env_key == key:
+                    incoming = True
                 if (w.state == "idle" and w.conn is not None
                         and w.env_key == key):
                     w.state = "busy"
                     return w
+            if incoming:
+                # a matching worker is already on its way — evicting more
+                # warm workers per dispatch retry would drain the whole
+                # pool for one task
+                return None
             spawn = n_alive < self._max_workers
             if not spawn:
                 for w in self._workers.values():
                     if (w.state == "idle" and w.conn is not None
                             and w.env_key != key):
-                        w.state = "dead"
+                        # not "dead": _on_worker_gone must still run its
+                        # cleanup (pop from registry, store refs, zombie
+                        # reap) when the channel closes
+                        w.state = "evicting"
                         evict = w
                         spawn = True
                         break
@@ -432,6 +443,12 @@ class Raylet(RpcServer):
                     evict.conn.close()
             except OSError:
                 pass
+            self._on_worker_gone(evict)
+            if evict.proc is not None:
+                try:
+                    evict.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    evict.proc.kill()
         if spawn:
             self._spawn_worker(runtime_env)
         return None

@@ -83,5 +83,8 @@ class TaskSpec:
     max_concurrency: int = 1
     max_restarts: int = 0
     runtime_env: dict | None = None
+    # tracing context captured at submission (util/tracing.py); None when
+    # tracing is off
+    trace_ctx: dict | None = None
     # observability
     submitted_at: float = 0.0

@@ -159,7 +159,11 @@ class Worker:
             self._store_error(task, e)
             return
         try:
-            result = fn(*args, **kwargs)
+            from ray_tpu.util.tracing import execution_span
+
+            with execution_span(task.get("name", "?"),
+                                task.get("trace_ctx")):
+                result = fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             self._store_error(
                 task, exc.TaskError(task.get("name", "?"), e,
@@ -209,9 +213,13 @@ class Worker:
 
     def _run_actor_task(self, task: dict):
         try:
+            from ray_tpu.util.tracing import execution_span
+
             args, kwargs = self._resolve_args(task)
             method = getattr(self.actor_instance, task["method_name"])
-            result = method(*args, **kwargs)
+            with execution_span(task.get("name", "?"),
+                                task.get("trace_ctx")):
+                result = method(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             self._store_error(
                 task, exc.TaskError(task.get("name", "?"), e,

@@ -85,6 +85,45 @@ def cmd_submit(args):
                              env=env))
 
 
+def cmd_summary(args):
+    """Summaries like `ray summary tasks/actors` (state CLI analog)."""
+    import ray_tpu
+    from ray_tpu.util import state as _state
+
+    ray_tpu.init(address=args.address)
+    out = {"cluster": _state.cluster_summary(),
+           "actors": _state.summarize_actors(),
+           "tasks": _state.summarize_tasks()}
+    print(json.dumps(out, indent=2, default=str))
+
+
+def cmd_dashboard(args):
+    """Serve the observability dashboard against a cluster."""
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard
+
+    if args.address:
+        ray_tpu.init(address=args.address)
+    else:
+        ray_tpu.init(num_cpus=args.num_cpus)
+    dash = start_dashboard(host=args.host, port=args.dashboard_port)
+    print(f"dashboard at {dash.url}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_timeline(args):
+    import ray_tpu
+
+    ray_tpu.init(address=args.address)
+    path = args.output or "timeline.json"
+    ray_tpu.timeline(path)
+    print(f"wrote chrome://tracing timeline to {path}")
+
+
 def cmd_memory(args):
     client = _gcs_client(args)
     nodes = client.call("get_nodes", alive_only=True)
@@ -134,6 +173,23 @@ def main(argv=None):
     p = sub.add_parser("memory", help="per-node store/worker stats")
     p.add_argument("--address", required=True)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("summary", help="cluster/actor/task summaries")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("dashboard", help="serve the HTTP dashboard")
+    p.add_argument("--address", help="GCS host:port (omit for local)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--dashboard-port", type=int, default=8265)
+    p.add_argument("--num-cpus", type=float,
+                   default=float(os.cpu_count() or 1))
+    p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("timeline", help="dump chrome://tracing timeline")
+    p.add_argument("--address", required=True)
+    p.add_argument("--output", "-o")
+    p.set_defaults(fn=cmd_timeline)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -1,0 +1,194 @@
+"""Distributed task tracing: spans around every remote call.
+
+Reference analog: ``python/ray/util/tracing/tracing_helper.py``
+(``_inject_tracing_into_function:326``, ``_inject_tracing_into_class:450``)
+— the reference wraps every remote function with OpenTelemetry spans and
+propagates context in task metadata. Here spans are written as JSON lines
+to a trace directory (the "exporter"); context (trace_id, parent span)
+rides in the task spec, so a task's spans parent to its submitter's span
+across process boundaries (workers inherit the trace dir via env).
+
+Usage:
+    ray_tpu.util.tracing.enable_tracing("/tmp/traces")
+    ... run work ...
+    spans = ray_tpu.util.tracing.read_spans("/tmp/traces")
+
+Span records: {"name", "trace_id", "span_id", "parent_id", "start",
+"duration", "pid", "kind"}. ``to_chrome_trace`` converts to
+chrome://tracing format (complements ray_tpu.timeline(), which covers
+task lifecycle events without cross-task parentage).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+
+_ENV_DIR = "RAY_TPU_TRACE_DIR"
+
+# ambient span context (submission captures it; execution restores it)
+_current: contextvars.ContextVar["SpanContext | None"] = \
+    contextvars.ContextVar("ray_tpu_trace_ctx", default=None)
+
+_write_lock = threading.Lock()
+
+
+@dataclass
+class SpanContext:
+    trace_id: str
+    span_id: str
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @staticmethod
+    def from_dict(d: dict | None) -> "SpanContext | None":
+        if not d:
+            return None
+        return SpanContext(d["trace_id"], d["span_id"])
+
+
+def enable_tracing(trace_dir: str) -> None:
+    """Turn tracing on for this process AND every worker spawned after
+    (the dir is inherited through the environment, like the reference's
+    tracing startup hook)."""
+    os.makedirs(trace_dir, exist_ok=True)
+    os.environ[_ENV_DIR] = trace_dir
+
+
+def disable_tracing() -> None:
+    os.environ.pop(_ENV_DIR, None)
+
+
+def is_enabled() -> bool:
+    return bool(os.environ.get(_ENV_DIR))
+
+
+def current_context() -> SpanContext | None:
+    return _current.get()
+
+
+def _emit(record: dict) -> None:
+    trace_dir = os.environ.get(_ENV_DIR)
+    if not trace_dir:
+        return
+    path = os.path.join(trace_dir, f"spans-{os.getpid()}.jsonl")
+    line = json.dumps(record)
+    with _write_lock:
+        with open(path, "a") as f:
+            f.write(line + "\n")
+
+
+@contextlib.contextmanager
+def span(name: str, *, kind: str = "local",
+         parent: SpanContext | None = None):
+    """Record one span; inside the block, the ambient context points at
+    it (children created here parent to it)."""
+    if not is_enabled():
+        yield None
+        return
+    if parent is None:
+        parent = _current.get()
+    ctx = SpanContext(
+        trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
+        span_id=uuid.uuid4().hex[:16],
+    )
+    token = _current.set(ctx)
+    start = time.time()
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+        _emit({
+            "name": name,
+            "trace_id": ctx.trace_id,
+            "span_id": ctx.span_id,
+            "parent_id": parent.span_id if parent else None,
+            "start": start,
+            "duration": time.time() - start,
+            "pid": os.getpid(),
+            "kind": kind,
+        })
+
+
+def submission_context(function_name: str) -> dict | None:
+    """Called at .remote() time: returns the wire context for the spec
+    (a fresh 'submit' span parented to the ambient one)."""
+    if not is_enabled():
+        return None
+    parent = _current.get()
+    ctx = SpanContext(
+        trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
+        span_id=uuid.uuid4().hex[:16],
+    )
+    _emit({
+        "name": f"submit:{function_name}",
+        "trace_id": ctx.trace_id,
+        "span_id": ctx.span_id,
+        "parent_id": parent.span_id if parent else None,
+        "start": time.time(),
+        "duration": 0.0,
+        "pid": os.getpid(),
+        "kind": "submit",
+    })
+    wire = ctx.to_dict()
+    # Cluster-mode workers are spawned by the RAYLET, whose environ never
+    # saw the driver's enable_tracing() — so the trace dir must ride the
+    # wire context, not env inheritance.
+    wire["trace_dir"] = os.environ.get(_ENV_DIR)
+    return wire
+
+
+@contextlib.contextmanager
+def execution_span(function_name: str, wire_ctx: dict | None):
+    """Wraps task execution; parents to the submitter's span."""
+    if wire_ctx is None:
+        yield
+        return
+    if not is_enabled() and wire_ctx.get("trace_dir"):
+        # adopt the submitter's trace dir (first traced task on this
+        # worker turns tracing on for the process)
+        os.environ[_ENV_DIR] = wire_ctx["trace_dir"]
+    if not is_enabled():
+        yield
+        return
+    with span(f"run:{function_name}", kind="task",
+              parent=SpanContext.from_dict(wire_ctx)):
+        yield
+
+
+def read_spans(trace_dir: str) -> list[dict]:
+    out = []
+    if not os.path.isdir(trace_dir):
+        return out
+    for fn in sorted(os.listdir(trace_dir)):
+        if fn.startswith("spans-") and fn.endswith(".jsonl"):
+            with open(os.path.join(trace_dir, fn)) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        out.append(json.loads(line))
+    return out
+
+
+def to_chrome_trace(spans: list[dict]) -> list[dict]:
+    return [
+        {
+            "name": s["name"],
+            "cat": s["kind"],
+            "ph": "X",
+            "ts": s["start"] * 1e6,
+            "dur": max(s["duration"], 1e-6) * 1e6,
+            "pid": s["pid"],
+            "tid": s["trace_id"],
+            "args": {"span_id": s["span_id"],
+                     "parent_id": s.get("parent_id")},
+        }
+        for s in spans
+    ]

@@ -1,0 +1,144 @@
+"""Dashboard HTTP head, tracing spans, usage stats.
+(reference analogs: dashboard/head.py + modules, util/tracing/
+tracing_helper.py, _private/usage/usage_lib.py)"""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import usage_stats
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def rt(ray_tpu_start):
+    return ray_tpu_start
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read()
+
+
+def test_dashboard_endpoints(rt):
+    from ray_tpu.dashboard import Dashboard
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    ray_tpu.get([f.remote(i) for i in range(3)])
+
+    dash = Dashboard(port=0).start()
+    try:
+        status, body = _get(dash.url + "/api/cluster_status")
+        assert status == 200
+        summary = json.loads(body)
+        assert summary["initialized"] and summary["mode"] == "local"
+
+        for ep in ("nodes", "actors", "tasks", "jobs",
+                   "placement_groups", "objects", "timeline"):
+            status, body = _get(f"{dash.url}/api/{ep}")
+            assert status == 200, ep
+            json.loads(body)
+
+        status, body = _get(dash.url + "/api/tasks")
+        assert any(t["name"].endswith("f") for t in json.loads(body))
+
+        from ray_tpu.util.metrics import Counter
+
+        c = Counter("dash_test_counter", "test")
+        c.inc(3)
+        status, body = _get(dash.url + "/metrics")
+        assert status == 200
+        assert b"dash_test_counter" in body
+
+        status, body = _get(dash.url + "/")
+        assert status == 200 and b"ray_tpu dashboard" in body
+
+        status, _ = _get(dash.url + "/api/nope")
+        assert status == 404
+    except urllib.error.HTTPError as e:
+        if e.code != 404:
+            raise
+        assert e.code == 404
+    finally:
+        dash.stop()
+
+
+def test_dashboard_404(rt):
+    from ray_tpu.dashboard import Dashboard
+
+    dash = Dashboard(port=0).start()
+    try:
+        try:
+            _get(dash.url + "/api/nope")
+            raised = False
+        except urllib.error.HTTPError as e:
+            raised = e.code == 404
+        assert raised
+    finally:
+        dash.stop()
+
+
+def test_tracing_spans_parented(rt, tmp_path):
+    trace_dir = str(tmp_path / "traces")
+    tracing.enable_tracing(trace_dir)
+    try:
+        @ray_tpu.remote
+        def child():
+            return 1
+
+        @ray_tpu.remote
+        def parent():
+            return ray_tpu.get(child.remote())
+
+        with tracing.span("driver-root"):
+            assert ray_tpu.get(parent.remote()) == 1
+
+        spans = tracing.read_spans(trace_dir)
+        names = {s["name"] for s in spans}
+        assert "driver-root" in names
+        assert any(n.startswith("submit:") and n.endswith("parent")
+                   for n in names)
+        assert any(n.startswith("run:") and n.endswith("child")
+                   for n in names)
+        # all spans share the driver-root trace id
+        root = next(s for s in spans if s["name"] == "driver-root")
+        run_child = next(s for s in spans
+                         if s["name"].startswith("run:")
+                         and s["name"].endswith("child"))
+        assert run_child["trace_id"] == root["trace_id"]
+        # chrome conversion shape
+        trace = tracing.to_chrome_trace(spans)
+        assert all(e["ph"] == "X" and "ts" in e for e in trace)
+    finally:
+        tracing.disable_tracing()
+
+
+def test_tracing_disabled_no_overhead(rt, tmp_path):
+    assert not tracing.is_enabled()
+
+    @ray_tpu.remote
+    def f():
+        return 2
+
+    assert ray_tpu.get(f.remote()) == 2
+    assert tracing.read_spans(str(tmp_path)) == []
+
+
+def test_usage_stats(tmp_path, monkeypatch):
+    usage_stats.record_library_usage("train")
+    usage_stats.record_extra_usage_tag("tasks_submitted", 5)
+    report = usage_stats.usage_report()
+    assert "train" in report["libraries"]
+    assert report["counters"]["tasks_submitted"] >= 5
+    path = usage_stats.write_report(str(tmp_path / "usage.json"))
+    assert json.load(open(path))["enabled"]
+
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    before = dict(usage_stats.usage_report()["counters"])
+    usage_stats.record_extra_usage_tag("tasks_submitted", 1)
+    assert usage_stats.usage_report()["counters"] == before

@@ -198,3 +198,77 @@ def test_cluster_env_eviction_at_worker_cap():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_eviction_does_not_drain_warm_pool():
+    """One new-env task at the cap must evict at most one warm worker,
+    not one per dispatch retry while the replacement boots."""
+    import time as _time
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    try:
+        ray_tpu.shutdown()
+        ray_tpu.init(address=cluster.gcs_address)
+
+        @ray_tpu.remote
+        def plain(i):
+            _time.sleep(0.2)
+            return os.getpid()
+
+        # warm two default-env workers (cap is reached)
+        pids = set(ray_tpu.get([plain.remote(i) for i in range(2)],
+                               timeout=30))
+        assert len(pids) == 2
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"EVICT_T": "1"}})
+        def with_env():
+            return os.environ.get("EVICT_T")
+
+        assert ray_tpu.get(with_env.remote(), timeout=30) == "1"
+        _time.sleep(0.5)  # let any (wrong) cascade evictions play out
+        raylet = next(iter(cluster.nodes.values())).raylet
+        alive_default = [
+            w for w in raylet._workers.values()
+            if w.state in ("idle", "busy") and w.env_key == ""
+        ]
+        # exactly one default worker was evicted; the other survived
+        assert len(alive_default) == 1, [
+            (w.state, w.env_key) for w in raylet._workers.values()]
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_cluster_tracing_spans(tmp_path):
+    """Cluster mode: run spans must appear even though workers were
+    spawned by the raylet (trace dir rides the wire context)."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import tracing
+
+    trace_dir = str(tmp_path / "tr")
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    try:
+        ray_tpu.shutdown()
+        ray_tpu.init(address=cluster.gcs_address)
+        tracing.enable_tracing(trace_dir)
+
+        @ray_tpu.remote
+        def traced():
+            return 7
+
+        with tracing.span("cluster-root"):
+            assert ray_tpu.get(traced.remote(), timeout=30) == 7
+
+        spans = tracing.read_spans(trace_dir)
+        assert any(s["name"].startswith("run:") for s in spans), spans
+        root = next(s for s in spans if s["name"] == "cluster-root")
+        run = next(s for s in spans if s["name"].startswith("run:"))
+        assert run["trace_id"] == root["trace_id"]
+    finally:
+        tracing.disable_tracing()
+        ray_tpu.shutdown()
+        cluster.shutdown()
