@@ -56,8 +56,15 @@ def init(
             return _core.get_runtime()
         raise RuntimeError("ray_tpu.init() called twice")
     if address is not None:
+        from ray_tpu.client import ClientRuntime, parse_client_address
         from ray_tpu.runtime.driver import ClusterRuntime
 
+        client_addr = parse_client_address(address) \
+            if isinstance(address, str) else None
+        if client_addr is not None:
+            rt = ClientRuntime(client_addr)
+            _core.install_runtime(rt)
+            return rt
         if isinstance(address, str):
             host, sep, port = address.rpartition(":")
             if not sep or not port.isdigit():
@@ -68,6 +75,9 @@ def init(
         rt = ClusterRuntime(address)
         _core.install_runtime(rt)
         return rt
+    from ray_tpu._private.usage_stats import record_extra_usage_tag
+
+    record_extra_usage_tag("init_count")
     reset_config()
     config = get_config().apply_overrides(system_config)
     res = dict(resources or {})
@@ -454,7 +464,15 @@ def timeline(filename: str | None = None) -> list:
     """Task timeline in chrome://tracing format (reference:
     ``ray.timeline()`` from ``_private/profiling.py:84``)."""
     rt = _runtime()
-    events = rt.task_events() if hasattr(rt, "task_events") else []
+    if hasattr(rt, "task_events"):
+        events = rt.task_events()
+    else:
+        # cluster mode: the GCS task-event sink (same source as the
+        # state API / dashboard)
+        from ray_tpu.util import state as _state
+
+        events = [e for e in _state.list_tasks()
+                  if "start" in e and "end" in e]
     trace = [
         {
             "name": e["name"],

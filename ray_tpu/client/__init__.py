@@ -1,0 +1,166 @@
+"""Client mode: remote driver over RPC (the ``ray://`` analog).
+
+Reference analog: ``python/ray/util/client/`` (P6, 6,357 LoC — client-
+side object refs + a server-side proxier). ``ray_tpu.init(
+address="client://host:port")`` installs a :class:`ClientRuntime` whose
+every API call (submit/get/put/wait/actors) is proxied to a
+:class:`ray_tpu.client.server.ClientServer` process, which hosts the
+REAL driver runtime (local or attached to a cluster). The client process
+needs no raylet, no object store, and no worker pool — useful for
+laptops/notebooks driving a remote TPU cluster.
+
+Functions/classes ship as cloudpickle blobs; ObjectRefs cross the wire
+as ids and stay server-owned (values move only on ``get``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import cloudpickle
+
+from ray_tpu.runtime.object_ref import ObjectRef
+from ray_tpu.runtime.rpc import RpcClient
+from ray_tpu.runtime.task_spec import TaskSpec, TaskType
+from ray_tpu.utils import exceptions as exc
+from ray_tpu.utils.ids import ActorID, ObjectID
+
+
+def parse_client_address(address: str) -> tuple[str, int] | None:
+    """'client://host:port' -> (host, port); None for other schemes."""
+    if not isinstance(address, str) or not address.startswith("client://"):
+        return None
+    rest = address[len("client://"):]
+    host, _, port = rest.rpartition(":")
+    if not port.isdigit():
+        raise ValueError(f"bad client address {address!r}")
+    return (host or "127.0.0.1", int(port))
+
+
+class ClientRuntime:
+    """Thin proxy implementing the runtime interface api.py drives."""
+
+    is_client = True
+
+    def __init__(self, address: tuple[str, int]):
+        self._rpc = RpcClient(address)
+        self._lock = threading.Lock()
+        info = self._rpc.call("client_hello")
+        self.job_id = info["job_id"]
+
+    # -- objects --------------------------------------------------------
+
+    def put(self, value) -> ObjectRef:
+        oid = self._rpc.call("client_put",
+                             blob=cloudpickle.dumps(value, protocol=5))
+        return ObjectRef(ObjectID.from_hex(oid))
+
+    def get(self, refs: list[ObjectRef], timeout: float | None = None):
+        out = self._rpc.call("client_get",
+                             oids=[r.id.hex() for r in refs],
+                             get_timeout=timeout)
+        if out.get("error_blob") is not None:
+            raise cloudpickle.loads(out["error_blob"])
+        return cloudpickle.loads(out["values_blob"])
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        out = self._rpc.call("client_wait",
+                             oids=[r.id.hex() for r in refs],
+                             num_returns=num_returns,
+                             wait_timeout=timeout)
+        by_id = {r.id.hex(): r for r in refs}
+        return ([by_id[h] for h in out["ready"]],
+                [by_id[h] for h in out["not_ready"]])
+
+    def cancel(self, ref: ObjectRef):
+        self._rpc.call("client_cancel", oid=ref.id.hex())
+
+    def note_return_owner(self, spec) -> None:
+        pass  # ownership lives server-side
+
+    # -- tasks ----------------------------------------------------------
+
+    def _wire_args(self, spec: TaskSpec) -> bytes:
+        args = [("__objref__", a.id.hex()) if isinstance(a, ObjectRef)
+                else a for a in spec.args]
+        kwargs = {k: ("__objref__", v.id.hex())
+                  if isinstance(v, ObjectRef) else v
+                  for k, v in spec.kwargs.items()}
+        return cloudpickle.dumps((args, kwargs), protocol=5)
+
+    def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
+        if spec.task_type == TaskType.ACTOR_TASK:
+            out = self._rpc.call(
+                "client_submit_actor_task",
+                actor_id=spec.actor_id.hex(),
+                method_name=spec.actor_method_name,
+                name=spec.function_name,
+                args_blob=self._wire_args(spec),
+                num_returns=spec.num_returns,
+                trace_ctx=spec.trace_ctx,
+            )
+        else:
+            out = self._rpc.call(
+                "client_submit_task",
+                name=spec.function_name,
+                fn_blob=cloudpickle.dumps(spec.function, protocol=5),
+                args_blob=self._wire_args(spec),
+                num_returns=spec.num_returns,
+                resources=dict(spec.resources.resources),
+                max_retries=spec.max_retries,
+                retry_exceptions=spec.retry_exceptions,
+                runtime_env=spec.runtime_env,
+                trace_ctx=spec.trace_ctx,
+            )
+        refs = [ObjectRef(ObjectID.from_hex(h)) for h in out]
+        spec.return_ids = [r.id for r in refs]
+        return refs
+
+    # -- actors ---------------------------------------------------------
+
+    def create_actor(self, spec: TaskSpec, name: str | None = None):
+        out = self._rpc.call(
+            "client_create_actor",
+            name=name,
+            class_name=spec.function_name,
+            cls_blob=cloudpickle.dumps(spec.function, protocol=5),
+            args_blob=self._wire_args(spec),
+            resources=dict(spec.resources.resources),
+            max_concurrency=spec.max_concurrency,
+            max_restarts=spec.max_restarts,
+            runtime_env=spec.runtime_env,
+        )
+        if out.get("error"):
+            raise ValueError(out["error"])
+        return ActorID.from_hex(out["actor_id"])
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self._rpc.call("client_kill_actor", actor_id=actor_id.hex(),
+                       no_restart=no_restart)
+
+    def get_actor(self, name: str) -> ActorID:
+        out = self._rpc.call("client_get_actor", name=name)
+        if out.get("error"):
+            raise ValueError(out["error"])
+        return ActorID.from_hex(out["actor_id"])
+
+    # -- introspection --------------------------------------------------
+
+    def cluster_resources(self) -> dict:
+        return self._rpc.call("client_cluster_resources")["total"]
+
+    def available_resources_snapshot(self) -> dict:
+        return self._rpc.call("client_cluster_resources")["available"]
+
+    def task_events(self, limit: int = 1000) -> list:
+        return self._rpc.call("client_task_events", limit=limit)
+
+    def actor_state(self, actor_id: ActorID):
+        return None  # class names resolve server-side only
+
+    def shutdown(self):
+        try:
+            self._rpc.call("client_disconnect")
+        except (OSError, exc.RayTpuError):
+            pass
+        self._rpc.close()

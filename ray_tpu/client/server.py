@@ -1,0 +1,214 @@
+"""Client server: hosts the real driver runtime for remote clients.
+
+Reference analog: ``python/ray/util/client/server/`` (proxier + server
+speaking ray_client.proto). One server process serves many clients over
+the shared RpcServer transport; it either runs a local in-process
+runtime or attaches to a cluster (GCS address), and all object ownership
+lives here.
+
+Run standalone:
+    python -m ray_tpu.client.server --port 10001 [--address GCS_HOST:PORT]
+Then from anywhere:
+    ray_tpu.init(address="client://HOST:10001")
+"""
+
+from __future__ import annotations
+
+import cloudpickle
+
+from ray_tpu.runtime.object_ref import ObjectRef
+from ray_tpu.runtime.rpc import RpcServer
+from ray_tpu.runtime.task_spec import ResourceSet, TaskSpec, TaskType
+from ray_tpu.utils.ids import ActorID, ObjectID, TaskID
+
+
+def _unwire_args(blob: bytes):
+    args, kwargs = cloudpickle.loads(blob)
+    args = [ObjectRef(ObjectID.from_hex(a[1]))
+            if isinstance(a, tuple) and len(a) == 2 and a[0] == "__objref__"
+            else a for a in args]
+    kwargs = {k: ObjectRef(ObjectID.from_hex(v[1]))
+              if isinstance(v, tuple) and len(v) == 2 and v[0] == "__objref__"
+              else v for k, v in kwargs.items()}
+    return args, kwargs
+
+
+class ClientServer(RpcServer):
+    """Serves client_* RPCs against an owned driver runtime."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 10001, *,
+                 gcs_address=None, num_cpus: float | None = None):
+        super().__init__(host, port)
+        import ray_tpu
+
+        if gcs_address is not None:
+            self._rt = ray_tpu.init(address=gcs_address)
+        else:
+            self._rt = ray_tpu.init(
+                num_cpus=num_cpus if num_cpus is not None else 4,
+                num_tpus=0)
+
+    # -- session ---------------------------------------------------------
+
+    def rpc_client_hello(self, conn, send_lock):
+        job = getattr(self._rt, "job_id", None)
+        return {"job_id": job.hex() if job is not None else "cluster"}
+
+    def rpc_client_disconnect(self, conn, send_lock):
+        return {"ok": True}
+
+    # -- objects ---------------------------------------------------------
+
+    def rpc_client_put(self, conn, send_lock, *, blob: bytes) -> str:
+        ref = self._rt.put(cloudpickle.loads(blob))
+        return ref.id.hex()
+
+    def rpc_client_get(self, conn, send_lock, *, oids, get_timeout=None):
+        refs = [ObjectRef(ObjectID.from_hex(h)) for h in oids]
+        try:
+            values = self._rt.get(refs, timeout=get_timeout)
+        except BaseException as e:  # noqa: BLE001 - ship to the client
+            return {"error_blob": cloudpickle.dumps(e, protocol=5),
+                    "values_blob": None}
+        return {"error_blob": None,
+                "values_blob": cloudpickle.dumps(values, protocol=5)}
+
+    def rpc_client_wait(self, conn, send_lock, *, oids, num_returns,
+                        wait_timeout=None):
+        refs = [ObjectRef(ObjectID.from_hex(h)) for h in oids]
+        ready, not_ready = self._rt.wait(refs, num_returns=num_returns,
+                                         timeout=wait_timeout)
+        return {"ready": [r.id.hex() for r in ready],
+                "not_ready": [r.id.hex() for r in not_ready]}
+
+    def rpc_client_cancel(self, conn, send_lock, *, oid):
+        self._rt.cancel(ObjectRef(ObjectID.from_hex(oid)))
+        return {"ok": True}
+
+    # -- tasks -----------------------------------------------------------
+
+    def rpc_client_submit_task(self, conn, send_lock, *, name, fn_blob,
+                               args_blob, num_returns, resources,
+                               max_retries, retry_exceptions, runtime_env,
+                               trace_ctx):
+        args, kwargs = _unwire_args(args_blob)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            task_type=TaskType.NORMAL_TASK,
+            function=cloudpickle.loads(fn_blob),
+            function_name=name,
+            args=tuple(args),
+            kwargs=kwargs,
+            num_returns=num_returns,
+            resources=ResourceSet({k: float(v)
+                                   for k, v in (resources or {}).items()}),
+            max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
+            runtime_env=runtime_env,
+            trace_ctx=trace_ctx,
+        )
+        refs = self._rt.submit_task(spec)
+        self._rt.note_return_owner(spec)
+        return [r.id.hex() for r in refs]
+
+    def rpc_client_submit_actor_task(self, conn, send_lock, *, actor_id,
+                                     method_name, name, args_blob,
+                                     num_returns, trace_ctx):
+        args, kwargs = _unwire_args(args_blob)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            task_type=TaskType.ACTOR_TASK,
+            function=None,
+            function_name=name,
+            args=tuple(args),
+            kwargs=kwargs,
+            num_returns=num_returns,
+            actor_id=ActorID.from_hex(actor_id),
+            actor_method_name=method_name,
+            trace_ctx=trace_ctx,
+        )
+        refs = self._rt.submit_task(spec)
+        self._rt.note_return_owner(spec)
+        return [r.id.hex() for r in refs]
+
+    # -- actors ----------------------------------------------------------
+
+    def rpc_client_create_actor(self, conn, send_lock, *, name, class_name,
+                                cls_blob, args_blob, resources,
+                                max_concurrency, max_restarts, runtime_env):
+        args, kwargs = _unwire_args(args_blob)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            function=cloudpickle.loads(cls_blob),
+            function_name=class_name,
+            args=tuple(args),
+            kwargs=kwargs,
+            num_returns=1,
+            resources=ResourceSet({k: float(v)
+                                   for k, v in (resources or {}).items()}),
+            max_concurrency=max_concurrency,
+            max_restarts=max_restarts,
+            runtime_env=runtime_env,
+        )
+        try:
+            actor_id = self._rt.create_actor(spec, name=name)
+        except ValueError as e:
+            return {"error": str(e), "actor_id": None}
+        return {"error": None, "actor_id": actor_id.hex()}
+
+    def rpc_client_kill_actor(self, conn, send_lock, *, actor_id,
+                              no_restart):
+        self._rt.kill_actor(ActorID.from_hex(actor_id),
+                            no_restart=no_restart)
+        return {"ok": True}
+
+    def rpc_client_get_actor(self, conn, send_lock, *, name):
+        try:
+            actor_id = self._rt.get_actor(name)
+        except ValueError as e:
+            return {"error": str(e), "actor_id": None}
+        return {"error": None, "actor_id": actor_id.hex()}
+
+    # -- introspection ----------------------------------------------------
+
+    def rpc_client_cluster_resources(self, conn, send_lock):
+        return {"total": self._rt.cluster_resources(),
+                "available": self._rt.available_resources_snapshot()}
+
+    def rpc_client_task_events(self, conn, send_lock, *, limit=1000):
+        if hasattr(self._rt, "task_events"):
+            return self._rt.task_events(limit)
+        return []
+
+
+def main(argv=None):
+    import argparse
+    import time
+
+    parser = argparse.ArgumentParser(
+        prog="ray-tpu-client-server",
+        description="remote-driver server (ray:// analog)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=10001)
+    parser.add_argument("--address", help="GCS host:port to attach to")
+    parser.add_argument("--num-cpus", type=float, default=None)
+    args = parser.parse_args(argv)
+
+    gcs = None
+    if args.address:
+        host, _, port = args.address.rpartition(":")
+        gcs = (host or "127.0.0.1", int(port))
+    server = ClientServer(args.host, args.port, gcs_address=gcs,
+                          num_cpus=args.num_cpus).start()
+    print(f"client server on {server.address[0]}:{server.address[1]}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -98,8 +98,14 @@ class _Handler(BaseHTTPRequestHandler):
                            "text/plain; version=0.0.4")
             else:
                 self._send_json({"error": f"unknown path {path}"}, 404)
+        except OSError:
+            # client went away mid-response; replying would raise again
+            pass
         except Exception as e:  # noqa: BLE001 - surface as 500, keep serving
-            self._send_json({"error": repr(e)}, 500)
+            try:
+                self._send_json({"error": repr(e)}, 500)
+            except OSError:
+                pass
 
 
 class Dashboard:

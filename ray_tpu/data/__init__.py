@@ -1,5 +1,10 @@
 """ray_tpu.data: streaming datasets (reference: Ray Data, SURVEY P13)."""
 
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("data")
+
+
 from ray_tpu.data import aggregate, preprocessors
 from ray_tpu.data.block import BlockAccessor
 from ray_tpu.data.context import DataContext

@@ -1,5 +1,10 @@
 """ray_tpu.rllib: RL training subset (reference: RLlib, SURVEY P18)."""
 
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("rllib")
+
+
 from ray_tpu.rllib.env import BanditEnv, CartPole, make_env
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 

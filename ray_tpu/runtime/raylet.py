@@ -436,19 +436,25 @@ class Raylet(RpcServer):
                         spawn = True
                         break
         if evict is not None:
-            try:
-                if evict.proc is not None:
-                    evict.proc.terminate()
-                if evict.conn is not None:
-                    evict.conn.close()
-            except OSError:
-                pass
-            self._on_worker_gone(evict)
-            if evict.proc is not None:
+            # off the dispatch thread: a worker slow to honor SIGTERM
+            # must not stall dispatch for every other queued task
+            def _reap(w=evict):
                 try:
-                    evict.proc.wait(timeout=5)
-                except subprocess.TimeoutExpired:
-                    evict.proc.kill()
+                    if w.proc is not None:
+                        w.proc.terminate()
+                    if w.conn is not None:
+                        w.conn.close()
+                except OSError:
+                    pass
+                self._on_worker_gone(w)
+                if w.proc is not None:
+                    try:
+                        w.proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        w.proc.kill()
+
+            threading.Thread(target=_reap, name="ray_tpu-evict",
+                             daemon=True).start()
         if spawn:
             self._spawn_worker(runtime_env)
         return None

@@ -50,6 +50,11 @@ class Worker:
         self.store = ShmObjectStore(os.environ["RAY_TPU_STORE_NAME"])
         # control client: request/response to the raylet (ensure_local etc.)
         self.ctrl = RpcClient(self.raylet_addr)
+        # task-event reporting to the GCS sink (lazy buffer)
+        self._gcs = RpcClient((os.environ["RAY_TPU_GCS_HOST"],
+                               int(os.environ["RAY_TPU_GCS_PORT"])))
+        self._event_buf: list[dict] = []
+        self._last_flush = 0.0
         # task channel: registered held connection
         import socket as _socket
         self.chan = _socket.create_connection(self.raylet_addr)
@@ -151,12 +156,47 @@ class Worker:
     # execution
     # ------------------------------------------------------------------
 
+    def _report_task_event(self, task: dict, start: float, ok: bool):
+        """Buffered task-event reporting to the GCS sink (reference:
+        task_event_buffer.cc -> gcs_task_manager.cc). Flushes every few
+        events so the state API / dashboard / timeline see cluster tasks
+        without a per-task RPC."""
+        import time as _time
+
+        self._event_buf.append({
+            "task_id": task.get("task_id", ""),
+            "name": task.get("name", "?"),
+            "start": start,
+            "end": _time.monotonic(),
+            "state": "FINISHED" if ok else "FAILED",
+            "thread": f"worker-{self.worker_id[:8]}",
+        })
+        if len(self._event_buf) >= 8 or \
+                _time.monotonic() - self._last_flush > 2.0:
+            self._flush_task_events()
+
+    def _flush_task_events(self):
+        import time as _time
+
+        if not self._event_buf:
+            return
+        batch, self._event_buf = self._event_buf, []
+        self._last_flush = _time.monotonic()
+        try:
+            self._gcs.call("add_task_events", events=batch)
+        except (OSError, ConnectionError):
+            pass  # observability only; never fail work for it
+
     def _execute(self, task: dict):
+        import time as _time
+
+        started = _time.monotonic()
         try:
             fn = cloudpickle.loads(task["function_blob"])
             args, kwargs = self._resolve_args(task)
         except BaseException as e:  # noqa: BLE001
             self._store_error(task, e)
+            self._report_task_event(task, started, False)
             return
         try:
             from ray_tpu.util.tracing import execution_span
@@ -168,11 +208,15 @@ class Worker:
             self._store_error(
                 task, exc.TaskError(task.get("name", "?"), e,
                                     tb=traceback.format_exc()))
+            self._report_task_event(task, started, False)
             return
         try:
             self._store_returns(task, result)
         except BaseException as e:  # noqa: BLE001
             self._store_error(task, e)
+            self._report_task_event(task, started, False)
+            return
+        self._report_task_event(task, started, True)
 
     def _create_actor(self, actor_id: str, task: dict):
         try:
@@ -212,6 +256,9 @@ class Worker:
             self._run_actor_task(t)
 
     def _run_actor_task(self, task: dict):
+        import time as _time
+
+        started = _time.monotonic()
         try:
             from ray_tpu.util.tracing import execution_span
 
@@ -224,12 +271,14 @@ class Worker:
             self._store_error(
                 task, exc.TaskError(task.get("name", "?"), e,
                                     tb=traceback.format_exc()))
+            self._report_task_event(task, started, False)
             self._send({"type": "task_done", "task_id": task.get("task_id")})
             return
         try:
             self._store_returns(task, result)
         except BaseException as e:  # noqa: BLE001
             self._store_error(task, e)
+        self._report_task_event(task, started, True)
         self._send({"type": "task_done", "task_id": task.get("task_id")})
 
 

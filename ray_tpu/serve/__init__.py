@@ -1,5 +1,10 @@
 """ray_tpu.serve: model serving (reference: Ray Serve, SURVEY P15)."""
 
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("serve")
+
+
 from ray_tpu.serve.api import (
     batch,
     delete,

@@ -1,6 +1,11 @@
 """ray_tpu.train: training harness + mesh trainer (reference: Ray Train,
 SURVEY P14)."""
 
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("train")
+
+
 from ray_tpu.air.config import (
     CheckpointConfig,
     FailureConfig,

@@ -1,5 +1,10 @@
 """ray_tpu.tune: hyperparameter search (reference: Ray Tune, SURVEY P16)."""
 
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("tune")
+
+
 from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,

@@ -151,10 +151,12 @@ def execution_span(function_name: str, wire_ctx: dict | None):
     if wire_ctx is None:
         yield
         return
-    if not is_enabled() and wire_ctx.get("trace_dir"):
-        # adopt the submitter's trace dir (first traced task on this
-        # worker turns tracing on for the process)
-        os.environ[_ENV_DIR] = wire_ctx["trace_dir"]
+    wire_dir = wire_ctx.get("trace_dir")
+    if wire_dir and os.environ.get(_ENV_DIR) != wire_dir:
+        # adopt/sync the submitter's trace dir: workers are spawned by
+        # the raylet (no env inheritance from the driver), and a warm
+        # worker must follow the driver when it switches directories
+        os.environ[_ENV_DIR] = wire_dir
     if not is_enabled():
         yield
         return

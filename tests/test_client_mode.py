@@ -1,0 +1,139 @@
+"""Client mode tests: remote driver over RPC (reference analog:
+python/ray/util/client tests — P6)."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def client_server():
+    """Standalone server process (like `ray start` + client server)."""
+    import socket
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    env = dict(__import__("os").environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.client.server", "--port", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    # wait for the listening line
+    deadline = time.monotonic() + 60
+    line = proc.stdout.readline().decode()
+    assert "client server on" in line, line
+    assert time.monotonic() < deadline
+    ray_tpu.shutdown()
+    yield f"client://127.0.0.1:{port}"
+    ray_tpu.shutdown()
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def test_client_tasks_objects(client_server):
+    ray_tpu.init(address=client_server)
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    ref = ray_tpu.put(10)
+    out = ray_tpu.get(add.remote(ref, 32))
+    assert out == 42
+
+    refs = [add.remote(i, i) for i in range(5)]
+    assert ray_tpu.get(refs) == [0, 2, 4, 6, 8]
+
+    ready, not_ready = ray_tpu.wait(refs, num_returns=5, timeout=10)
+    assert len(ready) == 5 and not not_ready
+
+
+def test_client_task_error_propagates(client_server):
+    ray_tpu.init(address=client_server)
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kapow")
+
+    with pytest.raises(Exception, match="kapow"):
+        ray_tpu.get(boom.remote())
+
+
+def test_client_actors(client_server):
+    ray_tpu.init(address=client_server)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def inc(self, by=1):
+            self.v += by
+            return self.v
+
+    c = Counter.options(name="client_counter").remote(100)
+    assert ray_tpu.get(c.inc.remote()) == 101
+    assert ray_tpu.get(c.inc.remote(9)) == 110
+
+    # named lookup round-trips through the server
+    again = ray_tpu.get_actor("client_counter")
+    assert ray_tpu.get(again.inc.remote()) == 111
+
+    ray_tpu.kill(c)
+    time.sleep(0.2)
+    with pytest.raises(Exception):
+        ray_tpu.get(c.inc.remote(), timeout=5)
+
+
+def test_client_cluster_resources(client_server):
+    ray_tpu.init(address=client_server)
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU", 0) > 0
+
+
+def test_client_attached_to_cluster(tmp_path):
+    """Client -> server -> real multi-process cluster (the proxier
+    deployment shape)."""
+    import os
+    import socket
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=2)
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    gcs = f"{cluster.gcs_address[0]}:{cluster.gcs_address[1]}"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.client.server",
+         "--port", str(port), "--address", gcs],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        line = proc.stdout.readline().decode()
+        assert "client server on" in line, line
+        ray_tpu.shutdown()
+        ray_tpu.init(address=f"client://127.0.0.1:{port}")
+
+        @ray_tpu.remote
+        def pid():
+            return __import__("os").getpid()
+
+        worker_pid = ray_tpu.get(pid.remote())
+        # ran in a cluster worker process, not the client, not the server
+        assert worker_pid not in (proc.pid, __import__("os").getpid())
+    finally:
+        ray_tpu.shutdown()
+        proc.terminate()
+        proc.wait(timeout=10)
+        cluster.shutdown()
