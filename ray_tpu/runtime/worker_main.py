@@ -54,7 +54,12 @@ class Worker:
         self._gcs = RpcClient((os.environ["RAY_TPU_GCS_HOST"],
                                int(os.environ["RAY_TPU_GCS_PORT"])))
         self._event_buf: list[dict] = []
+        self._event_lock = threading.Lock()
         self._last_flush = 0.0
+        # periodic flusher: without it, the tail of a burst (<batch size)
+        # strands in the buffer until the next task happens to run
+        threading.Thread(target=self._flush_loop, daemon=True,
+                         name="task-event-flusher").start()
         # task channel: registered held connection
         import socket as _socket
         self.chan = _socket.create_connection(self.raylet_addr)
@@ -163,24 +168,33 @@ class Worker:
         without a per-task RPC."""
         import time as _time
 
-        self._event_buf.append({
-            "task_id": task.get("task_id", ""),
-            "name": task.get("name", "?"),
-            "start": start,
-            "end": _time.monotonic(),
-            "state": "FINISHED" if ok else "FAILED",
-            "thread": f"worker-{self.worker_id[:8]}",
-        })
-        if len(self._event_buf) >= 8 or \
-                _time.monotonic() - self._last_flush > 2.0:
+        with self._event_lock:
+            self._event_buf.append({
+                "task_id": task.get("task_id", ""),
+                "name": task.get("name", "?"),
+                "start": start,
+                "end": _time.monotonic(),
+                "state": "FINISHED" if ok else "FAILED",
+                "thread": f"worker-{self.worker_id[:8]}",
+            })
+            full = len(self._event_buf) >= 8
+        if full or _time.monotonic() - self._last_flush > 2.0:
+            self._flush_task_events()
+
+    def _flush_loop(self):
+        import time as _time
+
+        while True:
+            _time.sleep(1.0)
             self._flush_task_events()
 
     def _flush_task_events(self):
         import time as _time
 
-        if not self._event_buf:
-            return
-        batch, self._event_buf = self._event_buf, []
+        with self._event_lock:
+            if not self._event_buf:
+                return
+            batch, self._event_buf = self._event_buf, []
         self._last_flush = _time.monotonic()
         try:
             self._gcs.call("add_task_events", events=batch)
@@ -278,6 +292,9 @@ class Worker:
             self._store_returns(task, result)
         except BaseException as e:  # noqa: BLE001
             self._store_error(task, e)
+            self._report_task_event(task, started, False)
+            self._send({"type": "task_done", "task_id": task.get("task_id")})
+            return
         self._report_task_event(task, started, True)
         self._send({"type": "task_done", "task_id": task.get("task_id")})
 

@@ -13,7 +13,9 @@ from ray_tpu.serve.api import (
     run,
     shutdown,
     start_http_proxy,
+    status,
 )
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.handle import DeploymentHandle
 
@@ -25,7 +27,10 @@ __all__ = [
     "delete",
     "deployment",
     "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "run",
     "shutdown",
     "start_http_proxy",
+    "status",
 ]

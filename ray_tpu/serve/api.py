@@ -120,6 +120,32 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name, _get_or_start_controller())
 
 
+def status() -> dict:
+    """Deployment states + replica metrics (reference: serve.status() /
+    the REST status schema)."""
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return {"deployments": {}}
+    deployments = ray_tpu.get(controller.list_deployments.remote())
+    out = {}
+    for name, info in deployments.items():
+        _, replicas = ray_tpu.get(controller.get_replicas.remote(name))
+        metrics = []
+        for r in replicas or []:
+            try:
+                metrics.append(ray_tpu.get(r.metrics.remote(), timeout=2))
+            except Exception:  # noqa: BLE001 - replica mid-teardown
+                continue
+        out[name] = {
+            **info,
+            "replica_metrics": metrics,
+            "total_requests": sum(m.get("total", 0) for m in metrics),
+            "ongoing_requests": sum(m.get("ongoing", 0) for m in metrics),
+        }
+    return {"deployments": out}
+
+
 def delete(name: str):
     controller = _get_or_start_controller()
     ray_tpu.get(controller.delete_deployment.remote(name))

@@ -33,18 +33,119 @@ class _Replica:
         self._ongoing = 0
         self._lock = threading.Lock()
         self._total = 0
+        self._streams: dict = {}
+        self._stream_errors: dict = {}
 
     def handle_request(self, method_name, args, kwargs):
+        from ray_tpu.serve.multiplex import (MODEL_ID_KWARG,
+                                             set_request_model_id)
+
+        model_id = kwargs.pop(MODEL_ID_KWARG, None)
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = set_request_model_id(model_id)
         try:
             target = (self._instance if method_name == "__call__"
                       else getattr(self._instance, method_name))
             return target(*args, **kwargs)
         finally:
+            from ray_tpu.serve.multiplex import _request_model_id
+
+            _request_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
+
+    # -- streaming (reference: replica.py handle_request_streaming:323) --
+
+    def start_stream(self, method_name, args, kwargs) -> str:
+        """Run a generator method; chunks buffer in a per-stream queue
+        drained by next_chunks() calls from the handle. An abandoned
+        stream (no consumer drain for 60s against a full queue) tears
+        itself down so threads/metrics don't leak."""
+        import queue as _q
+        import uuid
+
+        from ray_tpu.serve.multiplex import (MODEL_ID_KWARG,
+                                             set_request_model_id)
+
+        model_id = kwargs.pop(MODEL_ID_KWARG, None)
+        stream_id = uuid.uuid4().hex[:16]
+        q: "_q.Queue" = _q.Queue(maxsize=64)
+        with self._lock:
+            self._streams[stream_id] = q
+            self._ongoing += 1
+            self._total += 1
+
+        def pump():
+            token = set_request_model_id(model_id)
+            try:
+                target = (self._instance if method_name == "__call__"
+                          else getattr(self._instance, method_name))
+                for chunk in target(*args, **kwargs):
+                    q.put(("chunk", chunk), timeout=60.0)
+                q.put(("end", None), timeout=60.0)
+            except _q.Full:  # consumer gone: abandon the stream
+                with self._lock:
+                    self._streams.pop(stream_id, None)
+            except BaseException as e:  # noqa: BLE001 - ship to consumer
+                try:
+                    q.put(("error", e), timeout=60.0)
+                except _q.Full:
+                    with self._lock:
+                        self._streams.pop(stream_id, None)
+            finally:
+                from ray_tpu.serve.multiplex import _request_model_id
+
+                _request_model_id.reset(token)
+                with self._lock:
+                    self._ongoing -= 1
+
+        threading.Thread(target=pump, daemon=True).start()
+        return stream_id
+
+    def next_chunks(self, stream_id: str, max_chunks: int = 16,
+                    timeout_s: float = 10.0):
+        """Up to max_chunks buffered items; final state signals end. A
+        generator error is delivered AFTER its preceding chunks: chunks
+        already accumulated return normally and the error re-raises on
+        the next call."""
+        import queue as _q
+
+        pending_err = self._stream_errors.pop(stream_id, None)
+        if pending_err is not None:
+            with self._lock:
+                self._streams.pop(stream_id, None)
+            raise pending_err
+        q = self._streams.get(stream_id)
+        if q is None:
+            raise KeyError(f"unknown stream {stream_id}")
+        out = []
+        try:
+            kind, payload = q.get(timeout=timeout_s)
+        except _q.Empty:
+            return ("pending", out)
+        while True:
+            if kind == "chunk":
+                out.append(payload)
+            elif kind == "error":
+                if out:
+                    # deliver data first; error surfaces next call
+                    self._stream_errors[stream_id] = payload
+                    return ("more", out)
+                with self._lock:
+                    self._streams.pop(stream_id, None)
+                raise payload
+            else:  # end
+                with self._lock:
+                    self._streams.pop(stream_id, None)
+                return ("end", out)
+            if len(out) >= max_chunks:
+                return ("more", out)
+            try:
+                kind, payload = q.get_nowait()
+            except _q.Empty:
+                return ("more", out)
 
     def reconfigure(self, user_config):
         if hasattr(self._instance, "reconfigure"):
@@ -54,6 +155,11 @@ class _Replica:
     def metrics(self):
         with self._lock:
             return {"ongoing": self._ongoing, "total": self._total}
+
+    def multiplexed_model_ids(self) -> list:
+        from ray_tpu.serve.multiplex import loaded_model_ids
+
+        return loaded_model_ids(self._instance)
 
     def ping(self):
         return True

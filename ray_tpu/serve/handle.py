@@ -18,19 +18,24 @@ import ray_tpu
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, controller, method_name="__call__"):
+    def __init__(self, deployment_name: str, controller,
+                 method_name="__call__", multiplexed_model_id=None):
         self.deployment_name = deployment_name
         self._controller = controller
         self._method = method_name
+        self._model_id = multiplexed_model_id
         self._replicas: list = []
         self._version = -1
         self._checked_at = 0.0
         self._lock = threading.Lock()
         self._inflight: dict = {}   # replica -> count
 
-    def options(self, *, method_name: str) -> "DeploymentHandle":
+    def options(self, *, method_name: str | None = None,
+                multiplexed_model_id: str | None = None
+                ) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self._controller,
-                             method_name)
+                             method_name or self._method,
+                             multiplexed_model_id or self._model_id)
         h._replicas, h._version = self._replicas, self._version
         h._inflight = self._inflight
         return h
@@ -70,7 +75,18 @@ class DeploymentHandle:
 
     def _pick(self):
         """Power-of-two-choices on client-side outstanding-request counts
-        (pruned at pick time — no background bookkeeping threads)."""
+        (pruned at pick time — no background bookkeeping threads). With a
+        multiplexed model id, cache-affinity comes first: prefer replicas
+        that already hold the model (reference:
+        multiplexed_replica_info routing in the replica scheduler)."""
+        if self._model_id is not None:
+            warm = self._replicas_with_model(self._model_id)
+            if warm:
+                with self._lock:
+                    if len(warm) == 1:
+                        return warm[0]
+                    a, b = random.sample(warm, 2)
+                    return a if self._prune(a) <= self._prune(b) else b
         with self._lock:
             replicas = self._replicas
             if not replicas:
@@ -81,9 +97,37 @@ class DeploymentHandle:
             a, b = random.sample(replicas, 2)
             return a if self._prune(a) <= self._prune(b) else b
 
+    def _replicas_with_model(self, model_id: str) -> list:
+        """Replicas that currently hold model_id loaded. Cached with a
+        short TTL: polling every replica per request would put N
+        round-trips on the hot path (reference pushes model-id sets to
+        the router; a TTL cache is the pull-model equivalent)."""
+        now = time.monotonic()
+        with self._lock:
+            cache = getattr(self, "_model_map", None)
+            if cache is not None and now - self._model_map_at < 1.0:
+                return cache.get(model_id, [])
+            replicas = list(self._replicas)
+        model_map: dict = {}
+        for r in replicas:
+            try:
+                for mid in ray_tpu.get(r.multiplexed_model_ids.remote(),
+                                       timeout=2):
+                    model_map.setdefault(mid, []).append(r)
+            except Exception:  # noqa: BLE001 - dead replica: skip
+                continue
+        with self._lock:
+            self._model_map = model_map
+            self._model_map_at = now
+        return model_map.get(model_id, [])
+
     # -- request path ----------------------------------------------------
     def remote(self, *args, **kwargs):
         """Async call → ObjectRef (resolve with ray_tpu.get)."""
+        if self._model_id is not None:
+            from ray_tpu.serve.multiplex import MODEL_ID_KWARG
+
+            kwargs = {**kwargs, MODEL_ID_KWARG: self._model_id}
         self._refresh()
         last = None
         for attempt in range(5):
@@ -102,6 +146,47 @@ class DeploymentHandle:
                 self._refresh(ttl=0)
         raise RuntimeError(
             f"could not route request to {self.deployment_name!r}: {last!r}")
+
+    def stream(self, *args, **kwargs):
+        """Call a GENERATOR method and iterate its chunks as they are
+        produced (reference: replica handle_request_streaming:323 +
+        streaming DeploymentResponse). Chunks batch over the wire
+        (next_chunks) so per-chunk overhead amortizes. Stream START
+        retries against a refreshed replica set like remote(); once
+        streaming, a replica death surfaces to the consumer."""
+        if self._model_id is not None:
+            from ray_tpu.serve.multiplex import MODEL_ID_KWARG
+
+            kwargs = {**kwargs, MODEL_ID_KWARG: self._model_id}
+        self._refresh()
+        last = None
+        for attempt in range(5):
+            try:
+                replica = self._pick()
+                stream_id = ray_tpu.get(
+                    replica.start_stream.remote(self._method, args,
+                                                kwargs))
+                break
+            except Exception as e:  # noqa: BLE001 - stale/dead replica
+                last = e
+                with self._lock:
+                    self._version = -1
+                time.sleep(0.05 * attempt)
+                self._refresh(ttl=0)
+        else:
+            raise RuntimeError(
+                f"could not start stream on {self.deployment_name!r}: "
+                f"{last!r}")
+
+        def gen():
+            while True:
+                state, chunks = ray_tpu.get(
+                    replica.next_chunks.remote(stream_id))
+                yield from chunks
+                if state == "end":
+                    return
+
+        return gen()
 
     def call(self, *args, **kwargs):
         """Sync convenience: remote + get. A replica torn down mid-request

@@ -8,15 +8,14 @@ every step whose checkpoint exists — the saga-style recovery of the
 reference (``workflow_state_from_storage.py``) specialized to DAGs.
 """
 
-from ray_tpu._private.usage_stats import record_library_usage as _rlu
-
-_rlu("workflow")
-
-
 from __future__ import annotations
 
 import os
 import pickle
+
+from ray_tpu._private.usage_stats import record_library_usage as _rlu
+
+_rlu("workflow")
 
 import ray_tpu
 from ray_tpu.dag import DAGNode

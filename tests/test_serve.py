@@ -185,3 +185,133 @@ def test_delete_deployment(rt):
     with pytest.raises(Exception):
         fresh = serve.get_deployment_handle("Gone")
         fresh.call(None)
+
+
+# ---------------------------------------------------------------------------
+# multiplexing / streaming / status (reference: serve/multiplex.py,
+# replica handle_request_streaming, serve.status())
+# ---------------------------------------------------------------------------
+
+def test_multiplexed_models(rt):
+    from ray_tpu import serve
+
+    loads = []
+
+    @serve.deployment(num_replicas=1)
+    class Mux:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            loads.append(model_id)
+            return {"id": model_id, "scale": int(model_id[-1])}
+
+        def __call__(self, payload):
+            model = self.get_model()
+            return model["scale"] * payload["x"]
+
+    handle = serve.run(Mux.bind(), name="mux")
+    h1 = handle.options(multiplexed_model_id="m1")
+    h2 = handle.options(multiplexed_model_id="m2")
+    assert h1.call({"x": 10}) == 10
+    assert h2.call({"x": 10}) == 20
+    # cached: calling again must not reload
+    assert h1.call({"x": 5}) == 5
+    handle._refresh(ttl=0)
+    assert set(ray_tpu.get(
+        handle._replicas[0].multiplexed_model_ids.remote())) == {"m1", "m2"}
+
+    # LRU eviction at capacity 2: m1 was used most recently, so loading
+    # m3 evicts m2 (least recently used)
+    h3 = handle.options(multiplexed_model_id="m3")
+    assert h3.call({"x": 1}) == 3
+    ids = ray_tpu.get(handle._replicas[0].multiplexed_model_ids.remote())
+    assert "m2" not in ids and set(ids) == {"m1", "m3"}
+    serve.delete("mux")
+
+
+def test_streaming_response(rt):
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Streamer:
+        def generate(self, n):
+            for i in range(n):
+                yield i * i
+
+    handle = serve.run(Streamer.bind(), name="streamer")
+    chunks = list(handle.options(method_name="generate").stream(30))
+    assert chunks == [i * i for i in range(30)]
+    serve.delete("streamer")
+
+
+def test_streaming_error_propagates(rt):
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Bad:
+        def generate(self):
+            yield 1
+            raise ValueError("stream-boom")
+
+    handle = serve.run(Bad.bind(), name="bad_stream")
+    gen = handle.options(method_name="generate").stream()
+    with pytest.raises(Exception, match="stream-boom"):
+        list(gen)
+    serve.delete("bad_stream")
+
+
+def test_serve_status(rt):
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class S:
+        def __call__(self, payload):
+            return 1
+
+    handle = serve.run(S.bind(), name="stat")
+    for _ in range(4):
+        handle.call({})
+    st = serve.status()
+    assert "stat" in st["deployments"]
+    assert st["deployments"]["stat"]["total_requests"] >= 4
+    serve.delete("stat")
+
+
+def test_streaming_with_multiplex(rt):
+    """Multiplexed model id must reach the streaming request context."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class MuxStream:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            return model_id
+
+        def generate(self, n):
+            m = self.get_model()
+            for i in range(n):
+                yield f"{m}-{i}"
+
+    handle = serve.run(MuxStream.bind(), name="muxstream")
+    out = list(handle.options(method_name="generate",
+                              multiplexed_model_id="mm").stream(2))
+    assert out == ["mm-0", "mm-1"]
+    serve.delete("muxstream")
+
+
+def test_streaming_chunks_before_error_delivered(rt):
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Partial:
+        def generate(self):
+            yield "a"
+            yield "b"
+            raise RuntimeError("later-boom")
+
+    handle = serve.run(Partial.bind(), name="partial")
+    got = []
+    with pytest.raises(Exception, match="later-boom"):
+        for c in handle.options(method_name="generate").stream():
+            got.append(c)
+    assert got == ["a", "b"]
+    serve.delete("partial")
