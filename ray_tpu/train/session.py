@@ -52,6 +52,7 @@ class TrainContext:
     node_rank: int = 0
     trial_dir: str = ""
     experiment_name: str = ""
+    restore_checkpoint: str | None = None
 
     def get_world_size(self) -> int:
         return self.world_size
@@ -94,5 +95,10 @@ def report(metrics: dict, *, checkpoint_dir: str | None = None):
 
 
 def get_checkpoint_dir() -> str | None:
-    """Restore path for resumed runs (set by the trainer before launch)."""
+    """Restore path for resumed runs: per-trial context first (set by the
+    controller on restore / PBT exploit), env var as the out-of-band
+    fallback."""
+    ctx = get_context()
+    if ctx.restore_checkpoint:
+        return ctx.restore_checkpoint
     return os.environ.get("RAY_TPU_RESTORE_CHECKPOINT") or None

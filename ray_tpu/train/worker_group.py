@@ -42,13 +42,15 @@ class _RankWorker:
                 coordinator_address=coordinator,
                 num_processes=world_size, process_id=rank)
 
-    def run(self, fn_blob_or_fn, config: dict, bus, trial_dir: str):
+    def run(self, fn_blob_or_fn, config: dict, bus, trial_dir: str,
+            restore_checkpoint: str | None = None):
         import cloudpickle
 
         fn = (cloudpickle.loads(fn_blob_or_fn)
               if isinstance(fn_blob_or_fn, bytes) else fn_blob_or_fn)
         ctx = TrainContext(rank=self.rank, world_size=self.world_size,
-                           local_rank=self.rank, trial_dir=trial_dir)
+                           local_rank=self.rank, trial_dir=trial_dir,
+                           restore_checkpoint=restore_checkpoint)
         _init_session(ctx, bus)
         try:
             result = fn(config) if _wants_config(fn) else fn()
@@ -130,11 +132,13 @@ class BackendExecutor:
         self.bus = _ReportBus.remote(scaling.num_workers)
 
     def start_training(self, train_fn: Callable, config: dict,
-                       trial_dir: str) -> list:
+                       trial_dir: str,
+                       restore_checkpoint: str | None = None) -> list:
         import cloudpickle
 
         blob = cloudpickle.dumps(train_fn, protocol=5)
-        return [w.run.remote(blob, config, self.bus, trial_dir)
+        return [w.run.remote(blob, config, self.bus, trial_dir,
+                             restore_checkpoint)
                 for w in self.group.workers]
 
     def poll_reports(self) -> tuple[list, bool]:

@@ -8,11 +8,15 @@ _rlu("tune")
 from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -24,6 +28,10 @@ from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
 __all__ = [
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",
+    "ConcurrencyLimiter",
+    "HyperBandScheduler",
+    "Searcher",
+    "TPESearcher",
     "FIFOScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",

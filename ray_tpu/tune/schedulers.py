@@ -139,3 +139,82 @@ class PopulationBasedTraining:
                 factor = self.rng.choice([0.8, 1.2])
                 out[key] = out[key] * factor
         return out
+
+
+class HyperBandScheduler:
+    """Synchronous HyperBand-style successive halving (reference:
+    ``tune/schedulers/hyperband.py``). Simplification: one bracket sized
+    by the live trial population; at each rung boundary (``r * eta^k``
+    iterations) the bottom ``1 - 1/eta`` of trials AT that rung stop.
+
+    Unlike ASHA (async, per-result decisions vs historical quantiles),
+    rung cuts here wait until every live trial reaches the rung, which
+    matches the original algorithm's synchronous halving semantics."""
+
+    def __init__(self, *, metric: str, mode: str = "max", r: int = 1,
+                 eta: int = 3, max_t: int = 81,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.eta = eta
+        self.max_t = max_t
+        self.time_attr = time_attr
+        self.rungs = []
+        t = r
+        while t < max_t:
+            self.rungs.append(t)
+            t *= eta
+        # rung level -> {trial_id: score} of trials waiting at the rung
+        self._waiting: dict[int, dict] = {lvl: {} for lvl in self.rungs}
+        self._decided: dict[int, set] = {lvl: set() for lvl in self.rungs}
+        self._stopped: set = set()
+        # expected population: set by the controller (set_population) so a
+        # rung cut waits for EVERY planned trial, not just the subset that
+        # happens to have reported already (a singleton cut eliminates
+        # nobody and silently defeats successive halving)
+        self._population: set = set()
+
+    def _val(self, result):
+        return float(result[self.metric]) * (
+            1.0 if self.mode == "max" else -1.0)
+
+    def set_population(self, trial_ids):
+        """Controller hook: the full set of trials this bracket halves
+        over (called whenever trials are created)."""
+        self._population.update(trial_ids)
+
+    def on_result(self, trial, result: dict) -> str:
+        self._population.add(trial.trial_id)
+        if trial.trial_id in self._stopped:
+            return STOP
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        for lvl in self.rungs:
+            if t == lvl and trial.trial_id not in self._decided[lvl]:
+                self._waiting[lvl][trial.trial_id] = self._val(result)
+                undecided = (self._population - self._decided[lvl]
+                             - self._stopped)
+                if set(self._waiting[lvl]) >= undecided:
+                    # everyone still running has reached the rung: cut
+                    ranked = sorted(self._waiting[lvl].items(),
+                                    key=lambda kv: kv[1], reverse=True)
+                    keep = max(1, len(ranked) // self.eta)
+                    for tid, _ in ranked[keep:]:
+                        self._stopped.add(tid)
+                    for tid, _ in ranked:
+                        self._decided[lvl].add(tid)
+                    self._waiting[lvl].clear()
+                    if trial.trial_id in self._stopped:
+                        return STOP
+                # NOT decided yet: let the trial keep running; it will
+                # be stopped at its next report if the cut rejects it
+        return CONTINUE
+
+    def on_trial_gone(self, trial_id: str):
+        """A trial finished/errored outside scheduler control: it must
+        not hold up future rung cuts, and its stale score must not take
+        a keep slot from live trials."""
+        self._population.discard(trial_id)
+        for lvl in self.rungs:
+            self._waiting[lvl].pop(trial_id, None)

@@ -17,24 +17,45 @@ from typing import Any, Callable
 @dataclass
 class Domain:
     sampler: Callable[[random.Random], Any]
+    # bounds for numeric domains (None for categorical): adaptive
+    # searchers clamp proposals to [low, high]
+    low: float | None = None
+    high: float | None = None
+    integer: bool = False
 
     def sample(self, rng: random.Random):
         return self.sampler(rng)
 
+    def clamp(self, x):
+        if self.low is not None:
+            x = max(x, self.low)
+        if self.high is not None:
+            x = min(x, self.high)
+        if self.integer:
+            hi = self.high - 1 if self.high is not None else None
+            x = int(round(x))
+            if self.low is not None:
+                x = max(x, int(self.low))
+            if hi is not None:
+                x = min(x, int(hi))
+        return x
+
 
 def uniform(low: float, high: float) -> Domain:
-    return Domain(lambda rng: rng.uniform(low, high))
+    return Domain(lambda rng: rng.uniform(low, high), low=low, high=high)
 
 
 def loguniform(low: float, high: float) -> Domain:
     import math
 
     return Domain(lambda rng: math.exp(
-        rng.uniform(math.log(low), math.log(high))))
+        rng.uniform(math.log(low), math.log(high))), low=low, high=high)
 
 
 def randint(low: int, high: int) -> Domain:
-    return Domain(lambda rng: rng.randrange(low, high))
+    """Samples from [low, high) like the reference's tune.randint."""
+    return Domain(lambda rng: rng.randrange(low, high), low=low, high=high,
+                  integer=True)
 
 
 def choice(options: list) -> Domain:
@@ -99,3 +120,170 @@ def _set_path(cfg: dict, path: tuple, value):
     for k in path[:-1]:
         node = node[k]
     node[path[-1]] = value
+
+
+# ---------------------------------------------------------------------------
+# adaptive searchers (reference: tune/search/searcher.py Searcher interface;
+# hyperopt/optuna integrations plug in behind suggest/on_trial_complete)
+# ---------------------------------------------------------------------------
+
+class Searcher:
+    """suggest(trial_id) -> config | None (None = no budget left);
+    on_trial_result / on_trial_complete feed observations back."""
+
+    def suggest(self, trial_id: str) -> dict | None:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict):
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: dict | None = None,
+                          error: bool = False):
+        pass
+
+
+class TPESearcher(Searcher):
+    """Tree-structured-Parzen-Estimator-style adaptive search over a
+    Domain/grid-free param space (the native analog of the reference's
+    hyperopt integration, ``tune/search/hyperopt/``).
+
+    After ``n_startup`` random trials, numeric dimensions are proposed by
+    sampling candidates and scoring them by the ratio of Gaussian-kernel
+    densities fit to the good (top gamma quantile) vs bad observations;
+    categorical dimensions are drawn from smoothed good-split counts.
+    """
+
+    def __init__(self, space: dict, *, metric: str, mode: str = "max",
+                 num_samples: int = 32, n_startup: int = 8,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 seed: int | None = None):
+        self.space = space
+        self.metric = metric
+        self.mode = mode
+        self.budget = num_samples
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.rng = random.Random(seed)
+        self._suggested = 0
+        self._obs: dict[str, tuple[dict, float]] = {}  # id -> (cfg, score)
+        self._configs: dict[str, dict] = {}            # id -> suggested cfg
+
+    # -- observations ---------------------------------------------------
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        if error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        cfg = self._configs.get(trial_id)
+        if cfg is not None:
+            self._obs[trial_id] = (cfg, score)
+
+    # -- proposals ------------------------------------------------------
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._suggested >= self.budget:
+            return None
+        self._suggested += 1
+        if len(self._obs) < self.n_startup:
+            cfg = self._random_config()
+        else:
+            cfg = self._tpe_config()
+        self._configs[trial_id] = cfg
+        return cfg
+
+    def _random_config(self) -> dict:
+        return {k: (v.sample(self.rng) if isinstance(v, Domain) else v)
+                for k, v in self.space.items()}
+
+    def _split_obs(self):
+        obs = sorted(self._obs.values(), key=lambda cv: cv[1],
+                     reverse=(self.mode == "max"))
+        n_good = max(1, int(len(obs) * self.gamma))
+        return obs[:n_good], obs[n_good:]
+
+    def _tpe_config(self) -> dict:
+        good, bad = self._split_obs()
+        cfg = {}
+        for k, dom in self.space.items():
+            if not isinstance(dom, Domain):
+                cfg[k] = dom
+                continue
+            gvals = [c[k] for c, _ in good if k in c]
+            bvals = [c[k] for c, _ in bad if k in c]
+            sample = dom.sample(self.rng)
+            if isinstance(sample, (int, float)) and not isinstance(
+                    sample, bool) and gvals and all(
+                    isinstance(v, (int, float)) for v in gvals):
+                cfg[k] = self._propose_numeric(dom, gvals, bvals,
+                                               integer=isinstance(sample, int))
+            elif gvals:
+                cfg[k] = self._propose_categorical(dom, gvals)
+            else:
+                cfg[k] = sample
+        return cfg
+
+    def _kde(self, x: float, centers: list, bw: float) -> float:
+        import math
+
+        if not centers:
+            return 1e-12
+        return sum(math.exp(-0.5 * ((x - c) / bw) ** 2)
+                   for c in centers) / (len(centers) * bw)
+
+    def _propose_numeric(self, dom: Domain, gvals, bvals, *, integer):
+        lo = min(gvals + bvals)
+        hi = max(gvals + bvals)
+        bw = max((hi - lo) / 4.0, 1e-9)
+        best, best_ratio = None, -1.0
+        for _ in range(self.n_candidates):
+            # good-centered Gaussian mixture + prior samples; every
+            # candidate is clamped into the domain's declared bounds
+            # (a raw gauss() draw can land outside [low, high])
+            if self.rng.random() < 0.75 and gvals:
+                x = dom.clamp(self.rng.gauss(self.rng.choice(gvals), bw))
+            else:
+                x = dom.sample(self.rng)
+            ratio = self._kde(x, gvals, bw) / (
+                self._kde(x, bvals, bw) + 1e-12)
+            if ratio > best_ratio:
+                best, best_ratio = x, ratio
+        if integer:
+            best = dom.clamp(best)
+        return best
+
+    def _propose_categorical(self, dom: Domain, gvals):
+        # smoothed counts over the good split; fall back to the prior
+        # for unseen options by mixing one prior sample in
+        counts: dict = {}
+        for v in gvals:
+            counts[v] = counts.get(v, 0) + 1
+        options = list(counts) + [dom.sample(self.rng)]
+        weights = [counts.get(o, 0) + 0.5 for o in options]
+        return self.rng.choices(options, weights=weights, k=1)[0]
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions (reference:
+    tune/search/concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if len(self._live) >= self.max_concurrent:
+            return None  # controller retries later
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+

@@ -31,8 +31,15 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Any = None
+    # adaptive searcher (Searcher: suggest/on_trial_complete); when set,
+    # trials are created on demand from its suggestions instead of
+    # pre-expanding the param space (reference: search_alg in TuneConfig)
+    search_alg: Any = None
     seed: int | None = None
     time_attr: str = "training_iteration"
+    # Callback objects with optional on_trial_start/on_trial_result/
+    # on_trial_complete hooks (reference: tune/callback.py)
+    callbacks: list = field(default_factory=list)
 
 
 @dataclass
@@ -46,6 +53,8 @@ class Trial:
     executor: Any = None
     error: str | None = None
     checkpoint_dir: str | None = None
+    # resume this trial from checkpoint_dir when (re)started
+    restore_from_checkpoint: bool = False
 
 
 @dataclass
@@ -76,15 +85,59 @@ class Tuner:
         self.run_config = run_config or RunConfig()
 
     def fit(self) -> ResultGrid:
-        variants = BasicVariantGenerator(
-            self.param_space, num_samples=self.tune_config.num_samples,
-            seed=self.tune_config.seed).variants()
-        trials = [Trial(trial_id=f"trial_{i:05d}", config=cfg)
-                  for i, cfg in enumerate(variants)]
+        if self._restored_trials is not None:
+            # Tuner.restore(...).fit() continues the experiment —
+            # mirrors the reference pairing; fit_restored stays as an
+            # explicit alias
+            return self.fit_restored()
+        if self.tune_config.search_alg is not None:
+            trials: list[Trial] = []  # created on demand by the controller
+        else:
+            variants = BasicVariantGenerator(
+                self.param_space, num_samples=self.tune_config.num_samples,
+                seed=self.tune_config.seed).variants()
+            trials = [Trial(trial_id=f"trial_{i:05d}", config=cfg)
+                      for i, cfg in enumerate(variants)]
         controller = TuneController(
             self.trainable, trials, self.tune_config, self.run_config)
         controller.run()
-        return ResultGrid(trials)
+        return ResultGrid(controller.trials)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable, *,
+                tune_config: TuneConfig | None = None) -> "Tuner":
+        """Resume an interrupted experiment from its state file
+        (reference: ``Tuner.restore`` + ``tune/execution/
+        experiment_state.py``). Finished trials keep their results;
+        unfinished ones re-run, resuming from their last checkpoint."""
+        state_file = os.path.join(path, "experiment_state.json")
+        with open(state_file) as f:
+            state = json.load(f)
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=RunConfig(storage_path=path))
+        restored = []
+        for t in state:
+            trial = Trial(trial_id=t["trial_id"], config=t["config"],
+                          status=t["status"],
+                          last_result=t.get("last_result") or {},
+                          checkpoint_dir=t.get("checkpoint_dir"))
+            if trial.status in ("PENDING", "RUNNING", "ERROR"):
+                trial.status = "PENDING"
+                trial.restore_from_checkpoint = True
+            restored.append(trial)
+        tuner._restored_trials = restored
+        return tuner
+
+    _restored_trials: list | None = None
+
+    def fit_restored(self) -> ResultGrid:
+        """Continue a restored experiment (fit() for Tuner.restore)."""
+        assert self._restored_trials is not None, "use Tuner.restore first"
+        controller = TuneController(
+            self.trainable, self._restored_trials, self.tune_config,
+            self.run_config)
+        controller.run(only_pending=True)
+        return ResultGrid(controller.trials)
 
 
 class TuneController:
@@ -106,9 +159,20 @@ class TuneController:
         trial.executor = BackendExecutor(ScalingConfig(num_workers=1))
         trial_dir = os.path.join(self.exp_dir, trial.trial_id)
         os.makedirs(trial_dir, exist_ok=True)
+        restore = (trial.checkpoint_dir
+                   if trial.restore_from_checkpoint else None)
+        trial.restore_from_checkpoint = False
         trial.executor.start_training(self.trainable, dict(trial.config),
-                                      trial_dir)
+                                      trial_dir,
+                                      restore_checkpoint=restore)
         trial.status = "RUNNING"
+        self._callback("on_trial_start", trial)
+
+    def _callback(self, hook: str, trial: Trial, result: dict | None = None):
+        for cb in self.cfg.callbacks:
+            fn = getattr(cb, hook, None)
+            if fn is not None:
+                fn(trial, result) if result is not None else fn(trial)
 
     def _stop(self, trial: Trial, status: str):
         if trial.executor is not None:
@@ -118,26 +182,67 @@ class TuneController:
 
     def _exploit(self, trial: Trial, donor: Trial):
         """PBT exploit: adopt donor's (explored) config + checkpoint and
-        restart (reference: pbt.py _exploit)."""
+        restart from it (reference: pbt.py _exploit)."""
         explored = self.scheduler.explore(dict(donor.config))
         self._stop(trial, "PENDING")
         trial.config = explored
         trial.checkpoint_dir = donor.checkpoint_dir
+        trial.restore_from_checkpoint = donor.checkpoint_dir is not None
         trial.iteration = 0
 
     # -- event loop ------------------------------------------------------
-    def run(self):
-        pending = list(self.trials)
+    def run(self, only_pending: bool = False):
+        pending = [t for t in self.trials
+                   if not only_pending or t.status == "PENDING"]
         running: list[Trial] = []
-        while pending or running:
+        search = self.cfg.search_alg
+        next_id = len(self.trials)
+        while pending or running or search is not None:
+            # adaptive search: pull new suggestions up to the cap
+            while (search is not None
+                   and len(running) + len(pending)
+                   < self.cfg.max_concurrent_trials):
+                tid = f"trial_{next_id:05d}"
+                cfg = search.suggest(tid)
+                if cfg is None:
+                    if not running and not pending:
+                        search = None  # budget exhausted: drain and exit
+                    break
+                next_id += 1
+                t = Trial(trial_id=tid, config=cfg)
+                self.trials.append(t)
+                pending.append(t)
+            if search is None and not pending and not running:
+                break
             while pending and len(running) < self.cfg.max_concurrent_trials:
                 trial = pending.pop(0)
                 self._start(trial)
                 running.append(trial)
+            set_pop = getattr(self.scheduler, "set_population", None)
+            if set_pop is not None:
+                set_pop({t.trial_id for t in self.trials
+                         if t.status in ("PENDING", "RUNNING")}
+                        | {t.trial_id for t in running})
             time.sleep(0.02)
+            # Drain every running trial's reports, then process them
+            # ROUND-ROBIN one report at a time. Per-trial batch
+            # processing would let a fast trial replay its whole history
+            # before a sibling's first report is seen, which collapses
+            # population-based scheduler decisions (HyperBand rungs, PBT
+            # quantiles) to single-trial populations.
+            drained: dict = {}
             for trial in list(running):
                 reports, done = trial.executor.poll_reports()
-                for rep in reports:
+                drained[trial.trial_id] = [list(reports), done]
+            progressed = True
+            while progressed:
+                progressed = False
+                for trial in list(running):
+                    slot = drained.get(trial.trial_id)
+                    if not slot or not slot[0]:
+                        continue
+                    rep = slot[0].pop(0)
+                    progressed = True
                     if "error" in rep:
                         trial.error = rep["error"]
                         continue
@@ -148,26 +253,44 @@ class TuneController:
                     trial.results.append(result)
                     if rep.get("checkpoint"):
                         trial.checkpoint_dir = rep["checkpoint"]
+                    if self.cfg.search_alg is not None:
+                        self.cfg.search_alg.on_trial_result(
+                            trial.trial_id, result)
+                    self._callback("on_trial_result", trial, result)
                     decision = self.scheduler.on_result(trial, result)
                     if decision == STOP:
                         self._stop(trial, "STOPPED")
                         running.remove(trial)
-                        break
-                    if isinstance(decision, tuple) and decision[0] == "EXPLOIT":
+                        drained.pop(trial.trial_id, None)
+                        self._trial_over(trial)
+                    elif (isinstance(decision, tuple)
+                          and decision[0] == "EXPLOIT"):
                         donor = next((t for t in self.trials
                                       if t.trial_id == decision[1]), None)
                         if donor is not None and donor is not trial:
                             self._exploit(trial, donor)
                             running.remove(trial)
+                            drained.pop(trial.trial_id, None)
                             pending.append(trial)
-                            break
-                else:
-                    if done:
-                        self._stop(trial,
-                                   "ERROR" if trial.error else "TERMINATED")
-                        running.remove(trial)
+            for trial in list(running):
+                slot = drained.get(trial.trial_id)
+                if slot and slot[1]:  # done and all reports consumed
+                    self._stop(trial,
+                               "ERROR" if trial.error else "TERMINATED")
+                    running.remove(trial)
+                    self._trial_over(trial)
             self._save_state()
         self._save_state()
+
+    def _trial_over(self, trial: Trial):
+        if self.cfg.search_alg is not None:
+            self.cfg.search_alg.on_trial_complete(
+                trial.trial_id, trial.last_result or None,
+                error=trial.status == "ERROR")
+        gone = getattr(self.scheduler, "on_trial_gone", None)
+        if gone is not None:
+            gone(trial.trial_id)
+        self._callback("on_trial_complete", trial)
 
     def _save_state(self):
         state = [{"trial_id": t.trial_id, "status": t.status,
