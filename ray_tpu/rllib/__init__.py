@@ -5,7 +5,10 @@ from ray_tpu._private.usage_stats import record_library_usage as _rlu
 _rlu("rllib")
 
 
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import BanditEnv, CartPole, make_env
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
-__all__ = ["BanditEnv", "CartPole", "PPO", "PPOConfig", "make_env"]
+__all__ = ["BanditEnv", "CartPole", "DQN", "DQNConfig", "IMPALA",
+           "IMPALAConfig", "PPO", "PPOConfig", "make_env"]
