@@ -1,0 +1,23 @@
+"""ray_tpu.parallel: device-plane parallelism (net-new vs the reference —
+SURVEY §2c): meshes, logical shardings, XLA collectives, ring/Ulysses
+sequence parallelism, pipeline schedules."""
+
+from ray_tpu.parallel.mesh import (MeshSpec, create_hybrid_mesh, create_mesh,
+                                   mesh_registry, slice_topology)
+from ray_tpu.parallel.ring_attention import ring_attention
+from ray_tpu.parallel.sharding import Axes, ShardingRules
+from ray_tpu.parallel.ulysses import (ulysses_attention,
+                                      ulysses_attention_sharded)
+
+__all__ = [
+    "Axes",
+    "MeshSpec",
+    "ShardingRules",
+    "create_hybrid_mesh",
+    "create_mesh",
+    "mesh_registry",
+    "ring_attention",
+    "slice_topology",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
+]

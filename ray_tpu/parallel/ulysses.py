@@ -1,0 +1,76 @@
+"""Ulysses sequence parallelism: attention via head/sequence all-to-all.
+
+Reference: ABSENT from the reference repo (SURVEY.md §2c/§5 — "Ulysses
+(attn all-to-all): no"); this is net-new first-class capability. The
+DeepSpeed-Ulysses scheme (Jacobs et al. 2023): activations are sharded
+on the SEQUENCE axis everywhere except inside attention; at the
+attention boundary an all-to-all re-shards to the HEAD axis (each device
+sees the FULL sequence for its subset of heads), dense attention runs
+locally, and a second all-to-all restores sequence sharding.
+
+vs ring attention (ray_tpu/parallel/ring_attention.py): Ulysses moves
+2 all-to-alls of the activations (cheap on ICI, O(S·H·D/P) per device)
+and keeps attention dense; ring keeps activations put and rotates K/V
+around the ring. Ulysses requires heads % sp == 0; ring has no head
+constraint but pays P ppermute steps. Both are exposed so models pick by
+shape.
+
+Use inside shard_map over the ``sp`` axis (the provided
+``ulysses_attention_sharded`` wraps that), with inputs sharded
+[B, S/sp, H, D].
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.ops.attention import reference_attention
+from ray_tpu.parallel.collectives import all_to_all, axis_size
+
+
+def ulysses_attention(q, k, v, *, axis: str = "sp", causal: bool = True,
+                      scale: float | None = None):
+    """Inside shard_map: q/k/v are the LOCAL sequence shard
+    [B, S/sp, H, D]; returns the local output shard with full-sequence
+    attention semantics. H must be divisible by the sp axis size."""
+    sp = axis_size(axis)
+    b, s_local, h, d = q.shape
+    for name, x in (("q", q), ("k", k), ("v", v)):
+        if x.shape[2] % sp != 0:
+            raise ValueError(
+                f"Ulysses requires {name} heads ({x.shape[2]}) divisible "
+                f"by sp axis ({sp}) — GQA kv-head counts below sp can't "
+                "re-shard by head; use ring attention instead")
+    if scale is None:
+        scale = d ** -0.5
+
+    # [B, S/sp, H, D] -> [B, S, H/sp, D]: scatter heads, gather sequence
+    def to_heads(x):
+        return all_to_all(x, axis, split_axis=2, concat_axis=1)
+
+    def to_seq(x):
+        return all_to_all(x, axis, split_axis=1, concat_axis=2)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = reference_attention(qh, kh, vh, causal=causal, scale=scale)
+    return to_seq(out)
+
+
+def ulysses_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp",
+                              causal: bool = True,
+                              scale: float | None = None):
+    """Driver-level entry: shards [B, S, H, D] inputs on the sequence
+    axis over ``axis`` and runs ulysses_attention under shard_map."""
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(
+        partial(ulysses_attention, axis=axis, causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return fn(q, k, v)
