@@ -1,0 +1,81 @@
+"""Internal KV store API.
+
+Reference analog: ``python/ray/experimental/internal_kv.py`` — thin
+functions over the GCS KV (``GcsKvManager``). Cluster mode talks to the
+GCS ``kv_*`` RPCs; local mode uses a process-local table with the same
+semantics (namespaced bytes keys).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ray_tpu.runtime import core as _core
+
+_NS = "internal_kv"
+_local_kv: dict[str, bytes] = {}
+_lock = threading.Lock()
+
+
+def _gcs():
+    if not _core.is_initialized():
+        import os
+
+        if os.environ.get("RAY_TPU_GCS_HOST"):
+            # inside a cluster worker: resolve the implicit runtime the
+            # same way the task API does, so KV reads hit the GCS
+            from ray_tpu.api import _runtime
+
+            _runtime()
+        else:
+            return None
+    rt = _core.get_runtime()
+    return getattr(rt, "_gcs", None)
+
+
+def _as_str(x) -> str:
+    return x.decode() if isinstance(x, bytes) else str(x)
+
+
+def internal_kv_put(key, value, overwrite: bool = True) -> bool:
+    key = _as_str(key)
+    value = value if isinstance(value, bytes) else str(value).encode()
+    gcs = _gcs()
+    if gcs is not None:
+        reply = gcs.call("kv_put", ns=_NS, key=key, value=value,
+                         overwrite=overwrite)
+        if isinstance(reply, dict):
+            return bool(reply.get("ok"))
+        return bool(reply)
+    with _lock:
+        if not overwrite and key in _local_kv:
+            return False
+        _local_kv[key] = value
+        return True
+
+
+def internal_kv_get(key) -> bytes | None:
+    key = _as_str(key)
+    gcs = _gcs()
+    if gcs is not None:
+        return gcs.call("kv_get", ns=_NS, key=key)
+    with _lock:
+        return _local_kv.get(key)
+
+
+def internal_kv_del(key) -> bool:
+    key = _as_str(key)
+    gcs = _gcs()
+    if gcs is not None:
+        return bool(gcs.call("kv_del", ns=_NS, key=key).get("ok"))
+    with _lock:
+        return _local_kv.pop(key, None) is not None
+
+
+def internal_kv_list(prefix="") -> list[str]:
+    prefix = _as_str(prefix)
+    gcs = _gcs()
+    if gcs is not None:
+        return gcs.call("kv_keys", ns=_NS, prefix=prefix)
+    with _lock:
+        return [k for k in _local_kv if k.startswith(prefix)]

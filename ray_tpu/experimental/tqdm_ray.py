@@ -1,0 +1,121 @@
+"""Distributed progress bars.
+
+Reference analog: ``python/ray/experimental/tqdm_ray.py`` — tqdm-like
+bars whose updates flow from remote tasks/actors to the driver (a named
+aggregator actor) so concurrent workers don't corrupt the terminal.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import ray_tpu
+
+_AGGREGATOR_NAME = "__tqdm_ray_aggregator"
+
+
+class _Aggregator:
+    def __init__(self):
+        self.bars: dict[str, dict] = {}
+        self.lock = threading.Lock()
+
+    def update(self, bar_id: str, desc: str, total, n: int):
+        with self.lock:
+            bar = self.bars.setdefault(
+                bar_id, {"desc": desc, "total": total, "n": 0})
+            bar["n"] += n
+            bar["total"] = total
+            return dict(bar)
+
+    def close_bar(self, bar_id: str):
+        with self.lock:
+            return self.bars.pop(bar_id, None)
+
+    def snapshot(self):
+        with self.lock:
+            return {k: dict(v) for k, v in self.bars.items()}
+
+
+def _aggregator():
+    try:
+        return ray_tpu.get_actor(_AGGREGATOR_NAME)
+    except ValueError:
+        cls = ray_tpu.remote(_Aggregator)
+        try:
+            return cls.options(name=_AGGREGATOR_NAME,
+                               max_concurrency=8).remote()
+        except ValueError:
+            return ray_tpu.get_actor(_AGGREGATOR_NAME)
+
+
+class tqdm:  # noqa: N801 - mirrors the tqdm API name
+    """Works inside remote tasks: updates aggregate on the driver-side
+    actor; rendering happens wherever flush() runs (driver)."""
+
+    def __init__(self, iterable=None, *, desc: str = "", total=None,
+                 position: int = 0):
+        self._iterable = iterable
+        self.desc = desc or "progress"
+        self.total = total if total is not None else (
+            len(iterable) if iterable is not None and
+            hasattr(iterable, "__len__") else None)
+        import uuid
+
+        self._id = uuid.uuid4().hex[:12]
+        self._agg = _aggregator()
+
+    def update(self, n: int = 1):
+        state = ray_tpu.get(self._agg.update.remote(
+            self._id, self.desc, self.total, n))
+        return state
+
+    def close(self):
+        ray_tpu.get(self._agg.close_bar.remote(self._id))
+
+    def __iter__(self):
+        try:
+            for x in self._iterable:
+                yield x
+                self.update(1)
+        finally:
+            # break/exception must still retire the bar from the
+            # long-lived aggregator actor
+            self.close()
+
+
+def snapshot() -> dict:
+    """All live bars' state (driver-side render source)."""
+    return ray_tpu.get(_aggregator().snapshot.remote())
+
+
+def render(stream=None, *, clear: bool = False):
+    """One-shot textual render of every live bar."""
+    stream = stream or sys.stderr
+    bars = snapshot()
+    lines = []
+    for bar in bars.values():
+        total = bar["total"]
+        n = bar["n"]
+        if total:
+            frac = min(1.0, n / total)
+            fill = int(frac * 20)
+            lines.append(f"{bar['desc']}: |{'#' * fill}{'-' * (20 - fill)}| "
+                         f"{n}/{total}")
+        else:
+            lines.append(f"{bar['desc']}: {n} it")
+    out = "\n".join(lines)
+    if out:
+        stream.write(out + "\n")
+    return out
+
+
+def watch(interval: float = 0.5, *, duration: float = 5.0):
+    """Poll-and-render loop (driver helper)."""
+    deadline = time.monotonic() + duration
+    while time.monotonic() < deadline:
+        if not snapshot():
+            return
+        render()
+        time.sleep(interval)

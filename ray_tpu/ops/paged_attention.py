@@ -89,7 +89,12 @@ def paged_write(cache: PagedKVCache, layer: int, slot, k_new, v_new,
     `start` (its current length). Positions map to
     (page_table[slot][pos // page], pos % page). A position landing on
     an unassigned table hole (-1) is DROPPED, never written: -1 would
-    wrap to the last page and silently corrupt another sequence's KV."""
+    wrap to the last page and silently corrupt another sequence's KV.
+
+    PERF: each functional .at[].set copies the whole multi-layer page
+    pool when run eagerly — call this inside jit with the cache arrays
+    donated (XLA then updates in place), or write every layer at once
+    with paged_write_all."""
     page_size = cache.k_pages.shape[2]
     num_pages = cache.k_pages.shape[1]
     t = k_new.shape[0]
@@ -104,6 +109,27 @@ def paged_write(cache: PagedKVCache, layer: int, slot, k_new, v_new,
                                jnp.asarray(in_page)].set(
         k_new.astype(cache.k_pages.dtype), mode="drop")
     v_pages = cache.v_pages.at[layer, jnp.asarray(page_idx),
+                               jnp.asarray(in_page)].set(
+        v_new.astype(cache.v_pages.dtype), mode="drop")
+    return PagedKVCache(k_pages, v_pages, cache.page_table, cache.lengths)
+
+
+def paged_write_all(cache: PagedKVCache, slot, k_new, v_new,
+                    start) -> PagedKVCache:
+    """Append k_new/v_new [L, T, nkv, hd] for ALL layers in one indexed
+    update per tensor (one pool copy eagerly, in-place under jit) —
+    the per-decode-step entry point."""
+    page_size = cache.k_pages.shape[2]
+    num_pages = cache.k_pages.shape[1]
+    t = k_new.shape[1]
+    pos = start + np.arange(t)
+    page_idx = cache.page_table[slot][pos // page_size]
+    page_idx = np.where(page_idx >= 0, page_idx, num_pages)
+    in_page = pos % page_size
+    k_pages = cache.k_pages.at[:, jnp.asarray(page_idx),
+                               jnp.asarray(in_page)].set(
+        k_new.astype(cache.k_pages.dtype), mode="drop")
+    v_pages = cache.v_pages.at[:, jnp.asarray(page_idx),
                                jnp.asarray(in_page)].set(
         v_new.astype(cache.v_pages.dtype), mode="drop")
     return PagedKVCache(k_pages, v_pages, cache.page_table, cache.lengths)

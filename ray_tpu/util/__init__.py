@@ -1,6 +1,13 @@
 """ray_tpu.util: ecosystem utilities (reference: ray.util, SURVEY P22)."""
 
 from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.iter import ParallelIterator, from_items, from_range
 from ray_tpu.util.queue import Queue
 
-__all__ = ["ActorPool", "Queue"]
+__all__ = [
+    "ActorPool",
+    "ParallelIterator",
+    "Queue",
+    "from_items",
+    "from_range",
+]

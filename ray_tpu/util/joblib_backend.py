@@ -1,0 +1,57 @@
+"""joblib parallel backend over ray_tpu tasks.
+
+Reference analog: ``python/ray/util/joblib/`` (P22) —
+``register_ray()`` lets scikit-learn-style code run
+``with joblib.parallel_backend("ray_tpu"): Parallel()(delayed(f)(x)...)``
+and have each work item execute as a cluster task.
+"""
+
+from __future__ import annotations
+
+from joblib.parallel import ParallelBackendBase, register_parallel_backend
+
+import ray_tpu
+
+
+class RayTpuBackend(ParallelBackendBase):
+    """Minimal joblib backend: batches run as tasks; results gather at
+    retrieval (joblib drives callbacks)."""
+
+    supports_timeout = True
+    uses_threads = False
+    supports_sharedmem = False
+
+    def effective_n_jobs(self, n_jobs):
+        if n_jobs == 0:
+            raise ValueError("n_jobs == 0 has no meaning")
+        if n_jobs is None or n_jobs < 0:
+            return 8
+        return n_jobs
+
+    def apply_async(self, func, callback=None):
+        task = ray_tpu.remote(lambda: func())
+        ref = task.remote()
+
+        class _Future:
+            def get(self, timeout=None):
+                return ray_tpu.get(ref, timeout=timeout)
+
+        fut = _Future()
+        if callback is not None:
+            # joblib expects the callback once the result is ready; the
+            # runtime resolves it threadlessly via the object future
+            def _done(_f):
+                callback(fut)
+
+            ref.future().add_done_callback(_done)
+        return fut
+
+    def configure(self, n_jobs=1, parallel=None, **kwargs):
+        self.parallel = parallel
+        return self.effective_n_jobs(n_jobs)
+
+
+def register_ray():
+    """Register the 'ray_tpu' joblib backend (reference:
+    ``ray.util.joblib.register_ray``)."""
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,145 @@
+"""multiprocessing.Pool API over ray_tpu tasks.
+
+Reference analog: ``python/ray/util/multiprocessing/`` (P22) — drop-in
+Pool so existing ``multiprocessing`` code scales across the cluster
+without rewrites. Functions ship via the runtime's cloudpickle path, so
+lambdas/closures work (unlike stdlib multiprocessing).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs, *, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout=None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout=None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            # stdlib contract: pending is not failure
+            raise ValueError("AsyncResult not ready")
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+
+class Pool:
+    """Pool(processes) — processes caps per-task resources only in
+    spirit; the runtime schedules by resources, so `processes` simply
+    bounds chunking for map."""
+
+    def __init__(self, processes: int | None = None,
+                 initializer: Callable | None = None, initargs=()):
+        self._processes = processes or 8
+        self._initializer = initializer
+        self._initargs = tuple(initargs)
+        self._closed = False
+
+    # -- submission ------------------------------------------------------
+
+    def _task(self, fn):
+        init, initargs = self._initializer, self._initargs
+
+        def run(*args, **kwargs):
+            if init is not None:
+                init(*initargs)
+            return fn(*args, **kwargs)
+
+        return ray_tpu.remote(run)
+
+    def apply(self, fn, args=(), kwds=None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args=(), kwds=None) -> AsyncResult:
+        self._check_open()
+        ref = self._task(fn).remote(*args, **(kwds or {}))
+        return AsyncResult([ref], single=True)
+
+    def map(self, fn, iterable: Iterable, chunksize: int | None = None):
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        self._check_open()
+        items = list(iterable)
+        chunks = self._chunk(items, chunksize)
+        task = self._task(lambda chunk: [fn(x) for x in chunk])
+        refs = [task.remote(c) for c in chunks]
+
+        class _FlatResult(AsyncResult):
+            def get(self, timeout=None):
+                nested = ray_tpu.get(self._refs, timeout=timeout)
+                return list(itertools.chain.from_iterable(nested))
+
+        return _FlatResult(refs, single=False)
+
+    def starmap(self, fn, iterable):
+        items = list(iterable)
+        task = self._task(lambda chunk: [fn(*x) for x in chunk])
+        chunks = self._chunk(items, None)
+        refs = [task.remote(c) for c in chunks]
+        nested = ray_tpu.get(refs)
+        return list(itertools.chain.from_iterable(nested))
+
+    def imap(self, fn, iterable, chunksize: int | None = None):
+        """Lazy ordered iterator over results."""
+        self._check_open()
+        task = self._task(fn)
+        refs = [task.remote(x) for x in iterable]
+        for ref in refs:
+            yield ray_tpu.get(ref)
+
+    def imap_unordered(self, fn, iterable, chunksize: int | None = None):
+        self._check_open()
+        task = self._task(fn)
+        refs = [task.remote(x) for x in iterable]
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            yield ray_tpu.get(ready[0])
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        pass  # tasks are independent; nothing to join
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    def _chunk(self, items: list, chunksize: int | None) -> list[list]:
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]

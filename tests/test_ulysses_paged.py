@@ -147,3 +147,31 @@ def test_release_slot_frees_pages():
     assert len(alloc.free) == 8
     assert int(cache.lengths[0]) == 0
     assert np.all(np.asarray(cache.page_table)[0] == -1)
+
+
+def test_paged_write_all_matches_per_layer():
+    from ray_tpu.ops.paged_attention import (PageAllocator, assign_pages,
+                                             init_paged_cache, paged_write,
+                                             paged_write_all)
+
+    cfg = _Cfg()
+    rng = np.random.default_rng(3)
+    kv = rng.normal(size=(cfg.n_layers, 6, cfg.n_kv_heads,
+                          cfg.head_dim)).astype(np.float32)
+
+    def fresh():
+        c = init_paged_cache(cfg, num_pages=8, page_size=4, max_batch=1,
+                             max_pages_per_seq=4, dtype=jnp.float32)
+        a = PageAllocator(8)
+        return assign_pages(c, a, 0, 6)
+
+    c1 = fresh()
+    for layer in range(cfg.n_layers):
+        c1 = paged_write(c1, layer, 0, jnp.asarray(kv[layer]),
+                         jnp.asarray(kv[layer]), 0)
+    c2 = fresh()
+    c2 = paged_write_all(c2, 0, jnp.asarray(kv), jnp.asarray(kv), 0)
+    np.testing.assert_allclose(np.asarray(c1.k_pages),
+                               np.asarray(c2.k_pages))
+    np.testing.assert_allclose(np.asarray(c1.v_pages),
+                               np.asarray(c2.v_pages))
