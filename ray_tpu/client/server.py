@@ -181,6 +181,22 @@ class ClientServer(RpcServer):
             return self._rt.task_events(limit)
         return []
 
+    def rpc_client_kv(self, conn, send_lock, *, op, key, value=None,
+                      overwrite=True, prefix=""):
+        """Proxy internal-KV ops so client drivers share the cluster's
+        KV (not a process-local dict)."""
+        from ray_tpu.experimental import internal_kv
+
+        if op == "put":
+            return internal_kv.internal_kv_put(key, value, overwrite)
+        if op == "get":
+            return internal_kv.internal_kv_get(key)
+        if op == "del":
+            return internal_kv.internal_kv_del(key)
+        if op == "list":
+            return internal_kv.internal_kv_list(prefix)
+        raise ValueError(f"unknown kv op {op!r}")
+
 
 def main(argv=None):
     import argparse

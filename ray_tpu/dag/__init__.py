@@ -52,8 +52,12 @@ class DAGNode:
             kwargs = {k: refs[id(v)] if isinstance(v, DAGNode) else v
                       for k, v in node._kwargs.items()}
             remote_fn = ray_tpu.remote(node._fn)
-            if node._options:
-                remote_fn = remote_fn.options(**node._options)
+            # workflow_* options are consumed by workflow.run's step
+            # driver, not the task API
+            opts = {k: v for k, v in (node._options or {}).items()
+                    if not k.startswith("workflow_")}
+            if opts:
+                remote_fn = remote_fn.options(**opts)
             refs[id(node)] = remote_fn.remote(*args, **kwargs)
         return refs[id(self)]
 

@@ -17,7 +17,8 @@ _local_kv: dict[str, bytes] = {}
 _lock = threading.Lock()
 
 
-def _gcs():
+def _backend():
+    """Returns ("gcs", client) | ("client", rt) | ("local", None)."""
     if not _core.is_initialized():
         import os
 
@@ -28,9 +29,14 @@ def _gcs():
 
             _runtime()
         else:
-            return None
+            return "local", None
     rt = _core.get_runtime()
-    return getattr(rt, "_gcs", None)
+    if getattr(rt, "is_client", False):
+        return "client", rt
+    gcs = getattr(rt, "_gcs", None)
+    if gcs is not None:
+        return "gcs", gcs
+    return "local", None
 
 
 def _as_str(x) -> str:
@@ -40,10 +46,13 @@ def _as_str(x) -> str:
 def internal_kv_put(key, value, overwrite: bool = True) -> bool:
     key = _as_str(key)
     value = value if isinstance(value, bytes) else str(value).encode()
-    gcs = _gcs()
-    if gcs is not None:
-        reply = gcs.call("kv_put", ns=_NS, key=key, value=value,
-                         overwrite=overwrite)
+    kind, backend = _backend()
+    if kind == "client":
+        return bool(backend._rpc.call("client_kv", op="put", key=key,
+                                      value=value, overwrite=overwrite))
+    if kind == "gcs":
+        reply = backend.call("kv_put", ns=_NS, key=key, value=value,
+                             overwrite=overwrite)
         if isinstance(reply, dict):
             return bool(reply.get("ok"))
         return bool(reply)
@@ -56,26 +65,32 @@ def internal_kv_put(key, value, overwrite: bool = True) -> bool:
 
 def internal_kv_get(key) -> bytes | None:
     key = _as_str(key)
-    gcs = _gcs()
-    if gcs is not None:
-        return gcs.call("kv_get", ns=_NS, key=key)
+    kind, backend = _backend()
+    if kind == "client":
+        return backend._rpc.call("client_kv", op="get", key=key)
+    if kind == "gcs":
+        return backend.call("kv_get", ns=_NS, key=key)
     with _lock:
         return _local_kv.get(key)
 
 
 def internal_kv_del(key) -> bool:
     key = _as_str(key)
-    gcs = _gcs()
-    if gcs is not None:
-        return bool(gcs.call("kv_del", ns=_NS, key=key).get("ok"))
+    kind, backend = _backend()
+    if kind == "client":
+        return bool(backend._rpc.call("client_kv", op="del", key=key))
+    if kind == "gcs":
+        return bool(backend.call("kv_del", ns=_NS, key=key).get("ok"))
     with _lock:
         return _local_kv.pop(key, None) is not None
 
 
 def internal_kv_list(prefix="") -> list[str]:
     prefix = _as_str(prefix)
-    gcs = _gcs()
-    if gcs is not None:
-        return gcs.call("kv_keys", ns=_NS, prefix=prefix)
+    kind, backend = _backend()
+    if kind == "client":
+        return backend._rpc.call("client_kv", op="list", prefix=prefix)
+    if kind == "gcs":
+        return backend.call("kv_keys", ns=_NS, prefix=prefix)
     with _lock:
         return [k for k in _local_kv if k.startswith(prefix)]

@@ -67,9 +67,9 @@ class tqdm:  # noqa: N801 - mirrors the tqdm API name
         self._agg = _aggregator()
 
     def update(self, n: int = 1):
-        state = ray_tpu.get(self._agg.update.remote(
-            self._id, self.desc, self.total, n))
-        return state
+        # fire-and-forget: a blocking get per element would serialize
+        # the wrapped loop on actor RPC latency
+        self._agg.update.remote(self._id, self.desc, self.total, n)
 
     def close(self):
         ray_tpu.get(self._agg.close_bar.remote(self._id))

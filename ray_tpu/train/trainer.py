@@ -108,8 +108,7 @@ class JaxTrainer:
         """Abstract-eval a state skeleton to derive per-leaf logical axes
         (optimizer moments inherit their param's axes — ZeRO-style)."""
         param_axes = llama.param_logical_axes(self.model_cfg)
-        abstract = jax.eval_shape(self._make_state_fn, jax.random.key(0))
-        return state_logical_axes(abstract, param_axes)
+        return state_logical_axes(self.abstract_state(), param_axes)
 
     def _axes_to_sharding(self, ax) -> NamedSharding:
         from ray_tpu.parallel.sharding import logical_sharding
@@ -117,6 +116,11 @@ class JaxTrainer:
         if ax:
             return logical_sharding(tuple(ax), self.mesh, self.rules)
         return NamedSharding(self.mesh, P())
+
+    def abstract_state(self) -> Any:
+        """ShapeDtypeStruct pytree of a TrainState (shared by sharding
+        derivation and checkpoint restore)."""
+        return jax.eval_shape(self._make_state_fn, jax.random.key(0))
 
     def state_shardings(self) -> Any:
         """NamedSharding pytree for a TrainState (also used by checkpoint

@@ -9,9 +9,15 @@ lambdas/closures work (unlike stdlib multiprocessing).
 from __future__ import annotations
 
 import itertools
+import uuid
 from typing import Any, Callable, Iterable
 
 import ray_tpu
+
+# Pools whose initializer already ran IN THIS PROCESS (workers import
+# this module, so the set is per worker process — giving the stdlib's
+# once-per-worker initializer semantics instead of once-per-task).
+_initialized_pools: set[str] = set()
 
 
 class AsyncResult:
@@ -54,15 +60,21 @@ class Pool:
         self._initializer = initializer
         self._initargs = tuple(initargs)
         self._closed = False
+        self._pool_id = uuid.uuid4().hex
 
     # -- submission ------------------------------------------------------
 
     def _task(self, fn):
         init, initargs = self._initializer, self._initargs
+        pool_id = self._pool_id
 
         def run(*args, **kwargs):
             if init is not None:
-                init(*initargs)
+                from ray_tpu.util.multiprocessing import _initialized_pools
+
+                if pool_id not in _initialized_pools:
+                    _initialized_pools.add(pool_id)
+                    init(*initargs)
             return fn(*args, **kwargs)
 
         return ray_tpu.remote(run)
@@ -101,21 +113,33 @@ class Pool:
         return list(itertools.chain.from_iterable(nested))
 
     def imap(self, fn, iterable, chunksize: int | None = None):
-        """Lazy ordered iterator over results."""
+        """Ordered iterator over results. Submission is EAGER (stdlib
+        semantics: the pool may be closed while results are consumed);
+        chunksize batches items per task."""
         self._check_open()
-        task = self._task(fn)
-        refs = [task.remote(x) for x in iterable]
-        for ref in refs:
-            yield ray_tpu.get(ref)
+        task = self._task(lambda chunk: [fn(x) for x in chunk])
+        chunks = self._chunk(list(iterable), chunksize)
+        refs = [task.remote(c) for c in chunks]
+
+        def gen():
+            for ref in refs:
+                yield from ray_tpu.get(ref)
+
+        return gen()
 
     def imap_unordered(self, fn, iterable, chunksize: int | None = None):
         self._check_open()
-        task = self._task(fn)
-        refs = [task.remote(x) for x in iterable]
-        pending = list(refs)
-        while pending:
-            ready, pending = ray_tpu.wait(pending, num_returns=1)
-            yield ray_tpu.get(ready[0])
+        task = self._task(lambda chunk: [fn(x) for x in chunk])
+        chunks = self._chunk(list(iterable), chunksize)
+        refs = [task.remote(c) for c in chunks]
+
+        def gen():
+            pending = list(refs)
+            while pending:
+                ready, pending = ray_tpu.wait(pending, num_returns=1)
+                yield from ray_tpu.get(ready[0])
+
+        return gen()
 
     # -- lifecycle -------------------------------------------------------
 

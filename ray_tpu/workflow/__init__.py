@@ -27,6 +27,38 @@ def _step_id(index: int, node: DAGNode) -> str:
     return f"{index:04d}_{getattr(node._fn, '__name__', 'step')}"
 
 
+def _run_step(node: DAGNode, args, kwargs):
+    """One step with per-step workflow options (reference: step options
+    ``max_retries``/``catch_exceptions`` in ``workflow/api.py``).
+
+    - ``workflow_max_retries``: re-submit the step N extra times on error
+    - ``workflow_catch_exceptions``: return (result, None) / (None, exc)
+      instead of raising, so downstream steps can compensate (saga style)
+    """
+    import time as _time
+
+    opts = dict(node._options or {})
+    retries = int(opts.pop("workflow_max_retries", 0))
+    catch = bool(opts.pop("workflow_catch_exceptions", False))
+    task = ray_tpu.remote(node._fn)
+    if opts:
+        task = task.options(**opts)
+    last = None
+    for attempt in range(retries + 1):
+        try:
+            value = ray_tpu.get(task.remote(*args, **kwargs))
+            return (value, None) if catch else value
+        except Exception as e:  # noqa: BLE001
+            # surface the USER's exception, not the runtime's TaskError
+            # wrapper (reference: catch_exceptions hands back the cause)
+            last = getattr(e, "cause", None) or e
+            if attempt < retries:
+                _time.sleep(0.05 * (attempt + 1))
+    if catch:
+        return (None, last)
+    raise last
+
+
 def run(dag: DAGNode, *, workflow_id: str,
         storage: str | None = None):
     """Execute with checkpointing; returns the final result (sync)."""
@@ -34,24 +66,68 @@ def run(dag: DAGNode, *, workflow_id: str,
     os.makedirs(root, exist_ok=True)
     order = dag.topo_order()
     results: dict[int, object] = {}
-    for index, node in enumerate(order):
-        path = os.path.join(root, _step_id(index, node) + ".pkl")
-        if os.path.exists(path):
-            with open(path, "rb") as f:
-                results[id(node)] = pickle.load(f)
-            continue
-        args = [results[id(a)] if isinstance(a, DAGNode) else a
-                for a in node._args]
-        kwargs = {k: results[id(v)] if isinstance(v, DAGNode) else v
-                  for k, v in node._kwargs.items()}
-        value = ray_tpu.get(ray_tpu.remote(node._fn).remote(*args, **kwargs))
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(value, f)
-        os.replace(tmp, path)  # atomic: a crash never leaves half a step
-        results[id(node)] = value
+    final = None
+    try:
+        for index, node in enumerate(order):
+            path = os.path.join(root, _step_id(index, node) + ".pkl")
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    results[id(node)] = pickle.load(f)
+                continue
+            args = [results[id(a)] if isinstance(a, DAGNode) else a
+                    for a in node._args]
+            kwargs = {k: results[id(v)] if isinstance(v, DAGNode) else v
+                      for k, v in node._kwargs.items()}
+            value = _run_step(node, args, kwargs)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(value, f)
+            os.replace(tmp, path)  # atomic: a crash never leaves half a step
+            results[id(node)] = value
+        final = results[id(dag)]
+    except Exception:
+        _mark(root, "FAILED")
+        raise
+    # persist the workflow output for get_output() — atomically, like
+    # step checkpoints (a crash mid-write must not fake a half-output)
+    out_path = os.path.join(root, "_OUTPUT.pkl")
+    tmp = out_path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(final, f)
+    os.replace(tmp, out_path)
     _mark(root, "SUCCESS")
-    return results[id(dag)]
+    return final
+
+
+def run_async(dag: DAGNode, *, workflow_id: str,
+              storage: str | None = None):
+    """Run the whole workflow inside a task; returns an ObjectRef
+    (reference: ``workflow.run_async`` — ``workflow/api.py:174``).
+
+    The storage path resolves on the DRIVER so the executing worker and
+    the driver agree on it. On a multi-node cluster, pass a SHARED
+    filesystem path (NFS/GCS fuse) — same requirement as the
+    reference's workflow storage."""
+    blob = (dag, workflow_id, storage or _STORAGE)
+
+    @ray_tpu.remote
+    def _drive(payload):
+        d, wid, st = payload
+        return run(d, workflow_id=wid, storage=st)
+
+    return _drive.remote(blob)
+
+
+def get_output(workflow_id: str, *, storage: str | None = None):
+    """Result of a completed workflow (reference: workflow.get_output)."""
+    root = os.path.join(storage or _STORAGE, workflow_id)
+    path = os.path.join(root, "_OUTPUT.pkl")
+    if not os.path.exists(path):
+        raise ValueError(
+            f"workflow {workflow_id!r} has no recorded output "
+            f"(status={status(workflow_id, storage=storage)})")
+    with open(path, "rb") as f:
+        return pickle.load(f)
 
 
 def resume(dag: DAGNode, *, workflow_id: str, storage: str | None = None):
@@ -65,7 +141,58 @@ def status(workflow_id: str, *, storage: str | None = None) -> str:
         return "NOT_FOUND"
     if os.path.exists(os.path.join(root, "_STATUS_SUCCESS")):
         return "SUCCESS"
+    if os.path.exists(os.path.join(root, "_STATUS_FAILED")):
+        return "FAILED"
     return "RUNNING" if os.listdir(root) else "PENDING"
+
+
+def metadata(workflow_id: str, *, storage: str | None = None) -> dict:
+    """Steps completed + status (reference: workflow metadata API)."""
+    root = os.path.join(storage or _STORAGE, workflow_id)
+    steps = []
+    if os.path.isdir(root):
+        steps = sorted(f[:-4] for f in os.listdir(root)
+                       if f.endswith(".pkl") and not f.startswith("_"))
+    return {"workflow_id": workflow_id,
+            "status": status(workflow_id, storage=storage),
+            "steps_completed": steps}
+
+
+# ---------------------------------------------------------------------------
+# events (reference: workflow/http_event_provider.py — here events live in
+# the internal KV so any process can signal them)
+# ---------------------------------------------------------------------------
+
+def signal_event(name: str, payload=b"1") -> None:
+    """Fire an event; a workflow blocked in wait_for_event resumes."""
+    from ray_tpu.experimental import internal_kv_put
+
+    internal_kv_put(f"__wf_event_{name}", payload)
+
+
+def event(name: str, *, poll_interval_s: float = 0.05,
+          timeout_s: float = 60.0) -> DAGNode:
+    """A DAG node that completes when the named event fires; its value is
+    the event payload. Compose like any step:
+        done = process.bind(workflow.event("upstream-ready"))
+    """
+    from ray_tpu.dag import DAGNode as _Node
+
+    def _wait(_name=name, _poll=poll_interval_s, _timeout=timeout_s):
+        import time as _time
+
+        from ray_tpu.experimental import internal_kv_get
+
+        deadline = _time.monotonic() + _timeout
+        while _time.monotonic() < deadline:
+            val = internal_kv_get(f"__wf_event_{_name}")
+            if val is not None:
+                return val
+            _time.sleep(_poll)
+        raise TimeoutError(f"workflow event {_name!r} never fired")
+
+    _wait.__name__ = f"event_{name}"
+    return _Node(_wait, (), {})
 
 
 def list_all(*, storage: str | None = None) -> list[str]:

@@ -1,0 +1,104 @@
+"""Elastic mesh reformation tests (SURVEY §7 hard-parts: mesh rebuild
+from checkpoint as a first-class fast operation; net-new vs reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import llama
+from ray_tpu.train.elastic import ElasticTrainer
+from ray_tpu.train.trainer import TrainConfig
+
+
+def _data_iter(batch=8, seq=17, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    while True:
+        yield rng.integers(0, vocab, size=(batch, seq)).astype(np.int32)
+
+
+def _axes_fn(n):
+    # prefer dp x fsdp factorizations
+    if n % 4 == 0:
+        return {"dp": n // 4, "fsdp": 4}
+    if n % 2 == 0:
+        return {"dp": n // 2, "fsdp": 2}
+    return {"dp": n}
+
+
+@pytest.fixture
+def tiny_cfg():
+    return llama.LlamaConfig(
+        vocab_size=256, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, head_dim=8, remat="none")
+
+
+def test_reform_to_fewer_devices(tiny_cfg, tmp_path):
+    """Train on 8 devices, checkpoint, lose half the slice, reform on 4,
+    resume at the same step with identical params."""
+    et = ElasticTrainer(
+        tiny_cfg, TrainConfig(total_steps=100, warmup_steps=1),
+        checkpoint_dir=str(tmp_path / "ck"), mesh_axes_fn=_axes_fn,
+        devices=jax.devices()[:8], checkpoint_every=5)
+    data = _data_iter()
+    state = et.init_state(jax.random.key(0))
+    state = et.fit(state, data, steps=5)  # hits a checkpoint at step 5
+    params_before = jax.tree.map(np.asarray, state.params)
+    step_before = int(state.step)
+
+    # "failure": half the devices disappear
+    state2 = et.reform(devices=jax.devices()[:4])
+    assert int(state2.step) == step_before
+    assert et.trainer.mesh.devices.size == 4
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6),
+        params_before, jax.tree.map(np.asarray, state2.params))
+
+    # training continues on the smaller mesh
+    state3 = et.fit(state2, data, steps=2)
+    assert int(state3.step) == step_before + 2
+    assert len(et.reform_events) == 1
+    ev = et.reform_events[0]
+    assert ev.old_devices == 8 and ev.new_devices == 4
+    et.close()
+
+
+def test_reform_to_more_devices(tiny_cfg, tmp_path):
+    """Scale UP: checkpoint on 2 devices, reform on 8."""
+    et = ElasticTrainer(
+        tiny_cfg, TrainConfig(total_steps=100, warmup_steps=1),
+        checkpoint_dir=str(tmp_path / "ck"), mesh_axes_fn=_axes_fn,
+        devices=jax.devices()[:2], checkpoint_every=2)
+    data = _data_iter()
+    state = et.init_state(jax.random.key(1))
+    state = et.fit(state, data, steps=2)
+    loss_small = None
+
+    state2 = et.reform(devices=jax.devices()[:8])
+    assert et.trainer.mesh.devices.size == 8
+    # the step function compiles and runs on the new mesh
+    state3, metrics = et.trainer.train_step(state2, next(data))
+    loss_small = float(metrics["loss"])
+    assert np.isfinite(loss_small)
+    et.close()
+
+
+def test_save_restore_roundtrip_same_mesh(tiny_cfg, tmp_path):
+    et = ElasticTrainer(
+        tiny_cfg, TrainConfig(total_steps=50, warmup_steps=1),
+        checkpoint_dir=str(tmp_path / "ck"), mesh_axes_fn=_axes_fn,
+        devices=jax.devices()[:4], checkpoint_every=100)
+    data = _data_iter()
+    state = et.init_state(jax.random.key(2))
+    state, _ = et.trainer.train_step(state, next(data))
+    et.save(state, force=True)
+    et.ckpt.wait()
+    restored = et.restore_latest()
+    assert int(restored.step) == int(state.step)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6),
+        jax.tree.map(np.asarray, state.params),
+        jax.tree.map(np.asarray, restored.params))
+    et.close()

@@ -1,0 +1,106 @@
+"""Workflow widening tests: retries, catch_exceptions, run_async,
+get_output, events, metadata.
+(reference analogs: workflow/tests/ — api.py run/run_async, step options,
+http_event_provider)"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import DAGNode
+
+
+@pytest.fixture
+def rt(ray_tpu_start):
+    return ray_tpu_start
+
+
+def _node(fn, *args, **kw):
+    return DAGNode(fn, args, kw)
+
+
+def test_step_retries(rt, tmp_path):
+    """A flaky step succeeds within its retry budget; the attempt count
+    flows through a file (closures don't round-trip to tasks)."""
+    marker = tmp_path / "attempts"
+
+    def flaky():
+        n = int(marker.read_text()) if marker.exists() else 0
+        marker.write_text(str(n + 1))
+        if n < 2:
+            raise RuntimeError("flake")
+        return "ok"
+
+    node = _node(flaky).options(workflow_max_retries=3)
+    out = workflow.run(node, workflow_id="wf_retry",
+                       storage=str(tmp_path / "st"))
+    assert out == "ok"
+    assert int(marker.read_text()) == 3
+
+
+def test_step_retries_exhausted(rt, tmp_path):
+    def always_fails():
+        raise RuntimeError("perma")
+
+    node = _node(always_fails).options(workflow_max_retries=1)
+    with pytest.raises(Exception, match="perma"):
+        workflow.run(node, workflow_id="wf_fail",
+                     storage=str(tmp_path / "st"))
+    assert workflow.status("wf_fail", storage=str(tmp_path / "st")) == \
+        "FAILED"
+
+
+def test_catch_exceptions_saga(rt, tmp_path):
+    def boom():
+        raise ValueError("expected")
+
+    def compensate(res):
+        value, err = res
+        return f"compensated:{type(err).__name__}" if err else value
+
+    failing = _node(boom).options(workflow_catch_exceptions=True)
+    saga = _node(compensate, failing)
+    out = workflow.run(saga, workflow_id="wf_saga",
+                       storage=str(tmp_path / "st"))
+    assert out == "compensated:ValueError"
+
+
+def test_run_async_and_get_output(rt, tmp_path):
+    def slow(x):
+        time.sleep(0.1)
+        return x * 2
+
+    node = _node(slow, 21)
+    ref = workflow.run_async(node, workflow_id="wf_async",
+                             storage=str(tmp_path / "st"))
+    assert ray_tpu.get(ref, timeout=30) == 42
+    assert workflow.get_output("wf_async",
+                               storage=str(tmp_path / "st")) == 42
+    meta = workflow.metadata("wf_async", storage=str(tmp_path / "st"))
+    assert meta["status"] == "SUCCESS" and meta["steps_completed"]
+
+
+def test_event_trigger(rt, tmp_path):
+    def after(payload):
+        return b"payload:" + payload
+
+    node = _node(after, workflow.event("go", timeout_s=10))
+
+    def fire():
+        time.sleep(0.3)
+        workflow.signal_event("go", b"fired")
+
+    threading.Thread(target=fire, daemon=True).start()
+    out = workflow.run(node, workflow_id="wf_event",
+                       storage=str(tmp_path / "st"))
+    assert out == b"payload:fired"
+
+
+def test_event_timeout(rt, tmp_path):
+    node = workflow.event("never", timeout_s=0.3)
+    with pytest.raises(Exception, match="never fired"):
+        workflow.run(node, workflow_id="wf_event_t",
+                     storage=str(tmp_path / "st"))
