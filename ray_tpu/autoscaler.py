@@ -95,13 +95,36 @@ class StandardAutoscaler:
     def update(self):
         res = self.gcs.call("cluster_resources")
         total, avail = res["total"], res["available"]
-        # scale up: demanded resource classes nearly exhausted
+        under_cap = (len(self.provider.non_terminated_nodes())
+                     < self.max_nodes)
+        # scale up (1): explicit unmet demand — tasks parked as
+        # cluster-wide infeasible (reference: autoscaler v2's demand-
+        # driven path from GcsAutoscalerStateManager). Skips while a
+        # provider node is still booting (not yet registered in GCS):
+        # the demand stays pending for the whole provision window, and
+        # re-creating per poll would over-provision for one task.
+        alive = {n["node_id"]
+                 for n in self.gcs.call("get_nodes", alive_only=True)}
+        provisioning = [n for n in self.provider.non_terminated_nodes()
+                        if n not in alive]
+        if under_cap and not provisioning:
+            try:
+                pending = self.gcs.call("get_pending_demand")
+            except Exception:  # noqa: BLE001 - older GCS
+                pending = []
+            satisfiable = [d for d in pending
+                           if all(self.node_resources.get(k, 0) >= v
+                                  for k, v in d.items())]
+            if satisfiable:
+                self.provider.create_node(dict(self.node_resources))
+                return
+        # scale up (2): demanded resource classes nearly exhausted
         busy = any(
             total.get(k, 0) > 0
             and (total[k] - avail.get(k, 0)) / total[k]
             >= self.utilization_threshold
             for k in ("CPU", "TPU") if total.get(k))
-        if busy and len(self.provider.non_terminated_nodes()) < self.max_nodes:
+        if busy and under_cap:
             self.provider.create_node(dict(self.node_resources))
             return
         # scale down: provider nodes fully idle past the timeout

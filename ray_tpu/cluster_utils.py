@@ -46,7 +46,8 @@ class Cluster:
     def add_node(self, *, num_cpus: float = 4, num_tpus: float = 0,
                  resources: dict | None = None, external: bool = False,
                  store_capacity: int = 256 << 20,
-                 labels: dict | None = None) -> NodeHandle:
+                 labels: dict | None = None,
+                 infeasible_timeout_s: float = 10.0) -> NodeHandle:
         res = {"CPU": float(num_cpus)}
         if num_tpus:
             res["TPU"] = float(num_tpus)
@@ -72,7 +73,9 @@ class Cluster:
         else:
             raylet = Raylet(node_id=node_id, gcs_address=self.gcs_address,
                             resources=res, store_capacity=store_capacity,
-                            labels=labels).start()
+                            labels=labels,
+                            infeasible_timeout_s=infeasible_timeout_s
+                            ).start()
             handle = NodeHandle(node_id, raylet=raylet,
                                 address=raylet.address)
         with self._lock:

@@ -56,6 +56,47 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # silence request logging
         pass
 
+    def do_POST(self):  # noqa: N802 - http.server API
+        """Job submission REST (reference: dashboard/modules/job/
+        job_head.py): POST /api/jobs {"entrypoint": ..., "env": {...}}."""
+        path = self.path.split("?", 1)[0].rstrip("/")
+        try:
+            if path == "/api/jobs":
+                from ray_tpu.job_submission import JobSubmissionClient
+
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if "entrypoint" not in body:
+                    self._send_json({"error": "entrypoint required"}, 400)
+                    return
+                client = JobSubmissionClient()
+                job_id = client.submit_job(
+                    entrypoint=body["entrypoint"],
+                    env=body.get("env"),
+                    working_dir=body.get("working_dir"),
+                    submission_id=body.get("submission_id"))
+                self._send_json({"job_id": job_id}, 200)
+            elif path.startswith("/api/jobs/") and path.endswith("/stop"):
+                from ray_tpu.job_submission import JobSubmissionClient
+
+                job_id = path[len("/api/jobs/"):-len("/stop")]
+                JobSubmissionClient().stop_job(job_id)
+                self._send_json({"ok": True})
+            else:
+                self._send_json({"error": f"unknown path {path}"}, 404)
+        except ValueError as e:
+            try:
+                self._send_json({"error": str(e)}, 404)
+            except OSError:
+                pass
+        except OSError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            try:
+                self._send_json({"error": repr(e)}, 500)
+            except OSError:
+                pass
+
     def _send(self, body: bytes, content_type: str, status: int = 200):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
@@ -91,6 +132,18 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(_state.list_objects())
             elif path == "/api/timeline":
                 self._send_json(ray_tpu.timeline())
+            elif path.startswith("/api/jobs/") and path.endswith("/logs"):
+                from ray_tpu.job_submission import JobSubmissionClient
+
+                job_id = path[len("/api/jobs/"):-len("/logs")]
+                self._send(JobSubmissionClient().get_job_logs(
+                    job_id).encode(), "text/plain")
+            elif path.startswith("/api/jobs/"):
+                from ray_tpu.job_submission import JobSubmissionClient
+
+                job_id = path[len("/api/jobs/"):]
+                self._send_json(
+                    JobSubmissionClient().get_job_info(job_id))
             elif path == "/api/version":
                 self._send_json({"version": ray_tpu.__version__})
             elif path == "/metrics":
@@ -98,6 +151,12 @@ class _Handler(BaseHTTPRequestHandler):
                            "text/plain; version=0.0.4")
             else:
                 self._send_json({"error": f"unknown path {path}"}, 404)
+        except ValueError as e:
+            # unknown job/actor name lookups are client errors, not 500s
+            try:
+                self._send_json({"error": str(e)}, 404)
+            except OSError:
+                pass
         except OSError:
             # client went away mid-response; replying would raise again
             pass

@@ -86,6 +86,7 @@ class GcsServer(RpcServer):
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True)
         self._task_events: list[dict] = []           # bounded task event sink
+        self._pending_demand: dict[str, list] = {}   # node -> unmet demands
         self._max_task_events = 10000
 
     def start(self):
@@ -174,6 +175,10 @@ class GcsServer(RpcServer):
                 self._mark_node_dead(node_id, reason="heartbeat timeout")
 
     def _mark_node_dead(self, node_id: str, reason: str):
+        # a dead node's parked demand must not drive the
+        # autoscaler forever
+        with self._lock:
+            self._pending_demand.pop(node_id, None)
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
@@ -516,6 +521,21 @@ class GcsServer(RpcServer):
             if len(self._task_events) > self._max_task_events:
                 del self._task_events[:-self._max_task_events]
         return {"ok": True}
+
+    def rpc_report_demand(self, conn, send_lock, *, node_id, demands):
+        """Per-node unmet resource demand (reference:
+        GcsAutoscalerStateManager's cluster resource state feeding the
+        autoscaler)."""
+        with self._lock:
+            if demands:
+                self._pending_demand[node_id] = list(demands)
+            else:
+                self._pending_demand.pop(node_id, None)
+        return True
+
+    def rpc_get_pending_demand(self, conn, send_lock):
+        with self._lock:
+            return [d for ds in self._pending_demand.values() for d in ds]
 
     def rpc_get_task_events(self, conn, send_lock, *, limit=1000):
         with self._lock:

@@ -52,7 +52,8 @@ class WorkerHandle:
 class Raylet(RpcServer):
     def __init__(self, *, node_id: str, gcs_address, resources: dict,
                  store_capacity: int = 1 << 30, host: str = "127.0.0.1",
-                 labels: dict | None = None, heartbeat_interval_s: float = 0.5):
+                 labels: dict | None = None, heartbeat_interval_s: float = 0.5,
+                 infeasible_timeout_s: float = 10.0):
         super().__init__(host, 0)
         self.node_id = node_id
         self.gcs_address = tuple(gcs_address)
@@ -78,6 +79,10 @@ class Raylet(RpcServer):
         self._ready_cv = threading.Condition()
         self._hb_interval = heartbeat_interval_s
         self._threads: list[threading.Thread] = []
+        # cluster-wide infeasible tasks awaiting capacity (autoscaler)
+        self.infeasible_timeout_s = infeasible_timeout_s
+        self._infeasible: list = []
+        self._infeasible_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -91,11 +96,75 @@ class Raylet(RpcServer):
                 store_name=self.store_name, resources=self.total_resources,
                 labels=self.labels)
         for target in (self._dispatch_loop, self._heartbeat_loop,
-                       self._monitor_loop):
+                       self._monitor_loop, self._infeasible_loop):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
         return self
+
+    # ------------------------------------------------------------------
+    # infeasible-task parking (reference: ClusterTaskManager infeasible
+    # queue + GcsAutoscalerStateManager demand reporting)
+    # ------------------------------------------------------------------
+
+    def _park_infeasible(self, task: dict, demand: dict):
+        deadline = time.monotonic() + self.infeasible_timeout_s
+        with self._infeasible_lock:
+            self._infeasible.append((task, demand, deadline))
+            all_demands = [d for _, d, _ in self._infeasible]
+        try:
+            with self._gcs_lock:
+                # full parked set: a per-task report would overwrite
+                # siblings' demands in the GCS view
+                self._gcs.call("report_demand", node_id=self.node_id,
+                               demands=all_demands)
+        except Exception:  # noqa: BLE001 - advertising only
+            pass
+
+    def _infeasible_loop(self):
+        """Retry parked tasks as capacity appears (a new node registers);
+        error them when the grace window expires."""
+        while not self._stopping:
+            time.sleep(0.25)
+            with self._infeasible_lock:
+                parked, self._infeasible = self._infeasible, []
+            if not parked:
+                continue
+            still: list = []
+            now = time.monotonic()
+            demands_left = []
+            for task, demand, deadline in parked:
+                # this node's capacity is fixed; recovery means a NEW
+                # node registered and the GCS can now place the task
+                placed = False
+                try:
+                    with self._gcs_lock:
+                        target = self._gcs.call(
+                            "pick_node", demand=demand,
+                            exclude=[self.node_id])
+                    if target is not None and self._forward(
+                            task, target, 0):
+                        placed = True
+                except Exception:  # noqa: BLE001
+                    pass
+                if placed:
+                    continue
+                if now > deadline:
+                    self._store_task_error(task, ValueError(
+                        f"task {task.get('name')} demands {demand}: "
+                        f"infeasible (no node satisfied it within "
+                        f"{self.infeasible_timeout_s}s)"))
+                else:
+                    still.append((task, demand, deadline))
+                    demands_left.append(demand)
+            with self._infeasible_lock:
+                self._infeasible.extend(still)
+            try:
+                with self._gcs_lock:
+                    self._gcs.call("report_demand", node_id=self.node_id,
+                                   demands=demands_left)
+            except Exception:  # noqa: BLE001
+                pass
 
     def stop(self):
         super().stop()
@@ -287,9 +356,13 @@ class Raylet(RpcServer):
                 if self._forward(task, target, spill_count):
                     return {"ok": True, "node_id": target}
             if not _fits(demand, self.total_resources):
-                self._store_task_error(task, ValueError(
-                    f"task {task.get('name')} demands {demand}: infeasible"))
-                return {"ok": False, "reason": "infeasible"}
+                # Cluster-wide infeasible: PARK the task and advertise the
+                # unmet demand so the autoscaler can provision for it
+                # (reference: infeasible queue feeding
+                # GcsAutoscalerStateManager). Errors only after the grace
+                # window — a fixed cluster still fails fast enough.
+                self._park_infeasible(task, demand)
+                return {"ok": True, "parked": "infeasible"}
         elif spill_count < 2 and not _fits(demand, self._avail_snapshot()):
             # busy here: one spillback attempt through the GCS view
             with self._gcs_lock:

@@ -1,0 +1,27 @@
+"""Accelerator type constants (reference:
+``python/ray/util/accelerators/accelerators.py:9-11`` — TPU generations
+as schedulable resource labels, e.g.
+``@remote(resources={TPU_V5P: 1})``)."""
+
+TPU_V2 = "TPU-V2"
+TPU_V3 = "TPU-V3"
+TPU_V4 = "TPU-V4"
+TPU_V5E = "TPU-V5LITEPOD"
+TPU_V5P = "TPU-V5P"
+TPU_V6E = "TPU-V6E"
+
+ALL_TPU_TYPES = (TPU_V2, TPU_V3, TPU_V4, TPU_V5E, TPU_V5P, TPU_V6E)
+
+
+def tpu_generation_from_kind(device_kind: str) -> str | None:
+    """Map a JAX ``device_kind`` string to the resource label."""
+    kind = device_kind.lower()
+    for label in ALL_TPU_TYPES:
+        gen = label.split("-", 1)[1].lower()
+        if gen in kind.replace(" ", ""):
+            return label
+    if "v5 lite" in kind or "v5e" in kind:
+        return TPU_V5E
+    if "v6 lite" in kind or "v6e" in kind:
+        return TPU_V6E
+    return None

@@ -73,8 +73,8 @@ class Pool:
                 from ray_tpu.util.multiprocessing import _initialized_pools
 
                 if pool_id not in _initialized_pools:
+                    init(*initargs)  # a failed init is retried next task
                     _initialized_pools.add(pool_id)
-                    init(*initargs)
             return fn(*args, **kwargs)
 
         return ray_tpu.remote(run)

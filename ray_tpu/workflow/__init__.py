@@ -183,10 +183,15 @@ def event(name: str, *, poll_interval_s: float = 0.05,
 
         from ray_tpu.experimental import internal_kv_get
 
+        from ray_tpu.experimental import internal_kv_del
+
         deadline = _time.monotonic() + _timeout
         while _time.monotonic() < deadline:
             val = internal_kv_get(f"__wf_event_{_name}")
             if val is not None:
+                # consume-once: a stale payload must not instantly fire
+                # a later workflow reusing the event name
+                internal_kv_del(f"__wf_event_{_name}")
                 return val
             _time.sleep(_poll)
         raise TimeoutError(f"workflow event {_name!r} never fired")

@@ -129,3 +129,58 @@ def test_chaos_node_killer():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_autoscaler_provisions_for_infeasible_task():
+    """A task no existing node can EVER satisfy parks as pending demand;
+    the autoscaler sees the demand and provisions a node that fits it
+    (reference: autoscaler v2's demand-driven path)."""
+    import ray_tpu
+    from ray_tpu.autoscaler import LocalNodeProvider, StandardAutoscaler
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)  # too small for the task below
+    scaler = StandardAutoscaler(
+        cluster.gcs_address, LocalNodeProvider(cluster),
+        node_resources={"CPU": 4}, max_nodes=2,
+        poll_interval_s=0.2, idle_timeout_s=60).start()
+    try:
+        ray_tpu.shutdown()
+        ray_tpu.init(address=cluster.gcs_address)
+
+        @ray_tpu.remote(num_cpus=3)
+        def big():
+            return "ran"
+
+        # would be infeasible forever on the 1-CPU node; the autoscaler
+        # must provision the 4-CPU node within the grace window
+        assert ray_tpu.get(big.remote(), timeout=30) == "ran"
+        assert len(scaler.provider.non_terminated_nodes()) >= 1
+    finally:
+        scaler.stop()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_infeasible_task_errors_after_grace():
+    """Without an autoscaler, cluster-wide infeasible tasks still error
+    (after the grace window) rather than hanging forever."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1, infeasible_timeout_s=1.0)
+    try:
+        ray_tpu.shutdown()
+        ray_tpu.init(address=cluster.gcs_address)
+
+        @ray_tpu.remote(num_cpus=64)
+        def huge():
+            return 1
+
+        with pytest.raises(Exception, match="infeasible"):
+            ray_tpu.get(huge.remote(), timeout=20)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

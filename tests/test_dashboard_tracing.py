@@ -166,3 +166,49 @@ def test_cluster_timeline_has_events():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_jobs_rest_api(rt, tmp_path):
+    """Job submission through the dashboard REST surface (reference:
+    dashboard/modules/job/job_head.py)."""
+    import urllib.request as _rq
+
+    from ray_tpu.dashboard import Dashboard
+
+    dash = Dashboard(port=0).start()
+    try:
+        body = json.dumps({
+            "entrypoint": "python -c \"print('job-output-42')\"",
+        }).encode()
+        req = _rq.Request(dash.url + "/api/jobs", data=body,
+                          headers={"Content-Type": "application/json"})
+        with _rq.urlopen(req, timeout=30) as r:
+            job_id = json.loads(r.read())["job_id"]
+        assert job_id
+
+        import time as _time
+
+        deadline = _time.monotonic() + 30
+        status = None
+        while _time.monotonic() < deadline:
+            with _rq.urlopen(f"{dash.url}/api/jobs/{job_id}",
+                             timeout=10) as r:
+                status = json.loads(r.read())["status"]
+            if status in ("SUCCEEDED", "FAILED"):
+                break
+            _time.sleep(0.2)
+        assert status == "SUCCEEDED"
+        with _rq.urlopen(f"{dash.url}/api/jobs/{job_id}/logs",
+                         timeout=10) as r:
+            assert b"job-output-42" in r.read()
+    finally:
+        dash.stop()
+
+
+def test_accelerator_constants():
+    from ray_tpu.util import accelerators as acc
+
+    assert acc.TPU_V5P == "TPU-V5P"
+    assert acc.tpu_generation_from_kind("TPU v4") == "TPU-V4"
+    assert acc.tpu_generation_from_kind("TPU v5 lite") == "TPU-V5LITEPOD"
+    assert acc.tpu_generation_from_kind("H100") is None

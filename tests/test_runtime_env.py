@@ -213,14 +213,26 @@ def test_eviction_does_not_drain_warm_pool():
         ray_tpu.shutdown()
         ray_tpu.init(address=cluster.gcs_address)
 
+        import tempfile as _tf
+
+        barrier_dir = _tf.mkdtemp()
+
         @ray_tpu.remote
-        def plain(i):
-            _time.sleep(0.2)
+        def plain(i, bdir):
+            # filesystem barrier: both tasks must be in flight at once so
+            # the raylet provably spawns TWO workers (under load, quick
+            # sequential tasks can share one)
+            open(os.path.join(bdir, f"in{i}"), "w").close()
+            deadline = _time.monotonic() + 20
+            while len(os.listdir(bdir)) < 2:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError("barrier")
+                _time.sleep(0.01)
             return os.getpid()
 
         # warm two default-env workers (cap is reached)
-        pids = set(ray_tpu.get([plain.remote(i) for i in range(2)],
-                               timeout=30))
+        pids = set(ray_tpu.get(
+            [plain.remote(i, barrier_dir) for i in range(2)], timeout=30))
         assert len(pids) == 2
 
         @ray_tpu.remote(runtime_env={"env_vars": {"EVICT_T": "1"}})
