@@ -103,8 +103,15 @@ class StandardAutoscaler:
         # provider node is still booting (not yet registered in GCS):
         # the demand stays pending for the whole provision window, and
         # re-creating per poll would over-provision for one task.
-        alive = {n["node_id"]
-                 for n in self.gcs.call("get_nodes", alive_only=True)}
+        all_nodes = {n["node_id"]: n
+                     for n in self.gcs.call("get_nodes", alive_only=False)}
+        alive = {nid for nid, n in all_nodes.items() if n.get("alive")}
+        # reap provider nodes the GCS declared dead — left in place they
+        # count as "provisioning" forever and wedge demand-driven scaling
+        for nid in list(self.provider.non_terminated_nodes()):
+            if nid in all_nodes and not all_nodes[nid].get("alive"):
+                self.provider.terminate_node(nid)
+                self._idle_since.pop(nid, None)
         provisioning = [n for n in self.provider.non_terminated_nodes()
                         if n not in alive]
         if under_cap and not provisioning:

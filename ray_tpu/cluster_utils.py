@@ -61,7 +61,8 @@ class Cluster:
         if external:
             cfg = {"node_id": node_id, "gcs_address": list(self.gcs_address),
                    "resources": res, "store_capacity": store_capacity,
-                   "labels": labels}
+                   "labels": labels,
+                   "infeasible_timeout_s": infeasible_timeout_s}
             proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu.runtime.raylet",
                  json.dumps(cfg)],

@@ -64,8 +64,13 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/api/jobs":
                 from ray_tpu.job_submission import JobSubmissionClient
 
-                length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"{}")
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, TypeError):
+                    self._send_json({"error": "malformed request body"},
+                                    400)
+                    return
                 if "entrypoint" not in body:
                     self._send_json({"error": "entrypoint required"}, 400)
                     return

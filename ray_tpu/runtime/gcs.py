@@ -175,11 +175,10 @@ class GcsServer(RpcServer):
                 self._mark_node_dead(node_id, reason="heartbeat timeout")
 
     def _mark_node_dead(self, node_id: str, reason: str):
-        # a dead node's parked demand must not drive the
-        # autoscaler forever
         with self._lock:
+            # a dead node's parked demand must not drive the autoscaler
+            # forever
             self._pending_demand.pop(node_id, None)
-        with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
                 return
@@ -370,6 +369,20 @@ class GcsServer(RpcServer):
                 if n and n.alive and _fits(demand, n.resources):
                     return nid
             return None
+        # native hybrid policy (C++ fixed-point scoring —
+        # src/scheduler/scheduling.cc) when built; Python fallback below
+        # keeps source checkouts working without `make -C src`
+        from ray_tpu._private import scheduling as _sched
+
+        if _sched.available():
+            nodes = list(self._nodes.values())
+            return _sched.pick_node(
+                [n.node_id for n in nodes],
+                [n.resources for n in nodes],
+                [n.available for n in nodes],
+                [n.alive for n in nodes],
+                exclude or set(), demand,
+                spread_threshold=0.0, top_k=1)
         best, best_score = None, None
         feasible_busy = None
         for n in self._nodes.values():

@@ -356,6 +356,15 @@ class Raylet(RpcServer):
                 if self._forward(task, target, spill_count):
                     return {"ok": True, "node_id": target}
             if not _fits(demand, self.total_resources):
+                if strategy.get("pg_id") or                         strategy.get("kind") == "NODE_AFFINITY":
+                    # strategy-constrained tasks cannot be re-placed by
+                    # the plain-demand retry loop (it would escape the PG
+                    # reservation / ping-pong on affinity) — keep the
+                    # immediate infeasible error for them
+                    self._store_task_error(task, ValueError(
+                        f"task {task.get('name')} demands {demand}: "
+                        f"infeasible for its placement constraint"))
+                    return {"ok": False, "reason": "infeasible"}
                 # Cluster-wide infeasible: PARK the task and advertise the
                 # unmet demand so the autoscaler can provision for it
                 # (reference: infeasible queue feeding
@@ -735,6 +744,7 @@ def main():  # runs a raylet as a standalone process (cluster_utils spawns it)
         resources=cfg["resources"],
         store_capacity=cfg.get("store_capacity", 1 << 30),
         labels=cfg.get("labels"),
+        infeasible_timeout_s=cfg.get("infeasible_timeout_s", 10.0),
     )
     stop_ev = threading.Event()
     # graceful shutdown must run on SIGTERM too (Cluster.remove_node uses

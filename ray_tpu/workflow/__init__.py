@@ -170,6 +170,14 @@ def signal_event(name: str, payload=b"1") -> None:
     internal_kv_put(f"__wf_event_{name}", payload)
 
 
+def clear_event(name: str) -> None:
+    """Remove a fired event so its name can be reused without the new
+    waiter seeing the stale payload."""
+    from ray_tpu.experimental import internal_kv_del
+
+    internal_kv_del(f"__wf_event_{name}")
+
+
 def event(name: str, *, poll_interval_s: float = 0.05,
           timeout_s: float = 60.0) -> DAGNode:
     """A DAG node that completes when the named event fires; its value is
@@ -183,15 +191,13 @@ def event(name: str, *, poll_interval_s: float = 0.05,
 
         from ray_tpu.experimental import internal_kv_get
 
-        from ray_tpu.experimental import internal_kv_del
-
         deadline = _time.monotonic() + _timeout
         while _time.monotonic() < deadline:
             val = internal_kv_get(f"__wf_event_{_name}")
             if val is not None:
-                # consume-once: a stale payload must not instantly fire
-                # a later workflow reusing the event name
-                internal_kv_del(f"__wf_event_{_name}")
+                # BROADCAST semantics: the payload stays so every waiter
+                # (concurrent workflows, parallel event nodes) resumes;
+                # call clear_event() before reusing a name
                 return val
             _time.sleep(_poll)
         raise TimeoutError(f"workflow event {_name!r} never fired")
