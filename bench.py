@@ -30,20 +30,31 @@ def main():
 
     preset = os.environ.get("BENCH_PRESET", "base")
     steps = int(os.environ.get("BENCH_STEPS", "10"))
+    remat = os.environ.get("BENCH_REMAT")          # override: none|dots|full
+    batch_override = os.environ.get("BENCH_BATCH")
 
     n_dev = len(jax.devices())
     platform = jax.devices()[0].platform
 
     if preset == "small":
         model_cfg = llama.llama_tiny()
+        if remat:
+            from dataclasses import replace as _replace
+
+            model_cfg = _replace(model_cfg, remat=remat)
         batch, seq = 8, 128
     else:
         # ~0.5B-param Llama-style model: fits one v5e chip with Adam state.
         model_cfg = llama.LlamaConfig(
             vocab_size=32768, d_model=1536, n_layers=12, n_heads=12,
-            n_kv_heads=4, head_dim=128, d_ff=6144, remat="full",
+            n_kv_heads=4, head_dim=128, d_ff=6144,
+            # "dots" (recompute matmuls only) measured ~6% faster than
+            # "full" at this size on v5e; "none" OOMs with Adam state
+            remat=remat or "dots",
         )
         batch, seq = 8, 2048
+    if batch_override:
+        batch = int(batch_override)
 
     # Multi-chip: shard params/optimizer on an fsdp axis; single chip: dp.
     axis = "fsdp" if n_dev > 1 else "dp"

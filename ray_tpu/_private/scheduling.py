@@ -58,9 +58,10 @@ def pick_node(node_ids: list, totals: list[dict], avails: list[dict],
     lib = _load()
     if lib is None:
         raise RuntimeError("libtpusched.so not built")
+    # zero-valued demand keys still participate (they contribute node
+    # utilization, matching the Python policy); EMPTY demand means every
+    # alive node ties at score 0 -> first node, like the Python loop
     kinds = sorted(demand)
-    if not kinds:
-        kinds = ["CPU"]
     n, k = len(node_ids), len(kinds)
     t = np.zeros((n, k), np.float64)
     a = np.zeros((n, k), np.float64)
@@ -84,7 +85,7 @@ def score_nodes(totals: list[dict], avails: list[dict], alive: list[bool],
     lib = _load()
     if lib is None:
         raise RuntimeError("libtpusched.so not built")
-    kinds = sorted(demand) or ["CPU"]
+    kinds = sorted(demand)
     n, k = len(totals), len(kinds)
     t = np.zeros((n, k), np.float64)
     a = np.zeros((n, k), np.float64)

@@ -95,8 +95,6 @@ class StandardAutoscaler:
     def update(self):
         res = self.gcs.call("cluster_resources")
         total, avail = res["total"], res["available"]
-        under_cap = (len(self.provider.non_terminated_nodes())
-                     < self.max_nodes)
         # scale up (1): explicit unmet demand — tasks parked as
         # cluster-wide infeasible (reference: autoscaler v2's demand-
         # driven path from GcsAutoscalerStateManager). Skips while a
@@ -114,6 +112,10 @@ class StandardAutoscaler:
                 self._idle_since.pop(nid, None)
         provisioning = [n for n in self.provider.non_terminated_nodes()
                         if n not in alive]
+        # capacity AFTER the reap: the cycle that frees a dead node's
+        # slot must be able to provision its replacement immediately
+        under_cap = (len(self.provider.non_terminated_nodes())
+                     < self.max_nodes)
         if under_cap and not provisioning:
             try:
                 pending = self.gcs.call("get_pending_demand")

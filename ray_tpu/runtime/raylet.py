@@ -356,7 +356,8 @@ class Raylet(RpcServer):
                 if self._forward(task, target, spill_count):
                     return {"ok": True, "node_id": target}
             if not _fits(demand, self.total_resources):
-                if strategy.get("pg_id") or                         strategy.get("kind") == "NODE_AFFINITY":
+                if (strategy.get("pg_id")
+                        or strategy.get("kind") == "NODE_AFFINITY"):
                     # strategy-constrained tasks cannot be re-placed by
                     # the plain-demand retry loop (it would escape the PG
                     # reservation / ping-pong on affinity) — keep the

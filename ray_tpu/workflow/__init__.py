@@ -96,6 +96,13 @@ def run(dag: DAGNode, *, workflow_id: str,
         pickle.dump(final, f)
     os.replace(tmp, out_path)
     _mark(root, "SUCCESS")
+    # retire the events THIS workflow consumed so a later workflow
+    # reusing the name blocks for a fresh signal (broadcast within one
+    # run; no stale refire across runs)
+    for node in order:
+        ev = getattr(node._fn, "__wf_event_name__", None)
+        if ev is not None:
+            clear_event(ev)
     return final
 
 
@@ -203,6 +210,7 @@ def event(name: str, *, poll_interval_s: float = 0.05,
         raise TimeoutError(f"workflow event {_name!r} never fired")
 
     _wait.__name__ = f"event_{name}"
+    _wait.__wf_event_name__ = name
     return _Node(_wait, (), {})
 
 

@@ -17,8 +17,10 @@
 //   5. if nothing is available, fall back to the first feasible-but-busy
 //      node; else report infeasible (-1).
 //
-// Resources use fixed-point int64 micros internally (reference
-// FixedPoint) so repeated float arithmetic can't accumulate drift.
+// Resource QUANTITIES use fixed-point int64 micros (reference
+// FixedPoint) for exact feasibility comparisons; utilization RATIOS are
+// computed in double — a micros-scale multiply overflows int64 for
+// byte-denominated resources like memory (64e9 * 1e6 >> 2^63).
 // Exposed via C ABI for ctypes (no pybind11 in this image).
 
 #include <cstddef>
@@ -30,7 +32,15 @@ namespace {
 
 constexpr double kScale = 1e6;  // fixed-point micros
 
-int64_t fp(double x) { return static_cast<int64_t>(x * kScale + 0.5); }
+int64_t fp(double x) {
+  // clamp: 10TB-in-bytes scale quantities must not overflow the micros
+  // representation (comparisons remain correct at the clamp)
+  double scaled = x * kScale;
+  constexpr double kMax = 9.0e18;
+  if (scaled >= kMax) return static_cast<int64_t>(kMax);
+  if (scaled <= -kMax) return -static_cast<int64_t>(kMax);
+  return static_cast<int64_t>(scaled + 0.5);
+}
 
 }  // namespace
 
@@ -59,29 +69,32 @@ int sched_pick_node(const double* totals, const double* avails,
   };
   std::vector<Cand> cands;
   int feasible_busy = -1;
-  const int64_t thresh = fp(spread_threshold);
 
   for (int i = 0; i < n_nodes; i++) {
     if (!alive[i] || excluded[i]) continue;
     const double* tot = totals + static_cast<int64_t>(i) * n_kinds;
     const double* avl = avails + static_cast<int64_t>(i) * n_kinds;
     bool feasible = true, available = true;
-    int64_t crit = 0;  // max over kinds of (used + demand) / total
+    double crit = 0.0;  // max over kinds of (used + demand) / total
     for (int k = 0; k < n_kinds; k++) {
-      if (dem[k] <= 0) continue;
       int64_t t = fp(tot[k]);
       int64_t a = fp(avl[k]);
-      if (t < dem[k]) {
-        feasible = false;
-        break;
+      if (dem[k] > 0) {
+        if (t < dem[k]) {
+          feasible = false;
+          break;
+        }
+        if (a < dem[k]) available = false;
+      } else if (t <= 0) {
+        continue;  // kind absent on the node AND not demanded: ignore
       }
-      if (a < dem[k]) available = false;
-      int64_t used = t - a;
-      // utilization in micros: (used + demand) * 1e6 / total
-      int64_t util = (used + dem[k]) >= t
-                         ? static_cast<int64_t>(kScale)
-                         : ((used + dem[k]) * static_cast<int64_t>(kScale))
-                               / t;
+      // zero-demand kinds still contribute their utilization (matches
+      // the Python policy: a TPU-saturated node scores worse even for
+      // num_tpus=0 tasks)
+      double util = t <= 0 ? 0.0
+                           : static_cast<double>((t - a) + dem[k])
+                                 / static_cast<double>(t);
+      if (util > 1.0) util = 1.0;
       if (util > crit) crit = util;
     }
     if (!feasible) continue;
@@ -90,8 +103,8 @@ int sched_pick_node(const double* totals, const double* avails,
       continue;
     }
     // spread clamp: everything at or below the threshold ties
-    int64_t clamped = crit <= thresh ? thresh : crit;
-    cands.push_back({i, static_cast<double>(clamped)});
+    double clamped = crit <= spread_threshold ? spread_threshold : crit;
+    cands.push_back({i, clamped});
   }
 
   if (cands.empty()) return feasible_busy;
@@ -134,22 +147,21 @@ void sched_score_nodes(const double* totals, const double* avails,
     const double* tot = totals + static_cast<int64_t>(i) * n_kinds;
     const double* avl = avails + static_cast<int64_t>(i) * n_kinds;
     bool feasible = true;
-    int64_t crit = 0;
+    double crit = 0.0;
     for (int k = 0; k < n_kinds; k++) {
       int64_t d = fp(demand[k]);
-      if (d <= 0) continue;
       int64_t t = fp(tot[k]);
-      if (t < d) {
+      if (d > 0 && t < d) {
         feasible = false;
         break;
       }
-      int64_t used = t - fp(avl[k]);
-      int64_t util = (used + d) >= t
-                         ? static_cast<int64_t>(kScale)
-                         : ((used + d) * static_cast<int64_t>(kScale)) / t;
+      if (t <= 0) continue;
+      double util = static_cast<double>((t - fp(avl[k])) + d)
+                    / static_cast<double>(t);
+      if (util > 1.0) util = 1.0;
       if (util > crit) crit = util;
     }
-    if (feasible) scores_out[i] = static_cast<double>(crit) / kScale;
+    if (feasible) scores_out[i] = crit;
   }
 }
 

@@ -104,7 +104,9 @@ def test_matches_python_policy_randomized():
             specs.append((total, avail))
         demand = {"CPU": float(rng.integers(1, 4))}
         if rng.random() < 0.5:
-            demand["TPU"] = float(rng.integers(1, 3))
+            # include zero-valued demands: they must still contribute
+            # node utilization exactly like the Python policy
+            demand["TPU"] = float(rng.integers(0, 3))
         ids, totals, avails = _nodes(specs)
 
         class N:  # python policy's node view
@@ -129,6 +131,34 @@ def test_matches_python_policy_randomized():
         got = sched.pick_node(ids, totals, avails, [True] * n, set(),
                               demand, spread_threshold=0.0, top_k=1)
         assert got == expect, (specs, demand, got, expect)
+
+
+def test_byte_scale_resources_no_overflow():
+    """Memory advertised in bytes must not overflow the scorer (the
+    fixed-point multiply would wrap int64 above ~9.2e6 units)."""
+    ids, totals, avails = _nodes([
+        ({"memory": 64e9}, {"memory": 32e9}),
+        ({"memory": 64e9}, {"memory": 60e9}),
+    ])
+    scores = sched.score_nodes(totals, avails, [True, True],
+                               {"memory": 1e9})
+    assert abs(scores[0] - (32e9 + 1e9) / 64e9) < 1e-6
+    assert abs(scores[1] - (4e9 + 1e9) / 64e9) < 1e-6
+    out = sched.pick_node(ids, totals, avails, [True, True], set(),
+                          {"memory": 1e9})
+    assert out == "n1"
+
+
+def test_zero_demand_kind_scores_utilization():
+    """A num_tpus=0 task must avoid the TPU-saturated node (parity with
+    the Python policy, which scores zero-demand kinds too)."""
+    ids, totals, avails = _nodes([
+        ({"CPU": 8, "TPU": 4}, {"CPU": 8, "TPU": 0}),   # TPU util 1.0
+        ({"CPU": 8, "TPU": 4}, {"CPU": 2, "TPU": 4}),   # CPU util 0.875
+    ])
+    out = sched.pick_node(ids, totals, avails, [True, True], set(),
+                          {"CPU": 1, "TPU": 0})
+    assert out == "n1"
 
 
 def test_score_nodes():
