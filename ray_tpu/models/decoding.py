@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.models import llama
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.rope import apply_rope, rope_sin_cos
 
@@ -170,7 +171,7 @@ def cached_forward(cfg, params, tokens, cache: KVCache, *,
         block, x, (params["blocks"], cache.k, cache.v)
     )
     x = rms_norm(x, params["final_norm"], eps=cfg.rms_eps)
-    head = params["embedding"].T if cfg.tie_embeddings else params["lm_head"]
+    head = llama.lm_head_weights(cfg, params)
     if logits_mode == "last":
         x = x[:, -1, :]
     elif logits_mode == "index":

@@ -200,8 +200,21 @@ def forward(
     sp_axis: str = "sp",
 ):
     """Token ids -> logits [batch, seq, vocab] (fp32)."""
+    x = forward_hidden(cfg, params, tokens, positions=positions,
+                       segment_ids=segment_ids, attn_impl=attn_impl,
+                       mesh=mesh, sp_axis=sp_axis)
+    logits = jnp.einsum("bsd,dv->bsv", x, lm_head_weights(cfg, params),
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+def forward_hidden(cfg, params, tokens, *, positions=None,
+                   segment_ids=None, attn_impl="auto", mesh=None,
+                   sp_axis="sp"):
+    """Token ids -> final normalized hidden states [b, s, d] (the input
+    to the LM head). Split out so losses can fuse the head projection."""
     b, s = tokens.shape
-    x = params["embedding"][tokens]  # gather, [b, s, d]
+    x = params["embedding"][tokens]
     if positions is None:
         positions = jnp.arange(s, dtype=jnp.int32)[None, :]
     sin, cos = rope_sin_cos(positions, cfg.head_dim, theta=cfg.rope_theta)
@@ -212,19 +225,73 @@ def forward(
         body = jax.checkpoint(body)
     elif cfg.remat == "dots":
         body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+            body,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
         )
 
     def scan_fn(x, layer_params):
         return body(x, layer_params), None
 
     x, _ = lax.scan(scan_fn, x, params["blocks"])
+    return rms_norm(x, params["final_norm"], eps=cfg.rms_eps)
 
-    x = rms_norm(x, params["final_norm"], eps=cfg.rms_eps)
-    head = params["embedding"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bsd,dv->bsv", x, head,
-                        preferred_element_type=jnp.float32)
-    return logits
+
+def lm_head_weights(cfg, params):
+    """The LM head matrix [d, vocab] honoring tie_embeddings — the ONE
+    place tied-embedding semantics live."""
+    return (params["embedding"].T if cfg.tie_embeddings
+            else params["lm_head"])
+
+
+def fused_cross_entropy(cfg, params, hidden, targets, *, mask=None,
+                        chunk: int = 1024, z_loss: float = 0.0):
+    """CE loss WITHOUT materializing the full [b, s, vocab] fp32 logits
+    (2+ GB at 8x2048x32k): the LM-head matmul + logsumexp run per
+    sequence chunk inside a checkpointed scan, so peak memory is one
+    chunk of logits and the backward recomputes them. This is the
+    standard fused-softmax-xent trade: ~2x head FLOPs for ~vocab/chunk x
+    less logits HBM traffic.
+
+    hidden: [b, s, d] from forward_hidden; targets [b, s] int; mask
+    [b, s] in {0,1}.
+    """
+    head = lm_head_weights(cfg, params)
+    b, s, d = hidden.shape
+    n = b * s
+    xm = hidden.reshape(n, d)
+    tg = jnp.maximum(targets.reshape(n), 0)
+    # mask=None derives the mask from the -1 padding convention (same
+    # contract as the trainer's dense path) — silently averaging padding
+    # in as class-0 predictions would be a wrong loss with no error
+    mk = ((targets.reshape(n) >= 0).astype(jnp.float32) if mask is None
+          else mask.reshape(n).astype(jnp.float32))
+    # pad to a whole number of chunks (padding masked out)
+    pad = (-n) % chunk
+    if pad:
+        xm = jnp.concatenate([xm, jnp.zeros((pad, d), xm.dtype)])
+        tg = jnp.concatenate([tg, jnp.zeros((pad,), tg.dtype)])
+        mk = jnp.concatenate([mk, jnp.zeros((pad,), mk.dtype)])
+    n_chunks = (n + pad) // chunk
+    xc = xm.reshape(n_chunks, chunk, d)
+    tc = tg.reshape(n_chunks, chunk)
+    mc = mk.reshape(n_chunks, chunk)
+
+    def body(carry, inp):
+        x_i, t_i, m_i = inp
+        logits = jnp.einsum("cd,dv->cv", x_i, head,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(logits, t_i[:, None], axis=1).squeeze(-1)
+        nll = lse - tl
+        if z_loss > 0.0:
+            nll = nll + z_loss * jnp.square(lse)
+        total, count = carry
+        return (total + jnp.sum(nll * m_i), count + jnp.sum(m_i)), None
+
+    (total, count), _ = lax.scan(
+        jax.checkpoint(body), (jnp.float32(0.0), jnp.float32(0.0)),
+        (xc, tc, mc))
+    return total / jnp.maximum(count, 1.0)
 
 
 def cross_entropy_loss(logits, targets, *, mask=None, z_loss: float = 0.0):

@@ -156,6 +156,8 @@ def llama_forward_pipelined(
     x = out.reshape(b, s, x.shape[-1])
 
     x = rms_norm(x, params["final_norm"], eps=cfg.rms_eps)
-    head = params["embedding"].T if cfg.tie_embeddings else params["lm_head"]
+    from ray_tpu.models import llama
+
+    head = llama.lm_head_weights(cfg, params)
     return jnp.einsum("bsd,dv->bsv", x, head,
                       preferred_element_type=jnp.float32)

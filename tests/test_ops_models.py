@@ -225,3 +225,69 @@ def test_llama_sharded_forward_matches_unsharded(cpu_mesh_devices):
     np.testing.assert_allclose(got, want, rtol=0.1, atol=0.1)
     corr = np.corrcoef(got.ravel(), want.ravel())[0, 1]
     assert corr > 0.9999, corr
+
+
+def test_fused_cross_entropy_matches_dense():
+    """Chunked fused CE must match forward()+cross_entropy_loss exactly
+    (same math, different materialization), including value AND grads."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig(vocab_size=96, d_model=16, n_layers=1,
+                            n_heads=2, n_kv_heads=2, d_ff=32, head_dim=8,
+                            remat="none", dtype="float32")
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    batch = rng.integers(0, 96, size=(2, 33)).astype(np.int32)
+    inputs, targets = batch[:, :-1], batch[:, 1:]
+    mask = np.ones_like(targets, np.float32)
+    mask[:, -3:] = 0.0  # exercise masking
+
+    def dense_loss(p):
+        logits = llama.forward(cfg, p, inputs, attn_impl="reference")
+        return llama.cross_entropy_loss(
+            logits, jnp.maximum(jnp.asarray(targets), 0),
+            mask=jnp.asarray(mask))
+
+    def fused_loss(p):
+        hidden = llama.forward_hidden(cfg, p, inputs,
+                                      attn_impl="reference")
+        return llama.fused_cross_entropy(
+            cfg, p, hidden, jnp.asarray(targets), mask=jnp.asarray(mask),
+            chunk=16)  # 64 tokens -> 4 chunks (not divisible: 64/16 ok)
+
+    d_val, d_grad = jax.value_and_grad(dense_loss)(params)
+    f_val, f_grad = jax.value_and_grad(fused_loss)(params)
+    np.testing.assert_allclose(float(d_val), float(f_val), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+        d_grad, f_grad)
+
+
+def test_fused_cross_entropy_ragged_chunk():
+    """Token count not divisible by chunk: padding must not change the
+    masked mean."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig(vocab_size=64, d_model=8, n_layers=1,
+                            n_heads=1, n_kv_heads=1, d_ff=16, head_dim=8,
+                            remat="none", dtype="float32")
+    params = llama.init_params(cfg, jax.random.key(1))
+    rng = np.random.default_rng(1)
+    inputs = rng.integers(0, 64, size=(1, 10)).astype(np.int32)
+    targets = rng.integers(0, 64, size=(1, 10)).astype(np.int32)
+
+    hidden = llama.forward_hidden(cfg, params, inputs,
+                                  attn_impl="reference")
+    f = llama.fused_cross_entropy(cfg, params, hidden,
+                                  jnp.asarray(targets), chunk=4)  # 10 % 4 != 0
+    logits = llama.forward(cfg, params, inputs, attn_impl="reference")
+    d = llama.cross_entropy_loss(logits, jnp.asarray(targets))
+    np.testing.assert_allclose(float(f), float(d), rtol=1e-5)
