@@ -23,7 +23,7 @@ import cloudpickle
 from ray_tpu._private.shm_store import ShmObjectStore
 from ray_tpu.runtime import object_codec
 from ray_tpu.runtime.object_ref import ObjectRef
-from ray_tpu.runtime.rpc import RpcClient
+from ray_tpu.runtime.rpc import ConnectionLost, RpcClient
 from ray_tpu.runtime.task_spec import TaskSpec, TaskType
 from ray_tpu.utils import exceptions as exc
 from ray_tpu.utils.ids import ActorID, ObjectID, WorkerID
@@ -60,6 +60,9 @@ class ClusterRuntime:
         # permanent sequence gaps
         self._actor_send_locks: dict[str, threading.Lock] = {}
         self._named_cache: dict[str, str] = {}
+        # cached per-address actor-call clients (see _actor_client)
+        self._actor_clients: dict[tuple, RpcClient] = {}
+        self._actor_clients_lock = threading.Lock()
         self.metrics: dict[str, Any] = {}
 
     # ------------------------------------------------------------------
@@ -251,6 +254,25 @@ class ClusterRuntime:
         with send_lock:
             self._submit_actor_task_locked(spec, actor_hex)
 
+    def _actor_client(self, addr) -> RpcClient:
+        """Cached per-address client: a fresh socket + reader thread per
+        actor CALL is ruinous on polling paths (report buses poll at
+        50 Hz)."""
+        addr = tuple(addr)
+        with self._actor_clients_lock:
+            client = self._actor_clients.get(addr)
+            if client is not None and not client._closed:
+                return client
+            client = RpcClient(addr)
+            self._actor_clients[addr] = client
+            return client
+
+    def _drop_actor_client(self, addr):
+        with self._actor_clients_lock:
+            client = self._actor_clients.pop(tuple(addr), None)
+        if client is not None:
+            client.close()
+
     def _submit_actor_task_locked(self, spec: TaskSpec, actor_hex: str):
         task = {
             "task_id": spec.task_id.hex(),
@@ -273,13 +295,21 @@ class ClusterRuntime:
                     self._actor_seq[actor_hex] = seq + 1
                 task["seq"] = seq
                 task["incarnation"] = incarnation
-                client = RpcClient(addr)
+                client = self._actor_client(addr)
                 client.call("submit_actor_task", task=task)
-                client.close()
                 return
             except (exc.ActorDiedError, exc.ActorUnavailableError, OSError,
-                    LookupError) as e:
+                    ConnectionLost, LookupError) as e:
                 last_err = e
+                if isinstance(e, (OSError, ConnectionLost)):
+                    # transport failure: reconnect on retry. App-level
+                    # errors (actor died / incarnation mismatch) keep the
+                    # healthy shared connection — closing it would kill
+                    # OTHER actors' in-flight calls on this raylet.
+                    try:
+                        self._drop_actor_client(addr)
+                    except Exception:  # noqa: BLE001
+                        pass
                 # the seq was not consumed by the actor — roll it back so
                 # later calls don't leave a gap the actor waits on forever
                 with self._seq_lock:
@@ -323,6 +353,14 @@ class ClusterRuntime:
         return self._gcs.call("cluster_resources")["available"]
 
     def shutdown(self):
+        with self._actor_clients_lock:
+            clients = list(self._actor_clients.values())
+            self._actor_clients.clear()
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
         try:
             self._gcs.close()
             self._raylet.close()

@@ -154,9 +154,13 @@ class RpcClient:
         self._closed = False
 
     def _ensure_reader(self):
-        if not self._reader_started:
+        # guarded: a cached client is shared across threads, and two
+        # racing readers interleaving framed reads corrupt the stream
+        with self._pending_lock:
+            if self._reader_started:
+                return
             self._reader_started = True
-            threading.Thread(target=self._read_loop, daemon=True).start()
+        threading.Thread(target=self._read_loop, daemon=True).start()
 
     def _read_loop(self):
         while not self._closed:
@@ -202,6 +206,13 @@ class RpcClient:
 
     def close(self):
         self._closed = True
+        try:
+            # shutdown() WAKES a reader thread blocked in recv();
+            # close() alone leaves it blocked forever (the classic
+            # transient-client thread leak)
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

@@ -17,7 +17,9 @@ from ray_tpu.train.data_parallel_trainer import (
     JaxMeshTrainer,
     Result,
 )
+from ray_tpu.train.elastic import ElasticTrainer
 from ray_tpu.train.session import get_checkpoint_dir, get_context, report
+from ray_tpu.train.torch import TorchConfig, TorchTrainer
 from ray_tpu.train.trainer import JaxTrainer, TrainConfig
 from ray_tpu.train.worker_group import BackendExecutor, WorkerGroup
 
@@ -25,12 +27,15 @@ __all__ = [
     "BackendExecutor",
     "CheckpointConfig",
     "DataParallelTrainer",
+    "ElasticTrainer",
     "FailureConfig",
     "JaxMeshTrainer",
     "JaxTrainer",
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "TorchConfig",
+    "TorchTrainer",
     "TrainConfig",
     "WorkerGroup",
     "get_checkpoint_dir",

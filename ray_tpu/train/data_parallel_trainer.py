@@ -128,6 +128,29 @@ class DataParallelTrainer:
                     if rep.get("checkpoint") and rep["rank"] == 0:
                         manager.add(rep["checkpoint"], rep["metrics"])
                 if not done:
+                    # A rank that dies BEFORE reaching the session (e.g.
+                    # its train_fn fails to even deserialize) never posts
+                    # mark_done — detect finished task refs so fit()
+                    # surfaces the error instead of polling forever. One
+                    # final drain below still consumes reports that
+                    # landed after this poll; the post-loop get()
+                    # surfaces the task error.
+                    finished, _ = ray_tpu.wait(
+                        run_refs, num_returns=len(run_refs), timeout=0)
+                    if len(finished) == len(run_refs):
+                        reports, _ = executor.poll_reports()
+                        for rep in reports:
+                            if "error" in rep:
+                                result.error = rep["error"]
+                                continue
+                            if rep["rank"] == 0:
+                                result.metrics = rep["metrics"]
+                                result.metrics_history.append(
+                                    rep["metrics"])
+                            if rep.get("checkpoint") and rep["rank"] == 0:
+                                manager.add(rep["checkpoint"],
+                                            rep["metrics"])
+                        break
                     time.sleep(0.02)
             # surface worker exceptions not routed through the bus
             try:

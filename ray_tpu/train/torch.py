@@ -1,0 +1,117 @@
+"""TorchTrainer: torch.distributed (gloo) data-parallel training.
+
+Reference analog: ``train/torch/torch_trainer.py:14`` +
+``train/torch/config.py:23,149`` (``_setup_torch_process_group:63``) and
+``train/torch/train_loop_utils.py:74,116`` (``prepare_model`` /
+``prepare_data_loader``). The TPU-native flagship is JaxTrainer (the
+device plane is XLA, not NCCL); this exists for capability parity — CPU
+torch models train data-parallel across rank-actor processes with the
+same ``train_loop_per_worker`` + ``session.report`` surface.
+
+Process-group rendezvous uses a file:// store in the trial directory
+(ranks share a filesystem; the reference uses rank-0's TCP address).
+Requires real process workers — i.e. a cluster runtime; with the
+in-process local runtime use world_size=1.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train import session
+
+
+@dataclass
+class TorchConfig:
+    backend: str = "gloo"      # CPU image: no nccl
+    init_timeout_s: float = 60.0
+
+
+def _wrap_torch(train_fn, torch_config: TorchConfig):
+    """Boot/teardown the torch process group around the user loop
+    (reference: _TorchBackend.on_start -> _setup_torch_process_group)."""
+
+    def torch_loop(config):
+        import torch.distributed as dist
+
+        ctx = session.get_context()
+        world = ctx.get_world_size()
+        if world > 1:
+            # containers often lack resolvable hostnames; loopback works
+            # for single-host rank processes (multi-host: set explicitly)
+            os.environ.setdefault("GLOO_SOCKET_IFNAME", "lo")
+            # per-ATTEMPT store: the file must be fresh for each
+            # process group (a stale store from a finished group wedges
+            # re-initialization on retries)
+            store_path = os.path.join(ctx.get_trial_dir(),
+                                      "torch_pg_store")
+            from datetime import timedelta
+
+            dist.init_process_group(
+                backend=torch_config.backend,
+                init_method=f"file://{store_path}",
+                rank=ctx.get_world_rank(), world_size=world,
+                timeout=timedelta(
+                    seconds=torch_config.init_timeout_s),
+            )
+        try:
+            return train_fn(config)
+        finally:
+            if world > 1 and dist.is_initialized():
+                dist.destroy_process_group()
+
+    return torch_loop
+
+
+class TorchTrainer(DataParallelTrainer):
+    def __init__(self, train_loop_per_worker, *,
+                 train_loop_config: dict | None = None,
+                 torch_config: TorchConfig | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 datasets: dict | None = None):
+        super().__init__(
+            _wrap_torch(train_loop_per_worker,
+                        torch_config or TorchConfig()),
+            train_loop_config=train_loop_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+        )
+
+
+def prepare_model(model):
+    """DDP-wrap when a process group is live (reference:
+    train_loop_utils.py:74)."""
+    import torch.distributed as dist
+
+    if dist.is_available() and dist.is_initialized() and \
+            dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Re-build a DataLoader with a DistributedSampler so each rank sees
+    its shard (reference: train_loop_utils.py:116)."""
+    import torch.distributed as dist
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return data_loader
+    sampler = DistributedSampler(data_loader.dataset)
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=0,
+        collate_fn=data_loader.collate_fn,
+        drop_last=data_loader.drop_last,
+    )
