@@ -263,9 +263,16 @@ class ClusterRuntime:
             client = self._actor_clients.get(addr)
             if client is not None and not client._closed:
                 return client
-            client = RpcClient(addr)
-            self._actor_clients[addr] = client
-            return client
+        # connect OUTSIDE the lock: one unreachable raylet (30s connect
+        # timeout) must not stall submissions to every other node
+        fresh = RpcClient(addr)
+        with self._actor_clients_lock:
+            client = self._actor_clients.get(addr)
+            if client is not None and not client._closed:
+                fresh.close()  # lost the race; reuse the winner
+                return client
+            self._actor_clients[addr] = fresh
+            return fresh
 
     def _drop_actor_client(self, addr):
         with self._actor_clients_lock:
@@ -285,8 +292,10 @@ class ClusterRuntime:
             "trace_ctx": spec.trace_ctx,
         }
         last_err: BaseException | None = None
+        addr_used = None  # the raylet whose CONNECTION actually failed
         for attempt in range(2):
             try:
+                addr_used = None
                 addr, incarnation = self._actor_location(actor_hex)
                 # seq is assigned per send attempt so a reset (new
                 # incarnation) renumbers this task too
@@ -295,19 +304,21 @@ class ClusterRuntime:
                     self._actor_seq[actor_hex] = seq + 1
                 task["seq"] = seq
                 task["incarnation"] = incarnation
+                addr_used = tuple(addr)
                 client = self._actor_client(addr)
                 client.call("submit_actor_task", task=task)
                 return
             except (exc.ActorDiedError, exc.ActorUnavailableError, OSError,
                     ConnectionLost, LookupError) as e:
                 last_err = e
-                if isinstance(e, (OSError, ConnectionLost)):
-                    # transport failure: reconnect on retry. App-level
-                    # errors (actor died / incarnation mismatch) keep the
-                    # healthy shared connection — closing it would kill
-                    # OTHER actors' in-flight calls on this raylet.
+                if isinstance(e, (OSError, ConnectionLost)) and                         addr_used is not None:
+                    # transport failure ON THE RAYLET LINK: reconnect on
+                    # retry. App-level errors (actor died / incarnation
+                    # mismatch) and GCS-side failures keep the healthy
+                    # shared raylet connection — closing it would kill
+                    # OTHER actors' in-flight calls on that node.
                     try:
-                        self._drop_actor_client(addr)
+                        self._drop_actor_client(addr_used)
                     except Exception:  # noqa: BLE001
                         pass
                 # the seq was not consumed by the actor — roll it back so

@@ -590,7 +590,28 @@ def _critical_utilization(demand: dict, node: NodeInfo) -> float:
 
 
 def _place_bundles(bundles: list, strategy: str, nodes: list):
-    """Greedy bundle placement. Returns node_id per bundle or None."""
+    """Greedy bundle placement. Returns node_id per bundle or None.
+
+    ``SLICE_PACK`` (TPU twist, SURVEY §7): every bundle must land within
+    ONE TPU slice (nodes sharing a ``tpu_slice`` label) so the group's
+    collectives ride ICI, not DCN — wrong placement silently halves
+    collective bandwidth. Slices are tried in descending free-TPU order;
+    no single slice fitting ⇒ infeasible (strict by design)."""
+    if strategy == "SLICE_PACK":
+        slices: dict[str, list] = {}
+        for n in nodes:
+            key = n.labels.get("tpu_slice", f"__solo_{n.node_id}")
+            slices.setdefault(key, []).append(n)
+
+        def free_tpu(slice_nodes):
+            return sum(n.available.get("TPU", 0.0) for n in slice_nodes)
+
+        for _, slice_nodes in sorted(slices.items(),
+                                     key=lambda kv: -free_tpu(kv[1])):
+            res = _place_bundles(bundles, "PACK", slice_nodes)
+            if res is not None:
+                return res
+        return None
     avail = {n.node_id: dict(n.available) for n in nodes}
     order = sorted(avail, key=lambda nid: -sum(avail[nid].values()))
     assignment = []
@@ -615,9 +636,16 @@ def _place_bundles(bundles: list, strategy: str, nodes: list):
     used_nodes: set[str] = set()
     for b in bundles:
         placed = None
-        # spread: prefer unused nodes; pack fallback: any feasible
-        candidates = ([nid for nid in order if nid not in used_nodes]
-                      + [nid for nid in order if nid in used_nodes])
+        if strategy == "PACK":
+            # pack: fill nodes already in use before opening new ones —
+            # preferring fresh nodes here fragments capacity and can
+            # make a feasible packing spuriously infeasible
+            candidates = ([nid for nid in order if nid in used_nodes]
+                          + [nid for nid in order if nid not in used_nodes])
+        else:
+            # spread: prefer unused nodes; fall back to reuse
+            candidates = ([nid for nid in order if nid not in used_nodes]
+                          + [nid for nid in order if nid in used_nodes])
         if strategy == "STRICT_SPREAD":
             candidates = [nid for nid in order if nid not in used_nodes]
         for nid in candidates:

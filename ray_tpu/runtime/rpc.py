@@ -184,10 +184,14 @@ class RpcClient:
                 ev_reply[0].set()
 
     def call(self, method: str, timeout: float | None = None, **kwargs):
-        if self._closed:
-            raise ConnectionLost(f"client to {self.address} closed")
         self._ensure_reader()
         with self._pending_lock:
+            # _closed must be re-checked INSIDE the lock: the reader's
+            # failure path drains _pending and sets _closed under this
+            # lock, and an entry registered after that drain would never
+            # be completed (permanent hang for timeout=None callers)
+            if self._closed:
+                raise ConnectionLost(f"client to {self.address} closed")
             msg_id = self._next_id
             self._next_id += 1
             ev_reply = [threading.Event(), None]

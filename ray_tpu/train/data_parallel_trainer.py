@@ -112,44 +112,36 @@ class DataParallelTrainer:
             # each rank picks its shard by rank index inside the worker
             config["_dataset_splits"] = splits
         result = Result()
+
+        def consume(reports):
+            for rep in reports:
+                if "error" in rep:
+                    result.error = rep["error"]
+                    continue
+                if rep["rank"] == 0:
+                    result.metrics = rep["metrics"]
+                    result.metrics_history.append(rep["metrics"])
+                if rep.get("checkpoint") and rep["rank"] == 0:
+                    manager.add(rep["checkpoint"], rep["metrics"])
+
         try:
             run_refs = executor.start_training(
                 _wrap_with_shard(self.train_fn), config, trial_dir)
             done = False
             while not done:
                 reports, done = executor.poll_reports()
-                for rep in reports:
-                    if "error" in rep:
-                        result.error = rep["error"]
-                        continue
-                    if rep["rank"] == 0:
-                        result.metrics = rep["metrics"]
-                        result.metrics_history.append(rep["metrics"])
-                    if rep.get("checkpoint") and rep["rank"] == 0:
-                        manager.add(rep["checkpoint"], rep["metrics"])
+                consume(reports)
                 if not done:
                     # A rank that dies BEFORE reaching the session (e.g.
                     # its train_fn fails to even deserialize) never posts
                     # mark_done — detect finished task refs so fit()
-                    # surfaces the error instead of polling forever. One
-                    # final drain below still consumes reports that
-                    # landed after this poll; the post-loop get()
-                    # surfaces the task error.
+                    # surfaces the error instead of polling forever; one
+                    # final drain catches late reports and the post-loop
+                    # get() surfaces the task error.
                     finished, _ = ray_tpu.wait(
                         run_refs, num_returns=len(run_refs), timeout=0)
                     if len(finished) == len(run_refs):
-                        reports, _ = executor.poll_reports()
-                        for rep in reports:
-                            if "error" in rep:
-                                result.error = rep["error"]
-                                continue
-                            if rep["rank"] == 0:
-                                result.metrics = rep["metrics"]
-                                result.metrics_history.append(
-                                    rep["metrics"])
-                            if rep.get("checkpoint") and rep["rank"] == 0:
-                                manager.add(rep["checkpoint"],
-                                            rep["metrics"])
+                        consume(executor.poll_reports()[0])
                         break
                     time.sleep(0.02)
             # surface worker exceptions not routed through the bus

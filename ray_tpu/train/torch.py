@@ -96,22 +96,50 @@ def prepare_model(model):
     return model
 
 
+class _EpochedLoader:
+    """Iterating advances the DistributedSampler epoch so shuffled
+    shards re-permute each epoch (reference hooks set_epoch the same
+    way)."""
+
+    def __init__(self, loader, sampler):
+        self._loader = loader
+        self._sampler = sampler
+        self._epoch = 0
+
+    def __iter__(self):
+        self._sampler.set_epoch(self._epoch)
+        self._epoch += 1
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
+
+
 def prepare_data_loader(data_loader):
     """Re-build a DataLoader with a DistributedSampler so each rank sees
-    its shard (reference: train_loop_utils.py:116)."""
+    its shard (reference: train_loop_utils.py:116). The original
+    loader's shuffle setting is preserved (a sequential eval loader must
+    NOT come back shuffled+padded with reordered predictions), and
+    shuffled loaders re-permute per epoch via set_epoch."""
     import torch.distributed as dist
-    from torch.utils.data import DataLoader
+    from torch.utils.data import DataLoader, RandomSampler
     from torch.utils.data.distributed import DistributedSampler
 
     if not (dist.is_available() and dist.is_initialized()
             and dist.get_world_size() > 1):
         return data_loader
-    sampler = DistributedSampler(data_loader.dataset)
-    return DataLoader(
+    shuffled = isinstance(data_loader.sampler, RandomSampler)
+    sampler = DistributedSampler(data_loader.dataset, shuffle=shuffled)
+    loader = DataLoader(
         data_loader.dataset,
         batch_size=data_loader.batch_size,
         sampler=sampler,
-        num_workers=0,
+        num_workers=data_loader.num_workers,
+        pin_memory=data_loader.pin_memory,
         collate_fn=data_loader.collate_fn,
         drop_last=data_loader.drop_last,
     )
+    return _EpochedLoader(loader, sampler)

@@ -184,3 +184,52 @@ def test_infeasible_task_errors_after_grace():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_slice_pack_placement_group():
+    """SLICE_PACK confines all bundles to one TPU slice (ICI locality —
+    SURVEY §7 TPU twist); cross-slice placement would silently halve
+    collective bandwidth, so no-fitting-slice is strictly infeasible."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.placement_group import (placement_group,
+                                              placement_group_table)
+
+    cluster = Cluster()
+    # slice A: 2 nodes x 4 TPU; slice B: 2 nodes x 2 TPU
+    a1 = cluster.add_node(num_cpus=2, num_tpus=4,
+                          labels={"tpu_slice": "slice-a"})
+    a2 = cluster.add_node(num_cpus=2, num_tpus=4,
+                          labels={"tpu_slice": "slice-a"})
+    b1 = cluster.add_node(num_cpus=2, num_tpus=2,
+                          labels={"tpu_slice": "slice-b"})
+    b2 = cluster.add_node(num_cpus=2, num_tpus=2,
+                          labels={"tpu_slice": "slice-b"})
+    slice_a = {a1.node_id, a2.node_id}
+    slice_b = {b1.node_id, b2.node_id}
+    try:
+        ray_tpu.shutdown()
+        ray_tpu.init(address=cluster.gcs_address)
+
+        # two 3-TPU bundles: only slice A nodes can host them
+        pg = placement_group([{"TPU": 3}, {"TPU": 3}],
+                             strategy="SLICE_PACK")
+        assert pg.ready(timeout=15)
+        info = placement_group_table(pg)
+        nodes = set(info["bundle_nodes"])
+        assert nodes <= slice_a, (nodes, slice_a)
+
+        # 4 more TPUs fit only slice B now (A has 2 left after pg)
+        pg2 = placement_group([{"TPU": 1}, {"TPU": 1}, {"TPU": 2}],
+                              strategy="SLICE_PACK")
+        assert pg2.ready(timeout=15)
+        nodes2 = set(placement_group_table(pg2)["bundle_nodes"])
+        assert nodes2 <= slice_b, (nodes2, slice_b)
+
+        # no single slice can host 5+5 TPU -> strictly infeasible
+        pg3 = placement_group([{"TPU": 5}, {"TPU": 5}],
+                              strategy="SLICE_PACK")
+        assert not pg3.ready(timeout=3)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
