@@ -32,6 +32,7 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     remat = os.environ.get("BENCH_REMAT")          # override: none|dots|full
     batch_override = os.environ.get("BENCH_BATCH")
+    fused = os.environ.get("BENCH_FUSED")          # "1" forces fused CE loss
 
     n_dev = len(jax.devices())
     platform = jax.devices()[0].platform
@@ -63,6 +64,7 @@ def main():
         TrainConfig(
             mesh_axes={axis: n_dev}, strategy="fsdp" if n_dev > 1 else "dp",
             warmup_steps=10, total_steps=1000,
+            fused_loss=bool(fused and fused != "0"),
         ),
         mesh=create_mesh({axis: n_dev}),
     )

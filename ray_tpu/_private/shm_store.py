@@ -82,6 +82,8 @@ def _load():
                                         ctypes.POINTER(u64)]
     lib.store_seal.restype = ctypes.c_int
     lib.store_seal.argtypes = [p, ctypes.c_char_p]
+    lib.store_seal_hold.restype = ctypes.c_int
+    lib.store_seal_hold.argtypes = [p, ctypes.c_char_p]
     lib.store_get.restype = ctypes.c_int
     lib.store_get.argtypes = [p, ctypes.c_char_p, ctypes.c_int64,
                               ctypes.POINTER(u64), ctypes.POINTER(u64),
@@ -96,6 +98,8 @@ def _load():
     lib.store_evict_orphans.argtypes = [p, u64]
     lib.store_release_pid.restype = ctypes.c_int
     lib.store_release_pid.argtypes = [p, u64]
+    lib.store_spill_candidates.restype = ctypes.c_int
+    lib.store_spill_candidates.argtypes = [p, u64, ctypes.c_char_p, u64, u64]
     lib.store_stats.argtypes = [p, ctypes.POINTER(u64 * 6)]
     return lib
 
@@ -203,9 +207,13 @@ class ShmObjectStore:
         buf[:] = data
         self.seal(object_id)
 
-    def seal(self, object_id: bytes) -> None:
-        _check(self._lib.store_seal(self._h, _key(object_id)),
-               f"seal {object_id.hex()}")
+    def seal(self, object_id: bytes, hold: bool = False) -> None:
+        """Seal a created object. ``hold=True`` converts the writer's ref
+        into a tracked read ref instead of dropping it — the object is
+        never evictable between seal and the node manager's pin; the
+        caller must ``release`` after reporting it."""
+        fn = self._lib.store_seal_hold if hold else self._lib.store_seal
+        _check(fn(self._h, _key(object_id)), f"seal {object_id.hex()}")
 
     def get(self, object_id: bytes, timeout_ms: int = -1) -> memoryview:
         """Read-only zero-copy view of the data section (bumps refcount)."""
@@ -224,6 +232,11 @@ class ShmObjectStore:
     def delete(self, object_id: bytes) -> bool:
         return self._lib.store_delete(self._h, _key(object_id)) == TS_OK
 
+    def try_delete(self, object_id: bytes) -> int:
+        """Raw delete status: TS_OK, TS_NOT_FOUND (already gone), or
+        TS_ERR (still referenced) — spill needs the distinction."""
+        return self._lib.store_delete(self._h, _key(object_id))
+
     def contains(self, object_id: bytes) -> bool:
         return bool(self._lib.store_contains(self._h, _key(object_id)))
 
@@ -234,6 +247,33 @@ class ShmObjectStore:
     def release_pid(self, pid: int) -> int:
         """Drop all read refs held by a dead process (crash cleanup)."""
         return self._lib.store_release_pid(self._h, pid)
+
+    def spill_candidates(self, target_bytes: int, max_out: int = 512,
+                         pin_pid: int = 0) -> list[bytes]:
+        """LRU-ordered sealed object ids totaling ``target_bytes`` of
+        payload whose only refs are ``pin_pid``'s pin (0 = unreferenced
+        entries) — the node manager's spill-victim query."""
+        buf = ctypes.create_string_buffer(max_out * ID_LEN)
+        n = self._lib.store_spill_candidates(
+            self._h, target_bytes, buf, max_out, pin_pid)
+        raw = buf.raw
+        return [raw[i * ID_LEN:(i + 1) * ID_LEN] for i in range(max(n, 0))]
+
+    def pin(self, object_id: bytes) -> bool:
+        """Hold a read ref WITHOUT mapping a view (the node manager's
+        primary-copy pin — reference: raylet pinning via
+        ``PinObjectIDs``; pinned objects are never LRU-evicted, only
+        spilled). Returns False if the object is not sealed yet."""
+        off = ctypes.c_uint64()
+        dsz = ctypes.c_uint64()
+        msz = ctypes.c_uint64()
+        rc = self._lib.store_get(self._h, _key(object_id), -1,
+                                 ctypes.byref(off), ctypes.byref(dsz),
+                                 ctypes.byref(msz))
+        return rc == TS_OK
+
+    def unpin(self, object_id: bytes) -> None:
+        self.release(object_id)
 
     def stats(self) -> dict:
         out = (ctypes.c_uint64 * 6)()

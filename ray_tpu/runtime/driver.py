@@ -71,9 +71,18 @@ class ClusterRuntime:
 
     def put(self, value) -> ObjectRef:
         oid = ObjectID.from_random()
-        size = object_codec.put_value(self.store, oid.binary(), value)
-        self._gcs.call("add_object_location", oid=oid.hex(),
-                       node_id=self.node_id, size=size)
+        # hold=True: the sealed object keeps a read ref until the raylet
+        # has pinned the primary copy — never a window where LRU eviction
+        # can destroy the sole copy
+        size = object_codec.put_value_durable(
+            self.store, oid.binary(), value, hold=True,
+            request_space=lambda n: self._raylet.call("request_space",
+                                                      nbytes=n))
+        try:
+            self._raylet.call("report_object", oid=oid.hex(), size=size)
+        finally:
+            if size > 0:
+                self.store.release(oid.binary())
         return ObjectRef(oid)
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None):

@@ -89,6 +89,52 @@ def put_value(store, object_id: bytes, value, *, is_error: bool = False) -> int:
     return size
 
 
+def put_value_durable(store, object_id: bytes, value, *,
+                      is_error: bool = False, request_space=None,
+                      timeout_s: float = 30.0, hold: bool = False) -> int:
+    """``put_value`` with memory-pressure backoff: when the store is full,
+    ask the node manager to make room (synchronous spill of pinned-idle
+    objects — ``request_space`` callable takes the needed byte count) and
+    retry until the deadline (reference: plasma ``CreateRequestQueue``
+    retrying creates while ``LocalObjectManager`` spills). The value is
+    serialized ONCE, outside the retry loop.
+
+    ``hold=True`` seals with a kept read ref (see ``ShmObjectStore.seal``)
+    so the object cannot be evicted before the caller reports it to the
+    node manager for pinning; the caller must ``store.release`` it after.
+    """
+    import time as _time
+
+    from ray_tpu._private.shm_store import ObjectExistsError, StoreFullError
+
+    obj = serialize(value)
+    size = encoded_size(obj)
+    deadline = _time.monotonic() + timeout_s
+    delay = 0.02
+    while True:
+        try:
+            buf = store.create(object_id, size)
+        except ObjectExistsError:
+            return 0  # first write wins (see put_value)
+        except StoreFullError:
+            if _time.monotonic() >= deadline:
+                raise
+            if request_space is not None:
+                try:
+                    request_space(size)
+                except Exception:  # noqa: BLE001 - raylet busy; retry anyway
+                    pass
+            _time.sleep(delay)
+            delay = min(delay * 2, 0.5)
+            continue
+        try:
+            encode_into(buf, obj, is_error=is_error)
+        finally:
+            del buf
+        store.seal(object_id, hold=hold)
+        return size
+
+
 def get_value(store, object_id: bytes, timeout_ms: int = -1):
     """Read + deserialize. Returns (value, is_error).
 
@@ -113,11 +159,13 @@ def raw_bytes(store, object_id: bytes, timeout_ms: int = -1) -> bytes:
         store.release(object_id)
 
 
-def put_raw(store, object_id: bytes, payload: bytes):
-    """Write pre-encoded bytes (receiving side of a transfer)."""
+def put_raw(store, object_id: bytes, payload: bytes, *,
+            hold: bool = False):
+    """Write pre-encoded bytes (receiving side of a transfer).
+    ``hold=True`` keeps a read ref through the seal (caller releases)."""
     buf = store.create(object_id, len(payload))
     try:
         buf[:] = payload
     finally:
         del buf
-    store.seal(object_id)
+    store.seal(object_id, hold=hold)

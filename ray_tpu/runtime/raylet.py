@@ -17,8 +17,10 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from collections import deque
@@ -29,7 +31,7 @@ from ray_tpu._private.shm_store import ObjectNotFoundError, ShmObjectStore
 from ray_tpu.runtime import object_codec
 from ray_tpu.runtime.gcs import _fits
 from ray_tpu.runtime.rpc import RpcClient, RpcServer, recv_msg, send_msg
-from ray_tpu.utils.ids import WorkerID
+from ray_tpu.utils.ids import ObjectID, WorkerID
 
 
 @dataclass
@@ -79,6 +81,34 @@ class Raylet(RpcServer):
         self._ready_cv = threading.Condition()
         self._hb_interval = heartbeat_interval_s
         self._threads: list[threading.Thread] = []
+        # --- object spilling (reference: LocalObjectManager::SpillObjects
+        # local_object_manager.h:110 + external_storage.py FileSystemStorage).
+        # Spilled objects leave shm for files in _spill_dir; the GCS
+        # location entry stays (this node can still serve them), and any
+        # local read restores them into shm first.
+        from ray_tpu.utils.config import get_config
+        _cfg = get_config()
+        self._spill_enabled = _cfg.object_spilling_enabled
+        self._spill_high = _cfg.object_spilling_high_fraction
+        self._spill_low = _cfg.object_spilling_low_fraction
+        # always a per-raylet SUBdirectory: stop() removes the whole dir,
+        # and a shared configured path must not nuke other raylets' files
+        _spill_base = (_cfg.object_spilling_directory
+                       or tempfile.gettempdir())
+        self._spill_dir = os.path.join(
+            _spill_base, f"raytpu_spill_{os.getpid()}_{node_id[:8]}")
+        self._spilled: dict[str, str] = {}   # oid hex -> file path
+        self._spill_lock = threading.Lock()
+        self.spill_stats = {"num_spilled": 0, "bytes_spilled": 0,
+                            "num_restored": 0, "bytes_restored": 0}
+        # Primary-copy pins: every object CREATED on this node is pinned
+        # (one raylet-held read ref) so the store's LRU eviction can never
+        # destroy the sole copy — memory is reclaimed by SPILLING pinned
+        # objects instead (reference: raylet PinObjectIDs + spill-only
+        # reclamation of primaries; secondary/pulled copies stay
+        # unpinned and evictable).
+        self._pinned: set[str] = set()
+        self._pin_lock = threading.Lock()
         # cluster-wide infeasible tasks awaiting capacity (autoscaler)
         self.infeasible_timeout_s = infeasible_timeout_s
         self._infeasible: list = []
@@ -95,8 +125,11 @@ class Raylet(RpcServer):
                 "register_node", node_id=self.node_id, address=self.address,
                 store_name=self.store_name, resources=self.total_resources,
                 labels=self.labels)
-        for target in (self._dispatch_loop, self._heartbeat_loop,
-                       self._monitor_loop, self._infeasible_loop):
+        loops = [self._dispatch_loop, self._heartbeat_loop,
+                 self._monitor_loop, self._infeasible_loop]
+        if self._spill_enabled:
+            loops.append(self._spill_loop)
+        for target in loops:
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
@@ -168,6 +201,11 @@ class Raylet(RpcServer):
 
     def stop(self):
         super().stop()
+        # join background loops BEFORE closing the store: a mid-tick spill
+        # loop dereferencing the munmapped segment is a segfault, not an
+        # exception
+        for t in self._threads:
+            t.join(timeout=2.0)
         with self._workers_lock:
             workers = list(self._workers.values())
         for w in workers:
@@ -180,6 +218,7 @@ class Raylet(RpcServer):
                 except subprocess.TimeoutExpired:
                     w.proc.kill()
         self.store.close()
+        shutil.rmtree(self._spill_dir, ignore_errors=True)
 
     # ------------------------------------------------------------------
     # worker pool (reference: worker_pool.cc — spawn, registration
@@ -255,6 +294,7 @@ class Raylet(RpcServer):
                 self._gcs.call("actor_failed", actor_id=msg["actor_id"],
                                reason=msg.get("reason", "creation failed"))
         elif kind == "object_put":
+            self._pin_object(msg["oid"])
             with self._gcs_lock:
                 self._gcs.call("add_object_location", oid=msg["oid"],
                                node_id=self.node_id,
@@ -313,10 +353,19 @@ class Raylet(RpcServer):
             oid = bytes.fromhex(oid_hex)
             if not self.store.contains(oid):
                 try:
-                    size = object_codec.put_value(self.store, oid, err,
-                                                  is_error=True)
+                    # hold through seal→pin: the error object must not be
+                    # evictable before the pin (same protocol as worker
+                    # returns)
+                    size = object_codec.put_value_durable(
+                        self.store, oid, err, is_error=True, hold=True,
+                        timeout_s=5.0,
+                        request_space=(self._spill_bytes
+                                       if self._spill_enabled else None))
                 except Exception:  # noqa: BLE001 - already created etc.
                     continue
+                self._pin_object(oid_hex)
+                if size > 0:
+                    self.store.release(oid)
                 with self._gcs_lock:
                     self._gcs.call("add_object_location", oid=oid_hex,
                                    node_id=self.node_id, size=size)
@@ -613,14 +662,207 @@ class Raylet(RpcServer):
         return {"ok": True}
 
     # ------------------------------------------------------------------
+    # object spilling (reference: LocalObjectManager + ExternalStorage —
+    # spill LRU-cold objects to files under memory pressure, restore on
+    # read; the GCS object directory keeps this node as a location)
+    # ------------------------------------------------------------------
+
+    def _pin_object(self, oid_hex: str):
+        """Pin a newly created primary copy (idempotent)."""
+        with self._pin_lock:
+            if oid_hex in self._pinned:
+                return
+            if self.store.pin(bytes.fromhex(oid_hex)):
+                self._pinned.add(oid_hex)
+
+    def _unpin_object(self, oid_hex: str):
+        with self._pin_lock:
+            if oid_hex in self._pinned:
+                self._pinned.discard(oid_hex)
+                self.store.unpin(bytes.fromhex(oid_hex))
+
+    def rpc_report_object(self, conn, send_lock, *, oid: str, size: int = 0):
+        """A local process created an object: pin the primary copy and
+        register the location with the GCS (reference: the Put path's
+        PinObjectIDs + object directory update). Callers seal with a held
+        ref (``seal(hold=True)``) so the object cannot vanish before the
+        pin lands here."""
+        self._pin_object(oid)
+        with self._pin_lock:
+            pinned = oid in self._pinned
+        if not pinned and not self.store.contains(bytes.fromhex(oid)):
+            # should be unreachable under the hold protocol; never
+            # advertise a location that cannot serve the object
+            return {"ok": False, "reason": "object not present to pin"}
+        with self._gcs_lock:
+            self._gcs.call("add_object_location", oid=oid,
+                           node_id=self.node_id, size=size)
+        return {"ok": True}
+
+    def rpc_request_space(self, conn, send_lock, *, nbytes: int = 0):
+        """A writer hit store-OOM: synchronously spill pinned-idle objects
+        to make room (reference: CreateRequestQueue retry + triggered
+        spill). Returns the number of objects spilled."""
+        if not self._spill_enabled:
+            return {"spilled": 0}  # honor the no-disk-writes contract
+        # floor scaled to the allocation (2x for headroom) and the store
+        # (1/8 capacity) — a fixed large floor would thrash small stores
+        cap = self.store.capacity
+        target = min(max(2 * int(nbytes), cap // 8), cap)
+        n = self._spill_bytes(target)
+        if n == 0:
+            # nothing pinned-idle; last resort, spill unpinned cold
+            # entries too (they are evictable anyway — spilling keeps
+            # them readable instead of destroying them)
+            for oid in self.store.spill_candidates(target, pin_pid=0):
+                n += bool(self._spill_one(oid[:ObjectID.SIZE]))
+        return {"spilled": n}
+
+    def _spill_bytes(self, target: int) -> int:
+        n = 0
+        for oid in self.store.spill_candidates(target,
+                                               pin_pid=os.getpid()):
+            n += bool(self._spill_one(oid[:ObjectID.SIZE]))
+        return n
+
+    def _spill_loop(self):
+        while not self._stopping:
+            time.sleep(0.2)
+            try:
+                st = self.store.stats()
+            except Exception:  # noqa: BLE001 - store closing
+                return
+            cap = st["capacity"] or 1
+            if st["bytes_allocated"] <= self._spill_high * cap:
+                continue
+            self._spill_bytes(
+                st["bytes_allocated"] - int(self._spill_low * cap))
+
+    def _spill_one(self, oid: bytes) -> bool:
+        """Copy one sealed object out to a file, then drop it from shm."""
+        oid_hex = oid.hex()
+        try:
+            payload = object_codec.raw_bytes(self.store, oid, timeout_ms=0)
+        except Exception:  # noqa: BLE001 - vanished (freed/evicted) — fine
+            return False
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir, oid_hex)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        from ray_tpu._private.shm_store import TS_ERR, TS_OK
+
+        with self._spill_lock:
+            self._spilled[oid_hex] = path
+        self._unpin_object(oid_hex)
+        rc = self.store.try_delete(oid)
+        if rc == TS_ERR:
+            # a reader still holds a ref: keep the shm copy authoritative —
+            # re-pin, discard the file
+            self._pin_object(oid_hex)
+            with self._spill_lock:
+                self._spilled.pop(oid_hex, None)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+        # TS_OK: we removed it. TS_NOT_FOUND: a concurrent evict/spill beat
+        # us to it — the file we just wrote may now be the ONLY copy, so it
+        # must stay registered either way.
+        self.spill_stats["num_spilled"] += 1
+        self.spill_stats["bytes_spilled"] += len(payload)
+        return rc == TS_OK
+
+    def _restore_spilled(self, oid_hex: str) -> bool:
+        """Load a locally-spilled object back into shm (for readers)."""
+        with self._spill_lock:
+            path = self._spilled.get(oid_hex)
+        if path is None:
+            return False
+        try:
+            with open(path, "rb") as f:
+                payload = f.read()
+        except OSError:
+            with self._spill_lock:
+                self._spilled.pop(oid_hex, None)
+            return False
+        from ray_tpu._private.shm_store import (ObjectExistsError,
+                                                StoreFullError)
+
+        oid = bytes.fromhex(oid_hex)
+        held = False
+        for _ in range(8):
+            try:
+                # hold through the seal: the restored entry must never sit
+                # at refcount 0 where eviction/spill could destroy it
+                # before we pin + unlink the file
+                object_codec.put_raw(self.store, oid, payload, hold=True)
+                held = True
+                break
+            except ObjectExistsError:
+                break  # racing restore won; theirs is pinned
+            except StoreFullError:
+                # make room by spilling OTHER pinned-idle objects
+                if self._spill_bytes(len(payload)) == 0:
+                    time.sleep(0.05)  # wait for readers to release
+            except Exception:  # noqa: BLE001 - racing restore
+                break
+        self._pin_object(oid_hex)   # restored = primary again
+        if held:
+            self.store.release(oid)
+        with self._pin_lock:
+            pinned = oid_hex in self._pinned
+        if not pinned:
+            # could not secure a pinned shm copy — the file stays the
+            # authoritative copy; do NOT unlink
+            return self.store.contains(oid)
+        with self._spill_lock:
+            self._spilled.pop(oid_hex, None)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        self.spill_stats["num_restored"] += 1
+        self.spill_stats["bytes_restored"] += len(payload)
+        return True
+
+    def _read_spilled(self, oid_hex: str) -> bytes | None:
+        """Read a spilled object's bytes without restoring it to shm
+        (serving a remote fetch should not churn local memory)."""
+        with self._spill_lock:
+            path = self._spilled.get(oid_hex)
+        if path is None:
+            return None
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    # ------------------------------------------------------------------
     # object manager (reference: object_manager.cc Push/HandlePush +
     # PullManager; pull-only here)
     # ------------------------------------------------------------------
 
     def rpc_fetch_object(self, conn, send_lock, *, oid: str):
         """Return the encoded object bytes from the local store."""
-        return object_codec.raw_bytes(self.store, bytes.fromhex(oid),
-                                      timeout_ms=0)
+        try:
+            return object_codec.raw_bytes(self.store, bytes.fromhex(oid),
+                                          timeout_ms=0)
+        except ObjectNotFoundError:
+            payload = self._read_spilled(oid)
+            if payload is None:
+                raise
+            return payload
 
     def rpc_ensure_local(self, conn, send_lock, *, oids: list,
                          timeout_s: float = 30.0):
@@ -643,6 +885,9 @@ class Raylet(RpcServer):
         return missing
 
     def _pull(self, oid_hex: str) -> bool:
+        # locally spilled? restore without a network hop
+        if self._restore_spilled(oid_hex):
+            return True
         with self._gcs_lock:
             locs = self._gcs.call("get_object_locations",
                                   oids=[oid_hex])[oid_hex]
@@ -672,7 +917,8 @@ class Raylet(RpcServer):
         return {"node_id": self.node_id, "store_name": self.store_name,
                 "address": self.address, "resources": self.total_resources,
                 "available": self._avail_snapshot(),
-                "num_workers": len(self._workers)}
+                "num_workers": len(self._workers),
+                "spill_stats": dict(self.spill_stats)}
 
     # ------------------------------------------------------------------
     # background loops

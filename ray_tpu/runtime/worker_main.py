@@ -137,9 +137,25 @@ class Worker:
                     f"task declared {len(return_oids)} returns, got "
                     f"{len(values)}")
         for oid_hex, value in zip(return_oids, values):
-            size = object_codec.put_value(
-                self.store, bytes.fromhex(oid_hex), value)
-            self._send({"type": "object_put", "oid": oid_hex, "size": size})
+            self._put_and_report(oid_hex, value)
+
+    def _put_and_report(self, oid_hex: str, value, is_error: bool = False):
+        """Put with a held ref, then synchronously report so the raylet
+        pins the primary copy — NO window in which the sealed object is
+        evictable before the pin (reference: plasma seal + raylet
+        PinObjectIDs in the same task-return handshake)."""
+        oid = bytes.fromhex(oid_hex)
+        size = object_codec.put_value_durable(
+            self.store, oid, value, is_error=is_error,
+            request_space=self._request_space, hold=True)
+        try:
+            self.ctrl.call("report_object", oid=oid_hex, size=size)
+        finally:
+            if size > 0:   # size 0 = lost the first-write race: no hold
+                self.store.release(oid)
+
+    def _request_space(self, nbytes: int):
+        self.ctrl.call("request_space", nbytes=nbytes)
 
     def _store_error(self, task: dict, error: BaseException):
         for oid_hex in task["return_oids"]:
@@ -147,15 +163,13 @@ class Worker:
             if self.store.contains(oid):
                 continue
             try:
-                size = object_codec.put_value(self.store, oid, error,
-                                              is_error=True)
+                self._put_and_report(oid_hex, error, is_error=True)
             except Exception:  # noqa: BLE001 - unpicklable exception
-                size = object_codec.put_value(
-                    self.store, oid,
+                self._put_and_report(
+                    oid_hex,
                     exc.TaskError(task.get("name", "?"),
                                   RuntimeError(repr(error))),
                     is_error=True)
-            self._send({"type": "object_put", "oid": oid_hex, "size": size})
 
     # ------------------------------------------------------------------
     # execution

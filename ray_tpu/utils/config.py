@@ -53,6 +53,13 @@ class Config:
     object_store_eviction_fraction: float = 0.8
     # Enable automatic spilling to disk under memory pressure.
     object_spilling_enabled: bool = True
+    # Spill loop thresholds: start spilling above `high`, stop below `low`
+    # (fractions of store capacity; reference:
+    # RAY_object_spilling_threshold + LocalObjectManager).
+    object_spilling_high_fraction: float = 0.8
+    object_spilling_low_fraction: float = 0.5
+    # Directory for spilled object files ("" = a per-raylet temp dir).
+    object_spilling_directory: str = ""
 
     # --- workers ---
     num_workers: int = 0  # 0 = num_cpus

@@ -478,6 +478,45 @@ int store_seal(void* sp, const uint8_t* id) {
   return TS_OK;
 }
 
+// Seal WITHOUT dropping to refcount 0: the writer's ref converts into a
+// tracked reader ref, so there is NO window in which the freshly sealed
+// object is evictable before the node manager pins it (the writer releases
+// its hold after reporting the object). The hold is attributed to the
+// writer's pid in the reader slots so crash cleanup (store_release_pid)
+// reclaims it.
+int store_seal_hold(void* sp, const uint8_t* id) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  lock(h);
+  uint64_t i = find(s, id);
+  if (i == h->table_cap) {
+    unlock(h);
+    return TS_NOT_FOUND;
+  }
+  ObjectEntry& e = s->table[i];
+  if (e.state != kCreated) {
+    unlock(h);
+    return TS_ERR;
+  }
+  e.state = kSealed;
+  // keep refcount as-is (writer ref becomes the hold); attribute it
+  uint64_t pid = (uint64_t)getpid();
+  bool tracked = false;
+  for (uint32_t k = 0; k < kReaderSlots; k++) {
+    if (e.reader_pids[k] == pid ||
+        (e.reader_pids[k] == 0 && e.reader_counts[k] == 0)) {
+      e.reader_pids[k] = pid;
+      e.reader_counts[k]++;
+      tracked = true;
+      break;
+    }
+  }
+  if (!tracked) e.untracked_refs++;
+  pthread_cond_broadcast(&h->cv);
+  unlock(h);
+  return TS_OK;
+}
+
 // Get a sealed object: bumps refcount, returns offset/sizes.
 // timeout_ms < 0: non-blocking. timeout_ms >= 0 waits for seal.
 int store_get(void* sp, const uint8_t* id, int64_t timeout_ms,
@@ -633,6 +672,48 @@ int store_evict_orphans(void* sp, uint64_t pid) {
   pthread_cond_broadcast(&h->cv);
   unlock(h);
   return n;
+}
+
+// Collect LRU spill candidates, oldest-first, until their total payload
+// bytes reach `target_bytes` or `max_out` ids are written. A sealed entry
+// qualifies when its only refs are the node manager's pin (pin_pid != 0:
+// refcount equals the refs held by pin_pid; pin_pid == 0: refcount == 0).
+// Writes 20-byte ids consecutively into out_ids (caller provides
+// max_out*20 bytes) and returns the count. The entries are NOT freed —
+// the node manager copies them to external storage first, then unpins and
+// calls store_delete (reference: LocalObjectManager::SpillObjects picks
+// pinned-but-unused victims from plasma and deletes after the spill IO
+// completes, local_object_manager.h:110).
+int store_spill_candidates(void* sp, uint64_t target_bytes, uint8_t* out_ids,
+                           uint64_t max_out, uint64_t pin_pid) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  lock(h);
+  std::vector<std::pair<uint64_t, uint64_t>> candidates;  // (tick, idx)
+  for (uint64_t i = 0; i < h->table_cap; i++) {
+    ObjectEntry& e = s->table[i];
+    if (e.state != kSealed) continue;
+    uint64_t pinned = 0;
+    if (pin_pid != 0) {
+      for (uint32_t k = 0; k < kReaderSlots; k++)
+        if (e.reader_pids[k] == pin_pid) pinned = e.reader_counts[k];
+      if (pinned == 0 || e.refcount != pinned) continue;
+    } else if (e.refcount != 0) {
+      continue;
+    }
+    candidates.emplace_back(e.lru_tick, i);
+  }
+  std::sort(candidates.begin(), candidates.end());
+  uint64_t n = 0, bytes = 0;
+  for (auto& [tick, idx] : candidates) {
+    if (n >= max_out || bytes >= target_bytes) break;
+    ObjectEntry& e = s->table[idx];
+    memcpy(out_ids + n * kIdLen, e.id, kIdLen);
+    bytes += e.data_size + e.meta_size;
+    n++;
+  }
+  unlock(h);
+  return (int)n;
 }
 
 void store_stats(void* sp, uint64_t* out6) {
