@@ -64,6 +64,18 @@ class ClusterRuntime:
         self._actor_clients: dict[tuple, RpcClient] = {}
         self._actor_clients_lock = threading.Lock()
         self.metrics: dict[str, Any] = {}
+        # Lineage for object reconstruction (reference: ReferenceCounter
+        # lineage pinning reference_count.h:67-115 + TaskManager::
+        # ResubmitTask task_manager.h:234 + ObjectRecoveryManager
+        # object_recovery_manager.h:41): return oid -> the wire task that
+        # created it, so a lost object (its node died) can be re-computed
+        # by re-running the task. Actor-task results are NOT recorded
+        # (actor state is restored via actor restart, not re-execution).
+        self._lineage: dict[str, dict] = {}
+        self._lineage_lock = threading.Lock()
+        self._reconstructing: set[str] = set()
+        from ray_tpu.utils.config import get_config
+        self._lineage_grace_s = get_config().lineage_resubmit_grace_s
 
     # ------------------------------------------------------------------
     # objects
@@ -101,11 +113,77 @@ class ClusterRuntime:
             # RpcClient multiplexes by request id — no lock needed, and
             # holding one across the blocking poll would stall submits
             pending = self._raylet.call("ensure_local", oids=pending,
-                                        timeout_s=step)
+                                        timeout_s=min(step, 2.0))
+            if pending:
+                self._recover_lost(pending)
         out = []
         for oid_hex in oids:
             out.append(self._read_local(oid_hex, deadline))
         return out
+
+    # ------------------------------------------------------------------
+    # lineage reconstruction
+    # ------------------------------------------------------------------
+
+    def _recover_lost(self, oids: list[str], depth: int = 0):
+        """For objects with NO remaining copy anywhere (their node died),
+        re-run the creating task from lineage (reference:
+        ObjectRecoveryManager::RecoverObject object_recovery_manager.h:90
+        → TaskManager::ResubmitTask). Tasks still pending are untouched —
+        only objects the GCS once knew and has now lost (all locations
+        dropped on node death) are eligible."""
+        lost = self._gcs.call("get_lost_objects", oids=list(set(oids)))
+        for oid_hex in lost:
+            if self.store.contains(bytes.fromhex(oid_hex)):
+                continue
+            with self._lineage_lock:
+                entry = self._lineage.get(oid_hex)
+                reconstructing = oid_hex in self._reconstructing
+            if entry is None:
+                raise exc.ObjectLostError(
+                    oid_hex,
+                    "all copies lost with their node and no lineage is "
+                    "available to reconstruct it (max_retries=0?)")
+            if (entry["attempts"] <= 0 and not reconstructing
+                    and time.monotonic() - entry.get("last_resubmit", 0.0)
+                    > self._lineage_grace_s):
+                raise exc.ObjectLostError(
+                    oid_hex, "lineage re-execution budget exhausted")
+            self._reconstruct(oid_hex, depth)
+
+    def _reconstruct(self, oid_hex: str, depth: int = 0):
+        if depth > 10:
+            return
+        with self._lineage_lock:
+            entry = self._lineage.get(oid_hex)
+            if entry is None or entry["attempts"] <= 0:
+                return
+            if oid_hex in self._reconstructing:
+                return
+            # a re-execution is likely still running — don't stack another
+            # (the tombstone only clears when the new copy registers).
+            # Known limit: a re-run longer than the grace gets a duplicate
+            # submission; first-write-wins keeps that harmless.
+            if (time.monotonic() - entry.get("last_resubmit", 0.0)
+                    < self._lineage_grace_s):
+                return
+            entry["attempts"] -= 1
+            entry["last_resubmit"] = time.monotonic()
+            self._reconstructing.add(oid_hex)
+        try:
+            # deps first: a re-run will fail on lost inputs (recursive
+            # lineage re-execution, depth-bounded)
+            deps = entry["deps"]
+            if deps:
+                dep_lost = self._gcs.call("get_lost_objects", oids=deps)
+                for dep in dep_lost:
+                    if not self.store.contains(bytes.fromhex(dep)):
+                        self._reconstruct(dep, depth + 1)
+            # first-write-wins makes a duplicate re-execution harmless
+            self._raylet.call("submit_task", task=dict(entry["task"]))
+        finally:
+            with self._lineage_lock:
+                self._reconstructing.discard(oid_hex)
 
     def _read_local(self, oid_hex: str, deadline):
         """Read a locally-available object; if it was evicted between the
@@ -199,6 +277,16 @@ class ClusterRuntime:
                 "runtime_env": spec.runtime_env,
                 "trace_ctx": spec.trace_ctx,
             }
+            if spec.max_retries > 0:
+                deps = [a.id.hex() for a in spec.args
+                        if isinstance(a, ObjectRef)]
+                deps += [v.id.hex() for v in spec.kwargs.values()
+                         if isinstance(v, ObjectRef)]
+                entry = {"task": task, "deps": deps,
+                         "attempts": spec.max_retries}
+                with self._lineage_lock:
+                    for oid in spec.return_ids:
+                        self._lineage[oid.hex()] = entry
             self._raylet.call("submit_task", task=task)
         return [ObjectRef(oid) for oid in spec.return_ids]
 

@@ -78,6 +78,14 @@ class GcsServer(RpcServer):
         self._kv: dict[str, dict[str, bytes]] = {}
         self._object_dir: dict[str, set[str]] = {}   # oid -> node ids
         self._object_meta: dict[str, int] = {}       # oid -> size (for ref)
+        # objects whose LAST location died (known-then-lost tombstones):
+        # distinguishes "task hasn't produced it yet" from "needs lineage
+        # reconstruction" for owners (reference: the owner learns loss via
+        # object-eviction pubsub + ObjectDirectory). Bounded: a dict in
+        # insertion order, oldest dropped past the cap — a tombstone only
+        # matters while some owner still wants the object.
+        self._lost_objects: dict[str, None] = {}
+        self._max_lost_objects = 100_000
         self._pgs: dict[str, PlacementGroupInfo] = {}
         self._jobs: dict[str, dict] = {}
         # pubsub: channel -> list of (conn, send_lock)
@@ -183,11 +191,13 @@ class GcsServer(RpcServer):
             if node is None or not node.alive:
                 return
             node.alive = False
-            # drop object locations on that node
+            # drop object locations on that node; tombstone objects whose
+            # last copy just vanished so owners can trigger reconstruction
             for oid, locs in list(self._object_dir.items()):
                 locs.discard(node_id)
                 if not locs:
                     del self._object_dir[oid]
+                    self._tombstone(oid)
             doomed_actors = [a for a in self._actors.values()
                             if a.node_id == node_id
                             and a.state in ("ALIVE", "PENDING", "RESTARTING")]
@@ -468,6 +478,7 @@ class GcsServer(RpcServer):
                                 size=0):
         with self._lock:
             self._object_dir.setdefault(oid, set()).add(node_id)
+            self._lost_objects.pop(oid, None)  # re-created (reconstruction)
             if size:
                 self._object_meta[oid] = size
         self.publish(CH_OBJECT, {"event": "added", "oid": oid,
@@ -479,13 +490,30 @@ class GcsServer(RpcServer):
             return {oid: sorted(self._object_dir.get(oid, ()))
                     for oid in oids}
 
+    def _tombstone(self, oid: str):
+        """Record a lost object, dropping the oldest past the cap (caller
+        holds the lock)."""
+        self._lost_objects[oid] = None
+        while len(self._lost_objects) > self._max_lost_objects:
+            self._lost_objects.pop(next(iter(self._lost_objects)))
+
+    def rpc_get_lost_objects(self, conn, send_lock, *, oids):
+        """Subset of ``oids`` that were known and whose every copy died
+        with its node (lineage-reconstruction trigger)."""
+        with self._lock:
+            return [o for o in oids if o in self._lost_objects]
+
     def rpc_remove_object_location(self, conn, send_lock, *, oid, node_id):
         with self._lock:
             locs = self._object_dir.get(oid)
             if locs:
                 locs.discard(node_id)
                 if not locs:
+                    # last copy gone (evicted secondary after the primary's
+                    # node died, or explicit free): tombstone so owners can
+                    # reconstruct from lineage
                     del self._object_dir[oid]
+                    self._tombstone(oid)
         return {"ok": True}
 
     # ------------------------------------------------------------------

@@ -97,7 +97,9 @@ class Raylet(RpcServer):
                        or tempfile.gettempdir())
         self._spill_dir = os.path.join(
             _spill_base, f"raytpu_spill_{os.getpid()}_{node_id[:8]}")
-        self._spilled: dict[str, str] = {}   # oid hex -> file path
+        # oid hex -> (file path, was_primary): primaries re-pin on
+        # restore; spilled secondaries stay evictable after restore
+        self._spilled: dict[str, tuple[str, bool]] = {}
         self._spill_lock = threading.Lock()
         self.spill_stats = {"num_spilled": 0, "bytes_spilled": 0,
                             "num_restored": 0, "bytes_restored": 0}
@@ -109,6 +111,12 @@ class Raylet(RpcServer):
         # unpinned and evictable).
         self._pinned: set[str] = set()
         self._pin_lock = threading.Lock()
+        # every object registered with the GCS as located here (primary or
+        # pulled secondary); reconciled against the store so LRU-evicted
+        # secondaries don't leave stale locations in the directory forever
+        # (reference: object-eviction pubsub updating the ObjectDirectory)
+        self._local_objects: set[str] = set()
+        self._local_objects_lock = threading.Lock()
         # cluster-wide infeasible tasks awaiting capacity (autoscaler)
         self.infeasible_timeout_s = infeasible_timeout_s
         self._infeasible: list = []
@@ -293,12 +301,6 @@ class Raylet(RpcServer):
             with self._gcs_lock:
                 self._gcs.call("actor_failed", actor_id=msg["actor_id"],
                                reason=msg.get("reason", "creation failed"))
-        elif kind == "object_put":
-            self._pin_object(msg["oid"])
-            with self._gcs_lock:
-                self._gcs.call("add_object_location", oid=msg["oid"],
-                               node_id=self.node_id,
-                               size=msg.get("size", 0))
 
     def _finish_task(self, w: WorkerHandle, msg: dict):
         w.current_task = None
@@ -364,6 +366,7 @@ class Raylet(RpcServer):
                 except Exception:  # noqa: BLE001 - already created etc.
                     continue
                 self._pin_object(oid_hex)
+                self._track_local(oid_hex)
                 if size > 0:
                     self.store.release(oid)
                 with self._gcs_lock:
@@ -447,6 +450,11 @@ class Raylet(RpcServer):
     def _peer(self, node_id: str) -> RpcClient | None:
         with self._peers_lock:
             client = self._peers.get(node_id)
+            if client is not None and client._closed:
+                # connection died (peer restarted/stopped): re-resolve
+                self._peers.pop(node_id, None)
+                self._peer_addrs.pop(node_id, None)
+                client = None
         if client is not None:
             return client
         with self._gcs_lock:
@@ -667,6 +675,40 @@ class Raylet(RpcServer):
     # read; the GCS object directory keeps this node as a location)
     # ------------------------------------------------------------------
 
+    def _track_local(self, oid_hex: str):
+        with self._local_objects_lock:
+            self._local_objects.add(oid_hex)
+
+    def _reconcile_locations(self):
+        """Deregister objects that silently left the store (LRU-evicted
+        secondaries): a stale directory entry would make owners pull from
+        a node that cannot serve, and would mask true object loss from
+        the lineage-reconstruction path."""
+        with self._local_objects_lock:
+            snapshot = list(self._local_objects)
+        gone = []
+        for oid_hex in snapshot:
+            if self.store.contains(bytes.fromhex(oid_hex)):
+                continue
+            with self._spill_lock:
+                if oid_hex in self._spilled:
+                    continue   # spilled = still servable from disk
+            gone.append(oid_hex)
+        if not gone:
+            return
+        with self._local_objects_lock:
+            self._local_objects.difference_update(gone)
+        with self._pin_lock:
+            self._pinned.difference_update(gone)
+        for oid_hex in gone:
+            try:
+                with self._gcs_lock:
+                    self._gcs.call("remove_object_location", oid=oid_hex,
+                                   node_id=self.node_id)
+            except Exception:  # noqa: BLE001 - gcs down; retried next tick
+                with self._local_objects_lock:
+                    self._local_objects.add(oid_hex)
+
     def _pin_object(self, oid_hex: str):
         """Pin a newly created primary copy (idempotent)."""
         with self._pin_lock:
@@ -694,6 +736,7 @@ class Raylet(RpcServer):
             # should be unreachable under the hold protocol; never
             # advertise a location that cannot serve the object
             return {"ok": False, "reason": "object not present to pin"}
+        self._track_local(oid)
         with self._gcs_lock:
             self._gcs.call("add_object_location", oid=oid,
                            node_id=self.node_id, size=size)
@@ -760,8 +803,10 @@ class Raylet(RpcServer):
             return False
         from ray_tpu._private.shm_store import TS_ERR, TS_OK
 
+        with self._pin_lock:
+            was_primary = oid_hex in self._pinned
         with self._spill_lock:
-            self._spilled[oid_hex] = path
+            self._spilled[oid_hex] = (path, was_primary)
         self._unpin_object(oid_hex)
         rc = self.store.try_delete(oid)
         if rc == TS_ERR:
@@ -785,9 +830,10 @@ class Raylet(RpcServer):
     def _restore_spilled(self, oid_hex: str) -> bool:
         """Load a locally-spilled object back into shm (for readers)."""
         with self._spill_lock:
-            path = self._spilled.get(oid_hex)
-        if path is None:
+            entry = self._spilled.get(oid_hex)
+        if entry is None:
             return False
+        path, was_primary = entry
         try:
             with open(path, "rb") as f:
                 payload = f.read()
@@ -816,13 +862,18 @@ class Raylet(RpcServer):
                     time.sleep(0.05)  # wait for readers to release
             except Exception:  # noqa: BLE001 - racing restore
                 break
-        self._pin_object(oid_hex)   # restored = primary again
+        if was_primary:
+            self._pin_object(oid_hex)   # restored primary: pin again
         if held:
             self.store.release(oid)
-        with self._pin_lock:
-            pinned = oid_hex in self._pinned
-        if not pinned:
-            # could not secure a pinned shm copy — the file stays the
+        if was_primary:
+            with self._pin_lock:
+                ok = oid_hex in self._pinned
+        else:
+            # secondary: stays unpinned/evictable; success = it is present
+            ok = held or self.store.contains(oid)
+        if not ok:
+            # could not secure the shm copy — the file stays the
             # authoritative copy; do NOT unlink
             return self.store.contains(oid)
         with self._spill_lock:
@@ -839,11 +890,11 @@ class Raylet(RpcServer):
         """Read a spilled object's bytes without restoring it to shm
         (serving a remote fetch should not churn local memory)."""
         with self._spill_lock:
-            path = self._spilled.get(oid_hex)
-        if path is None:
+            entry = self._spilled.get(oid_hex)
+        if entry is None:
             return None
         try:
-            with open(path, "rb") as f:
+            with open(entry[0], "rb") as f:
                 return f.read()
         except OSError:
             return None
@@ -907,6 +958,7 @@ class Raylet(RpcServer):
                     object_codec.put_raw(self.store, oid, payload)
                 except Exception:  # noqa: BLE001 - racing pull
                     pass
+            self._track_local(oid_hex)
             with self._gcs_lock:
                 self._gcs.call("add_object_location", oid=oid_hex,
                                node_id=self.node_id, size=len(payload))
@@ -925,8 +977,15 @@ class Raylet(RpcServer):
     # ------------------------------------------------------------------
 
     def _heartbeat_loop(self):
+        ticks = 0
         while not self._stopping:
             time.sleep(self._hb_interval)
+            ticks += 1
+            if ticks % 2 == 0:
+                try:
+                    self._reconcile_locations()
+                except Exception:  # noqa: BLE001 - next tick retries
+                    pass
             try:
                 with self._gcs_lock:
                     reply = self._gcs.call("heartbeat", node_id=self.node_id,

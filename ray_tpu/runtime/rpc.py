@@ -71,6 +71,8 @@ class RpcServer:
         self._sock.listen(256)
         self.address = self._sock.getsockname()
         self._stopping = False
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name=f"{type(self).__name__}-accept",
             daemon=True,
@@ -86,6 +88,22 @@ class RpcServer:
             self._sock.close()
         except OSError:
             pass
+        # sever live connections: a stopped server must not keep accepting
+        # work over held sockets — peers would get "ok" replies for
+        # requests that silently black-hole (e.g. a task enqueued on a
+        # raylet whose dispatch loop is gone)
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _accept_loop(self):
         while not self._stopping:
@@ -94,6 +112,14 @@ class RpcServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                if self._stopping:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    continue
+                self._conns.add(conn)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
@@ -105,6 +131,16 @@ class RpcServer:
                 try:
                     req = recv_msg(conn)
                 except (ConnectionLost, OSError, EOFError):
+                    return
+                if self._stopping:
+                    # request raced the shutdown: error instead of
+                    # half-processing on a dying service
+                    try:
+                        send_msg(conn, {"_id": req.get("_id"),
+                                        "error": ConnectionLost(
+                                            "server stopping")}, send_lock)
+                    except (OSError, Exception):  # noqa: BLE001
+                        pass
                     return
                 req_id = req.pop("_id", None)
                 method = req.pop("method")
@@ -128,9 +164,12 @@ class RpcServer:
                             return
                     continue
                 if result is RpcServer.HELD:
-                    return  # handler owns the connection now
+                    return  # handler owns the connection (stays in _conns
+                    # so stop() severs it too)
                 send_msg(conn, {"_id": req_id, "result": result}, send_lock)
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             if not self._stopping:
                 self.on_disconnect(conn)
 

@@ -68,6 +68,10 @@ class Config:
 
     # --- fault tolerance ---
     task_max_retries: int = 3
+    # Min seconds between lineage re-submissions of the same lost object
+    # (and the grace before budget exhaustion is declared terminal). Must
+    # exceed the longest expected task re-execution time.
+    lineage_resubmit_grace_s: float = 60.0
     actor_max_restarts: int = 0
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5

@@ -1,0 +1,116 @@
+"""Lineage reconstruction: lost objects are re-computed from their
+creating task when their node dies.
+
+Reference analog: ``python/ray/tests/test_reconstruction*.py`` —
+``ObjectRecoveryManager::RecoverObject`` (object_recovery_manager.h:90)
+re-executes the creating task via ``TaskManager::ResubmitTask``
+(task_manager.h:234); lineage is pinned by the owner
+(reference_count.h:67-115).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.runtime.task_spec import SchedulingStrategy
+
+
+def _on(node_handle):
+    """Soft node-affinity: initial run lands on the node; a lineage re-run
+    falls back elsewhere once that node is dead."""
+    return SchedulingStrategy(kind="NODE_AFFINITY",
+                              node_id=node_handle.node_id)
+
+
+@pytest.fixture
+def two_node_cluster():
+    ray_tpu.shutdown()
+    c = Cluster(heartbeat_timeout_s=1.0)
+    c.add_node(num_cpus=2)                              # head (driver side)
+    c.add_node(num_cpus=2, resources={"side": 2})       # victim node
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _victim(cluster):
+    return next(h for h in cluster.nodes.values()
+                if h.raylet is not None
+                and "side" in h.raylet.total_resources)
+
+
+def test_object_reconstructed_after_node_death(two_node_cluster):
+    victim = _victim(two_node_cluster)
+
+    @ray_tpu.remote(max_retries=2, scheduling_strategy=_on(victim))
+    def make():
+        return np.arange(1000, dtype=np.int64)
+
+    ref = make.remote()
+    # materialize on the victim node first (proves it existed remotely)
+    assert int(ray_tpu.get(ref).sum()) == 499500
+
+    two_node_cluster.remove_node(victim)
+    time.sleep(2.5)  # heartbeat timeout -> GCS drops locations, tombstones
+
+    # driver never held a local copy? it pulled one during the first get —
+    # drop it to force reconstruction
+    head = next(h for h in two_node_cluster.nodes.values()
+                if h.raylet is not None)
+    head.raylet.store.delete(ref.id.binary())
+
+    got = ray_tpu.get(ref, timeout=30)
+    assert int(got.sum()) == 499500
+
+
+def test_chained_reconstruction(two_node_cluster):
+    """A lost object whose inputs are ALSO lost: recursive re-execution."""
+    victim = _victim(two_node_cluster)
+
+    @ray_tpu.remote(max_retries=2, scheduling_strategy=_on(victim))
+    def base():
+        return np.full(64, 7, dtype=np.int64)
+
+    @ray_tpu.remote(max_retries=2, scheduling_strategy=_on(victim))
+    def double(x):
+        return 2 * x
+
+    r1 = base.remote()
+    r2 = double.remote(r1)
+    assert int(ray_tpu.get(r2)[0]) == 14
+
+    two_node_cluster.remove_node(victim)
+    time.sleep(2.5)
+
+    head = next(h for h in two_node_cluster.nodes.values()
+                if h.raylet is not None)
+    head.raylet.store.delete(r1.id.binary())
+    head.raylet.store.delete(r2.id.binary())
+
+    got = ray_tpu.get(r2, timeout=60)
+    assert int(got[0]) == 14 and got.shape == (64,)
+
+
+def test_no_lineage_raises_lost(two_node_cluster):
+    """max_retries=0 disables reconstruction: the object stays lost."""
+    victim = _victim(two_node_cluster)
+
+    @ray_tpu.remote(max_retries=0, scheduling_strategy=_on(victim))
+    def make():
+        return 41
+
+    ref = make.remote()
+    assert ray_tpu.get(ref) == 41
+    two_node_cluster.remove_node(victim)
+    time.sleep(2.5)
+    head = next(h for h in two_node_cluster.nodes.values()
+                if h.raylet is not None)
+    head.raylet.store.delete(ref.id.binary())
+
+    with pytest.raises((ray_tpu.exceptions.ObjectLostError,
+                        ray_tpu.exceptions.GetTimeoutError)):
+        ray_tpu.get(ref, timeout=10)
