@@ -6,10 +6,14 @@ vs_baseline is reported against the North-star target proxy of 1.0 until a
 measured reference exists.
 
 Env knobs:
-    BENCH_MODE=train|serve    (default train)
+    BENCH_MODE=train|serve|core  (default train)
     BENCH_PRESET=small|base   (default base; small for CPU smoke runs)
     BENCH_STEPS=N             (timed steps, default 10)
     BENCH_REQUESTS=N          (serve mode: requests, default 16)
+
+``core`` mode is the microbenchmark suite analog
+(``python/ray/_private/ray_perf.py:93``): task/actor/put/get op
+throughput on the cluster runtime.
 """
 
 from __future__ import annotations
@@ -207,7 +211,75 @@ def bench_serve():
     print(json.dumps(result))
 
 
+def bench_core():
+    """Core-op microbenchmarks (reference: ``ray_perf.py`` — tasks/sec,
+    actor calls/sec, put/get throughput on a real multi-process cluster)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    n = int(os.environ.get("BENCH_STEPS", "500"))
+    c = Cluster()
+    c.add_node(num_cpus=4)
+    ray_tpu.init(address=c.gcs_address)
+    results = {}
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    # warm the worker pool
+    ray_tpu.get([nop.remote() for _ in range(8)])
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(n)])
+    results["tasks_per_sec"] = round(n / (time.perf_counter() - t0), 1)
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([a.m.remote() for _ in range(n)])
+    results["actor_calls_per_sec"] = round(n / (time.perf_counter() - t0), 1)
+
+    small = b"x" * 1024
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(small) for _ in range(n)]
+    results["puts_1kb_per_sec"] = round(n / (time.perf_counter() - t0), 1)
+    t0 = time.perf_counter()
+    ray_tpu.get(refs)
+    results["gets_1kb_per_sec"] = round(n / (time.perf_counter() - t0), 1)
+
+    big = np.zeros(32 << 18, dtype=np.float64)  # 64 MiB
+    t0 = time.perf_counter()
+    bref = ray_tpu.put(big)
+    put_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = ray_tpu.get(bref)
+    get_s = time.perf_counter() - t0
+    assert out.nbytes == big.nbytes
+    results["put_gbps"] = round(big.nbytes / put_s / 1e9, 2)
+    results["get_gbps"] = round(big.nbytes / get_s / 1e9, 2)
+
+    ray_tpu.shutdown()
+    c.shutdown()
+    print(json.dumps({
+        "metric": "core_tasks_per_sec",
+        "value": results["tasks_per_sec"],
+        "unit": "tasks/s",
+        "vs_baseline": None,  # reference's numbers are external (nightly)
+        "detail": results,
+    }))
+
+
 if __name__ == "__main__":
-    if os.environ.get("BENCH_MODE", "train") == "serve":
+    mode = os.environ.get("BENCH_MODE", "train")
+    if mode == "serve":
         sys.exit(bench_serve())
+    if mode == "core":
+        sys.exit(bench_core())
     sys.exit(main())

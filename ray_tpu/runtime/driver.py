@@ -76,6 +76,7 @@ class ClusterRuntime:
         self._reconstructing: set[str] = set()
         from ray_tpu.utils.config import get_config
         self._lineage_grace_s = get_config().lineage_resubmit_grace_s
+        self._lineage_max = get_config().lineage_max_entries
 
     # ------------------------------------------------------------------
     # objects
@@ -287,6 +288,11 @@ class ClusterRuntime:
                 with self._lineage_lock:
                     for oid in spec.return_ids:
                         self._lineage[oid.hex()] = entry
+                    # bounded (reference: RAY_max_lineage_bytes caps the
+                    # lineage the owner pins): oldest entries dropped —
+                    # their objects simply lose reconstructability
+                    while len(self._lineage) > self._lineage_max:
+                        self._lineage.pop(next(iter(self._lineage)))
             self._raylet.call("submit_task", task=task)
         return [ObjectRef(oid) for oid in spec.return_ids]
 

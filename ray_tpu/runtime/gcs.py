@@ -130,6 +130,8 @@ class GcsServer(RpcServer):
                         self._subs.get(channel, []).remove(item)
                     except ValueError:
                         pass
+            for conn, _ in dead:
+                self.release_conn(conn)   # held channel finished
 
     # ------------------------------------------------------------------
     # nodes + health (reference: GcsNodeManager / GcsHealthCheckManager)

@@ -45,6 +45,10 @@ class WorkerHandle:
     incarnation: int = 0
     current_task: dict | None = None
     acquired: dict = field(default_factory=dict)
+    # set by the memory monitor right before a pressure kill so the death
+    # handler stores OutOfMemoryError instead of WorkerCrashedError
+    oom_killed: bool = False
+    dispatched_at: float = 0.0   # monotonic time the current task started
     # runtime-env identity this worker booted with; tasks only run on a
     # worker with a matching key (reference: (language, runtime_env)-
     # keyed worker caching in worker_pool.cc)
@@ -89,6 +93,8 @@ class Raylet(RpcServer):
         from ray_tpu.utils.config import get_config
         _cfg = get_config()
         self._spill_enabled = _cfg.object_spilling_enabled
+        self._mem_threshold = _cfg.memory_usage_threshold
+        self._mem_refresh_s = max(_cfg.memory_monitor_refresh_ms, 50) / 1e3
         self._spill_high = _cfg.object_spilling_high_fraction
         self._spill_low = _cfg.object_spilling_low_fraction
         # always a per-raylet SUBdirectory: stop() removes the whole dir,
@@ -137,6 +143,8 @@ class Raylet(RpcServer):
                  self._monitor_loop, self._infeasible_loop]
         if self._spill_enabled:
             loops.append(self._spill_loop)
+        if self._mem_threshold > 0:
+            loops.append(self._memory_monitor_loop)
         for target in loops:
             t = threading.Thread(target=target, daemon=True)
             t.start()
@@ -286,6 +294,7 @@ class Raylet(RpcServer):
                     break
                 self._on_worker_msg(handle, msg)
         finally:
+            self.release_conn(conn)   # held channel finished
             self._on_worker_gone(handle)
         return RpcServer.HELD
 
@@ -343,6 +352,12 @@ class Raylet(RpcServer):
             if task.get("max_retries", 0) > 0:
                 task["max_retries"] -= 1
                 self._enqueue(task)
+            elif w.oom_killed:
+                from ray_tpu.utils import exceptions as exc
+                self._store_task_error(task, exc.OutOfMemoryError(
+                    f"task {task.get('name')}: worker killed to relieve "
+                    f"host memory pressure (threshold "
+                    f"{self._mem_threshold})"))
             else:
                 self._store_task_error(
                     task, RuntimeError(
@@ -350,7 +365,8 @@ class Raylet(RpcServer):
 
     def _store_task_error(self, task: dict, error: BaseException):
         from ray_tpu.utils import exceptions as exc
-        err = exc.WorkerCrashedError(str(error))
+        err = (error if isinstance(error, exc.RayTpuError)
+               else exc.WorkerCrashedError(str(error)))
         for oid_hex in task.get("return_oids", ()):
             oid = bytes.fromhex(oid_hex)
             if not self.store.contains(oid):
@@ -519,7 +535,11 @@ class Raylet(RpcServer):
             worker = self._idle_worker(task.get("runtime_env"))
             if worker is None:
                 self._enqueue(task)
-                time.sleep(0.01)
+                # wait for a completion/registration kick instead of a
+                # fixed sleep: task_done latency, not a 10ms poll, sets
+                # the dispatch rate when all workers are busy
+                with self._ready_cv:
+                    self._ready_cv.wait(timeout=0.05)
                 continue
             if not self._try_acquire(task.get("resources", {})):
                 worker.state = "idle"
@@ -527,6 +547,7 @@ class Raylet(RpcServer):
                 continue
             worker.acquired = dict(task.get("resources", {}))
             worker.current_task = task
+            worker.dispatched_at = time.monotonic()
             try:
                 send_msg(worker.conn, {"type": "task", "task": task},
                          worker.send_lock)
@@ -688,11 +709,16 @@ class Raylet(RpcServer):
             snapshot = list(self._local_objects)
         gone = []
         for oid_hex in snapshot:
-            if self.store.contains(bytes.fromhex(oid_hex)):
-                continue
+            # _spilled FIRST, store second: a concurrent restore pops
+            # _spilled only AFTER the shm copy is secured+pinned, so this
+            # order can never classify a mid-restore object as gone
+            # (store-first could: miss the store, then miss _spilled
+            # right after the restore completed)
             with self._spill_lock:
                 if oid_hex in self._spilled:
                     continue   # spilled = still servable from disk
+            if self.store.contains(bytes.fromhex(oid_hex)):
+                continue
             gone.append(oid_hex)
         if not gone:
             return
@@ -999,6 +1025,67 @@ class Raylet(RpcServer):
                             labels=self.labels)
             except Exception:  # noqa: BLE001 - gcs down; keep trying
                 pass
+
+    # ------------------------------------------------------------------
+    # memory monitor (reference: MemoryMonitor common/memory_monitor.h:52
+    # driving the raylet's WorkerKillingPolicy — kill the newest retriable
+    # task's worker first so forward progress is preserved)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _host_memory_fraction() -> float:
+        """Used fraction of host memory from /proc/meminfo (the reference
+        also honors cgroup limits; host-level covers TPU-VM deployments)."""
+        total = avail = None
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1])
+                    elif line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1])
+                    if total is not None and avail is not None:
+                        break
+        except OSError:
+            return 0.0
+        if not total or avail is None:
+            return 0.0
+        return 1.0 - avail / total
+
+    def _memory_monitor_loop(self):
+        while not self._stopping:
+            time.sleep(self._mem_refresh_s)
+            if self._host_memory_fraction() < self._mem_threshold:
+                continue
+            if self._kill_one_for_memory():
+                time.sleep(1.0)   # cooldown: let the kill take effect
+
+    def _kill_one_for_memory(self) -> bool:
+        """Pick and kill one worker to relieve pressure. Policy (reference
+        worker_killing_policy_retriable_fifo.cc): newest-started RETRIABLE
+        task first (its re-execution is cheapest and guaranteed safe),
+        then newest non-retriable task worker; actors are never chosen —
+        their state is not re-executable (the reference's group-by-owner
+        policy similarly deprioritizes them)."""
+        with self._workers_lock:
+            # snapshot tasks INSIDE the lock: _finish_task nulls
+            # current_task concurrently
+            busy = [(w, w.current_task, w.dispatched_at)
+                    for w in self._workers.values()
+                    if w.state == "busy" and w.current_task is not None
+                    and w.proc is not None]
+        if not busy:
+            return False
+        busy.sort(key=lambda it: it[2])   # oldest-dispatched first
+        retriable = [it for it in busy
+                     if it[1].get("max_retries", 0) > 0]
+        victim = (retriable or busy)[-1][0]   # newest-dispatched last
+        victim.oom_killed = True
+        try:
+            victim.proc.kill()
+        except OSError:
+            return False
+        return True
 
     def _monitor_loop(self):
         """Reap dead worker processes (reference: worker failure detection

@@ -124,8 +124,16 @@ class RpcServer:
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
 
+    def release_conn(self, conn: socket.socket):
+        """Drop a HELD connection from the severing set once its owner is
+        done with it (held-handler finally blocks / publisher dead-sub
+        cleanup). Prevents dead sockets accumulating in _conns."""
+        with self._conns_lock:
+            self._conns.discard(conn)
+
     def _serve_conn(self, conn: socket.socket):
         send_lock = threading.Lock()
+        held = False
         try:
             while not self._stopping:
                 try:
@@ -164,12 +172,16 @@ class RpcServer:
                             return
                     continue
                 if result is RpcServer.HELD:
-                    return  # handler owns the connection (stays in _conns
-                    # so stop() severs it too)
+                    # handler owns the connection; it STAYS in _conns so
+                    # stop() can sever it — the owner calls release_conn
+                    # when the channel is truly finished
+                    held = True
+                    return
                 send_msg(conn, {"_id": req_id, "result": result}, send_lock)
         finally:
-            with self._conns_lock:
-                self._conns.discard(conn)
+            if not held:
+                with self._conns_lock:
+                    self._conns.discard(conn)
             if not self._stopping:
                 self.on_disconnect(conn)
 

@@ -61,6 +61,14 @@ class Config:
     # Directory for spilled object files ("" = a per-raylet temp dir).
     object_spilling_directory: str = ""
 
+    # --- memory monitor (reference: common/memory_monitor.h:52 +
+    # raylet/worker_killing_policy*.cc) ---
+    # Host memory-used fraction above which the raylet kills a worker to
+    # relieve pressure (reference default 0.95). <= 0 disables.
+    memory_usage_threshold: float = 0.95
+    # Sampling period for the monitor loop.
+    memory_monitor_refresh_ms: int = 250
+
     # --- workers ---
     num_workers: int = 0  # 0 = num_cpus
     worker_register_timeout_s: float = 30.0
@@ -72,6 +80,9 @@ class Config:
     # (and the grace before budget exhaustion is declared terminal). Must
     # exceed the longest expected task re-execution time.
     lineage_resubmit_grace_s: float = 60.0
+    # Max lineage entries the owner keeps for reconstruction (reference:
+    # RAY_max_lineage_bytes); oldest dropped beyond this.
+    lineage_max_entries: int = 100_000
     actor_max_restarts: int = 0
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5

@@ -17,6 +17,10 @@ Analog of the reference's ``ray_start_regular`` fixture
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Disable the host memory monitor in tests: a CI host already above the
+# 95% kill threshold would otherwise see random worker kills. The OOM
+# tests opt back in explicitly.
+os.environ.setdefault("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0")
 # Append (not guard): XLA's flag parsing is last-occurrence-wins, so this
 # forces 8 virtual devices even if the env already set a different count.
 os.environ["XLA_FLAGS"] = (

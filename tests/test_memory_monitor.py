@@ -1,0 +1,63 @@
+"""Memory monitor: kill workers under host memory pressure, surface
+OutOfMemoryError.
+
+Reference analog: ``python/ray/tests/test_memory_pressure.py`` —
+``MemoryMonitor`` (common/memory_monitor.h:52) + retriable-FIFO worker
+killing policy (raylet/worker_killing_policy_retriable_fifo.cc).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.utils.config import reset_config
+
+
+@pytest.fixture
+def pressure_cluster(monkeypatch):
+    """Cluster whose raylet believes the host is ALWAYS above the memory
+    threshold (0.01 used fraction triggers on any real host)."""
+    monkeypatch.setenv("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0.01")
+    monkeypatch.setenv("RAY_TPU_MEMORY_MONITOR_REFRESH_MS", "100")
+    reset_config()
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+    monkeypatch.setenv("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0")
+    reset_config()
+
+
+def test_oom_kill_surfaces_out_of_memory_error(pressure_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def hog():
+        time.sleep(30)   # stays busy until the monitor kills it
+        return "survived"
+
+    ref = hog.remote()
+    with pytest.raises(ray_tpu.exceptions.OutOfMemoryError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_monitor_disabled_leaves_workers_alone(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0")
+    reset_config()
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.gcs_address)
+    try:
+        @ray_tpu.remote
+        def work():
+            time.sleep(0.5)
+            return 7
+
+        assert ray_tpu.get(work.remote(), timeout=30) == 7
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
