@@ -125,11 +125,14 @@ class GcsServer(RpcServer):
                 dead.append((conn, send_lock))
         if dead:
             with self._lock:
-                for item in dead:
-                    try:
-                        self._subs.get(channel, []).remove(item)
-                    except ValueError:
-                        pass
+                # strip dead conns from EVERY channel (multi-channel
+                # subscribers leave stale entries otherwise)
+                for subs in self._subs.values():
+                    for item in dead:
+                        try:
+                            subs.remove(item)
+                        except ValueError:
+                            pass
             for conn, _ in dead:
                 self.release_conn(conn)   # held channel finished
 

@@ -539,7 +539,10 @@ class Raylet(RpcServer):
                 # fixed sleep: task_done latency, not a 10ms poll, sets
                 # the dispatch rate when all workers are busy
                 with self._ready_cv:
-                    self._ready_cv.wait(timeout=0.05)
+                    # 10ms cap: a task_done notify can race between the
+                    # enqueue above and this wait (missed wakeup); the
+                    # short timeout bounds that stall at the old poll rate
+                    self._ready_cv.wait(timeout=0.01)
                 continue
             if not self._try_acquire(task.get("resources", {})):
                 worker.state = "idle"
@@ -1068,23 +1071,24 @@ class Raylet(RpcServer):
         their state is not re-executable (the reference's group-by-owner
         policy similarly deprioritizes them)."""
         with self._workers_lock:
-            # snapshot tasks INSIDE the lock: _finish_task nulls
-            # current_task concurrently
+            # select AND kill inside the lock: a victim finishing its task
+            # in between would take the SIGKILL for a brand-new task
             busy = [(w, w.current_task, w.dispatched_at)
                     for w in self._workers.values()
                     if w.state == "busy" and w.current_task is not None
                     and w.proc is not None]
-        if not busy:
-            return False
-        busy.sort(key=lambda it: it[2])   # oldest-dispatched first
-        retriable = [it for it in busy
-                     if it[1].get("max_retries", 0) > 0]
-        victim = (retriable or busy)[-1][0]   # newest-dispatched last
-        victim.oom_killed = True
-        try:
-            victim.proc.kill()
-        except OSError:
-            return False
+            if not busy:
+                return False
+            busy.sort(key=lambda it: it[2])   # oldest-dispatched first
+            retriable = [it for it in busy
+                         if it[1].get("max_retries", 0) > 0]
+            victim = (retriable or busy)[-1][0]  # newest-dispatched last
+            victim.oom_killed = True
+            try:
+                victim.proc.kill()
+            except OSError:
+                victim.oom_killed = False  # a later crash is NOT an OOM
+                return False
         return True
 
     def _monitor_loop(self):

@@ -73,6 +73,44 @@ def test_flash_grad_matches_reference():
 
 
 def test_flash_rejects_bad_seq():
-    q = jnp.zeros((1, 200, 2, 64))
-    with pytest.raises(ValueError, match="divisible"):
+    q = jnp.zeros((1, 200, 2, 64))   # 200 is not a multiple of 128
+    with pytest.raises(ValueError, match="multiple of 128"):
         flash_attention(q, q, q, block_q=128, block_k=128, interpret=True)
+
+
+def test_flash_block_autofit():
+    """Requested blocks that don't divide seq shrink to a fitting
+    128-multiple instead of erroring (640 = 5 x 128)."""
+    b, s, h, d = 1, 640, 2, 64
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.key(1), (b, s, h, d))
+    v = jax.random.normal(jax.random.key(2), (b, s, h, d))
+    got = flash_attention(q, k, v, causal=True, block_q=512, block_k=1024,
+                          interpret=True)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_grad_gqa_matches_reference():
+    """Backward with GQA: dk/dv are group-summed across the q-heads that
+    share each kv head."""
+    b, s, h, d = 1, 256, 4, 64
+    q = jax.random.normal(jax.random.key(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.key(1), (b, s, h // 2, d))
+    v = jax.random.normal(jax.random.key(2), (b, s, h // 2, d))
+    w = jax.random.normal(jax.random.key(3), (b, s, h, d))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=128,
+            interpret=True) * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) * w)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-2, atol=2e-2)

@@ -6,9 +6,14 @@ Reference analog: ``python/ray/tests/test_memory_pressure.py`` —
 killing policy (raylet/worker_killing_policy_retriable_fifo.cc).
 """
 
+import os
 import time
 
 import pytest
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists("/proc/meminfo"),
+    reason="host memory sampling reads /proc/meminfo (Linux only)")
 
 import ray_tpu
 from ray_tpu.cluster_utils import Cluster
