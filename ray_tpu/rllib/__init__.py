@@ -6,9 +6,17 @@ _rlu("rllib")
 
 
 from ray_tpu.rllib.dqn import DQN, DQNConfig
-from ray_tpu.rllib.env import BanditEnv, CartPole, make_env
+from ray_tpu.rllib.env import (
+    BanditEnv,
+    CartPole,
+    ContinuousBandit,
+    Pendulum,
+    make_env,
+)
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.sac import SAC, SACConfig
 
-__all__ = ["BanditEnv", "CartPole", "DQN", "DQNConfig", "IMPALA",
-           "IMPALAConfig", "PPO", "PPOConfig", "make_env"]
+__all__ = ["BanditEnv", "CartPole", "ContinuousBandit", "DQN", "DQNConfig",
+           "IMPALA", "IMPALAConfig", "PPO", "PPOConfig", "Pendulum",
+           "SAC", "SACConfig", "make_env"]

@@ -40,13 +40,16 @@ def _np_q(params, obs):
 
 class ReplayBuffer:
     """Uniform ring buffer (reference:
-    ``rllib/utils/replay_buffers/replay_buffer.py``)."""
+    ``rllib/utils/replay_buffers/replay_buffer.py``). ``action_shape``/
+    ``action_dtype`` cover discrete (scalar int) and continuous (vector
+    float) action spaces with one implementation."""
 
-    def __init__(self, capacity: int, obs_dim: int):
+    def __init__(self, capacity: int, obs_dim: int,
+                 action_shape: tuple = (), action_dtype=np.int32):
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros((capacity,), np.int32)
+        self.actions = np.zeros((capacity, *action_shape), action_dtype)
         self.rewards = np.zeros((capacity,), np.float32)
         self.dones = np.zeros((capacity,), np.float32)
         self.size = 0

@@ -83,7 +83,78 @@ class BanditEnv:
         return obs, reward, True, {}
 
 
-ENV_REGISTRY = {"CartPole-v1": CartPole, "Bandit-v0": BanditEnv}
+class Pendulum:
+    """Classic control Pendulum-v1 dynamics (continuous torque in
+    [-2, 2]; reward is negative cost of angle/velocity/effort)."""
+
+    obs_dim = 3
+    action_dim = 1
+    action_low = -2.0
+    action_high = 2.0
+    continuous = True
+
+    def __init__(self, seed: int | None = None):
+        self.rng = np.random.default_rng(seed)
+        self.max_speed = 8.0
+        self.dt = 0.05
+        self.g = 10.0
+        self.m = 1.0
+        self.length = 1.0
+        self.max_steps = 200
+        self.state = None
+        self.steps = 0
+
+    def _obs(self):
+        th, thdot = self.state
+        return np.array([np.cos(th), np.sin(th), thdot], dtype=np.float32)
+
+    def reset(self):
+        self.state = self.rng.uniform([-np.pi, -1.0], [np.pi, 1.0])
+        self.steps = 0
+        return self._obs()
+
+    def step(self, action):
+        th, thdot = self.state
+        u = float(np.clip(np.asarray(action).reshape(-1)[0], -2.0, 2.0))
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+        thdot = thdot + (3 * self.g / (2 * self.length) * np.sin(th)
+                         + 3.0 / (self.m * self.length**2) * u) * self.dt
+        thdot = float(np.clip(thdot, -self.max_speed, self.max_speed))
+        th = th + thdot * self.dt
+        self.state = np.array([th, thdot])
+        self.steps += 1
+        done = self.steps >= self.max_steps
+        return self._obs(), -float(cost), done, {}
+
+
+class ContinuousBandit:
+    """One-step continuous-action env with a deterministic optimum
+    (reward = -(a - 0.5)^2): fast, non-flaky learning signal for
+    continuous-control tests."""
+
+    obs_dim = 1
+    action_dim = 1
+    action_low = -1.0
+    action_high = 1.0
+    continuous = True
+    target = 0.5
+
+    def __init__(self, seed: int | None = None):
+        self.rng = np.random.default_rng(seed)
+
+    def reset(self):
+        return np.zeros(1, dtype=np.float32)
+
+    def step(self, action):
+        a = float(np.clip(np.asarray(action).reshape(-1)[0], -1.0, 1.0))
+        reward = -(a - self.target) ** 2
+        return self.reset(), reward, True, {}
+
+
+ENV_REGISTRY = {"CartPole-v1": CartPole, "Bandit-v0": BanditEnv,
+                "Pendulum-v1": Pendulum,
+                "ContinuousBandit-v0": ContinuousBandit}
 
 
 def make_env(name_or_cls, seed=None):

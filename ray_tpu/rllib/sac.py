@@ -1,0 +1,311 @@
+"""SAC on JAX: continuous control with squashed-Gaussian actor, twin Q
+critics, soft target updates, and auto-tuned entropy temperature.
+
+Reference analog: ``rllib/algorithms/sac/`` (SAC with twin Q networks,
+target entropy = -|A|, replay buffer). TPU-first shape: the entire update
+(actor + both critics + alpha) is ONE jitted function of stacked batches —
+small MLP matmuls fuse on the MXU; replay stays host-side numpy like the
+reference keeps it on CPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.dqn import ReplayBuffer
+from ray_tpu.rllib.env import make_env
+
+LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
+
+
+# ---------------------------------------------------------------------------
+# networks (pure-functional MLPs, kept local — actor outputs (mu, log_std),
+# critics take [obs, action] and output one scalar)
+# ---------------------------------------------------------------------------
+
+def _init_mlp(key, sizes):
+    import jax
+
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, (n_in, n_out) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        w = jax.random.normal(k, (n_in, n_out)) * (n_in ** -0.5)
+        params.append({"w": w, "b": np.zeros((n_out,), np.float32)})
+    return params
+
+
+def _mlp(params, x):
+    import jax.numpy as jnp
+
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def init_sac(key, obs_dim: int, action_dim: int, hidden: int = 64):
+    import jax
+
+    ka, k1, k2 = jax.random.split(key, 3)
+    return {
+        "actor": _init_mlp(ka, (obs_dim, hidden, hidden, 2 * action_dim)),
+        "q1": _init_mlp(k1, (obs_dim + action_dim, hidden, hidden, 1)),
+        "q2": _init_mlp(k2, (obs_dim + action_dim, hidden, hidden, 1)),
+        "log_alpha": np.zeros((), np.float32),
+    }
+
+
+def _actor_dist(actor_params, obs):
+    import jax.numpy as jnp
+
+    out = _mlp(actor_params, obs)
+    mu, log_std = jnp.split(out, 2, axis=-1)
+    log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+    return mu, log_std
+
+
+def _sample_action(actor_params, obs, key):
+    """Squashed-Gaussian sample + its log-prob (tanh correction)."""
+    import jax
+    import jax.numpy as jnp
+
+    mu, log_std = _actor_dist(actor_params, obs)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(key, mu.shape)
+    pre = mu + std * eps
+    a = jnp.tanh(pre)
+    logp = jnp.sum(
+        -0.5 * (eps**2 + 2 * log_std + jnp.log(2 * jnp.pi))
+        - jnp.log(1 - a**2 + 1e-6),
+        axis=-1,
+    )
+    return a, logp
+
+
+def _q(params, obs, act):
+    import jax.numpy as jnp
+
+    x = jnp.concatenate([obs, act], axis=-1)
+    return _mlp(params, x)[..., 0]
+
+
+def _sac_update(params, target_q, opt_state, batch, key, *, tx, gamma, tau,
+                target_entropy):
+    """One SAC step: critics -> actor -> temperature, then polyak targets."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    obs, act = batch["obs"], batch["actions"]
+    rew, nxt, done = batch["rewards"], batch["next_obs"], batch["dones"]
+    k1, k2 = jax.random.split(key)
+    alpha = jnp.exp(params["log_alpha"])
+
+    # target: r + gamma * (min Q_target(s', a') - alpha * logp(a'))
+    na, nlogp = _sample_action(params["actor"], nxt, k1)
+    tq = jnp.minimum(_q(target_q["q1"], nxt, na),
+                     _q(target_q["q2"], nxt, na))
+    target = rew + gamma * (1.0 - done) * (tq - alpha * nlogp)
+    target = jax.lax.stop_gradient(target)
+
+    def loss_fn(p):
+        q1 = _q(p["q1"], obs, act)
+        q2 = _q(p["q2"], obs, act)
+        critic_loss = jnp.mean((q1 - target) ** 2) \
+            + jnp.mean((q2 - target) ** 2)
+        a_new, logp = _sample_action(p["actor"], obs, k2)
+        q_new = jnp.minimum(
+            _q(jax.lax.stop_gradient(p["q1"]), obs, a_new),
+            _q(jax.lax.stop_gradient(p["q2"]), obs, a_new))
+        actor_loss = jnp.mean(
+            jnp.exp(jax.lax.stop_gradient(p["log_alpha"])) * logp - q_new)
+        alpha_loss = -p["log_alpha"] * jnp.mean(
+            jax.lax.stop_gradient(logp) + target_entropy)
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {"critic_loss": critic_loss,
+                       "actor_loss": actor_loss,
+                       "alpha": jnp.exp(p["log_alpha"]),
+                       "entropy": -jnp.mean(logp)}
+
+    grads, metrics = jax.grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    target_q = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                            target_q,
+                            {"q1": params["q1"], "q2": params["q2"]})
+    return params, target_q, opt_state, metrics
+
+
+class _SACRolloutWorker:
+    def __init__(self, env_name, seed: int):
+        self.env = make_env(env_name, seed=seed)
+        self.rng = np.random.default_rng(seed)
+        self.obs = self.env.reset()
+        self.ep_ret = 0.0
+        self.low = float(getattr(self.env, "action_low", -1.0))
+        self.high = float(getattr(self.env, "action_high", 1.0))
+
+    def _act(self, actor_np, obs):
+        # numpy mirror of _sample_action (rollout actors stay jax-free)
+        x = obs[None]
+        for i, layer in enumerate(actor_np):
+            x = x @ layer["w"] + layer["b"]
+            if i < len(actor_np) - 1:
+                x = np.tanh(x)
+        mu, log_std = np.split(x[0], 2)
+        std = np.exp(np.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+        a = np.tanh(mu + std * self.rng.standard_normal(mu.shape))
+        return a
+
+    def _scale(self, a):
+        return self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+
+    def sample(self, actor_np, num_steps: int, random_actions: bool):
+        obs_l, next_l, act_l, rew_l, done_l = [], [], [], [], []
+        episode_returns = []
+        for _ in range(num_steps):
+            if random_actions:
+                a = self.rng.uniform(-1.0, 1.0,
+                                     size=self.env.action_dim)
+            else:
+                a = self._act(actor_np, self.obs)
+            next_obs, reward, done, _ = self.env.step(self._scale(a))
+            obs_l.append(self.obs)
+            next_l.append(next_obs)
+            act_l.append(a.astype(np.float32))
+            rew_l.append(reward)
+            done_l.append(float(done))
+            self.ep_ret += reward
+            if done:
+                episode_returns.append(self.ep_ret)
+                self.ep_ret = 0.0
+                self.obs = self.env.reset()
+            else:
+                self.obs = next_obs
+        return {"obs": np.asarray(obs_l, np.float32),
+                "next_obs": np.asarray(next_l, np.float32),
+                "actions": np.asarray(act_l, np.float32),
+                "rewards": np.asarray(rew_l, np.float32),
+                "dones": np.asarray(done_l, np.float32),
+                "episode_returns": episode_returns}
+
+
+@dataclass
+class SACConfig:
+    env: str = "Pendulum-v1"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 128
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005              # polyak target rate
+    buffer_capacity: int = 100_000
+    learning_starts: int = 500
+    train_batch_size: int = 128
+    num_updates_per_iter: int = 32
+    target_entropy: float | None = None   # default -action_dim
+    hidden: int = 64
+    seed: int = 0
+
+    def environment(self, env) -> "SACConfig":
+        return replace(self, env=env)
+
+    def rollouts(self, **kw) -> "SACConfig":
+        return replace(self, **kw)
+
+    def training(self, **kw) -> "SACConfig":
+        return replace(self, **kw)
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    def __init__(self, config: SACConfig):
+        import jax
+        import optax
+
+        self.config = config
+        env = make_env(config.env, seed=config.seed)
+        if not getattr(env, "continuous", False):
+            raise ValueError(f"SAC requires a continuous-action env, "
+                             f"got {config.env!r}")
+        self.obs_dim = env.obs_dim
+        self.action_dim = env.action_dim
+        self.action_low = float(getattr(env, "action_low", -1.0))
+        self.action_high = float(getattr(env, "action_high", 1.0))
+        self.params = init_sac(jax.random.key(config.seed), self.obs_dim,
+                               self.action_dim, config.hidden)
+        self.target_q = jax.tree.map(
+            lambda x: x, {"q1": self.params["q1"], "q2": self.params["q2"]})
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = ReplayBuffer(config.buffer_capacity, self.obs_dim,
+                                   action_shape=(self.action_dim,),
+                                   action_dtype=np.float32)
+        self.iteration = 0
+        self.rng = np.random.default_rng(config.seed)
+        self.key = jax.random.key(config.seed + 1)
+        te = (config.target_entropy if config.target_entropy is not None
+              else -float(self.action_dim))
+        worker_cls = ray_tpu.remote(_SACRolloutWorker)
+        self.workers = [
+            worker_cls.remote(config.env, config.seed + 1000 * (i + 1))
+            for i in range(config.num_rollout_workers)
+        ]
+        self._update = jax.jit(partial(
+            _sac_update, tx=self.tx, gamma=config.gamma, tau=config.tau,
+            target_entropy=te))
+
+    def train(self) -> dict:
+        import jax
+
+        cfg = self.config
+        actor_np = jax.tree.map(np.asarray, self.params["actor"])
+        warmup = self.buffer.size < cfg.learning_starts
+        batches = ray_tpu.get([
+            w.sample.remote(actor_np, cfg.rollout_fragment_length, warmup)
+            for w in self.workers
+        ])
+        episode_returns = []
+        for b in batches:
+            episode_returns.extend(b.pop("episode_returns"))
+            self.buffer.add_batch(b)
+
+        metrics = {}
+        if self.buffer.size >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size, self.rng)
+                self.key, sub = jax.random.split(self.key)
+                (self.params, self.target_q, self.opt_state,
+                 metrics) = self._update(
+                    self.params, self.target_q, self.opt_state, mb, sub)
+            metrics = {k: float(v) for k, v in metrics.items()}
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (float(np.mean(episode_returns))
+                                    if episode_returns else float("nan")),
+            "episodes_this_iter": len(episode_returns),
+            "buffer_size": self.buffer.size,
+            **metrics,
+        }
+
+    def compute_single_action(self, obs) -> np.ndarray:
+        """Deterministic (mean) action for evaluation."""
+        import jax
+        import jax.numpy as jnp
+
+        mu, _ = _actor_dist(self.params["actor"],
+                            jnp.asarray(obs, jnp.float32)[None])
+        a = np.tanh(np.asarray(mu)[0])
+        return self.action_low + (a + 1.0) * 0.5 * (
+            self.action_high - self.action_low)
+
+    def stop(self):
+        for w in self.workers:
+            ray_tpu.kill(w)

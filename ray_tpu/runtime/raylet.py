@@ -83,6 +83,9 @@ class Raylet(RpcServer):
         self._max_workers = max(1, int(resources.get("CPU", 1)))
         self._ready: deque[dict] = deque()
         self._ready_cv = threading.Condition()
+        # bumped on every completion/registration: the dispatch loop
+        # re-checks it under the cv so a kick racing the wait is never lost
+        self._dispatch_gen = 0
         self._hb_interval = heartbeat_interval_s
         self._threads: list[threading.Thread] = []
         # --- object spilling (reference: LocalObjectManager::SpillObjects
@@ -494,6 +497,7 @@ class Raylet(RpcServer):
 
     def _kick_dispatch(self):
         with self._ready_cv:
+            self._dispatch_gen += 1
             self._ready_cv.notify()
 
     def _avail_snapshot(self) -> dict:
@@ -532,17 +536,18 @@ class Raylet(RpcServer):
                 if task is None:
                     self._ready_cv.wait(timeout=0.1)
                     continue
+            gen = self._dispatch_gen
             worker = self._idle_worker(task.get("runtime_env"))
             if worker is None:
                 self._enqueue(task)
                 # wait for a completion/registration kick instead of a
-                # fixed sleep: task_done latency, not a 10ms poll, sets
-                # the dispatch rate when all workers are busy
+                # fixed sleep: task_done latency, not a poll, sets the
+                # dispatch rate when all workers are busy. The generation
+                # check under the cv closes the missed-wakeup race (a
+                # kick between the snapshot above and this wait).
                 with self._ready_cv:
-                    # 10ms cap: a task_done notify can race between the
-                    # enqueue above and this wait (missed wakeup); the
-                    # short timeout bounds that stall at the old poll rate
-                    self._ready_cv.wait(timeout=0.01)
+                    if self._dispatch_gen == gen and not self._stopping:
+                        self._ready_cv.wait(timeout=0.2)
                 continue
             if not self._try_acquire(task.get("resources", {})):
                 worker.state = "idle"

@@ -92,12 +92,20 @@ class LLMEngine:
     """Slot-based continuous batching over `ray_tpu.models.decoding`."""
 
     def __init__(self, cfg, params, *, max_batch: int = 8,
-                 max_len: int = 2048, prefill_chunk: int = 1024):
+                 max_len: int = 2048, prefill_chunk: int = 1024,
+                 decode_chunk: int = 16):
         self.cfg = cfg
         self.params = params
         self.max_batch = max_batch
         self.max_len = max_len
         self.prefill_chunk = prefill_chunk
+        # tokens generated per device round trip: one host sync per CHUNK
+        # of decode steps (lax.scan), not per token — essential when the
+        # chip sits behind a network tunnel where each sync costs an RTT,
+        # and still fewer dispatches on local chips. Admission of waiting
+        # requests happens between chunks (adds <= chunk * step_time to
+        # queueing latency).
+        self.decode_chunk = max(1, decode_chunk)
         self._cache = decoding.init_cache(cfg, max_batch, max_len)
         # host-side slot state (mirrors cache.lengths but trusted copy)
         self._lengths = np.zeros((max_batch,), np.int32)
@@ -118,7 +126,8 @@ class LLMEngine:
         self.ttfts: "deque[float]" = deque(maxlen=1024)
 
         self._decode_fn = jax.jit(
-            partial(self._decode_impl, cfg), donate_argnums=(1,)
+            partial(self._decode_impl, cfg, chunk=self.decode_chunk),
+            donate_argnums=(1,)
         )
         self._prefill_fn = jax.jit(
             partial(self._prefill_impl, cfg),
@@ -129,19 +138,31 @@ class LLMEngine:
 
     @staticmethod
     def _decode_impl(cfg, params, cache: KVCache, tokens, lengths, active,
-                     temps, key):
-        """One decode step over every slot. Inactive slots are computed but
-        masked (position 0 write is harmless: a later prefill overwrites)."""
-        start = jnp.where(active, lengths, 0)
-        logits, cache = decoding.cached_forward(
-            cfg, params, tokens[:, None], cache, start=start,
-            logits_mode="last",
-        )
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-        nxt = jnp.where(temps > 0.0, sampled, greedy)
-        return cache, nxt
+                     temps, key, *, chunk):
+        """``chunk`` decode steps over every slot in one compiled program
+        (scan); returns the [chunk, max_batch] token matrix. Inactive
+        slots are computed but masked (position 0 write is harmless: a
+        later prefill overwrites). Slots finishing mid-chunk keep
+        decoding; the host drops their surplus tokens."""
+        def step(carry, _):
+            cache, toks, lens, key = carry
+            key, sub = jax.random.split(key)
+            start = jnp.where(active, lens, 0)
+            logits, cache = decoding.cached_forward(
+                cfg, params, toks[:, None], cache, start=start,
+                logits_mode="last",
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jax.random.categorical(
+                sub, scaled, axis=-1).astype(jnp.int32)
+            nxt = jnp.where(temps > 0.0, sampled, greedy)
+            lens = jnp.where(active, lens + 1, lens)
+            return (cache, nxt, lens, key), nxt
+
+        (cache, _, _, _), toks = jax.lax.scan(
+            step, (cache, tokens, lengths, key), None, length=chunk)
+        return cache, toks
 
     @staticmethod
     def _prefill_impl(cfg, params, cache: KVCache, tokens, plen, slot, *,
@@ -281,16 +302,19 @@ class LLMEngine:
             temps = np.array(
                 [r.temperature if r is not None else 0.0
                  for r in self._active], np.float32)
-            self._cache, nxt = self._decode_fn(
+            self._cache, toks = self._decode_fn(
                 self.params, self._cache, jnp.asarray(self._last_tok),
                 jnp.asarray(self._lengths), jnp.asarray(active),
                 jnp.asarray(temps), self._next_key(),
             )
-            nxt = np.asarray(nxt)
+            toks = np.asarray(toks)           # [chunk, max_batch]
             for i in active_idx:
-                self._lengths[i] += 1  # the token just consumed is now cached
-                req = self._active[i]
-                self._emit(req, int(nxt[i]))
+                for t in range(toks.shape[0]):
+                    req = self._active[i]
+                    if req is None:
+                        break   # finished mid-chunk; drop surplus tokens
+                    self._lengths[i] += 1  # consumed token is now cached
+                    self._emit(req, int(toks[t, i]))
 
     # -- metrics -----------------------------------------------------------
 
