@@ -85,3 +85,30 @@ class DataIterator:
                 yield window.popleft()
         while window:
             yield window.popleft()
+
+    def iter_torch_batches(self, *, batch_size: int | None = None,
+                           drop_last: bool = False, dtypes: dict | None = None,
+                           device: str | None = None) -> Iterator[dict]:
+        """Batches as torch tensors (reference:
+        ``data/iterator.py:239 iter_torch_batches``) — CPU torch interop
+        for TorchTrainer-style loops; numeric columns only."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                arr = np.ascontiguousarray(v)
+                if not arr.flags.writeable or arr is v:
+                    # blocks alias the (read-only, shared) object store;
+                    # torch tensors must own their memory — an in-place
+                    # op on a zero-copy view would corrupt the stored
+                    # block for every other consumer (or SIGSEGV on the
+                    # read-only shm mapping)
+                    arr = arr.copy()
+                t = torch.from_numpy(arr)
+                if (dtypes and k in dtypes) or device is not None:
+                    t = t.to(device=device,
+                             dtype=dtypes.get(k) if dtypes else None)
+                out[k] = t
+            yield out

@@ -13,6 +13,7 @@ from ray_tpu.tune.schedulers import (
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
+    BOHBSearcher,
     BasicVariantGenerator,
     ConcurrencyLimiter,
     Searcher,
@@ -27,6 +28,7 @@ from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
 
 __all__ = [
     "AsyncHyperBandScheduler",
+    "BOHBSearcher",
     "BasicVariantGenerator",
     "ConcurrencyLimiter",
     "HyperBandScheduler",

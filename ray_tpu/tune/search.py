@@ -263,6 +263,61 @@ class TPESearcher(Searcher):
         return self.rng.choices(options, weights=weights, k=1)[0]
 
 
+class BOHBSearcher(TPESearcher):
+    """BOHB-style model-based search: TPE models fit PER TRAINING BUDGET
+    (training_iteration), proposals drawn from the largest budget with
+    enough observations. Pair with ``HyperBandScheduler`` — together they
+    are the native analog of the reference's TuneBOHB + HpBandSter
+    (``tune/search/bohb/``): HyperBand allocates budgets and promotes,
+    BOHB replaces its random sampling with a model.
+
+        tuner = Tuner(train_fn, param_space=space,
+                      tune_config=TuneConfig(
+                          search_alg=BOHBSearcher(space, metric="loss",
+                                                  mode="min", num_samples=32),
+                          scheduler=HyperBandScheduler(metric="loss",
+                                                       mode="min")))
+    """
+
+    def __init__(self, space: dict, *, metric: str, mode: str = "max",
+                 num_samples: int = 32, n_startup: int = 8,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 seed: int | None = None):
+        super().__init__(space, metric=metric, mode=mode,
+                         num_samples=num_samples, n_startup=n_startup,
+                         gamma=gamma, n_candidates=n_candidates, seed=seed)
+        # budget (training_iteration) -> {trial_id: (cfg, score)}
+        self._obs_by_budget: dict[int, dict] = {}
+
+    def _record(self, trial_id: str, result: dict):
+        if not result or self.metric not in result:
+            return
+        cfg = self._configs.get(trial_id)
+        if cfg is None:
+            return
+        budget = int(result.get("training_iteration", 1))
+        level = self._obs_by_budget.setdefault(budget, {})
+        level[trial_id] = (cfg, float(result[self.metric]))
+
+    def on_trial_result(self, trial_id, result):
+        # BOHB's point: partial results at rung boundaries feed the model
+        self._record(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        if not error and result:
+            self._record(trial_id, result)
+
+    def suggest(self, trial_id: str) -> dict | None:
+        # model on the LARGEST budget with enough observations (BOHB rule)
+        self._obs = {}
+        for budget in sorted(self._obs_by_budget, reverse=True):
+            level = self._obs_by_budget[budget]
+            if len(level) >= self.n_startup:
+                self._obs = dict(level)
+                break
+        return super().suggest(trial_id)
+
+
 class ConcurrencyLimiter(Searcher):
     """Caps in-flight suggestions (reference:
     tune/search/concurrency_limiter.py)."""

@@ -218,3 +218,18 @@ def test_zip_no_silent_overwrite(rt):
     b = rd.from_numpy({"k": np.arange(3) * 5})
     cols = set(a.zip(b).schema())
     assert cols == {"k", "k_1", "k_2"}
+
+
+def test_iter_torch_batches():
+    import numpy as np
+    import torch
+
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(100, num_blocks=4).map_batches(
+        lambda b: {"x": np.asarray(b["id"], np.float32) * 2})
+    batches = list(ds.iterator().iter_torch_batches(batch_size=32))
+    assert all(isinstance(b["x"], torch.Tensor) for b in batches)
+    total = torch.cat([b["x"] for b in batches])
+    assert total.shape == (100,)
+    assert float(total.sum()) == float(2 * sum(range(100)))

@@ -151,3 +151,37 @@ def test_tuner_restore(rt, tmp_path):
     rerun = next(t for t in grid2.trials
                  if t.last_result.get("resumed_from"))
     assert rerun.last_result["resumed_from"].endswith("ck")
+
+
+def test_bohb_searcher_with_hyperband():
+    """BOHB = HyperBand budgets + per-budget TPE models: finds a good lr
+    on a deterministic objective (reference: tune/search/bohb/)."""
+    from ray_tpu.tune import (BOHBSearcher, HyperBandScheduler, TuneConfig,
+                              Tuner, loguniform)
+
+    def objective(config):
+        import math
+
+        from ray_tpu.train import session
+
+        for i in range(4):
+            # best at lr=1e-2; quality improves with iterations
+            loss = abs(math.log10(config["lr"]) + 2) + 1.0 / (i + 1)
+            session.report({"loss": loss})
+
+    space = {"lr": loguniform(1e-5, 1e0)}
+    tuner = Tuner(
+        objective,
+        param_space=space,
+        tune_config=TuneConfig(
+            search_alg=BOHBSearcher(space, metric="loss", mode="min",
+                                    num_samples=16, n_startup=4, seed=0),
+            scheduler=HyperBandScheduler(metric="loss", mode="min", r=1,
+                                         max_t=4),
+            max_concurrent_trials=4,
+        ),
+    )
+    results = tuner.fit()
+    best = results.get_best_result(metric="loss", mode="min")
+    import math
+    assert abs(math.log10(best.config["lr"]) + 2) < 1.5, best.config
