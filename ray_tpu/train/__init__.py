@@ -19,11 +19,14 @@ from ray_tpu.train.data_parallel_trainer import (
 )
 from ray_tpu.train.elastic import ElasticTrainer
 from ray_tpu.train.session import get_checkpoint_dir, get_context, report
+from ray_tpu.train.accelerate import AccelerateTrainer
 from ray_tpu.train.torch import TorchConfig, TorchTrainer
+from ray_tpu.train.transformers import TransformersTrainer
 from ray_tpu.train.trainer import JaxTrainer, TrainConfig
 from ray_tpu.train.worker_group import BackendExecutor, WorkerGroup
 
 __all__ = [
+    "AccelerateTrainer",
     "BackendExecutor",
     "CheckpointConfig",
     "DataParallelTrainer",
@@ -36,6 +39,7 @@ __all__ = [
     "ScalingConfig",
     "TorchConfig",
     "TorchTrainer",
+    "TransformersTrainer",
     "TrainConfig",
     "WorkerGroup",
     "get_checkpoint_dir",

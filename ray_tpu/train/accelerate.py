@@ -1,0 +1,33 @@
+"""AccelerateTrainer: HuggingFace Accelerate loops on rank workers.
+
+Reference analog: ``train/huggingface/accelerate/accelerate_trainer.py``.
+``accelerate.Accelerator()`` constructed inside ``train_loop_per_worker``
+discovers the torch.distributed (gloo) process group the torch backend
+already initialized — RANK/WORLD_SIZE env vars are set per rank actor —
+so ``accelerator.prepare(model, optimizer, loader)`` gives the standard
+Accelerate DDP behavior with no extra configuration.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.train.torch import TorchTrainer
+
+
+class AccelerateTrainer(TorchTrainer):
+    """``TorchTrainer`` whose contract is an Accelerate-style loop.
+
+    Usage::
+
+        def train_loop(config):
+            from accelerate import Accelerator
+            accelerator = Accelerator(cpu=True)
+            model, opt, loader = accelerator.prepare(model, opt, loader)
+            for batch in loader:
+                loss = model(**batch)
+                accelerator.backward(loss)
+                ...
+                session.report({"loss": float(loss)})
+
+        AccelerateTrainer(train_loop,
+                          scaling_config=ScalingConfig(num_workers=2)).fit()
+    """

@@ -48,6 +48,19 @@ def _wrap_torch(train_fn, torch_config: TorchConfig):
             # re-initialization on retries)
             store_path = os.path.join(ctx.get_trial_dir(),
                                       "torch_pg_store")
+            # torch-ecosystem libraries (HF Trainer, accelerate) detect
+            # distribution from these env vars, NOT from an
+            # already-initialized process group — without them they
+            # silently fall back to single-process semantics (no data
+            # sharding, no gradient averaging) on every rank
+            os.environ["RANK"] = str(ctx.get_world_rank())
+            os.environ["WORLD_SIZE"] = str(world)
+            os.environ["LOCAL_RANK"] = str(ctx.get_local_rank())
+            # accelerate validates these even though the group below is
+            # initialized via the file store (it only falls back to
+            # env:// when no group exists yet)
+            os.environ.setdefault("MASTER_ADDR", "127.0.0.1")
+            os.environ.setdefault("MASTER_PORT", "29500")
             from datetime import timedelta
 
             dist.init_process_group(

@@ -1,0 +1,91 @@
+"""TransformersTrainer: HuggingFace Trainer inside the rank-actor harness.
+
+Reference analog: ``train/huggingface/transformers/transformers_trainer.py``
+(the reference also ships deprecation shims for the older
+``HuggingFaceTrainer`` name — ``train/huggingface/_deprecation_msg.py``).
+Shape follows the reference's prepare-style API: the user builds a normal
+``transformers.Trainer`` inside ``trainer_init_per_worker``; this wrapper
+runs it on each rank under the torch (gloo) process group that
+``TorchTrainer`` boots, wires HF's logging callbacks into
+``session.report`` so Tune schedulers see intermediate metrics, and
+reports the final train result with a checkpoint.
+
+The TPU-native flagship path is ``JaxTrainer`` (XLA device plane); this
+exists for capability parity with torch-ecosystem users.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train import session
+from ray_tpu.train.torch import TorchConfig, TorchTrainer
+
+
+def _wrap_hf(trainer_init_per_worker):
+    def hf_loop(config):
+        import transformers
+
+        trainer = trainer_init_per_worker(config)
+        if not isinstance(trainer, transformers.Trainer):
+            raise TypeError(
+                "trainer_init_per_worker must return a transformers.Trainer,"
+                f" got {type(trainer).__name__}")
+
+        class _ReportCallback(transformers.TrainerCallback):
+            def on_log(self, args, state, control, logs=None, **kwargs):
+                if logs and state.is_world_process_zero:
+                    metrics = {k: v for k, v in logs.items()
+                               if isinstance(v, (int, float))}
+                    metrics["step"] = state.global_step
+                    session.report(metrics)
+
+        trainer.add_callback(_ReportCallback())
+        result = trainer.train()
+        ckpt_dir = None
+        ctx = session.get_context()
+        if ctx.get_world_rank() == 0:
+            import os
+
+            ckpt_dir = os.path.join(ctx.get_trial_dir(), "hf_final")
+            trainer.save_model(ckpt_dir)
+        final = {"training_loss": float(result.training_loss),
+                 "global_step": int(result.global_step)}
+        session.report(final, checkpoint_dir=ckpt_dir)
+
+    return hf_loop
+
+
+class TransformersTrainer(TorchTrainer):
+    """Run a ``transformers.Trainer`` on every rank worker.
+
+    Usage::
+
+        def trainer_init(config):
+            model = AutoModelForSequenceClassification.from_pretrained(...)
+            args = TrainingArguments(output_dir=..., max_steps=10, ...)
+            return Trainer(model=model, args=args, train_dataset=ds)
+
+        result = TransformersTrainer(
+            trainer_init,
+            scaling_config=ScalingConfig(num_workers=2),
+        ).fit()
+
+    HF's own distributed support (torch.distributed env vars) picks up the
+    gloo process group the torch backend initializes, so per-rank data
+    sharding and gradient averaging follow the standard HF behavior.
+    """
+
+    def __init__(self, trainer_init_per_worker, *,
+                 train_loop_config: dict | None = None,
+                 torch_config: TorchConfig | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 datasets: dict | None = None):
+        super().__init__(
+            _wrap_hf(trainer_init_per_worker),
+            train_loop_config=train_loop_config,
+            torch_config=torch_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+        )
