@@ -170,7 +170,7 @@ def wait(
 
 
 def cancel(ref: ObjectRef, *, force: bool = False):
-    _runtime().cancel(ref)
+    _runtime().cancel(ref, force=force)
 
 
 # ---------------------------------------------------------------------------

@@ -72,8 +72,8 @@ class ClientRuntime:
         return ([by_id[h] for h in out["ready"]],
                 [by_id[h] for h in out["not_ready"]])
 
-    def cancel(self, ref: ObjectRef):
-        self._rpc.call("client_cancel", oid=ref.id.hex())
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        self._rpc.call("client_cancel", oid=ref.id.hex(), force=force)
 
     def note_return_owner(self, spec) -> None:
         pass  # ownership lives server-side

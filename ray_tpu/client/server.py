@@ -81,7 +81,7 @@ class ClientServer(RpcServer):
         return {"ready": [r.id.hex() for r in ready],
                 "not_ready": [r.id.hex() for r in not_ready]}
 
-    def rpc_client_cancel(self, conn, send_lock, *, oid):
+    def rpc_client_cancel(self, conn, send_lock, *, oid, force=False):
         self._rt.cancel(ObjectRef(ObjectID.from_hex(oid)))
         return {"ok": True}
 

@@ -746,7 +746,7 @@ class Runtime:
     # Cancellation
     # ------------------------------------------------------------------
 
-    def cancel(self, ref: ObjectRef):
+    def cancel(self, ref: ObjectRef, force: bool = False):
         # Best-effort: mark every task whose return id matches. Local mode
         # cannot interrupt a running Python frame (same caveat as the
         # reference for non-async actors); queued tasks fail fast.

@@ -241,8 +241,16 @@ class ClusterRuntime:
             time.sleep(0.01)
         return ready, not_ready
 
-    def cancel(self, ref: ObjectRef):
-        pass  # best-effort: cluster-mode cancellation lands in round 2
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        """Best-effort task cancellation (reference ``ray.cancel``):
+        queued tasks are dequeued, running tasks interrupted (``force``:
+        worker killed); consumers of the return object observe
+        ``TaskCancelledError``. Finished tasks are untouched."""
+        try:
+            self._raylet.call("cancel_task", oids=[ref.id.hex()],
+                              force=force)
+        except (OSError, ConnectionLost):
+            pass
 
     def note_return_owner(self, spec: TaskSpec):
         pass  # ownership is tracked centrally (GCS object directory)

@@ -315,7 +315,8 @@ class Raylet(RpcServer):
                                reason=msg.get("reason", "creation failed"))
 
     def _finish_task(self, w: WorkerHandle, msg: dict):
-        w.current_task = None
+        with self._workers_lock:
+            w.current_task = None
         if w.state == "busy":
             # actor workers keep their acquisition for their LIFETIME
             # (released on death/kill); only per-task resources return here
@@ -352,7 +353,12 @@ class Raylet(RpcServer):
             except Exception:  # noqa: BLE001 - gcs may be shutting down
                 pass
         elif task is not None:
-            if task.get("max_retries", 0) > 0:
+            decided = all(self.store.contains(bytes.fromhex(o))
+                          for o in task.get("return_oids", ()))
+            if decided or task.get("cancelled"):
+                pass   # cancelled (error pre-stored) or results written:
+                       # a retry would re-run completed/cancelled work
+            elif task.get("max_retries", 0) > 0:
                 task["max_retries"] -= 1
                 self._enqueue(task)
             elif w.oom_killed:
@@ -553,9 +559,17 @@ class Raylet(RpcServer):
                 worker.state = "idle"
                 self._enqueue(task)
                 continue
-            worker.acquired = dict(task.get("resources", {}))
-            worker.current_task = task
-            worker.dispatched_at = time.monotonic()
+            with self._workers_lock:
+                # under the lock: cancel_task scans current_task here, and
+                # a cancel that ran between the queue pop and this point
+                # left a flag on the task dict
+                if task.get("cancelled"):
+                    self._release(task.get("resources", {}))
+                    worker.state = "idle"
+                    continue
+                worker.acquired = dict(task.get("resources", {}))
+                worker.current_task = task
+                worker.dispatched_at = time.monotonic()
             try:
                 send_msg(worker.conn, {"type": "task", "task": task},
                          worker.send_lock)
@@ -686,6 +700,102 @@ class Raylet(RpcServer):
         send_msg(target.conn, {"type": "actor_task", "task": task},
                  target.send_lock)
         return {"ok": True}
+
+    def rpc_cancel_task(self, conn, send_lock, *, oids: list,
+                        force: bool = False, broadcast: bool = True):
+        """Cancel the task owning these return oids (reference:
+        ``CoreWorker::CancelTask`` → raylet CancelTask RPC): queued tasks
+        are dequeued; a running task's worker gets SIGINT (``force``:
+        SIGKILL). The TaskCancelledError return object is written FIRST —
+        first-write-wins makes a racing normal completion a no-op.
+        Already-finished tasks (return objects exist) are untouched."""
+        from ray_tpu.utils import exceptions as exc
+
+        targets = set(oids)
+        if all(self.store.contains(bytes.fromhex(o)) for o in targets):
+            return {"found": True, "state": "finished"}
+
+        def matches(task):
+            return task and targets & set(task.get("return_oids", ()))
+
+        # queued here? Flag + dequeue under the cv; the error store (a
+        # durable put + GCS RPC) runs OUTSIDE the cv so dispatch/enqueue
+        # never stall behind it. The flag also covers a task already
+        # popped by the dispatch loop but not yet assigned to a worker.
+        queued = None
+        with self._ready_cv:
+            for i, t in enumerate(self._ready):
+                if matches(t):
+                    queued = t
+                    del self._ready[i]
+                    break
+        if queued is not None:
+            queued["cancelled"] = True
+            self._store_task_error(queued, exc.TaskCancelledError(
+                f"task {queued.get('name')} cancelled while queued"))
+            return {"found": True, "state": "queued"}
+        # running here?
+        with self._workers_lock:
+            victim = None
+            for w in self._workers.values():
+                if w.state == "busy" and matches(w.current_task):
+                    victim = w
+                    victim.current_task["cancelled"] = True
+                    break
+        if victim is not None:
+            task = victim.current_task
+            # pre-store the cancelled error; the worker's own
+            # (interrupted or successful) write loses the race
+            self._store_task_error(task, exc.TaskCancelledError(
+                f"task {task.get('name')} cancelled while running"))
+            with self._workers_lock:
+                # re-verify: the worker may have finished the target and
+                # moved on — never signal it over someone else's task
+                if not matches(victim.current_task):
+                    return {"found": True, "state": "running"}
+                if force:
+                    # no retry for a cancelled task: detach it first
+                    victim.current_task = None
+                    if victim.proc is not None:
+                        try:
+                            victim.proc.kill()
+                        except OSError:
+                            pass
+                    return {"found": True, "state": "running"}
+            if victim.proc is not None:
+                import signal
+
+                try:
+                    victim.proc.send_signal(signal.SIGINT)
+                except OSError:
+                    pass
+            return {"found": True, "state": "running"}
+        # parked infeasible here?
+        with self._infeasible_lock:
+            for i, (t, _, _) in enumerate(self._infeasible):
+                if matches(t):
+                    t2 = self._infeasible.pop(i)[0]
+                    self._store_task_error(t2, exc.TaskCancelledError(
+                        f"task {t2.get('name')} cancelled while "
+                        f"infeasible"))
+                    return {"found": True, "state": "infeasible"}
+        if broadcast:
+            with self._gcs_lock:
+                nodes = self._gcs.call("get_nodes", alive_only=True)
+            for n in nodes:
+                if n["node_id"] == self.node_id:
+                    continue
+                peer = self._peer(n["node_id"])
+                if peer is None:
+                    continue
+                try:
+                    reply = peer.call("cancel_task", oids=list(oids),
+                                      force=force, broadcast=False)
+                    if reply.get("found"):
+                        return reply
+                except Exception:  # noqa: BLE001 - peer gone
+                    continue
+        return {"found": False}
 
     def rpc_kill_actor_worker(self, conn, send_lock, *, actor_id):
         with self._workers_lock:

@@ -82,6 +82,10 @@ class Worker:
         while True:
             try:
                 msg = recv_msg(self.chan)
+            except KeyboardInterrupt:
+                # a cancel SIGINT that raced past its task (the task
+                # finished first): ignore — the worker stays in the pool
+                continue
             except Exception:  # raylet gone -> exit
                 return
             kind = msg.get("type")

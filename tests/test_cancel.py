@@ -1,0 +1,79 @@
+"""Cluster-mode task cancellation (reference: python/ray/tests/
+test_cancel.py — ray.cancel dequeues queued tasks, interrupts running
+ones, no-ops on finished tasks)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def one_cpu_cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=1)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_cancel_queued_task(one_cpu_cluster):
+    @ray_tpu.remote
+    def busy():
+        time.sleep(5)
+        return "done"
+
+    @ray_tpu.remote
+    def quick():
+        return "ran"
+
+    blocker = busy.remote()          # occupies the only CPU
+    time.sleep(0.5)
+    victim = quick.remote()          # stays queued behind it
+    ray_tpu.cancel(victim)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(victim, timeout=20)
+    ray_tpu.cancel(blocker, force=True)
+
+
+def test_cancel_running_task(one_cpu_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def sleeper():
+        time.sleep(30)
+        return "done"
+
+    ref = sleeper.remote()
+    time.sleep(1.5)                  # let it start running
+    t0 = time.monotonic()
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=20)
+    assert time.monotonic() - t0 < 15   # did not wait out the sleep
+
+
+def test_cancel_finished_task_is_noop(one_cpu_cluster):
+    @ray_tpu.remote
+    def val():
+        return 7
+
+    ref = val.remote()
+    assert ray_tpu.get(ref) == 7
+    ray_tpu.cancel(ref)
+    assert ray_tpu.get(ref) == 7     # still readable
+
+
+def test_cancel_force_kills_worker(one_cpu_cluster):
+    @ray_tpu.remote(max_retries=0)
+    def hang():
+        while True:
+            time.sleep(1)
+
+    ref = hang.remote()
+    time.sleep(1.5)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=20)
