@@ -82,7 +82,7 @@ class ClientServer(RpcServer):
                 "not_ready": [r.id.hex() for r in not_ready]}
 
     def rpc_client_cancel(self, conn, send_lock, *, oid, force=False):
-        self._rt.cancel(ObjectRef(ObjectID.from_hex(oid)))
+        self._rt.cancel(ObjectRef(ObjectID.from_hex(oid)), force=force)
         return {"ok": True}
 
     # -- tasks -----------------------------------------------------------

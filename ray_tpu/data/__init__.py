@@ -12,10 +12,13 @@ from ray_tpu.data.dataset import (
     Dataset,
     from_items,
     from_numpy,
+    from_pandas,
     range,  # noqa: A004 - mirrors the reference's ray.data.range
+    read_binary_files,
     read_csv,
     read_json,
     read_parquet,
+    read_text,
 )
 from ray_tpu.data.execution import ExecutionOptions, StreamingExecutor
 from ray_tpu.data.grouped import GroupedData
@@ -32,9 +35,12 @@ __all__ = [
     "aggregate",
     "from_items",
     "from_numpy",
+    "from_pandas",
     "preprocessors",
     "range",
+    "read_binary_files",
     "read_csv",
     "read_json",
     "read_parquet",
+    "read_text",
 ]

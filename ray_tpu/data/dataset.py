@@ -329,6 +329,13 @@ class Dataset:
                 break
         return out
 
+    def to_pandas(self):
+        """Materialize as one pandas DataFrame (reference:
+        ``Dataset.to_pandas``)."""
+        import pandas as pd
+
+        return pd.DataFrame(self.take_all())
+
     def take_all(self) -> list:
         return list(self.iter_rows())
 
@@ -629,4 +636,57 @@ def read_parquet(paths, *, num_blocks: int = 8, columns=None) -> Dataset:
                     for name in table.column_names}
             out.extend(_emit_blocks(cols, per_file))
         return out
+    return Dataset(source)
+
+
+def from_pandas(dfs, *, num_blocks: int = 8) -> Dataset:
+    """pandas DataFrame(s) → column-block dataset (reference:
+    ``data/read_api.py from_pandas``)."""
+    import pandas as pd
+
+    if isinstance(dfs, pd.DataFrame):
+        dfs = [dfs]
+    frames = [df.reset_index(drop=True) for df in dfs]
+    if not frames:
+        return from_items([])
+    merged = frames[0] if len(frames) == 1 else pd.concat(
+        frames, ignore_index=True)
+    return from_numpy({c: merged[c].to_numpy() for c in merged.columns},
+                      num_blocks=num_blocks)
+
+
+def read_text(paths, *, num_blocks: int = 8, drop_empty: bool = True
+              ) -> Dataset:
+    """Text files → one row per line, column ``text`` (reference:
+    ``data/read_api.py read_text``)."""
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def source():
+        lines = []
+        for p in paths:
+            with open(p) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    if line or not drop_empty:
+                        lines.append({"text": line})
+        return from_items(lines, num_blocks=num_blocks)._source_fn()
+    return Dataset(source)
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      num_blocks: int = 8) -> Dataset:
+    """Whole files as ``bytes`` rows (reference: ``read_binary_files``)."""
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def source():
+        rows = []
+        for p in paths:
+            with open(p, "rb") as f:
+                row = {"bytes": f.read()}
+                if include_paths:
+                    row["path"] = p
+                rows.append(row)
+        return from_items(rows, num_blocks=num_blocks)._source_fn()
     return Dataset(source)

@@ -737,21 +737,25 @@ class Raylet(RpcServer):
         # running here?
         with self._workers_lock:
             victim = None
+            task = None
             for w in self._workers.values():
                 if w.state == "busy" and matches(w.current_task):
                     victim = w
-                    victim.current_task["cancelled"] = True
+                    task = w.current_task   # captured under the lock
+                    task["cancelled"] = True
                     break
         if victim is not None:
-            task = victim.current_task
             # pre-store the cancelled error; the worker's own
             # (interrupted or successful) write loses the race
             self._store_task_error(task, exc.TaskCancelledError(
                 f"task {task.get('name')} cancelled while running"))
             with self._workers_lock:
-                # re-verify: the worker may have finished the target and
-                # moved on — never signal it over someone else's task
-                if not matches(victim.current_task):
+                # re-verify AND signal under the lock: the worker may
+                # have finished the target and been handed new work —
+                # never deliver the kill/interrupt over someone else's
+                # task (_finish_task and dispatch both mutate
+                # current_task under this lock)
+                if victim.current_task is not task:
                     return {"found": True, "state": "running"}
                 if force:
                     # no retry for a cancelled task: detach it first
@@ -761,24 +765,28 @@ class Raylet(RpcServer):
                             victim.proc.kill()
                         except OSError:
                             pass
-                    return {"found": True, "state": "running"}
-            if victim.proc is not None:
-                import signal
+                elif victim.proc is not None:
+                    import signal
 
-                try:
-                    victim.proc.send_signal(signal.SIGINT)
-                except OSError:
-                    pass
+                    try:
+                        victim.proc.send_signal(signal.SIGINT)
+                    except OSError:
+                        pass
             return {"found": True, "state": "running"}
-        # parked infeasible here?
+        # parked infeasible here? (pop under the lock; the durable error
+        # store runs outside it — _park_infeasible on the submit path
+        # contends for this lock)
+        parked = None
         with self._infeasible_lock:
             for i, (t, _, _) in enumerate(self._infeasible):
                 if matches(t):
-                    t2 = self._infeasible.pop(i)[0]
-                    self._store_task_error(t2, exc.TaskCancelledError(
-                        f"task {t2.get('name')} cancelled while "
-                        f"infeasible"))
-                    return {"found": True, "state": "infeasible"}
+                    parked = self._infeasible.pop(i)[0]
+                    break
+        if parked is not None:
+            parked["cancelled"] = True
+            self._store_task_error(parked, exc.TaskCancelledError(
+                f"task {parked.get('name')} cancelled while infeasible"))
+            return {"found": True, "state": "infeasible"}
         if broadcast:
             with self._gcs_lock:
                 nodes = self._gcs.call("get_nodes", alive_only=True)

@@ -233,3 +233,41 @@ def test_iter_torch_batches():
     total = torch.cat([b["x"] for b in batches])
     assert total.shape == (100,)
     assert float(total.sum()) == float(2 * sum(range(100)))
+
+
+def test_from_pandas_and_to_rows():
+    import pandas as pd
+
+    import ray_tpu.data as rdata
+
+    df = pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    ds = rdata.from_pandas(df, num_blocks=2)
+    rows = ds.take_all()
+    assert [r["a"] for r in rows] == [1, 2, 3]
+    assert [r["b"] for r in rows] == ["x", "y", "z"]
+
+
+def test_read_text_and_binary(tmp_path):
+    import ray_tpu.data as rdata
+
+    p1 = tmp_path / "a.txt"
+    p1.write_text("hello\nworld\n\nlast\n")
+    ds = rdata.read_text(str(p1))
+    assert [r["text"] for r in ds.take_all()] == ["hello", "world", "last"]
+
+    p2 = tmp_path / "blob.bin"
+    p2.write_bytes(b"\x00\x01\x02")
+    ds2 = rdata.read_binary_files(str(p2), include_paths=True)
+    row = ds2.take_all()[0]
+    assert row["bytes"] == b"\x00\x01\x02" and row["path"].endswith("blob.bin")
+
+
+def test_to_pandas_roundtrip():
+    import pandas as pd
+
+    import ray_tpu.data as rdata
+
+    df = pd.DataFrame({"a": [1, 2, 3], "b": [4.0, 5.0, 6.0]})
+    out = rdata.from_pandas(df).to_pandas()
+    pd.testing.assert_frame_equal(
+        out.sort_values("a").reset_index(drop=True), df)
