@@ -331,10 +331,14 @@ class Dataset:
 
     def to_pandas(self):
         """Materialize as one pandas DataFrame (reference:
-        ``Dataset.to_pandas``)."""
+        ``Dataset.to_pandas``) — concatenates whole column batches,
+        never per-row dicts."""
         import pandas as pd
 
-        return pd.DataFrame(self.take_all())
+        frames = [pd.DataFrame(b) for b in self.iter_batches()]
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat(frames, ignore_index=True)
 
     def take_all(self) -> list:
         return list(self.iter_rows())
@@ -651,6 +655,8 @@ def from_pandas(dfs, *, num_blocks: int = 8) -> Dataset:
         return from_items([])
     merged = frames[0] if len(frames) == 1 else pd.concat(
         frames, ignore_index=True)
+    if merged.empty and not len(merged.columns):
+        return from_items([])
     return from_numpy({c: merged[c].to_numpy() for c in merged.columns},
                       num_blocks=num_blocks)
 
@@ -667,7 +673,7 @@ def read_text(paths, *, num_blocks: int = 8, drop_empty: bool = True
         for p in paths:
             with open(p) as f:
                 for line in f:
-                    line = line.rstrip("\n")
+                    line = line.rstrip("\r\n")   # CRLF-safe
                     if line or not drop_empty:
                         lines.append({"text": line})
         return from_items(lines, num_blocks=num_blocks)._source_fn()
