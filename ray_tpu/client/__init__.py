@@ -75,6 +75,9 @@ class ClientRuntime:
     def cancel(self, ref: ObjectRef, force: bool = False):
         self._rpc.call("client_cancel", oid=ref.id.hex(), force=force)
 
+    def free(self, refs: list):
+        self._rpc.call("client_free", oids=[r.id.hex() for r in refs])
+
     def note_return_owner(self, spec) -> None:
         pass  # ownership lives server-side
 

@@ -81,6 +81,10 @@ class ClientServer(RpcServer):
         return {"ready": [r.id.hex() for r in ready],
                 "not_ready": [r.id.hex() for r in not_ready]}
 
+    def rpc_client_free(self, conn, send_lock, *, oids):
+        self._rt.free([ObjectRef(ObjectID.from_hex(o)) for o in oids])
+        return {"ok": True}
+
     def rpc_client_cancel(self, conn, send_lock, *, oid, force=False):
         self._rt.cancel(ObjectRef(ObjectID.from_hex(oid)), force=force)
         return {"ok": True}

@@ -335,7 +335,14 @@ class Dataset:
         never per-row dicts."""
         import pandas as pd
 
-        frames = [pd.DataFrame(b) for b in self.iter_batches()]
+        def frame(batch):
+            # multi-dim columns can't build a DataFrame column-wise;
+            # fall back to object cells (list of per-row arrays)
+            cols = {k: (list(v) if getattr(v, "ndim", 1) > 1 else v)
+                    for k, v in batch.items()}
+            return pd.DataFrame(cols)
+
+        frames = [frame(b) for b in self.iter_batches()]
         if not frames:
             return pd.DataFrame()
         return pd.concat(frames, ignore_index=True)
@@ -655,7 +662,7 @@ def from_pandas(dfs, *, num_blocks: int = 8) -> Dataset:
         return from_items([])
     merged = frames[0] if len(frames) == 1 else pd.concat(
         frames, ignore_index=True)
-    if merged.empty and not len(merged.columns):
+    if not len(merged.columns):   # from_numpy({}) would StopIteration
         return from_items([])
     return from_numpy({c: merged[c].to_numpy() for c in merged.columns},
                       num_blocks=num_blocks)

@@ -1,12 +1,14 @@
 """ray_tpu.experimental (reference: ``python/ray/experimental/`` — P22)."""
 
 from ray_tpu.experimental import tqdm_ray
+from ray_tpu.experimental.free import free
 from ray_tpu.experimental.internal_kv import (internal_kv_del,
                                               internal_kv_get,
                                               internal_kv_list,
                                               internal_kv_put)
 
 __all__ = [
+    "free",
     "internal_kv_del",
     "internal_kv_get",
     "internal_kv_list",

@@ -1,0 +1,17 @@
+"""Explicit object release (reference: ``ray._private.internal_api.free``
+exposed via ``ray.experimental``): drop every stored copy of the objects
+cluster-wide AND their lineage, so memory is reclaimed immediately and a
+later ``get`` raises ``ObjectLostError`` instead of reconstructing."""
+
+from __future__ import annotations
+
+from ray_tpu.runtime.object_ref import ObjectRef
+
+
+def free(refs):
+    from ray_tpu import api as _api
+
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    # lazy like every other entry point: auto-connects inside workers
+    _api._runtime().free(list(refs))

@@ -241,6 +241,18 @@ class ClusterRuntime:
             time.sleep(0.01)
         return ready, not_ready
 
+    def free(self, refs: list):
+        """Release object memory cluster-wide AND drop lineage, so the
+        objects cannot be reconstructed (reference: ray.internal.free)."""
+        oids = [r.id.hex() for r in refs]
+        with self._lineage_lock:
+            for o in oids:
+                self._lineage.pop(o, None)
+        try:
+            self._raylet.call("free_objects", oids=oids)
+        except (OSError, ConnectionLost):
+            pass
+
     def cancel(self, ref: ObjectRef, force: bool = False):
         """Best-effort task cancellation (reference ``ray.cancel``):
         queued tasks are dequeued, running tasks interrupted (``force``:

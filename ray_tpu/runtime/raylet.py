@@ -701,6 +701,69 @@ class Raylet(RpcServer):
                  target.send_lock)
         return {"ok": True}
 
+    def rpc_free_objects(self, conn, send_lock, *, oids: list,
+                         broadcast: bool = True):
+        """Explicitly release object copies on this node (reference:
+        ``ray.internal.free``): unpin, drop from shm and the spill dir,
+        deregister the location. Owners drop lineage separately so a
+        subsequent ``get`` raises ObjectLostError instead of
+        resurrecting the object."""
+        from ray_tpu._private.shm_store import TS_ERR, TS_OK
+
+        freed = 0
+        for oid_hex in oids:
+            oid = bytes.fromhex(oid_hex)
+            self._unpin_object(oid_hex)
+            with self._spill_lock:
+                entry = self._spilled.pop(oid_hex, None)
+            if entry is not None:
+                try:
+                    os.unlink(entry[0])
+                except OSError:
+                    pass
+                freed += 1
+            # brief drain: a writer's seal-hold (released right after its
+            # report RPC) or a reader mid-get may still hold a ref — give
+            # in-flight refs ~200ms before declaring best-effort
+            rc = self.store.try_delete(oid)
+            for _ in range(20):
+                if rc != TS_ERR:
+                    break
+                time.sleep(0.01)
+                rc = self.store.try_delete(oid)
+            if rc == TS_OK and entry is None:
+                freed += 1
+            if rc == TS_ERR:
+                # a reader outlived the drain: the copy stays, tracked,
+                # registered — freeing it now would orphan live shm (the
+                # reconcile loop could no longer see it). Best-effort.
+                continue
+            with self._local_objects_lock:
+                was_local = oid_hex in self._local_objects
+                self._local_objects.discard(oid_hex)
+            if was_local or entry is not None:
+                try:
+                    with self._gcs_lock:
+                        self._gcs.call("remove_object_location",
+                                       oid=oid_hex, node_id=self.node_id)
+                except Exception:  # noqa: BLE001 - best-effort
+                    pass
+        if broadcast:
+            with self._gcs_lock:
+                nodes = self._gcs.call("get_nodes", alive_only=True)
+            for n in nodes:
+                if n["node_id"] == self.node_id:
+                    continue
+                peer = self._peer(n["node_id"])
+                if peer is None:
+                    continue
+                try:
+                    peer.call("free_objects", oids=list(oids),
+                              broadcast=False)
+                except Exception:  # noqa: BLE001 - peer gone
+                    continue
+        return {"freed": freed}
+
     def rpc_cancel_task(self, conn, send_lock, *, oids: list,
                         force: bool = False, broadcast: bool = True):
         """Cancel the task owning these return oids (reference:

@@ -271,3 +271,14 @@ def test_to_pandas_roundtrip():
     out = rdata.from_pandas(df).to_pandas()
     pd.testing.assert_frame_equal(
         out.sort_values("a").reset_index(drop=True), df)
+
+
+def test_to_pandas_multidim_column():
+    import numpy as np
+
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_numpy({"emb": np.arange(8.0).reshape(4, 2)})
+    df = ds.to_pandas()
+    assert len(df) == 4
+    assert list(df["emb"].iloc[0]) == [0.0, 1.0]
