@@ -5,6 +5,7 @@ from ray_tpu._private.usage_stats import record_library_usage as _rlu
 _rlu("rllib")
 
 
+from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import (
     BanditEnv,
@@ -17,6 +18,6 @@ from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
 
-__all__ = ["BanditEnv", "CartPole", "ContinuousBandit", "DQN", "DQNConfig",
+__all__ = ["APPO", "APPOConfig", "BanditEnv", "CartPole", "ContinuousBandit", "DQN", "DQNConfig",
            "IMPALA", "IMPALAConfig", "PPO", "PPOConfig", "Pendulum",
            "SAC", "SACConfig", "make_env"]

@@ -1,0 +1,32 @@
+"""APPO on JAX: IMPALA's async architecture + PPO's clipped surrogate.
+
+Reference analog: ``rllib/algorithms/appo/`` — asynchronous PPO keeps
+IMPALA's decoupled rollout workers and V-trace off-policy correction but
+replaces the plain policy-gradient term with the PPO clip objective,
+which bounds how far one update can move the policy from the behavior
+policy that collected the data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+
+
+@dataclass
+class APPOConfig(IMPALAConfig):
+    clip_param: float = 0.3
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    def __init__(self, config):
+        if getattr(config, "clip_param", None) is None:
+            # a plain IMPALAConfig was passed: lift it into APPOConfig
+            # (replace() would reject the unknown clip_param field)
+            config = APPOConfig(**dataclasses.asdict(config))
+        super().__init__(config)

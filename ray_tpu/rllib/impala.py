@@ -119,7 +119,8 @@ class IMPALA:
             _impala_update, tx=self.tx, gamma=config.gamma,
             rho_clip=config.rho_clip, c_clip=config.c_clip,
             entropy_coeff=config.entropy_coeff,
-            vf_coeff=config.vf_coeff))
+            vf_coeff=config.vf_coeff,
+            clip_param=getattr(config, "clip_param", None)))
         self._inflight = None  # refs sampled with lagged params
 
     def train(self) -> dict:
@@ -222,7 +223,7 @@ def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
 
 
 def _impala_update(params, opt_state, batch, *, tx, gamma, rho_clip,
-                   c_clip, entropy_coeff, vf_coeff):
+                   c_clip, entropy_coeff, vf_coeff, clip_param=None):
     import jax
     import jax.numpy as jnp
 
@@ -252,7 +253,16 @@ def _impala_update(params, opt_state, batch, *, tx, gamma, rho_clip,
             jax.lax.stop_gradient(bootstrap_value), dones,
             gamma=gamma, rho_clip=rho_clip, c_clip=c_clip)
 
-        policy_loss = -jnp.mean(target_logp * pg_adv)
+        if clip_param is not None:
+            # APPO: PPO clipped surrogate on the V-trace advantage
+            # (reference: rllib/algorithms/appo/ — IMPALA architecture
+            # with the clip objective stabilizing the off-policy update)
+            ratio = jnp.exp(target_logp - behavior_logp)
+            clipped = jnp.clip(ratio, 1.0 - clip_param, 1.0 + clip_param)
+            policy_loss = -jnp.mean(
+                jnp.minimum(ratio * pg_adv, clipped * pg_adv))
+        else:
+            policy_loss = -jnp.mean(target_logp * pg_adv)
         vf_loss = jnp.mean((values - vs) ** 2)
         entropy = -jnp.mean(
             jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))

@@ -711,8 +711,10 @@ class Raylet(RpcServer):
         from ray_tpu._private.shm_store import TS_ERR, TS_OK
 
         freed = 0
+        pending: list[tuple[str, bool, bool]] = []  # (oid, was_pinned, spilled)
         for oid_hex in oids:
-            oid = bytes.fromhex(oid_hex)
+            with self._pin_lock:
+                was_pinned = oid_hex in self._pinned
             self._unpin_object(oid_hex)
             with self._spill_lock:
                 entry = self._spilled.pop(oid_hex, None)
@@ -722,26 +724,38 @@ class Raylet(RpcServer):
                 except OSError:
                     pass
                 freed += 1
-            # brief drain: a writer's seal-hold (released right after its
-            # report RPC) or a reader mid-get may still hold a ref — give
-            # in-flight refs ~200ms before declaring best-effort
-            rc = self.store.try_delete(oid)
-            for _ in range(20):
-                if rc != TS_ERR:
-                    break
+            pending.append((oid_hex, was_pinned, entry is not None))
+        # drain in-flight refs (a writer's seal-hold released right after
+        # its report RPC, or a reader mid-get) with ONE shared ~200ms
+        # budget across all oids, not per object
+        done: list[tuple[str, bool, int]] = []
+        deadline = time.monotonic() + 0.2
+        while pending:
+            still = []
+            for oid_hex, was_pinned, had_spill in pending:
+                rc = self.store.try_delete(bytes.fromhex(oid_hex))
+                if rc == TS_ERR and time.monotonic() < deadline:
+                    still.append((oid_hex, was_pinned, had_spill))
+                else:
+                    done.append((oid_hex, had_spill, rc))
+                    if rc == TS_ERR and was_pinned:
+                        # a reader outlived the drain: the surviving
+                        # primary stays authoritative — re-pin it so LRU
+                        # eviction cannot silently orphan the stale GCS
+                        # location (same rule as _spill_one)
+                        self._pin_object(oid_hex)
+            pending = still
+            if pending:
                 time.sleep(0.01)
-                rc = self.store.try_delete(oid)
-            if rc == TS_OK and entry is None:
+        for oid_hex, had_spill, rc in done:
+            if rc == TS_OK and not had_spill:
                 freed += 1
             if rc == TS_ERR:
-                # a reader outlived the drain: the copy stays, tracked,
-                # registered — freeing it now would orphan live shm (the
-                # reconcile loop could no longer see it). Best-effort.
-                continue
+                continue   # copy stays: tracked, registered, re-pinned
             with self._local_objects_lock:
                 was_local = oid_hex in self._local_objects
                 self._local_objects.discard(oid_hex)
-            if was_local or entry is not None:
+            if was_local or had_spill:
                 try:
                     with self._gcs_lock:
                         self._gcs.call("remove_object_location",
