@@ -9,15 +9,14 @@ policy that collected the data.
 
 from __future__ import annotations
 
-import dataclasses
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 
 
 @dataclass
 class APPOConfig(IMPALAConfig):
-    clip_param: float = 0.3
+    clip_param: float | None = 0.3
 
     def build(self) -> "APPO":
         return APPO(self)
@@ -25,8 +24,6 @@ class APPOConfig(IMPALAConfig):
 
 class APPO(IMPALA):
     def __init__(self, config):
-        if getattr(config, "clip_param", None) is None:
-            # a plain IMPALAConfig was passed: lift it into APPOConfig
-            # (replace() would reject the unknown clip_param field)
-            config = APPOConfig(**dataclasses.asdict(config))
+        if config.clip_param is None:   # field lives on IMPALAConfig
+            config = replace(config, clip_param=0.3)
         super().__init__(config)

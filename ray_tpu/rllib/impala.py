@@ -74,6 +74,9 @@ class IMPALAConfig:
     vf_coeff: float = 0.5
     rho_clip: float = 1.0     # V-trace rho-bar
     c_clip: float = 1.0       # V-trace c-bar
+    # None = plain V-trace policy gradient; a float enables the APPO
+    # clipped surrogate (see rllib/appo.py)
+    clip_param: float | None = None
     hidden: int = 64
     seed: int = 0
 
@@ -120,7 +123,7 @@ class IMPALA:
             rho_clip=config.rho_clip, c_clip=config.c_clip,
             entropy_coeff=config.entropy_coeff,
             vf_coeff=config.vf_coeff,
-            clip_param=getattr(config, "clip_param", None)))
+            clip_param=config.clip_param))
         self._inflight = None  # refs sampled with lagged params
 
     def train(self) -> dict:

@@ -77,6 +77,7 @@ class ClusterRuntime:
         from ray_tpu.utils.config import get_config
         self._lineage_grace_s = get_config().lineage_resubmit_grace_s
         self._lineage_max = get_config().lineage_max_entries
+        self._pending_grace_s = get_config().task_pending_resubmit_grace_s
 
     # ------------------------------------------------------------------
     # objects
@@ -127,13 +128,59 @@ class ClusterRuntime:
     # ------------------------------------------------------------------
 
     def _recover_lost(self, oids: list[str], depth: int = 0):
-        """For objects with NO remaining copy anywhere (their node died),
-        re-run the creating task from lineage (reference:
+        """Re-run creating tasks from lineage (reference:
         ObjectRecoveryManager::RecoverObject object_recovery_manager.h:90
-        → TaskManager::ResubmitTask). Tasks still pending are untouched —
-        only objects the GCS once knew and has now lost (all locations
-        dropped on node death) are eligible."""
-        lost = self._gcs.call("get_lost_objects", oids=list(set(oids)))
+        → TaskManager::ResubmitTask) for two loss modes:
+
+        1. Tombstoned objects: the GCS once knew them and every location
+           died with its node — deterministic loss, budgeted by
+           max_retries.
+        2. Presumed-lost pending tasks: output never registered anywhere
+           and the submission is older than the pending grace (the task
+           was queued/running on a node that died — no object existed to
+           tombstone). Heuristic: a merely slow task gets a DUPLICATE
+           submission (harmless via first-write-wins), capped by its own
+           small budget that does NOT consume the max_retries lineage
+           budget."""
+        uniq = list(set(oids))
+        lost = self._gcs.call("get_lost_objects", oids=uniq)
+        # Tasks lost IN FLIGHT leave no tombstone (their output never
+        # existed): a pending object with lineage, no location anywhere,
+        # and a stale submission is presumed dead-with-its-node and
+        # resubmitted (idempotent: first-write-wins).
+        lost_set = set(lost)
+        unlocated = [o for o, locs in self._gcs.call(
+            "get_object_locations", oids=uniq).items()
+            if not locs and o not in lost_set]
+        now = time.monotonic()
+        for oid_hex in unlocated:
+            with self._lineage_lock:
+                entry = self._lineage.get(oid_hex)
+            if entry is None:
+                continue
+            ref_t = max(entry.get("submitted_at", 0.0),
+                        entry.get("last_resubmit", 0.0))
+            if now - ref_t <= self._pending_grace_s:
+                continue
+            exhausted = (entry["attempts"] <= 0
+                         or entry.get("pending_resubmits", 0) >= 3)
+            if exhausted:
+                # cannot (or may no longer) resubmit: surface a terminal
+                # error once a long grace passes rather than hanging a
+                # timeout-less get() forever. False positive only for a
+                # still-running task slower than 4x the grace with no
+                # retry budget — tune task_pending_resubmit_grace_s up
+                # for such workloads.
+                if now - ref_t > 3 * self._pending_grace_s:
+                    raise exc.ObjectLostError(
+                        oid_hex,
+                        "task output never registered and its submission "
+                        "is stale (node presumed dead); retry budget "
+                        "unavailable")
+                continue
+            with self._lineage_lock:
+                entry["pending_resubmits"] =                     entry.get("pending_resubmits", 0) + 1
+            self._reconstruct(oid_hex, depth, pending_grace=True)
         for oid_hex in lost:
             if self.store.contains(bytes.fromhex(oid_hex)):
                 continue
@@ -152,23 +199,33 @@ class ClusterRuntime:
                     oid_hex, "lineage re-execution budget exhausted")
             self._reconstruct(oid_hex, depth)
 
-    def _reconstruct(self, oid_hex: str, depth: int = 0):
+    def _reconstruct(self, oid_hex: str, depth: int = 0,
+                     pending_grace: bool = False):
         if depth > 10:
             return
         with self._lineage_lock:
             entry = self._lineage.get(oid_hex)
-            if entry is None or entry["attempts"] <= 0:
+            if entry is None:
+                return
+            if not pending_grace and entry["attempts"] <= 0:
                 return
             if oid_hex in self._reconstructing:
                 return
             # a re-execution is likely still running — don't stack another
             # (the tombstone only clears when the new copy registers).
             # Known limit: a re-run longer than the grace gets a duplicate
-            # submission; first-write-wins keeps that harmless.
+            # submission; first-write-wins keeps that harmless. The
+            # pending-task path uses its own (shorter) grace, already
+            # checked by the caller against submit/resubmit time.
+            grace = (self._pending_grace_s if pending_grace
+                     else self._lineage_grace_s)
             if (time.monotonic() - entry.get("last_resubmit", 0.0)
-                    < self._lineage_grace_s):
+                    < grace):
                 return
-            entry["attempts"] -= 1
+            if not pending_grace:
+                # only DETERMINISTIC loss consumes the max_retries budget;
+                # heuristic pending resubmits have their own cap
+                entry["attempts"] -= 1
             entry["last_resubmit"] = time.monotonic()
             self._reconstructing.add(oid_hex)
         try:
@@ -304,7 +361,8 @@ class ClusterRuntime:
                 deps += [v.id.hex() for v in spec.kwargs.values()
                          if isinstance(v, ObjectRef)]
                 entry = {"task": task, "deps": deps,
-                         "attempts": spec.max_retries}
+                         "attempts": spec.max_retries,
+                         "submitted_at": time.monotonic()}
                 with self._lineage_lock:
                     for oid in spec.return_ids:
                         self._lineage[oid.hex()] = entry
