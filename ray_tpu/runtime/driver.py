@@ -162,24 +162,18 @@ class ClusterRuntime:
                         entry.get("last_resubmit", 0.0))
             if now - ref_t <= self._pending_grace_s:
                 continue
-            exhausted = (entry["attempts"] <= 0
-                         or entry.get("pending_resubmits", 0) >= 3)
-            if exhausted:
-                # cannot (or may no longer) resubmit: surface a terminal
-                # error once a long grace passes rather than hanging a
-                # timeout-less get() forever. False positive only for a
-                # still-running task slower than 4x the grace with no
-                # retry budget — tune task_pending_resubmit_grace_s up
-                # for such workloads.
-                if now - ref_t > 3 * self._pending_grace_s:
-                    raise exc.ObjectLostError(
-                        oid_hex,
-                        "task output never registered and its submission "
-                        "is stale (node presumed dead); retry budget "
-                        "unavailable")
+            if entry.get("pending_resubmits", 0) >= 3:
+                # duplicate budget spent: keep WAITING (the original or a
+                # duplicate may still be running — raising here would
+                # fail healthy long tasks). Callers bound the wait with
+                # get(timeout=...); max_retries=0 tasks have no lineage
+                # entry at all, so in-flight loss there also surfaces as
+                # a timeout (the reference detects that case through its
+                # worker-lease channel, which this design doesn't have).
                 continue
             with self._lineage_lock:
-                entry["pending_resubmits"] =                     entry.get("pending_resubmits", 0) + 1
+                entry["pending_resubmits"] = 1 + entry.get(
+                    "pending_resubmits", 0)
             self._reconstruct(oid_hex, depth, pending_grace=True)
         for oid_hex in lost:
             if self.store.contains(bytes.fromhex(oid_hex)):

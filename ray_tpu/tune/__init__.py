@@ -6,6 +6,7 @@ _rlu("tune")
 
 
 from ray_tpu.tune.schedulers import (
+    PB2,
     AsyncHyperBandScheduler,
     FIFOScheduler,
     HyperBandScheduler,
@@ -36,6 +37,7 @@ __all__ = [
     "TPESearcher",
     "FIFOScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
     "ResultGrid",
     "Trial",
