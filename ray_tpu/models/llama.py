@@ -75,19 +75,20 @@ def llama_tiny(vocab_size: int = 512) -> LlamaConfig:
 
 def param_logical_axes(cfg: LlamaConfig) -> dict:
     """Logical axis annotation pytree, mirroring init_params' structure.
-    The leading scan axis of stacked blocks is ``None`` (never sharded);
-    "stage" sharding for pipeline parallelism is applied to it by the PP
-    runtime instead."""
+    The leading scan axis of stacked blocks carries the ``layers`` logical
+    axis: replicated under dp/fsdp/tp presets (rules.layers=None) and
+    sharded over ``pp`` under the pipeline-parallel preset, which makes the
+    contiguous per-stage layer groups land on their stage's devices."""
     block = {
-        "attn_norm": (None, "embed"),
-        "wq": (None, "embed", "heads"),       # [L, D, H*hd]
-        "wk": (None, "embed", "kv_heads"),
-        "wv": (None, "embed", "kv_heads"),
-        "wo": (None, "heads", "embed"),
-        "mlp_norm": (None, "embed"),
-        "w_gate": (None, "embed", "mlp"),
-        "w_up": (None, "embed", "mlp"),
-        "w_down": (None, "mlp", "embed"),
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),       # [L, D, H*hd]
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
     }
     axes = {
         "embedding": ("vocab", "embed"),

@@ -1,17 +1,36 @@
 """Pipeline parallelism: stage-sharded layers + microbatch rotation.
 
 Net-new capability vs. the reference (SURVEY.md §2c: pipeline parallel is
-ABSENT there). TPU-idiomatic GPipe: the stacked layer arrays are split into
+ABSENT there). TPU-idiomatic: the stacked layer arrays are split into
 ``n_stages`` contiguous groups sharded over the ``pp`` mesh axis; microbatches
 flow through the stage ring via ``lax.ppermute``. Each tick every stage runs
 its layer group on its current microbatch while the permute moves activations
 to the next stage — compute and ICI transfer overlap, and the whole schedule
-is one jit-compiled ``lax.scan`` (bubble fraction (S-1)/(M+S-1), the GPipe
-formula).
+is one jit-compiled ``lax.scan``. The shard_map is partially manual
+(``axis_names={pp}``): only the pp axis is hand-scheduled; dp/fsdp/tp stay
+under GSPMD, so stage-internal matmuls keep their tensor/FSDP shardings and
+XLA still inserts those collectives automatically.
 
-The backward pass is jax.grad through the scan: XLA reverses the schedule
-automatically (reverse pipeline with the same overlap). 1F1B memory
-scheduling is a planned refinement; GPipe semantics are exact.
+Two schedules:
+
+- ``pipeline_apply`` — GPipe forward; the backward is jax.grad through the
+  scan (XLA reverses the schedule into the mirror-image reverse pipeline).
+  Bubble fraction (S-1)/(M+S-1) each direction; activation stash grows with
+  M (one stage-input per tick, rematerialized inside the stage).
+- ``pipeline_value_and_grad`` — 1F1B: forward and backward interleaved in
+  ONE lockstep scan, with the loss/head computed per-microbatch on the last
+  stage so microbatch m's backward starts S-1 ticks after its forward. The
+  stage-input stash is a ring buffer of 2·S entries — O(pipeline depth)
+  instead of O(microbatches) — which is what lets M (and therefore bubble
+  amortization M/(M+2S-2)) scale without activation memory scaling with it.
+  Returns grads directly (it implements backprop; it is not differentiated
+  through).
+
+Lockstep-SPMD honesty note: every device executes the full tick body with
+inactive slots masked out (``jnp.where``), because data-dependent branches
+around GSPMD-inserted collectives would deadlock the mesh. The warmup /
+cooldown bubbles therefore burn flops rather than idling — same wall-clock
+as the classic async schedule, simpler program, one compiled step.
 """
 
 from __future__ import annotations
@@ -92,9 +111,187 @@ def pipeline_apply(
         device_body, mesh=mesh,
         in_specs=(P(axis), P()),
         out_specs=P(),
+        axis_names={axis},
         check_vma=False,
     )
     return fn(stage_params, x_micro)
+
+
+def pipeline_value_and_grad(
+    stage_fn,        # (stage_params, x[mb,s,d]) -> y[mb,s,d]
+    head_fn,         # (io_params, y[mb,s,d], tgt[mb,s], msk[mb,s])
+                     #   -> (loss_sum, weight_sum) scalars, fp32
+    stage_params,    # pytree, leaves [n_stages, L/ns, ...]
+    io_params,       # pytree (replicated over pp): head weights, and the
+                     # embedding when embed_fn is given
+    x_micro,         # [M, mb, s, d] activations — or [M, mb, s] tokens
+                     # when embed_fn is given
+    tgt_micro,       # [M, mb, s] int targets
+    msk_micro,       # [M, mb, s] {0,1} loss mask
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    embed_fn=None,   # optional (io_params, tokens[mb,s]) -> x[mb,s,d]:
+                     # runs in stage 0's forward slot, its vjp in stage 0's
+                     # backward slot, so the embedding grad accumulates
+                     # inside the schedule like every other grad
+):
+    """1F1B pipelined loss + backprop in one lockstep scan.
+
+    Returns ``(loss_sum, weight_sum), (d_stage_params, d_io_params,
+    d_x_micro)`` where the grads are of ``loss_sum`` (scale by
+    ``1/weight_sum`` outside for mean-loss grads — the weight does not
+    depend on params, so scaling commutes). ``d_x_micro`` is None when
+    ``embed_fn`` is given (tokens have no gradient; the embedding grad is
+    folded into ``d_io_params``).
+
+    Schedule (S stages, M microbatches, tick t, stage s):
+      forward slot:  microbatch  f = t - s            (stage 0 ingests f=t)
+      loss slot:     last stage runs head_fn + its vjp on this tick's y
+      backward slot: microbatch  b = t - 2(S-1) + s   (last stage: b = f)
+    so grads for microbatch m leave the last stage at tick m+S-1 and reach
+    stage s at tick m + 2(S-1) - s: T = M + 2(S-1) ticks total. Each
+    backward slot re-runs its stage forward from the stashed input
+    (``jax.vjp``), i.e. rematerialization is built in; only stage INPUTS
+    are stashed, in a 2-S-slot ring buffer (max in-flight span at stage 0
+    is 2(S-1) ticks).
+
+    The head (and embedding) run masked on every stage each tick (uniform
+    SPMD — see module docstring); with a tp-sharded vocab their flops
+    divide by tp.
+    """
+    n_stages = mesh.shape[axis]
+    m = x_micro.shape[0]
+    window = 2 * n_stages
+    ticks = m + 2 * (n_stages - 1)
+    perm_f = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    perm_b = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+    def device_body(stage_params_local, io_params, xm, tgt, msk):
+        sid = lax.axis_index(axis)
+        last = sid == n_stages - 1
+        first = sid == 0
+        sp = jax.tree.map(lambda a: a[0], stage_params_local)
+        if embed_fn is None:
+            act0 = jnp.zeros_like(xm[0])
+        else:
+            act_s = jax.eval_shape(embed_fn, io_params, xm[0])
+            act0 = jnp.zeros(act_s.shape, act_s.dtype)
+
+        carry0 = dict(
+            fbuf=act0,
+            bbuf=act0,
+            stash=jnp.zeros((window,) + act0.shape, act0.dtype),
+            d_sp=jax.tree.map(jnp.zeros_like, sp),
+            d_io=jax.tree.map(jnp.zeros_like, io_params),
+            loss=jnp.float32(0.0),
+            weight=jnp.float32(0.0),
+        )
+        if embed_fn is None:
+            carry0["d_x"] = jnp.zeros_like(xm)
+
+        def tick(c, t):
+            # --- forward slot: mb f flows down the ring ---
+            f = t - sid
+            f_on = jnp.logical_and(f >= 0, f < m)
+            fc = jnp.clip(f, 0, m - 1)
+            x_f = xm[fc] if embed_fn is None else embed_fn(io_params, xm[fc])
+            inp = jnp.where(first, x_f, c["fbuf"])
+            slot = fc % window
+            prev = lax.dynamic_index_in_dim(c["stash"], slot, 0,
+                                            keepdims=False)
+            stash = lax.dynamic_update_index_in_dim(
+                c["stash"], jnp.where(f_on, inp, prev), slot, 0)
+            y = stage_fn(sp, inp)
+
+            # --- loss slot: last stage turns y around into a grad ---
+            (l_mb, w_mb), head_vjp = jax.vjp(
+                lambda hp, yy: head_fn(hp, yy, tgt[fc], msk[fc]),
+                io_params, y)
+            d_io_mb, dy = head_vjp((jnp.float32(1.0), jnp.float32(0.0)))
+            turn_f = jnp.logical_and(last, f_on).astype(jnp.float32)
+            loss = c["loss"] + turn_f * l_mb
+            weight = c["weight"] + turn_f * w_mb
+            d_io = jax.tree.map(
+                lambda acc, g: acc
+                + (g.astype(jnp.float32) * turn_f).astype(acc.dtype),
+                c["d_io"], d_io_mb)
+
+            # --- backward slot: mb b flows back up the ring ---
+            b = t - 2 * (n_stages - 1) + sid
+            b_on = jnp.logical_and(b >= 0, b < m)
+            bc = jnp.clip(b, 0, m - 1)
+            g_in = jnp.where(last, dy.astype(act0.dtype), c["bbuf"])
+            x_in = lax.dynamic_index_in_dim(stash, bc % window, 0,
+                                            keepdims=False)
+            _, stage_vjp = jax.vjp(stage_fn, sp, x_in)
+            d_sp_mb, dx = stage_vjp(g_in)
+            b_on_f = b_on.astype(jnp.float32)
+            d_sp = jax.tree.map(
+                lambda acc, g: acc
+                + (g.astype(jnp.float32) * b_on_f).astype(acc.dtype),
+                c["d_sp"], d_sp_mb)
+            nc = dict(
+                fbuf=lax.ppermute(y, axis, perm_f),
+                bbuf=lax.ppermute(dx, axis, perm_b),
+                stash=stash, d_sp=d_sp, loss=loss, weight=weight,
+            )
+            if embed_fn is None:
+                d_x_upd = lax.dynamic_update_index_in_dim(
+                    c["d_x"], dx.astype(c["d_x"].dtype), bc, 0)
+                nc["d_x"] = jnp.where(jnp.logical_and(b_on, first), d_x_upd,
+                                      c["d_x"])
+                nc["d_io"] = d_io
+            else:
+                # stage 0 converts its input-grad into an embedding grad
+                _, embed_vjp = jax.vjp(
+                    lambda io: embed_fn(io, xm[bc]), io_params)
+                (d_io_emb,) = embed_vjp(dx.astype(act0.dtype))
+                gate = jnp.logical_and(b_on, first).astype(jnp.float32)
+                nc["d_io"] = jax.tree.map(
+                    lambda acc, g: acc
+                    + (g.astype(jnp.float32) * gate).astype(acc.dtype),
+                    d_io, d_io_emb)
+            return nc, None
+
+        c, _ = lax.scan(tick, carry0, jnp.arange(ticks))
+        # Per-stage grads stay stage-sharded. Grads living on one stage
+        # (io on first/last, d_x on first) are returned STAGE-STACKED
+        # (out_spec P(axis)) and reduced by the caller: an in-region psum
+        # of these carry-accumulated pytrees trips an XLA partitioner
+        # crash ("Invalid binary instruction opcode copy") under
+        # partially-manual shard_map.
+        stack = lambda tree: jax.tree.map(  # noqa: E731
+            lambda a: jnp.expand_dims(a, 0), tree)
+        out = (
+            lax.psum(c["loss"], axis),
+            lax.psum(c["weight"], axis),
+            stack(c["d_sp"]),
+            stack(c["d_io"]),
+        )
+        if embed_fn is None:
+            out = out + (stack(c["d_x"]),)
+        return out
+
+    out_specs = (P(), P(), P(axis), P(axis))
+    if embed_fn is None:
+        out_specs = out_specs + (P(axis),)
+    fn = jax.shard_map(
+        device_body, mesh=mesh,
+        in_specs=(P(axis), P(), P(), P(), P()),
+        out_specs=out_specs,
+        axis_names={axis},
+        check_vma=False,
+    )
+    out = fn(stage_params, io_params, x_micro, tgt_micro, msk_micro)
+    loss, weight, d_sp, d_io_stacked = out[:4]
+    # cross-stage reduce of the single-stage grads (only one stage's slot
+    # is nonzero, but summing is uniform and cheap)
+    unstack = lambda tree: jax.tree.map(  # noqa: E731
+        lambda a: jnp.sum(a, axis=0), tree)
+    d_io = unstack(d_io_stacked)
+    d_x = unstack(out[4]) if embed_fn is None else None
+    return (loss, weight), (d_sp, d_io, d_x)
 
 
 # ---------------------------------------------------------------------------
@@ -102,35 +299,10 @@ def pipeline_apply(
 # ---------------------------------------------------------------------------
 
 
-def llama_forward_pipelined(
-    cfg,
-    params: dict,
-    tokens,                    # [batch, seq]
-    *,
-    mesh: Mesh,
-    axis: str = "pp",
-    n_microbatches: int | None = None,
-    attn_impl: str = "auto",
-):
-    """Llama forward with the layer stack pipelined over ``axis``.
-
-    Embedding and the LM head run outside the pipelined region under plain
-    GSPMD (they live on every stage; their cost is O(vocab) once, not per
-    layer). Default positions only (no packing/segment support in v1).
-    """
+def make_llama_stage_fn(cfg, sin, cos, attn_impl: str = "auto"):
+    """(stage_blocks [L/ns, ...], x [mb, s, d]) -> x: one pipeline stage =
+    a scan over its contiguous layer group, honoring cfg.remat."""
     from ray_tpu.models.llama import _block
-    from ray_tpu.ops.norms import rms_norm
-    from ray_tpu.ops.rope import rope_sin_cos
-
-    n_stages = mesh.shape[axis]
-    m = n_microbatches or n_stages
-    b, s = tokens.shape
-    if b % m:
-        raise ValueError(f"batch {b} not divisible by {m} microbatches")
-
-    x = params["embedding"][tokens]  # [b, s, d]
-    positions = jnp.arange(s, dtype=jnp.int32)[None, :]
-    sin, cos = rope_sin_cos(positions, cfg.head_dim, theta=cfg.rope_theta)
 
     def stage_fn(stage_blocks, xm):
         body = partial(_block, cfg, sin=sin, cos=cos, segment_ids=None,
@@ -149,6 +321,59 @@ def llama_forward_pipelined(
         out, _ = lax.scan(scan_fn, xm, stage_blocks)
         return out
 
+    return stage_fn
+
+
+def make_llama_head_fn(cfg):
+    """(head_params, y [mb,s,d], tgt [mb,s], msk [mb,s]) ->
+    (loss_sum, weight_sum): final norm + LM head + masked CE sums, for the
+    1F1B loss slot. head_params = {"final_norm", "embedding"|"lm_head"}."""
+    from ray_tpu.ops.norms import rms_norm
+
+    def head_fn(hp, y, tgt, msk):
+        h = rms_norm(y, hp["final_norm"], eps=cfg.rms_eps)
+        head = (hp["embedding"].T if cfg.tie_embeddings else hp["lm_head"])
+        logits = jnp.einsum("bsd,dv->bsv", h, head,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(
+            logits, jnp.maximum(tgt, 0)[..., None], axis=-1).squeeze(-1)
+        mk = msk.astype(jnp.float32)
+        return jnp.sum((lse - tl) * mk), jnp.sum(mk)
+
+    return head_fn
+
+
+def llama_forward_pipelined(
+    cfg,
+    params: dict,
+    tokens,                    # [batch, seq]
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    n_microbatches: int | None = None,
+    attn_impl: str = "auto",
+):
+    """Llama forward with the layer stack pipelined over ``axis``.
+
+    Embedding and the LM head run outside the pipelined region under plain
+    GSPMD (they live on every stage; their cost is O(vocab) once, not per
+    layer). Default positions only (no packing/segment support in v1).
+    """
+    from ray_tpu.ops.norms import rms_norm
+    from ray_tpu.ops.rope import rope_sin_cos
+
+    n_stages = mesh.shape[axis]
+    m = n_microbatches or n_stages
+    b, s = tokens.shape
+    if b % m:
+        raise ValueError(f"batch {b} not divisible by {m} microbatches")
+
+    x = params["embedding"][tokens]  # [b, s, d]
+    positions = jnp.arange(s, dtype=jnp.int32)[None, :]
+    sin, cos = rope_sin_cos(positions, cfg.head_dim, theta=cfg.rope_theta)
+
+    stage_fn = make_llama_stage_fn(cfg, sin, cos, attn_impl)
     stage_params = split_stages(params["blocks"], n_stages)
     x_micro = x.reshape(m, b // m, s, x.shape[-1])
     out = pipeline_apply(stage_fn, stage_params, x_micro, mesh=mesh,

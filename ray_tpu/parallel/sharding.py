@@ -16,6 +16,7 @@ Logical axes used by the model library:
     mlp      — FFN hidden dim               → tp
     vocab    — vocabulary dim               → tp
     expert   — MoE expert dim               → ep
+    layers   — stacked layer dim            → pp (pipeline parallel)
     stage    — pipeline stage dim           → pp
     (None)   — replicated
 """
@@ -65,6 +66,7 @@ class ShardingRules:
     mlp: Any = None
     vocab: Any = None
     expert: Any = None
+    layers: Any = None
     stage: Any = None
 
     def mesh_axes(self, logical: tuple) -> P:
@@ -105,6 +107,11 @@ FSDP_TP_SP_RULES = FSDP_TP_RULES.with_overrides(seq="sp")
 # MoE: experts split on ep, everything else as FSDP×TP.
 MOE_RULES = FSDP_TP_RULES.with_overrides(expert="ep")
 
+# Pipeline parallel: the stacked layer dim split over pp (contiguous layer
+# groups = stages), everything else FSDP×TP (tp entries drop out on meshes
+# without a tp axis via _filter_spec_for_mesh).
+PP_FSDP_RULES = FSDP_TP_RULES.with_overrides(layers="pp")
+
 PRESETS = {
     "dp": DP_RULES,
     "fsdp": FSDP_RULES,
@@ -112,6 +119,7 @@ PRESETS = {
     "fsdp_tp": FSDP_TP_RULES,
     "fsdp_tp_sp": FSDP_TP_SP_RULES,
     "moe": MOE_RULES,
+    "pp_fsdp": PP_FSDP_RULES,
 }
 
 

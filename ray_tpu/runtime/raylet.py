@@ -130,6 +130,9 @@ class Raylet(RpcServer):
         self.infeasible_timeout_s = infeasible_timeout_s
         self._infeasible: list = []
         self._infeasible_lock = threading.Lock()
+        # OOM-backoff timers (cancelled by stop())
+        self._deferred_timers: set[threading.Timer] = set()
+        self._timers_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -220,6 +223,11 @@ class Raylet(RpcServer):
 
     def stop(self):
         super().stop()
+        with self._timers_lock:
+            timers = list(self._deferred_timers)
+            self._deferred_timers.clear()
+        for timer in timers:
+            timer.cancel()
         # join background loops BEFORE closing the store: a mid-tick spill
         # loop dereferencing the munmapped segment is a segfault, not an
         # exception
@@ -358,15 +366,31 @@ class Raylet(RpcServer):
             if decided or task.get("cancelled"):
                 pass   # cancelled (error pre-stored) or results written:
                        # a retry would re-run completed/cancelled work
+            elif w.oom_killed:
+                # OOM kills have their OWN budget (config task_oom_retries,
+                # reference RAY_task_oom_retries): host pressure from an
+                # unrelated process must not burn the task's max_retries
+                # lineage budget, and re-dispatch backs off so a
+                # still-pressured node doesn't churn through the budget in
+                # a few monitor ticks.
+                from ray_tpu.utils.config import get_config
+
+                total = get_config().task_oom_retries
+                left = task.get("_oom_retries_left", total)
+                if left > 0:
+                    task["_oom_retries_left"] = left - 1
+                    delay = min(8.0, 1.0 * 2 ** (total - left))
+                    self._defer_enqueue(task, delay)
+                else:
+                    from ray_tpu.utils import exceptions as exc
+                    self._store_task_error(task, exc.OutOfMemoryError(
+                        f"task {task.get('name')}: worker killed to relieve "
+                        f"host memory pressure (threshold "
+                        f"{self._mem_threshold}; {total} OOM retries "
+                        f"exhausted)"))
             elif task.get("max_retries", 0) > 0:
                 task["max_retries"] -= 1
                 self._enqueue(task)
-            elif w.oom_killed:
-                from ray_tpu.utils import exceptions as exc
-                self._store_task_error(task, exc.OutOfMemoryError(
-                    f"task {task.get('name')}: worker killed to relieve "
-                    f"host memory pressure (threshold "
-                    f"{self._mem_threshold})"))
             else:
                 self._store_task_error(
                     task, RuntimeError(
@@ -500,6 +524,27 @@ class Raylet(RpcServer):
         with self._ready_cv:
             self._ready.append(task)
             self._ready_cv.notify()
+
+    def _defer_enqueue(self, task: dict, delay: float):
+        """Re-enqueue after a delay (OOM backoff). Timers are tracked so
+        stop() cancels them — an untracked timer firing after the store
+        closes would enqueue into a dead dispatch loop; the task is then
+        lost like any other task queued on a stopping node (cluster-level
+        recovery owns that case)."""
+        timer = threading.Timer(delay, self._timer_enqueue, args=(task,))
+        timer.daemon = True
+        with self._timers_lock:
+            if self._stopping:
+                return
+            self._deferred_timers.add(timer)
+        timer.start()
+
+    def _timer_enqueue(self, task: dict):
+        with self._timers_lock:
+            self._deferred_timers = {t for t in self._deferred_timers
+                                     if t.is_alive()}
+        if not self._stopping:
+            self._enqueue(task)
 
     def _kick_dispatch(self):
         with self._ready_cv:
@@ -823,7 +868,14 @@ class Raylet(RpcServer):
                     break
         if victim is not None:
             # pre-store the cancelled error; the worker's own
-            # (interrupted or successful) write loses the race
+            # (interrupted or successful) write loses the race. Known
+            # best-effort window for MULTI-return tasks: if the worker is
+            # concurrently writing its returns, the task can complete with
+            # a mix of real values and TaskCancelledError across the
+            # return set (each oid resolves first-write-wins
+            # independently). Cancel is best-effort by contract — callers
+            # must treat any TaskCancelledError among the returns as "the
+            # task may have partially run".
             self._store_task_error(task, exc.TaskCancelledError(
                 f"task {task.get('name')} cancelled while running"))
             with self._workers_lock:
@@ -1208,7 +1260,9 @@ class Raylet(RpcServer):
     def _heartbeat_loop(self):
         ticks = 0
         while not self._stopping:
-            time.sleep(self._hb_interval)
+            self._interruptible_sleep(self._hb_interval)
+            if self._stopping:
+                return
             ticks += 1
             if ticks % 2 == 0:
                 try:
@@ -1255,13 +1309,27 @@ class Raylet(RpcServer):
             return 0.0
         return 1.0 - avail / total
 
+    def _interruptible_sleep(self, seconds: float):
+        """Sleep in small increments so background loops observe
+        ``_stopping`` within ~0.1s — stop() joins them with a short
+        timeout before munmapping the store, and a loop that oversleeps
+        the join touches freed memory (segfault, not an exception)."""
+        deadline = time.monotonic() + seconds
+        while not self._stopping:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                return
+            time.sleep(min(0.1, remain))
+
     def _memory_monitor_loop(self):
         while not self._stopping:
-            time.sleep(self._mem_refresh_s)
+            self._interruptible_sleep(self._mem_refresh_s)
+            if self._stopping:
+                return
             if self._host_memory_fraction() < self._mem_threshold:
                 continue
             if self._kill_one_for_memory():
-                time.sleep(1.0)   # cooldown: let the kill take effect
+                self._interruptible_sleep(1.0)  # let the kill take effect
 
     def _kill_one_for_memory(self) -> bool:
         """Pick and kill one worker to relieve pressure. Policy (reference

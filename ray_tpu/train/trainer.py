@@ -57,6 +57,15 @@ class TrainConfig:
     # ~4% SLOWER on v5e, so it's opt-in.
     fused_loss: bool = False
     loss_chunk: int = 1024
+    # Pipeline parallelism (strategy="pp_fsdp"): microbatch count (default
+    # = pp size, the minimum that fills the pipeline). The schedule is
+    # 1F1B (interleaved fwd/bwd, O(pipeline-depth) activation stash) —
+    # autodiff-through-GPipe is NOT offered here because differentiating
+    # through the pipelined region with the embedding/head outside trips an
+    # XLA partitioner crash on multi-axis meshes (see
+    # parallel/pipeline.py); forward-only GPipe remains available via
+    # llama_forward_pipelined.
+    n_microbatches: int | None = None
 
 
 class JaxTrainer:
@@ -88,6 +97,26 @@ class JaxTrainer:
             and self.mesh.shape[sp] > 1 else "auto"
         )
         self.sp_axis = sp if self.attn_impl == "ring" else "sp"
+        # Pipeline parallelism: active when the rules map the stacked-layer
+        # dim onto a mesh axis that exists with size > 1.
+        ppax = self.rules.layers
+        self.pp_axis = (
+            ppax if isinstance(ppax, str) and ppax in self.mesh.axis_names
+            and self.mesh.shape[ppax] > 1 else None
+        )
+        if self.pp_axis:
+            n_pp = self.mesh.shape[self.pp_axis]
+            if model_cfg.n_layers % n_pp:
+                raise ValueError(
+                    f"n_layers={model_cfg.n_layers} not divisible by "
+                    f"pp={n_pp}"
+                )
+            if cfg.fused_loss:
+                raise ValueError(
+                    "fused_loss is redundant under pipeline parallelism: "
+                    "the 1F1B loss slot already computes the head "
+                    "per-microbatch"
+                )
 
     # --- optimizer (AdamW + cosine schedule + clip, the Llama recipe) ---
 
@@ -169,8 +198,67 @@ class JaxTrainer:
         )
         return loss
 
+    def _pp_loss_and_grad(self, params, batch):
+        """1F1B pipelined loss + grads (pipeline_value_and_grad implements
+        the backward itself — this is NOT differentiated through)."""
+        from ray_tpu.ops.rope import rope_sin_cos
+        from ray_tpu.parallel.pipeline import (
+            make_llama_head_fn,
+            make_llama_stage_fn,
+            pipeline_value_and_grad,
+            split_stages,
+        )
+
+        cfg = self.model_cfg
+        n_pp = self.mesh.shape[self.pp_axis]
+        m = self.cfg.n_microbatches or n_pp
+        inputs = batch[:, :-1]
+        targets = batch[:, 1:]
+        mask = (targets != -1).astype(jnp.float32)
+        b, s = inputs.shape
+        if b % m:
+            raise ValueError(f"batch {b} not divisible by {m} microbatches")
+
+        positions = jnp.arange(s, dtype=jnp.int32)[None, :]
+        sin, cos = rope_sin_cos(positions, cfg.head_dim, theta=cfg.rope_theta)
+        stage_fn = make_llama_stage_fn(cfg, sin, cos, self.attn_impl)
+        head_fn = make_llama_head_fn(cfg)
+        # io params: embedding (stage-0 lookup, + head when tied), final
+        # norm + head (last stage). The schedule accumulates ALL their grad
+        # contributions into one d_io — tied embeddings need no fixup.
+        io_params = {k: v for k, v in params.items() if k != "blocks"}
+
+        def embed_fn(io, tok):
+            return io["embedding"][tok]
+
+        mb = b // m
+        (loss_sum, weight_sum), (d_sp, d_io, _) = pipeline_value_and_grad(
+            stage_fn, head_fn,
+            split_stages(params["blocks"], n_pp), io_params,
+            inputs.reshape(m, mb, s),
+            targets.reshape(m, mb, s),
+            mask.reshape(m, mb, s),
+            mesh=self.mesh, axis=self.pp_axis,
+            embed_fn=embed_fn,
+        )
+        weight = jnp.maximum(weight_sum, 1.0)
+        grads = dict(
+            d_io,
+            blocks=jax.tree.map(
+                lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]),
+                d_sp),
+        )
+        # grads are of loss_sum; mean-loss grads = grads / Σmask
+        grads = jax.tree.map(
+            lambda g, p: (g / weight).astype(p.dtype), grads, params)
+        return loss_sum / weight, grads
+
     def _step(self, state: TrainState, batch):
-        loss, grads = jax.value_and_grad(self._loss_fn)(state.params, batch)
+        if self.pp_axis:
+            loss, grads = self._pp_loss_and_grad(state.params, batch)
+        else:
+            loss, grads = jax.value_and_grad(self._loss_fn)(
+                state.params, batch)
         updates, new_opt = self.optimizer.update(
             grads, state.opt_state, state.params
         )

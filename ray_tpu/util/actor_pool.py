@@ -1,8 +1,14 @@
-"""ActorPool (reference: ``python/ray/util/actor_pool.py:13``)."""
+"""ActorPool (reference API: ``python/ray/util/actor_pool.py:13``; the
+bookkeeping here is this repo's own — work is tracked by a submission
+serial, with a deque of queued calls and one in-flight table keyed both
+ways)."""
 
 from __future__ import annotations
 
+from collections import deque
+
 import ray_tpu
+from ray_tpu.utils.exceptions import GetTimeoutError
 
 
 class ActorPool:
@@ -11,61 +17,82 @@ class ActorPool:
     iteration."""
 
     def __init__(self, actors: list):
-        self._idle = list(actors)
-        self._future_to_actor: dict = {}
-        self._index_to_future: dict = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: list = []
+        self._idle = deque(actors)
+        self._queued: deque = deque()      # (fn, value) waiting for an actor
+        self._in_flight: dict = {}         # serial -> (ref, actor)
+        self._serial_of: dict = {}         # ref -> serial
+        self._submitted = 0                # serials handed out
+        self._yielded = 0                  # next serial get_next() returns
+
+    # -- submission ----------------------------------------------------
 
     def submit(self, fn, value):
         """fn(actor, value) -> ObjectRef; queued if all actors busy."""
         if self._idle:
-            actor = self._idle.pop()
-            ref = fn(actor, value)
-            self._future_to_actor[ref] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = ref
-            self._next_task_index += 1
+            self._launch(fn, value)
         else:
-            self._pending_submits.append((fn, value))
+            self._queued.append((fn, value))
+
+    def _launch(self, fn, value):
+        actor = self._idle.popleft()
+        ref = fn(actor, value)
+        self._in_flight[self._submitted] = (ref, actor)
+        self._serial_of[ref] = self._submitted
+        self._submitted += 1
+
+    def _recycle(self, serial):
+        ref, actor = self._in_flight.pop(serial)
+        self._serial_of.pop(ref, None)
+        self._idle.append(actor)
+        if self._queued:
+            self._launch(*self._queued.popleft())
+
+    # -- results -------------------------------------------------------
 
     def has_next(self) -> bool:
-        return bool(self._index_to_future) or bool(self._pending_submits)
+        return bool(self._in_flight) or bool(self._queued)
 
     def get_next(self, timeout=None):
         """Next result in SUBMISSION order."""
-        if self._next_return_index not in self._index_to_future:
+        # slots consumed out-of-order by get_next_unordered leave holes;
+        # the in-order cursor walks past them
+        while (self._yielded < self._submitted
+               and self._yielded not in self._in_flight):
+            self._yielded += 1
+        if self._yielded not in self._in_flight:
             raise StopIteration("no pending results")
-        ref = self._index_to_future.pop(self._next_return_index)
-        self._next_return_index += 1
+        serial = self._yielded
+        ref = self._in_flight[serial][0]
         try:
-            return ray_tpu.get(ref, timeout=timeout)
-        finally:
-            # even when the task errored, the actor itself is healthy —
-            # return it so queued submits aren't stranded
-            self._return_actor(ref)
+            value = ray_tpu.get(ref, timeout=timeout)
+        except GetTimeoutError:
+            # nothing consumed: the same serial is retrievable on retry,
+            # and the still-busy actor is NOT recycled
+            raise
+        except BaseException:
+            # the task errored but the actor itself is healthy — consume
+            # the slot and recycle so queued submits aren't stranded
+            self._yielded = serial + 1
+            self._recycle(serial)
+            raise
+        self._yielded = serial + 1
+        self._recycle(serial)
+        return value
 
     def get_next_unordered(self, timeout=None):
         """Next result in COMPLETION order."""
-        if not self._future_to_actor:
+        if not self._in_flight:
             raise StopIteration("no pending results")
-        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+        ready, _ = ray_tpu.wait([r for r, _ in self._in_flight.values()],
                                 num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("get_next_unordered timed out")
         ref = ready[0]
-        index, _ = self._future_to_actor[ref]
-        self._index_to_future.pop(index, None)
+        serial = self._serial_of[ref]
         try:
             return ray_tpu.get(ref)
         finally:
-            self._return_actor(ref)
-
-    def _return_actor(self, ref):
-        _, actor = self._future_to_actor.pop(ref)
-        self._idle.append(actor)
-        if self._pending_submits:
-            self.submit(*self._pending_submits.pop(0))
+            self._recycle(serial)
 
     def map(self, fn, values):
         for v in values:
@@ -78,6 +105,8 @@ class ActorPool:
             self.submit(fn, v)
         while self.has_next():
             yield self.get_next_unordered()
+
+    # -- manual actor management ---------------------------------------
 
     def has_free(self) -> bool:
         return bool(self._idle)

@@ -68,6 +68,11 @@ class Config:
     memory_usage_threshold: float = 0.95
     # Sampling period for the monitor loop.
     memory_monitor_refresh_ms: int = 250
+    # OOM kills draw from their own per-task budget (reference:
+    # RAY_task_oom_retries) so host pressure — possibly caused by an
+    # unrelated process — cannot burn a task's max_retries lineage budget;
+    # re-dispatch backs off exponentially while pressure persists.
+    task_oom_retries: int = 3
 
     # --- workers ---
     num_workers: int = 0  # 0 = num_cpus

@@ -288,6 +288,29 @@ bool evict_for(Store* s, uint64_t needed) {
   return any;
 }
 
+// Attribute one reference to `pid` in the entry's reader slots. An existing
+// slot for this pid ALWAYS wins over an earlier empty slot — otherwise one
+// pid can end up spread across two slots (or share a slot with its own
+// pin), which breaks every "sum of this pid's refs" consumer
+// (store_spill_candidates' pinned test, release_pid cleanup).
+bool track_reader(ObjectEntry& e, uint64_t pid) {
+  int empty = -1;
+  for (uint32_t k = 0; k < kReaderSlots; k++) {
+    if (e.reader_pids[k] == pid) {
+      e.reader_counts[k]++;
+      return true;
+    }
+    if (empty < 0 && e.reader_pids[k] == 0 && e.reader_counts[k] == 0)
+      empty = (int)k;
+  }
+  if (empty >= 0) {
+    e.reader_pids[empty] = pid;
+    e.reader_counts[empty] = 1;
+    return true;
+  }
+  return false;
+}
+
 }  // namespace
 
 extern "C" {
@@ -500,18 +523,7 @@ int store_seal_hold(void* sp, const uint8_t* id) {
   }
   e.state = kSealed;
   // keep refcount as-is (writer ref becomes the hold); attribute it
-  uint64_t pid = (uint64_t)getpid();
-  bool tracked = false;
-  for (uint32_t k = 0; k < kReaderSlots; k++) {
-    if (e.reader_pids[k] == pid ||
-        (e.reader_pids[k] == 0 && e.reader_counts[k] == 0)) {
-      e.reader_pids[k] = pid;
-      e.reader_counts[k]++;
-      tracked = true;
-      break;
-    }
-  }
-  if (!tracked) e.untracked_refs++;
+  if (!track_reader(e, (uint64_t)getpid())) e.untracked_refs++;
   pthread_cond_broadcast(&h->cv);
   unlock(h);
   return TS_OK;
@@ -541,18 +553,7 @@ int store_get(void* sp, const uint8_t* id, int64_t timeout_ms,
       ObjectEntry& e = s->table[i];
       e.refcount++;
       // record this reader's pid so a crash can be cleaned up
-      uint64_t pid = (uint64_t)getpid();
-      bool tracked = false;
-      for (uint32_t k = 0; k < kReaderSlots; k++) {
-        if (e.reader_pids[k] == pid ||
-            (e.reader_pids[k] == 0 && e.reader_counts[k] == 0)) {
-          e.reader_pids[k] = pid;
-          e.reader_counts[k]++;
-          tracked = true;
-          break;
-        }
-      }
-      if (!tracked) e.untracked_refs++;
+      if (!track_reader(e, (uint64_t)getpid())) e.untracked_refs++;
       e.lru_tick = ++h->lru_clock;
       *offset_out = e.offset;
       *data_size_out = e.data_size;
@@ -695,8 +696,12 @@ int store_spill_candidates(void* sp, uint64_t target_bytes, uint8_t* out_ids,
     if (e.state != kSealed) continue;
     uint64_t pinned = 0;
     if (pin_pid != 0) {
+      // SUM over every slot with this pid: historic slot-scan bugs could
+      // split one pid across slots, and a single-slot read then both
+      // skips legitimately pinned-idle victims and can pick an object
+      // the pinner is concurrently reading
       for (uint32_t k = 0; k < kReaderSlots; k++)
-        if (e.reader_pids[k] == pin_pid) pinned = e.reader_counts[k];
+        if (e.reader_pids[k] == pin_pid) pinned += e.reader_counts[k];
       if (pinned == 0 || e.refcount != pinned) continue;
     } else if (e.refcount != 0) {
       continue;

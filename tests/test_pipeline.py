@@ -66,6 +66,94 @@ def test_llama_pipelined_matches_plain():
     assert corr > 0.999, corr
 
 
+def test_pipeline_value_and_grad_matches_autodiff():
+    """1F1B grads == plain autodiff grads on a toy stage chain + head."""
+    from ray_tpu.parallel.pipeline import pipeline_value_and_grad
+
+    S, M, mb, s, d = 4, 8, 2, 3, 8
+    mesh = create_mesh({"pp": S, "fsdp": 2}, devices=jax.devices()[:8])
+    key = jax.random.key(0)
+    stage_params = {"w": jax.random.normal(key, (S, 1, d, d)) * 0.3}
+    head_params = {"h": jax.random.normal(jax.random.key(1), (d, 16)) * 0.3}
+    x = jax.random.normal(jax.random.key(2), (M, mb, s, d))
+    tgt = jax.random.randint(jax.random.key(3), (M, mb, s), 0, 16)
+    msk = jnp.ones((M, mb, s), jnp.float32)
+
+    def stage_fn(sp, xm):
+        return jnp.tanh(xm @ sp["w"][0])
+
+    def head_fn(hp, y, t, m):
+        logits = (y @ hp["h"]).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(logits, t[..., None], -1).squeeze(-1)
+        return jnp.sum((lse - tl) * m), jnp.sum(m)
+
+    (loss_sum, w_sum), (d_sp, d_head, d_x) = jax.jit(
+        lambda sp, hp, x: pipeline_value_and_grad(
+            stage_fn, head_fn, sp, hp, x, tgt, msk, mesh=mesh, axis="pp")
+    )(stage_params, head_params, x)
+
+    def ref_loss(sp, hp, x):
+        y = x
+        for i in range(S):
+            y = stage_fn({"w": sp["w"][i]}, y)
+        l, w = head_fn(hp, y, tgt, msk)
+        return l
+
+    ref, (g_sp, g_hp, g_x) = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(
+        jax.device_get(stage_params), jax.device_get(head_params),
+        jax.device_get(x))
+    ref = np.float64(jax.device_get(ref))
+    np.testing.assert_allclose(float(loss_sum), ref, rtol=1e-5)
+    assert float(w_sum) == M * mb * s
+    np.testing.assert_allclose(np.asarray(d_sp["w"]), np.asarray(g_sp["w"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(d_head["h"]), np.asarray(g_hp["h"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(d_x), np.asarray(g_x),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pp_fsdp_trainer_matches_fsdp():
+    """JaxTrainer(strategy='pp_fsdp') step == fsdp step: same loss, same
+    grad norm, same updated params (VERDICT r1 item 2's done-criterion)."""
+    from ray_tpu.train.trainer import JaxTrainer, TrainConfig
+
+    cfg = llama.llama_tiny(vocab_size=64)
+    batch = jax.random.randint(jax.random.key(1), (8, 17), 0, 64,
+                               dtype=jnp.int32)
+
+    pp_mesh = create_mesh({"pp": 2, "dp": 2, "fsdp": 2},
+                          devices=jax.devices()[:8])
+    tr_pp = JaxTrainer(
+        cfg, TrainConfig(strategy="pp_fsdp", warmup_steps=1, total_steps=10,
+                         n_microbatches=4),
+        mesh=pp_mesh)
+    assert tr_pp.pp_axis == "pp"
+    st_pp = tr_pp.init_state(jax.random.key(0))
+    st_pp, m_pp = tr_pp.train_step(st_pp, batch)
+
+    fsdp_mesh = create_mesh({"dp": 2, "fsdp": 2}, devices=jax.devices()[:4])
+    tr_ref = JaxTrainer(
+        cfg, TrainConfig(strategy="fsdp", warmup_steps=1, total_steps=10),
+        mesh=fsdp_mesh)
+    st_ref = tr_ref.init_state(jax.random.key(0))
+    st_ref, m_ref = tr_ref.train_step(st_ref, batch)
+
+    np.testing.assert_allclose(float(m_pp["loss"]), float(m_ref["loss"]),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(m_pp["grad_norm"]),
+                               float(m_ref["grad_norm"]), rtol=1e-3)
+    got = jax.device_get(st_pp.params)
+    want = jax.device_get(st_ref.params)
+    for path, a in jax.tree_util.tree_flatten_with_path(got)[0]:
+        b_leaf = want
+        for p in path:
+            b_leaf = b_leaf[p.key]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_leaf),
+                                   rtol=2e-3, atol=2e-5, err_msg=str(path))
+
+
 def test_llama_pipelined_trains():
     import optax
 
