@@ -34,12 +34,47 @@ class Cluster:
     """``Cluster()`` → ``add_node(num_cpus=...)`` → drive via ray_tpu.init
     (address=cluster.gcs_address)."""
 
-    def __init__(self, *, heartbeat_timeout_s: float = 3.0):
-        self.gcs = GcsServer(heartbeat_timeout_s=heartbeat_timeout_s).start()
+    def __init__(self, *, heartbeat_timeout_s: float = 3.0,
+                 gcs_fault_tolerance: bool = False):
+        self._hb_timeout = heartbeat_timeout_s
+        self._gcs_persist_dir = None
+        self._owns_persist_dir = False
+        if gcs_fault_tolerance:
+            import tempfile
+
+            self._gcs_persist_dir = tempfile.mkdtemp(prefix="raytpu_gcs_")
+            self._owns_persist_dir = True
+        self.gcs = GcsServer(
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            persistence_dir=self._gcs_persist_dir).start()
         self.gcs_address = self.gcs.address
         self.nodes: dict[str, NodeHandle] = {}
         self._head_id: str | None = None
         self._lock = threading.Lock()
+
+    def kill_gcs(self):
+        """Chaos path: hard-stop the GCS WITHOUT a final snapshot (as a
+        crash would), severing every client connection."""
+        if self.gcs._persist is not None:
+            self.gcs._persist.close()
+            self.gcs._persist = None   # skip stop()'s snapshot
+        self.gcs.stop()
+
+    def restart_gcs(self):
+        """Start a fresh GCS on the SAME address, reloading persisted
+        state (reference: GCS fault-tolerance restart with Redis-backed
+        reload — gcs_init_data.cc). Raylets/drivers reconnect via their
+        ReconnectingRpcClient and re-register on the first heartbeat."""
+        if self._gcs_persist_dir is None:
+            raise RuntimeError(
+                "restart_gcs requires Cluster(gcs_fault_tolerance=True)")
+        host, port = self.gcs_address
+        self.gcs = GcsServer(
+            host=host, port=port,
+            heartbeat_timeout_s=self._hb_timeout,
+            persistence_dir=self._gcs_persist_dir).start()
+        self.gcs_address = self.gcs.address
+        return self.gcs
 
     # ------------------------------------------------------------------
 
@@ -125,3 +160,7 @@ class Cluster:
         for handle in list(self.nodes.values()):
             self.remove_node(handle, graceful=True)
         self.gcs.stop()
+        if self._owns_persist_dir and self._gcs_persist_dir:
+            import shutil
+
+            shutil.rmtree(self._gcs_persist_dir, ignore_errors=True)

@@ -16,6 +16,7 @@ from ray_tpu.data.dataset import (
     range,  # noqa: A004 - mirrors the reference's ray.data.range
     read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_parquet,
     read_text,
@@ -40,6 +41,7 @@ __all__ = [
     "range",
     "read_binary_files",
     "read_csv",
+    "read_images",
     "read_json",
     "read_parquet",
     "read_text",

@@ -24,6 +24,17 @@ class DataContext:
     shuffle_partitions: int | None = None
     # target rows per block for sources that chunk data
     target_num_blocks: int = 8
+    # Blocks larger than this are split after a map task (size-based
+    # block splitting; reference: DataContext.target_max_block_size,
+    # default 128 MiB — smaller here because blocks round-trip through a
+    # per-node shm store sized for tests and single hosts).
+    target_max_block_size: int = 32 << 20
+    # Streaming-executor backpressure: cap on bytes resident across the
+    # topology (queued + in-flight). None = execution_budget_fraction of
+    # the object store capacity (reference budgets 25% of the store —
+    # streaming_executor_state.py:39).
+    execution_budget_bytes: int | None = None
+    execution_budget_fraction: float = 0.25
     extra: dict = field(default_factory=dict)
 
     _lock: ClassVar[threading.Lock] = threading.Lock()

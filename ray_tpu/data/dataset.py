@@ -703,3 +703,64 @@ def read_binary_files(paths, *, include_paths: bool = False,
                 rows.append(row)
         return from_items(rows, num_blocks=num_blocks)._source_fn()
     return Dataset(source)
+
+
+_IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp", ".tiff")
+
+
+def _expand_image_paths(paths) -> list[str]:
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _, names in sorted(os.walk(p)):
+                for n in sorted(names):
+                    if n.lower().endswith(_IMAGE_EXTS):
+                        files.append(os.path.join(root, n))
+        elif os.path.exists(p):
+            files.append(p)
+        else:
+            raise FileNotFoundError(f"no such image file or directory: {p}")
+    return files
+
+
+def read_images(paths, *, size: tuple | None = None, mode: str = "RGB",
+                include_paths: bool = False,
+                num_blocks: int | None = None) -> Dataset:
+    """Image files/directories → rows with an ``image`` ndarray column
+    (reference: ``data/datasource/image_datasource.py:41`` — the input
+    side of the ViT/CLIP BASELINE config).
+
+    Listing happens on the driver; DECODING happens inside the streaming
+    executor's map tasks, so ingest parallelizes across the cluster and
+    flows through the byte-budget backpressure like any other operator.
+
+    size: optional (height, width) resize. mode: PIL conversion mode
+    ("RGB", "L", ...). Decoded dtype is uint8, shape [H, W, C] ([H, W]
+    for mode "L").
+    """
+    files = _expand_image_paths(paths)
+    if not files:
+        raise FileNotFoundError(f"no image files under {paths!r}")
+    n_blocks = num_blocks or min(len(files), 8)
+
+    def decode(row: dict) -> dict:
+        from PIL import Image
+
+        img = Image.open(row["path"])
+        if mode:
+            img = img.convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))  # PIL takes (w, h)
+        import numpy as _np
+
+        out = {"image": _np.asarray(img)}
+        if include_paths:
+            out["path"] = row["path"]
+        return out
+
+    return from_items([{"path": f} for f in files],
+                      num_blocks=n_blocks).map(decode)

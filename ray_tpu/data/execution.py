@@ -40,6 +40,27 @@ class ExecutionOptions:
     max_in_flight_tasks: int = 8        # per operator
     max_buffered_bundles: int = 16      # per operator output queue
     actor_pool_size: int = 2
+    # Byte budget for data resident in the topology (queued bundles +
+    # in-flight task inputs). None = resolved from DataContext at
+    # execution time (fraction of the object store). The most-downstream
+    # runnable operator is always allowed to dispatch, so the pipeline
+    # drains instead of deadlocking when one bundle exceeds the budget.
+    max_in_flight_bytes: int | None = None
+
+
+def _resolve_byte_budget(options: ExecutionOptions) -> int:
+    if options.max_in_flight_bytes is not None:
+        return options.max_in_flight_bytes
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    if ctx.execution_budget_bytes is not None:
+        return ctx.execution_budget_bytes
+    try:
+        capacity = ray_tpu.api._runtime().store.stats()["capacity"]
+    except Exception:  # noqa: BLE001 - local mode / no store stats
+        capacity = 1 << 30
+    return max(int(capacity * ctx.execution_budget_fraction), 16 << 20)
 
 
 class PhysicalOperator:
@@ -60,6 +81,12 @@ class PhysicalOperator:
 
     def num_active_tasks(self) -> int:
         return 0
+
+    def outstanding_bytes(self) -> int:
+        """Bytes resident in this operator (queued + in-flight inputs) —
+        the backpressure accounting unit."""
+        return (sum(b.size_bytes for b in self.input_queue)
+                + sum(b.size_bytes for b in self.output_queue))
 
     def dispatch(self, options: ExecutionOptions):
         raise NotImplementedError
@@ -148,6 +175,10 @@ class MapOperator(PhysicalOperator):
     def num_active_tasks(self) -> int:
         return len(self._active)
 
+    def outstanding_bytes(self) -> int:
+        return (super().outstanding_bytes()
+                + sum(b.size_bytes for _, b in self._active))
+
     def _ensure_pool(self):
         if self._pool or self.compute != "actors":
             return
@@ -183,9 +214,11 @@ class MapOperator(PhysicalOperator):
             ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
             if ready:
                 block, rows, nbytes = ray_tpu.get(ref)
-                out_ref = ray_tpu.put(block)
-                self.output_queue.append(
-                    RefBundle([out_ref], num_rows=rows, size_bytes=nbytes))
+                for out_block, out_rows, out_bytes in _maybe_split(
+                        block, rows, nbytes):
+                    self.output_queue.append(RefBundle(
+                        [ray_tpu.put(out_block)], num_rows=out_rows,
+                        size_bytes=out_bytes))
                 self.metrics["bundles_out"] += 1
             else:
                 still.append((ref, bundle))
@@ -198,6 +231,27 @@ class MapOperator(PhysicalOperator):
             except Exception:  # noqa: BLE001
                 pass
         self._pool = []
+
+
+def _maybe_split(block, rows: int, nbytes: int):
+    """Size-based block splitting (reference: DataContext
+    target_max_block_size + output splitting in MapOperator): an
+    oversized map output becomes several row-sliced blocks so one fat
+    block can't blow the byte budget or a downstream consumer's memory."""
+    from ray_tpu.data.context import DataContext
+
+    target = DataContext.get_current().target_max_block_size
+    if nbytes <= target or rows <= 1:
+        return [(block, rows, nbytes)]
+    n_chunks = min(rows, -(-nbytes // target))
+    per = -(-rows // n_chunks)
+    acc = BlockAccessor.for_block(block)
+    out = []
+    for start in range(0, rows, per):
+        piece = acc.slice(start, min(start + per, rows))
+        pacc = BlockAccessor.for_block(piece)
+        out.append((piece, pacc.num_rows(), pacc.size_bytes()))
+    return out
 
 
 class AllToAllOperator(PhysicalOperator):
@@ -268,6 +322,7 @@ class StreamingExecutor:
                  options: ExecutionOptions | None = None):
         self.operators = operators
         self.options = options or ExecutionOptions()
+        self._byte_budget = _resolve_byte_budget(self.options)
 
     def execute(self) -> Iterator[RefBundle]:
         ops = self.operators
@@ -291,11 +346,21 @@ class StreamingExecutor:
                 if tail.is_done():
                     return
                 # pick operators to run: furthest-downstream first
-                # (select_operator_to_run analog)
+                # (select_operator_to_run analog). Byte-budget admission
+                # (_execution_allowed analog): once the topology holds
+                # more than the budget, only the most-downstream runnable
+                # operator may dispatch — it shrinks the resident set;
+                # upstream dispatch would grow it.
+                over_budget = (sum(op.outstanding_bytes() for op in ops)
+                               > self._byte_budget)
+                drained_one = False
                 for op in reversed(ops):
                     op.poll()
                     while op.can_accept_work(self.options):
+                        if over_budget and drained_one:
+                            break
                         op.dispatch(self.options)
+                        drained_one = True
                         progressed = True
                 if not progressed:
                     time.sleep(0.002)

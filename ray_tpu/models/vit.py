@@ -1,0 +1,197 @@
+"""Vision Transformer (the ViT-L / CLIP-vision BASELINE model family).
+
+TPU-first design mirroring the llama module's conventions: stacked-layer
+parameter arrays scanned with ``lax.scan`` (the ``layers`` logical axis
+makes the stack pp-shardable), logical-axis annotations for GSPMD
+sharding via ``parallel/sharding.py`` rules, fp32 statistics inside
+bf16-friendly compute, and patch embedding expressed as ONE matmul
+([B, N, P·P·C] @ [P·P·C, D]) instead of a conv — XLA maps it straight
+onto the MXU.
+
+Reference analog: the torchvision/timm ViT models the reference's AIR
+examples fine-tune (e.g. ``python/ray/train`` image examples); there is
+no first-party ViT in the reference — this is the TPU-native equivalent
+the BASELINE's "ViT-L / CLIP multimodal (image pipeline → TPU)" config
+needs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.llama import fanin_init
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    n_classes: int = 1000
+    ln_eps: float = 1e-6
+    param_dtype: object = jnp.float32
+    pool: str = "cls"            # "cls" token or "mean" pooling
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+
+def vit_tiny(image_size: int = 32, patch_size: int = 8,
+             n_classes: int = 10) -> ViTConfig:
+    """Test-size config: runs in seconds on the 8-device CPU mesh."""
+    return ViTConfig(image_size=image_size, patch_size=patch_size,
+                     d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                     n_classes=n_classes)
+
+
+def vit_l16() -> ViTConfig:
+    """ViT-L/16 (the BASELINE's ViT-L)."""
+    return ViTConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096)
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def param_logical_axes(cfg: ViTConfig) -> dict:
+    block = {
+        "ln1_w": ("layers", "embed"),
+        "ln1_b": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "heads"),
+        "wv": ("layers", "embed", "heads"),
+        "wo": ("layers", "heads", "embed"),
+        "ln2_w": ("layers", "embed"),
+        "ln2_b": ("layers", "embed"),
+        "w_up": ("layers", "embed", "mlp"),
+        "b_up": ("layers", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+        "b_down": ("layers", "embed"),
+    }
+    return {
+        "patch_embed": (None, "embed"),
+        "patch_bias": ("embed",),
+        "pos_embed": (None, "embed"),
+        "cls_token": ("embed",),
+        "blocks": block,
+        "final_ln_w": ("embed",),
+        "final_ln_b": ("embed",),
+        "head": ("embed", "vocab"),
+        "head_bias": ("vocab",),
+    }
+
+
+def init_params(cfg: ViTConfig, key) -> dict:
+    dt = cfg.param_dtype
+    d, l = cfg.d_model, cfg.n_layers
+    ks = jax.random.split(key, 10)
+
+    def dense(k, shape, fan_in):
+        return fanin_init(k, shape, fan_in).astype(dt)
+
+    blocks = {
+        "ln1_w": jnp.ones((l, d), dt),
+        "ln1_b": jnp.zeros((l, d), dt),
+        "wq": dense(ks[0], (l, d, d), d),
+        "wk": dense(ks[1], (l, d, d), d),
+        "wv": dense(ks[2], (l, d, d), d),
+        "wo": dense(ks[3], (l, d, d), d),
+        "ln2_w": jnp.ones((l, d), dt),
+        "ln2_b": jnp.zeros((l, d), dt),
+        "w_up": dense(ks[4], (l, d, cfg.d_ff), d),
+        "b_up": jnp.zeros((l, cfg.d_ff), dt),
+        "w_down": dense(ks[5], (l, cfg.d_ff, d), cfg.d_ff),
+        "b_down": jnp.zeros((l, d), dt),
+    }
+    return {
+        "patch_embed": dense(ks[6], (cfg.patch_dim, d), cfg.patch_dim),
+        "patch_bias": jnp.zeros((d,), dt),
+        "pos_embed": (jax.random.normal(
+            ks[7], (cfg.n_patches + 1, d)) * 0.02).astype(dt),
+        "cls_token": (jax.random.normal(ks[8], (d,)) * 0.02).astype(dt),
+        "blocks": blocks,
+        "final_ln_w": jnp.ones((d,), dt),
+        "final_ln_b": jnp.zeros((d,), dt),
+        "head": dense(ks[9], (d, cfg.n_classes), d),
+        "head_bias": jnp.zeros((cfg.n_classes,), dt),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def patchify(cfg: ViTConfig, images):
+    """[B, H, W, C] -> [B, N, P·P·C]: reshape-only (no conv needed)."""
+    b, h, w, c = images.shape
+    p = cfg.patch_size
+    if h != cfg.image_size or w != cfg.image_size or c != cfg.channels:
+        raise ValueError(
+            f"expected [{cfg.image_size},{cfg.image_size},{cfg.channels}] "
+            f"images, got {images.shape[1:]}")
+    x = images.reshape(b, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)          # [B, hp, wp, p, p, c]
+    return x.reshape(b, cfg.n_patches, cfg.patch_dim)
+
+
+def _block(cfg: ViTConfig, x, p):
+    """Pre-LN encoder block: MHA + GELU MLP, both with residuals."""
+    b, s, d = x.shape
+    h = layer_norm(x, p["ln1_w"], p["ln1_b"], eps=cfg.ln_eps)
+    q = (h @ p["wq"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ p["wk"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    v = (h @ p["wv"]).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    attn = attention(q, k, v, causal=False, impl="reference")
+    x = x + attn.reshape(b, s, d) @ p["wo"]
+    h = layer_norm(x, p["ln2_w"], p["ln2_b"], eps=cfg.ln_eps)
+    h = jax.nn.gelu(h @ p["w_up"] + p["b_up"])
+    return x + (h @ p["w_down"] + p["b_down"])
+
+
+def forward(cfg: ViTConfig, params: dict, images):
+    """Images [B, H, W, C] (float; caller normalizes) -> logits
+    [B, n_classes] (fp32)."""
+    x = patchify(cfg, images).astype(params["patch_embed"].dtype)
+    x = x @ params["patch_embed"] + params["patch_bias"]   # [B, N, D]
+    b = x.shape[0]
+    cls = jnp.broadcast_to(params["cls_token"], (b, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"]
+
+    body = partial(_block, cfg)
+
+    def scan_fn(x, layer_params):
+        return body(x, layer_params), None
+
+    x, _ = lax.scan(scan_fn, x, params["blocks"])
+    x = layer_norm(x, params["final_ln_w"], params["final_ln_b"],
+                   eps=cfg.ln_eps)
+    pooled = x[:, 0] if cfg.pool == "cls" else x.mean(axis=1)
+    return jnp.einsum("bd,dc->bc", pooled, params["head"],
+                      preferred_element_type=jnp.float32) \
+        + params["head_bias"].astype(jnp.float32)
+
+
+def num_params(params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

@@ -14,10 +14,22 @@ from ray_tpu.rllib.env import (
     Pendulum,
     make_env,
 )
+from ray_tpu.rllib.gym_env import GymEnvAdapter
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.offline import (
+    BC,
+    BCConfig,
+    CQL,
+    CQLConfig,
+    DatasetWriter,
+    OfflineDataset,
+    collect_dataset,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
 
-__all__ = ["APPO", "APPOConfig", "BanditEnv", "CartPole", "ContinuousBandit", "DQN", "DQNConfig",
-           "IMPALA", "IMPALAConfig", "PPO", "PPOConfig", "Pendulum",
-           "SAC", "SACConfig", "make_env"]
+__all__ = ["APPO", "APPOConfig", "BC", "BCConfig", "BanditEnv", "CQL",
+           "CQLConfig", "CartPole", "ContinuousBandit", "DQN", "DQNConfig",
+           "DatasetWriter", "GymEnvAdapter", "IMPALA", "IMPALAConfig",
+           "OfflineDataset", "PPO", "PPOConfig", "Pendulum",
+           "SAC", "SACConfig", "collect_dataset", "make_env"]

@@ -159,5 +159,16 @@ ENV_REGISTRY = {"CartPole-v1": CartPole, "Bandit-v0": BanditEnv,
 
 def make_env(name_or_cls, seed=None):
     if isinstance(name_or_cls, str):
-        return ENV_REGISTRY[name_or_cls](seed=seed)
+        cls = ENV_REGISTRY.get(name_or_cls)
+        if cls is not None:
+            return cls(seed=seed)
+        # unknown id: resolve through gymnasium (Atari/MuJoCo-class envs)
+        from ray_tpu.rllib.gym_env import try_make_gym_env
+
+        env = try_make_gym_env(name_or_cls, seed=seed)
+        if env is None:
+            raise KeyError(
+                f"unknown env {name_or_cls!r}: not in ENV_REGISTRY and "
+                f"not a gymnasium id")
+        return env
     return name_or_cls(seed=seed)

@@ -23,7 +23,11 @@ import cloudpickle
 from ray_tpu._private.shm_store import ShmObjectStore
 from ray_tpu.runtime import object_codec
 from ray_tpu.runtime.object_ref import ObjectRef
-from ray_tpu.runtime.rpc import ConnectionLost, RpcClient
+from ray_tpu.runtime.rpc import (
+    ConnectionLost,
+    ReconnectingRpcClient,
+    RpcClient,
+)
 from ray_tpu.runtime.task_spec import TaskSpec, TaskType
 from ray_tpu.utils import exceptions as exc
 from ray_tpu.utils.ids import ActorID, ObjectID, WorkerID
@@ -34,7 +38,8 @@ class ClusterRuntime:
 
     def __init__(self, gcs_address, raylet_address=None):
         self.gcs_address = tuple(gcs_address)
-        self._gcs = RpcClient(self.gcs_address)
+        # reconnecting: survives a GCS restart (file-backed recovery)
+        self._gcs = ReconnectingRpcClient(self.gcs_address)
         self.caller_id = WorkerID.from_random().hex()
         # choose local raylet: given address, or the head node from GCS
         if raylet_address is None:
@@ -78,6 +83,18 @@ class ClusterRuntime:
         self._lineage_grace_s = get_config().lineage_resubmit_grace_s
         self._lineage_max = get_config().lineage_max_entries
         self._pending_grace_s = get_config().task_pending_resubmit_grace_s
+        # Owner-side worker leases for default-strategy tasks (reference:
+        # direct_task_transport.cc): direct worker push with synchronous
+        # loss detection; placement-constrained tasks fall back to the
+        # raylet queue via _legacy_submit.
+        from ray_tpu.runtime.lease import LeaseManager
+        self._closed = False
+        self._fn_blobs: dict[int, tuple] = {}   # id(fn) -> (fn, blob)
+        self._leases = LeaseManager(
+            self._raylet,
+            legacy_submit=self._legacy_submit,
+            on_task_failed=self._fail_task_returns,
+        )
 
     # ------------------------------------------------------------------
     # objects
@@ -144,10 +161,12 @@ class ClusterRuntime:
            budget."""
         uniq = list(set(oids))
         lost = self._gcs.call("get_lost_objects", oids=uniq)
-        # Tasks lost IN FLIGHT leave no tombstone (their output never
-        # existed): a pending object with lineage, no location anywhere,
-        # and a stale submission is presumed dead-with-its-node and
-        # resubmitted (idempotent: first-write-wins).
+        # LEGACY-path tasks lost IN FLIGHT leave no tombstone (their output
+        # never existed): a pending object with lineage, no location
+        # anywhere, and a stale submission is presumed dead-with-its-node
+        # and resubmitted (idempotent: first-write-wins). Lease-path tasks
+        # never enter this heuristic — their owner observes the lease
+        # connection break synchronously and retries/fails on the spot.
         lost_set = set(lost)
         unlocated = [o for o, locs in self._gcs.call(
             "get_object_locations", oids=uniq).items()
@@ -157,6 +176,13 @@ class ClusterRuntime:
             with self._lineage_lock:
                 entry = self._lineage.get(oid_hex)
             if entry is None:
+                continue
+            # eligible: legacy-path tasks (no lease watches them), or
+            # lease-path tasks that COMPLETED (their object existed; the
+            # node died before the batched location flush — nothing is
+            # watching anymore). A lease-path task still running is
+            # watched by its lease connection: never resubmit on time.
+            if not (entry.get("legacy") or entry["task"].get("_completed")):
                 continue
             ref_t = max(entry.get("submitted_at", 0.0),
                         entry.get("last_resubmit", 0.0))
@@ -231,8 +257,13 @@ class ClusterRuntime:
                 for dep in dep_lost:
                     if not self.store.contains(bytes.fromhex(dep)):
                         self._reconstruct(dep, depth + 1)
-            # first-write-wins makes a duplicate re-execution harmless
-            self._raylet.call("submit_task", task=dict(entry["task"]))
+            # first-write-wins makes a duplicate re-execution harmless.
+            # Strip the completion marker: the COPY is a fresh attempt,
+            # and a stale _completed=True would disable the lease-break
+            # retry/fail path for it.
+            resubmit = dict(entry["task"])
+            resubmit.pop("_completed", None)
+            self._leases.submit(resubmit)
         finally:
             with self._lineage_lock:
                 self._reconstructing.discard(oid_hex)
@@ -309,11 +340,23 @@ class ClusterRuntime:
         queued tasks are dequeued, running tasks interrupted (``force``:
         worker killed); consumers of the return object observe
         ``TaskCancelledError``. Finished tasks are untouched."""
+        # lease-managed tasks are invisible to the raylet queues — the
+        # owner cancels them itself
+        hit = self._leases.cancel({ref.id.hex()}, force=force)
+        if hit is not None:
+            state, task = hit
+            if state == "queued":
+                self._seal_cancel_error(task)
+            return
         try:
             self._raylet.call("cancel_task", oids=[ref.id.hex()],
                               force=force)
         except (OSError, ConnectionLost):
             pass
+
+    def _seal_cancel_error(self, task: dict):
+        self._fail_task_returns(task, exc.TaskCancelledError(
+            f"task {task.get('name', '?')} cancelled while queued"))
 
     def note_return_owner(self, spec: TaskSpec):
         pass  # ownership is tracked centrally (GCS object directory)
@@ -331,6 +374,21 @@ class ClusterRuntime:
                   else v for k, v in spec.kwargs.items()}
         return cloudpickle.dumps((args, kwargs), protocol=5)
 
+    def _function_blob(self, fn) -> bytes:
+        """Pickle-once function export (reference: the GCS function table
+        — ``_private/function_manager.py:228`` exports each function once;
+        executors fetch by id). Re-pickling the closure on EVERY submit
+        dominates the hot path for small tasks."""
+        key = id(fn)
+        hit = self._fn_blobs.get(key)
+        if hit is not None and hit[0] is fn:
+            return hit[1]
+        blob = cloudpickle.dumps(fn, protocol=5)
+        if len(self._fn_blobs) > 512:
+            self._fn_blobs.clear()
+        self._fn_blobs[key] = (fn, blob)   # fn ref pins id(fn) stable
+        return blob
+
     def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
         spec.return_ids = [ObjectID.from_random()
                            for _ in range(spec.num_returns)]
@@ -340,7 +398,7 @@ class ClusterRuntime:
             task = {
                 "task_id": spec.task_id.hex(),
                 "name": spec.function_name,
-                "function_blob": cloudpickle.dumps(spec.function, protocol=5),
+                "function_blob": self._function_blob(spec.function),
                 "args_blob": self._wire_args(spec),
                 "return_oids": [o.hex() for o in spec.return_ids],
                 "resources": dict(spec.resources.resources),
@@ -365,8 +423,55 @@ class ClusterRuntime:
                     # their objects simply lose reconstructability
                     while len(self._lineage) > self._lineage_max:
                         self._lineage.pop(next(iter(self._lineage)))
-            self._raylet.call("submit_task", task=task)
+            self._leases.submit(task)
         return [ObjectRef(oid) for oid in spec.return_ids]
+
+    def _legacy_submit(self, task: dict):
+        """Raylet-queue submission (placement-constrained tasks, lease
+        fallbacks). These have no lease channel watching them, so their
+        lineage entries opt back into the pending-grace loss heuristic."""
+        with self._lineage_lock:
+            for oid_hex in task.get("return_oids", ()):
+                entry = self._lineage.get(oid_hex)
+                if entry is not None:
+                    entry["legacy"] = True
+        self._raylet.call("submit_task", task=task)
+
+    def _fail_task_returns(self, task: dict, error: BaseException):
+        """A lease broke under a non-retriable task: seal error objects so
+        waiters unblock (reference: TaskManager failing the task spec's
+        returns). Skips oids that were completed before the break."""
+        locs: dict = {}
+        try:
+            locs = self._gcs.call("get_object_locations",
+                                  oids=list(task.get("return_oids", ())))
+        except Exception:  # noqa: BLE001 - degrade to local checks
+            pass
+        err = (error if isinstance(error, exc.RayTpuError)
+               else exc.WorkerCrashedError(
+                   f"worker lease broke while executing "
+                   f"{task.get('name', '?')}: {error}"))
+        for oid_hex in task.get("return_oids", ()):
+            if locs.get(oid_hex):
+                continue  # the task actually finished before the break
+            oid = bytes.fromhex(oid_hex)
+            if self._closed:
+                return  # store may be unmapped mid-shutdown: never touch
+            if self.store.contains(oid):
+                continue
+            try:
+                size = object_codec.put_value_durable(
+                    self.store, oid, err, is_error=True, hold=True,
+                    request_space=lambda n: self._raylet.call(
+                        "request_space", nbytes=n))
+                try:
+                    self._raylet.call("report_object", oid=oid_hex,
+                                      size=size)
+                finally:
+                    if size > 0:
+                        self.store.release(oid)
+            except Exception:  # noqa: BLE001 - racing completion wins
+                pass
 
     # ------------------------------------------------------------------
     # actors
@@ -539,6 +644,11 @@ class ClusterRuntime:
         return self._gcs.call("cluster_resources")["available"]
 
     def shutdown(self):
+        self._closed = True
+        self._leases.stop()
+        # grace for pusher threads already past their _closed checks to
+        # finish touching the store before it unmaps
+        time.sleep(0.05)
         with self._actor_clients_lock:
             clients = list(self._actor_clients.values())
             self._actor_clients.clear()

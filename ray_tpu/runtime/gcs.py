@@ -67,9 +67,91 @@ class PlacementGroupInfo:
     bundle_nodes: list = field(default_factory=list)  # node_id per bundle
 
 
+class GcsPersistence:
+    """File-backed store client (reference: ``StoreClient`` behind the
+    GCS — ``store_client/redis_store_client.h:33`` — plus restart reload
+    via ``gcs_init_data.cc``; Redis is not in this image, so the durable
+    medium is the session directory).
+
+    Layout: ``snapshot.pkl`` (periodic full-state dump, atomic rename)
+    + ``wal.bin`` (length-prefixed pickled mutation records appended
+    between snapshots and truncated by each snapshot). Restart = load
+    snapshot, replay WAL."""
+
+    def __init__(self, path: str):
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        self.snap_path = os.path.join(path, "snapshot.pkl")
+        self.wal_path = os.path.join(path, "wal.bin")
+        self._wal_f = None
+        self._io_lock = threading.Lock()
+
+    def append(self, record: tuple):
+        import pickle
+        import struct
+
+        blob = pickle.dumps(record, protocol=5)
+        with self._io_lock:
+            if self._wal_f is None:
+                self._wal_f = open(self.wal_path, "ab")
+            self._wal_f.write(struct.pack(">I", len(blob)) + blob)
+            self._wal_f.flush()
+
+    def snapshot(self, state: dict):
+        import os
+        import pickle
+
+        with self._io_lock:
+            tmp = self.snap_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f, protocol=5)
+            os.replace(tmp, self.snap_path)
+            if self._wal_f is not None:
+                self._wal_f.close()
+                self._wal_f = None
+            open(self.wal_path, "wb").close()   # WAL folded into snapshot
+
+    def load(self) -> tuple[dict | None, list]:
+        import os
+        import pickle
+        import struct
+
+        state = None
+        if os.path.exists(self.snap_path):
+            try:
+                with open(self.snap_path, "rb") as f:
+                    state = pickle.load(f)
+            except Exception:  # noqa: BLE001 - torn snapshot: WAL only
+                state = None
+        records = []
+        if os.path.exists(self.wal_path):
+            try:
+                with open(self.wal_path, "rb") as f:
+                    data = f.read()
+                off = 0
+                while off + 4 <= len(data):
+                    (n,) = struct.unpack_from(">I", data, off)
+                    off += 4
+                    if off + n > len(data):
+                        break   # torn tail record (crash mid-append)
+                    records.append(pickle.loads(data[off:off + n]))
+                    off += n
+            except Exception:  # noqa: BLE001
+                pass
+        return state, records
+
+    def close(self):
+        with self._io_lock:
+            if self._wal_f is not None:
+                self._wal_f.close()
+                self._wal_f = None
+
+
 class GcsServer(RpcServer):
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 heartbeat_timeout_s: float = 5.0):
+                 heartbeat_timeout_s: float = 5.0,
+                 persistence_dir: str | None = None):
         super().__init__(host, port)
         self._lock = threading.RLock()
         self._nodes: dict[str, NodeInfo] = {}
@@ -96,11 +178,166 @@ class GcsServer(RpcServer):
         self._task_events: list[dict] = []           # bounded task event sink
         self._pending_demand: dict[str, list] = {}   # node -> unmet demands
         self._max_task_events = 10000
+        # --- persistence (GCS fault tolerance) ---
+        self._persist = (GcsPersistence(persistence_dir)
+                         if persistence_dir else None)
+        self._dirty = False
+        if self._persist is not None:
+            self._restore()
+
+    # ------------------------------------------------------------------
+    # persistence (reference: StoreClient-backed tables + GcsInitData
+    # restart reload; critical mutations WAL'd, full state snapshotted)
+    # ------------------------------------------------------------------
+
+    def _log(self, kind: str, key, payload):
+        """WAL one mutation (entity upsert/delete, last-writer-wins on
+        replay). No-op without persistence."""
+        persist = self._persist   # may be nulled by a chaos kill
+        if persist is None:
+            return
+        try:
+            persist.append((kind, key, payload))
+        except (OSError, ValueError):
+            pass
+        self._dirty = True
+
+    def _state_dict(self) -> dict:
+        from dataclasses import asdict
+
+        with self._lock:
+            return {
+                "actors": {k: asdict(a) for k, a in self._actors.items()},
+                "named_actors": dict(self._named_actors),
+                "kv": {ns: dict(kv) for ns, kv in self._kv.items()},
+                "pgs": {k: asdict(p) for k, p in self._pgs.items()},
+                "jobs": {k: dict(j) for k, j in self._jobs.items()},
+                "object_dir": {o: sorted(ls)
+                               for o, ls in self._object_dir.items()},
+                "object_meta": dict(self._object_meta),
+                "lost_objects": list(self._lost_objects),
+            }
+
+    def _apply_record(self, kind: str, key, payload):
+        if kind == "actor":
+            if payload is None:
+                self._actors.pop(key, None)
+            else:
+                self._actors[key] = ActorInfo(**payload)
+        elif kind == "named":
+            if payload is None:
+                self._named_actors.pop(key, None)
+            else:
+                self._named_actors[key] = payload
+        elif kind == "kv":
+            ns, k = key
+            if payload is None:
+                self._kv.get(ns, {}).pop(k, None)
+            else:
+                self._kv.setdefault(ns, {})[k] = payload
+        elif kind == "pg":
+            if payload is None:
+                self._pgs.pop(key, None)
+            else:
+                self._pgs[key] = PlacementGroupInfo(**payload)
+        elif kind == "job":
+            self._jobs[key] = payload
+
+    def _restore(self):
+        """Reload snapshot + WAL; nodes are NOT restored — live raylets
+        re-register within one heartbeat (their reconnecting clients get
+        ``reregister`` on the first post-restart heartbeat), and their
+        location reconciliation re-populates dead entries' truth."""
+        state, records = self._persist.load()
+        if state:
+            self._actors = {k: ActorInfo(**v)
+                            for k, v in state["actors"].items()}
+            self._named_actors = dict(state["named_actors"])
+            self._kv = {ns: dict(kv) for ns, kv in state["kv"].items()}
+            self._pgs = {k: PlacementGroupInfo(**v)
+                         for k, v in state["pgs"].items()}
+            self._jobs = dict(state["jobs"])
+            self._object_dir = {o: set(ls)
+                                for o, ls in state["object_dir"].items()}
+            self._object_meta = dict(state["object_meta"])
+            self._lost_objects = dict.fromkeys(state["lost_objects"])
+        for kind, key, payload in records:
+            try:
+                self._apply_record(kind, key, payload)
+            except Exception:  # noqa: BLE001 - skip torn/stale records
+                pass
+
+    def _snapshot_loop(self):
+        while not self._stopping:
+            time.sleep(2.0)
+            persist = self._persist   # may be nulled by a chaos kill
+            if self._dirty and persist is not None:
+                self._dirty = False
+                try:
+                    # capture + truncate under the GCS lock: every _log
+                    # runs under it, so no WAL record can land between
+                    # the state capture and the truncation (it would be
+                    # silently discarded — the loss the WAL prevents)
+                    with self._lock:
+                        persist.snapshot(self._state_dict())
+                except OSError:
+                    self._dirty = True
+
+    def _log_actor(self, actor: "ActorInfo"):
+        from dataclasses import asdict
+
+        self._log("actor", actor.actor_id, asdict(actor))
+
+    def _restore_reconcile(self):
+        """Post-restart reconciliation (reference: GcsInitData load then
+        reconcile against re-registering raylets): give live raylets one
+        re-registration window, then (a) reschedule actors stuck in
+        PENDING/RESTARTING (their placement RPC died with the old
+        process) and (b) run the failure path for ALIVE actors whose
+        node never came back."""
+        deadline = time.monotonic() + max(self._hb_timeout, 2.0)
+        while time.monotonic() < deadline and not self._stopping:
+            with self._lock:
+                if self._nodes:
+                    break
+            time.sleep(0.1)
+        time.sleep(0.5)   # let the rest of the fleet re-register too
+        if self._stopping:
+            return
+        with self._lock:
+            stuck = [a.actor_id for a in self._actors.values()
+                     if a.state in ("PENDING", "RESTARTING")]
+            orphaned = [a for a in self._actors.values()
+                        if a.state == "ALIVE" and (
+                            a.node_id not in self._nodes
+                            or not self._nodes[a.node_id].alive)]
+        for actor_id in stuck:
+            self._schedule_actor(actor_id)
+        for actor in orphaned:
+            self._on_actor_failure(
+                actor, "node lost while the control plane was down")
 
     def start(self):
         super().start()
         self._health_thread.start()
+        if self._persist is not None:
+            threading.Thread(target=self._snapshot_loop,
+                             daemon=True).start()
+            with self._lock:
+                needs_reconcile = bool(self._actors)
+            if needs_reconcile:
+                threading.Thread(target=self._restore_reconcile,
+                                 daemon=True).start()
         return self
+
+    def stop(self):
+        super().stop()
+        if self._persist is not None:
+            try:
+                self._persist.snapshot(self._state_dict())
+            except OSError:
+                pass
+            self._persist.close()
 
     # ------------------------------------------------------------------
     # pubsub (reference: src/ray/pubsub/ publisher.h)
@@ -228,6 +465,9 @@ class GcsServer(RpcServer):
                 creation_spec=creation_spec, resources=dict(resources),
                 max_restarts=max_restarts, pg_id=pg_id,
             )
+            self._log_actor(self._actors[actor_id])
+            if name is not None:
+                self._log("named", name, actor_id)
         node_id = self._schedule_actor(actor_id)
         return {"ok": True, "node_id": node_id}
 
@@ -252,6 +492,7 @@ class GcsServer(RpcServer):
                 actor.node_id = node_id
                 node = self._nodes[node_id]
                 spec = actor.creation_spec
+            self._log_actor(actor)
         if node_id is None:
             self.publish(CH_ACTOR, {"event": "dead", "actor_id": actor_id,
                                     "reason": "unschedulable"})
@@ -278,6 +519,7 @@ class GcsServer(RpcServer):
                 return {"ok": False}
             actor.state = "ALIVE"
             actor.node_id = node_id
+            self._log_actor(actor)
         self.publish(CH_ACTOR, {"event": "alive", "actor_id": actor_id,
                                 "node_id": node_id})
         return {"ok": True}
@@ -311,7 +553,9 @@ class GcsServer(RpcServer):
                 actor.death_reason = reason
                 if actor.name:
                     self._named_actors.pop(actor.name, None)
+                    self._log("named", actor.name, None)
                 restarting = False
+            self._log_actor(actor)
         if restarting:
             self.publish(CH_ACTOR, {"event": "restarting",
                                     "actor_id": actor.actor_id,
@@ -435,6 +679,8 @@ class GcsServer(RpcServer):
                 self._pgs[pg_id] = PlacementGroupInfo(
                     pg_id=pg_id, strategy=strategy, bundles=bundles,
                     state="PENDING")
+                from dataclasses import asdict as _asdict
+                self._log("pg", pg_id, _asdict(self._pgs[pg_id]))
                 return {"ok": False, "state": "PENDING"}
             # reserve: deduct from the GCS view AND the node totals so
             # regular tasks do not oversubscribe reserved capacity
@@ -445,6 +691,8 @@ class GcsServer(RpcServer):
             self._pgs[pg_id] = PlacementGroupInfo(
                 pg_id=pg_id, strategy=strategy, bundles=bundles,
                 state="CREATED", bundle_nodes=assignment)
+            from dataclasses import asdict as _asdict
+            self._log("pg", pg_id, _asdict(self._pgs[pg_id]))
         return {"ok": True, "state": "CREATED", "bundle_nodes": assignment}
 
     def rpc_get_placement_group(self, conn, send_lock, *, pg_id):
@@ -466,6 +714,8 @@ class GcsServer(RpcServer):
     def rpc_remove_placement_group(self, conn, send_lock, *, pg_id):
         with self._lock:
             pg = self._pgs.pop(pg_id, None)
+            if pg is not None:
+                self._log("pg", pg_id, None)
             if pg is not None and pg.state == "CREATED":
                 for bundle, nid in zip(pg.bundles, pg.bundle_nodes):
                     node = self._nodes.get(nid)
@@ -488,6 +738,23 @@ class GcsServer(RpcServer):
                 self._object_meta[oid] = size
         self.publish(CH_OBJECT, {"event": "added", "oid": oid,
                                  "node_id": node_id})
+        return {"ok": True}
+
+    def rpc_add_object_locations(self, conn, send_lock, *, node_id,
+                                 entries):
+        """Batched location registration (raylets buffer task-return
+        locations and flush them together — one directory RPC per flush
+        instead of per task; the hot-path win behind the reference's
+        ownership-based directory being OFF the task critical path)."""
+        with self._lock:
+            for oid, size in entries:
+                self._object_dir.setdefault(oid, set()).add(node_id)
+                self._lost_objects.pop(oid, None)
+                if size:
+                    self._object_meta[oid] = size
+        for oid, _ in entries:
+            self.publish(CH_OBJECT, {"event": "added", "oid": oid,
+                                     "node_id": node_id})
         return {"ok": True}
 
     def rpc_get_object_locations(self, conn, send_lock, *, oids):
@@ -532,6 +799,7 @@ class GcsServer(RpcServer):
             if not overwrite and key in table:
                 return {"ok": False}
             table[key] = value
+            self._log("kv", (ns, key), value)
         return {"ok": True}
 
     def rpc_kv_get(self, conn, send_lock, *, ns, key):
@@ -540,7 +808,10 @@ class GcsServer(RpcServer):
 
     def rpc_kv_del(self, conn, send_lock, *, ns, key):
         with self._lock:
-            return {"ok": self._kv.get(ns, {}).pop(key, None) is not None}
+            hit = self._kv.get(ns, {}).pop(key, None) is not None
+            if hit:
+                self._log("kv", (ns, key), None)
+            return {"ok": hit}
 
     def rpc_kv_keys(self, conn, send_lock, *, ns, prefix=""):
         with self._lock:
@@ -555,6 +826,7 @@ class GcsServer(RpcServer):
             self._jobs[job_id] = {"job_id": job_id, "state": "RUNNING",
                                   "start_time": time.time(),
                                   "metadata": metadata or {}}
+            self._log("job", job_id, dict(self._jobs[job_id]))
         return {"ok": True}
 
     def rpc_list_jobs(self, conn, send_lock):

@@ -30,7 +30,13 @@ from typing import Any
 from ray_tpu._private.shm_store import ObjectNotFoundError, ShmObjectStore
 from ray_tpu.runtime import object_codec
 from ray_tpu.runtime.gcs import _fits
-from ray_tpu.runtime.rpc import RpcClient, RpcServer, recv_msg, send_msg
+from ray_tpu.runtime.rpc import (
+    ReconnectingRpcClient,
+    RpcClient,
+    RpcServer,
+    recv_msg,
+    send_msg,
+)
 from ray_tpu.utils.ids import ObjectID, WorkerID
 
 
@@ -40,7 +46,10 @@ class WorkerHandle:
     proc: subprocess.Popen | None = None
     conn: Any = None            # held task-channel socket
     send_lock: Any = None
-    state: str = "starting"     # starting | idle | busy | actor | dead
+    state: str = "starting"     # starting | idle | busy | leased | actor | dead
+    # owner-facing task port (worker-lease protocol); leases hand this
+    # address to the owner, which pushes tasks to it directly
+    push_addr: tuple | None = None
     actor_id: str | None = None
     incarnation: int = 0
     current_task: dict | None = None
@@ -71,7 +80,8 @@ class Raylet(RpcServer):
         self.labels = labels or {}
         self._res_lock = threading.Lock()
 
-        self._gcs = RpcClient(self.gcs_address)
+        # reconnecting: survives a GCS restart (file-backed recovery)
+        self._gcs = ReconnectingRpcClient(self.gcs_address)
         self._gcs_lock = threading.Lock()   # RpcClient is thread-safe; lock
                                             # keeps call+interpret atomic
         self._peers: dict[str, RpcClient] = {}
@@ -133,6 +143,16 @@ class Raylet(RpcServer):
         # OOM-backoff timers (cancelled by stop())
         self._deferred_timers: set[threading.Timer] = set()
         self._timers_lock = threading.Lock()
+        # why recent workers died, queried by lease owners on break
+        # (bounded FIFO; reference: worker exit detail in death reports)
+        self._death_info: dict[str, dict] = {}
+        # buffered object-location registrations (batched to the GCS)
+        self._loc_buf: list[tuple[str, int]] = []
+        self._loc_cv = threading.Condition()
+        # parked worker-lease requests (owner-side lease protocol;
+        # reference: the lease queue behind HandleRequestWorkerLease,
+        # node_manager.cc:1778). Guarded by _ready_cv.
+        self._lease_waiters: deque[dict] = deque()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -146,7 +166,8 @@ class Raylet(RpcServer):
                 store_name=self.store_name, resources=self.total_resources,
                 labels=self.labels)
         loops = [self._dispatch_loop, self._heartbeat_loop,
-                 self._monitor_loop, self._infeasible_loop]
+                 self._monitor_loop, self._infeasible_loop,
+                 self._location_flush_loop]
         if self._spill_enabled:
             loops.append(self._spill_loop)
         if self._mem_threshold > 0:
@@ -228,6 +249,14 @@ class Raylet(RpcServer):
             self._deferred_timers.clear()
         for timer in timers:
             timer.cancel()
+        # wake parked lease requests so owners fall back instead of
+        # blocking out their full timeout on a dying node
+        with self._ready_cv:
+            waiters = list(self._lease_waiters)
+            self._lease_waiters.clear()
+        for waiter in waiters:
+            waiter["result"] = {"retry": True}
+            waiter["event"].set()
         # join background loops BEFORE closing the store: a mid-tick spill
         # loop dereferencing the munmapped segment is a segfault, not an
         # exception
@@ -281,7 +310,8 @@ class Raylet(RpcServer):
             self._workers[worker_id] = handle
         return handle
 
-    def rpc_register_worker(self, conn, send_lock, *, worker_id):
+    def rpc_register_worker(self, conn, send_lock, *, worker_id,
+                            push_addr=None):
         """Registration handshake; the connection becomes the raylet→worker
         task channel and worker→raylet completion stream."""
         with self._workers_lock:
@@ -291,6 +321,8 @@ class Raylet(RpcServer):
                 self._workers[worker_id] = handle
             handle.conn = conn
             handle.send_lock = send_lock
+            if push_addr is not None:
+                handle.push_addr = tuple(push_addr)
             if handle.state == "starting":
                 # actor-designated workers keep their "actor" state — the
                 # dispatcher must never hand them normal tasks
@@ -344,6 +376,9 @@ class Raylet(RpcServer):
             prior_state = w.state
             w.state = "dead"
             self._workers.pop(w.worker_id, None)
+            self._death_info[w.worker_id] = {"oom_killed": w.oom_killed}
+            while len(self._death_info) > 256:
+                self._death_info.pop(next(iter(self._death_info)))
         # reclaim created-but-unsealed allocations and pinned read refs of
         # the dead worker only (live writers/readers are untouched)
         if w.proc is not None and w.proc.pid:
@@ -569,14 +604,18 @@ class Raylet(RpcServer):
         with self._res_lock:
             for k, v in demand.items():
                 self.available[k] = self.available.get(k, 0.0) + v
+        # freed capacity may unblock a parked lease request or queued task
+        self._kick_dispatch()
 
     def _dispatch_loop(self):
         while not self._stopping:
             with self._ready_cv:
-                while not self._ready and not self._stopping:
+                while (not self._ready and not self._lease_waiters
+                       and not self._stopping):
                     self._ready_cv.wait(timeout=0.2)
                 if self._stopping:
                     return
+                gen0 = self._dispatch_gen
                 task = None
                 # first task whose resources fit (avoid head-of-line block)
                 for i, t in enumerate(self._ready):
@@ -584,9 +623,14 @@ class Raylet(RpcServer):
                         task = t
                         del self._ready[i]
                         break
-                if task is None:
-                    self._ready_cv.wait(timeout=0.1)
-                    continue
+            self._serve_lease_waiters()
+            if task is None:
+                # only lease waiters, or no fitting task: block until the
+                # next kick (completion/registration/release)
+                with self._ready_cv:
+                    if self._dispatch_gen == gen0 and not self._stopping:
+                        self._ready_cv.wait(timeout=0.1)
+                continue
             gen = self._dispatch_gen
             worker = self._idle_worker(task.get("runtime_env"))
             if worker is None:
@@ -604,17 +648,24 @@ class Raylet(RpcServer):
                 worker.state = "idle"
                 self._enqueue(task)
                 continue
+            cancelled = False
             with self._workers_lock:
                 # under the lock: cancel_task scans current_task here, and
                 # a cancel that ran between the queue pop and this point
                 # left a flag on the task dict
                 if task.get("cancelled"):
-                    self._release(task.get("resources", {}))
+                    cancelled = True
                     worker.state = "idle"
-                    continue
-                worker.acquired = dict(task.get("resources", {}))
-                worker.current_task = task
-                worker.dispatched_at = time.monotonic()
+                else:
+                    worker.acquired = dict(task.get("resources", {}))
+                    worker.current_task = task
+                    worker.dispatched_at = time.monotonic()
+            if cancelled:
+                # outside _workers_lock: _release kicks the dispatch cv,
+                # and holding the worker lock across that inverts the
+                # cv→workers lock order used by the lease grant path
+                self._release(task.get("resources", {}))
+                continue
             try:
                 send_msg(worker.conn, {"type": "task", "task": task},
                          worker.send_lock)
@@ -638,7 +689,8 @@ class Raylet(RpcServer):
             n_alive = 0
             incoming = False  # replacement with this env already booting?
             for w in self._workers.values():
-                if w.state in ("idle", "busy", "starting", "actor"):
+                if w.state in ("idle", "busy", "starting", "actor",
+                               "leased"):
                     n_alive += 1
                 if w.state == "starting" and w.env_key == key:
                     incoming = True
@@ -1009,7 +1061,14 @@ class Raylet(RpcServer):
         register the location with the GCS (reference: the Put path's
         PinObjectIDs + object directory update). Callers seal with a held
         ref (``seal(hold=True)``) so the object cannot vanish before the
-        pin lands here."""
+        pin lands here.
+
+        The PIN is synchronous (it is what makes the object durable); the
+        GCS directory registration is BUFFERED and flushed in batches —
+        one directory RPC per flush, not per task return, keeping the
+        head-node round trip off the task hot path (reference: the
+        ownership-based object directory is similarly not on the task
+        completion critical path)."""
         self._pin_object(oid)
         with self._pin_lock:
             pinned = oid in self._pinned
@@ -1018,10 +1077,51 @@ class Raylet(RpcServer):
             # advertise a location that cannot serve the object
             return {"ok": False, "reason": "object not present to pin"}
         self._track_local(oid)
-        with self._gcs_lock:
-            self._gcs.call("add_object_location", oid=oid,
-                           node_id=self.node_id, size=size)
+        self._queue_location(oid, size)
         return {"ok": True}
+
+    def rpc_report_objects(self, conn, send_lock, *, entries: list):
+        """Batched report_object (workers buffer their task-return
+        reports and flush together; each object is protected by its
+        writer's seal-hold until the pin lands here)."""
+        ok = []
+        for oid, size in entries:
+            self._pin_object(oid)
+            with self._pin_lock:
+                pinned = oid in self._pinned
+            if pinned or self.store.contains(bytes.fromhex(oid)):
+                self._track_local(oid)
+                self._queue_location(oid, size)
+                ok.append(oid)
+        return {"ok": ok}
+
+    def _queue_location(self, oid: str, size: int):
+        with self._loc_cv:
+            self._loc_buf.append((oid, size))
+            self._loc_cv.notify()
+
+    def _location_flush_loop(self):
+        """Drain the location buffer into batched GCS registrations. A
+        short linger coalesces bursts; an empty buffer blocks on the cv
+        (no polling)."""
+        while not self._stopping:
+            with self._loc_cv:
+                if not self._loc_buf:
+                    self._loc_cv.wait(timeout=0.2)
+                if not self._loc_buf:
+                    continue
+                time_to_linger = 0.002
+            time.sleep(time_to_linger)  # let the burst accumulate
+            with self._loc_cv:
+                batch, self._loc_buf = self._loc_buf, []
+            if not batch:
+                continue
+            try:
+                with self._gcs_lock:
+                    self._gcs.call("add_object_locations",
+                                   node_id=self.node_id, entries=batch)
+            except Exception:  # noqa: BLE001 - GCS down; heartbeat
+                pass           # reconciliation re-registers local objects
 
     def rpc_request_space(self, conn, send_lock, *, nbytes: int = 0):
         """A writer hit store-OOM: synchronously spill pinned-idle objects
@@ -1246,6 +1346,177 @@ class Raylet(RpcServer):
             return True
         return False
 
+    # ------------------------------------------------------------------
+    # worker leases (owner-side lease protocol; reference:
+    # NodeManager::HandleRequestWorkerLease node_manager.cc:1778 +
+    # CoreWorkerDirectTaskSubmitter direct_task_transport.cc:134,240)
+    # ------------------------------------------------------------------
+
+    def _peer_address(self, node_id) -> tuple | None:
+        if node_id is None or node_id == self.node_id:
+            return None
+        if self._peer(node_id) is None:
+            return None
+        with self._peers_lock:
+            return self._peer_addrs.get(node_id)
+
+    def rpc_request_lease(self, conn, send_lock, *, demand: dict,
+                          runtime_env: dict | None = None,
+                          timeout_s: float = 10.0, spill_count: int = 0):
+        """Grant a worker lease: the reply carries the worker's push
+        address, and the owner pushes tasks to it directly for as long as
+        it holds the lease (= keeps its connection to the worker open).
+        Replies: {ok, worker_addr, worker_id, node_id} | {redirect: addr}
+        (spillback — caller retries there) | {retry: True} (parked past
+        timeout_s — caller may re-request) | {infeasible: True}."""
+        if not _fits(demand, self.total_resources):
+            with self._gcs_lock:
+                target = self._gcs.call("pick_node", demand=demand,
+                                        exclude=[self.node_id])
+            addr = self._peer_address(target)
+            if addr:
+                return {"redirect": list(addr), "node_id": target}
+            return {"infeasible": True}
+        if spill_count < 1 and not _fits(demand, self._avail_snapshot()):
+            # busy here: one spillback attempt through the GCS view
+            # (mirror of rpc_submit_task's policy)
+            with self._gcs_lock:
+                target = self._gcs.call("pick_node", demand=demand,
+                                        exclude=[self.node_id])
+            addr = self._peer_address(target)
+            if addr:
+                return {"redirect": list(addr), "node_id": target}
+        waiter = {"demand": demand, "runtime_env": runtime_env,
+                  "event": threading.Event(), "result": None}
+        with self._ready_cv:
+            self._lease_waiters.append(waiter)
+            self._ready_cv.notify()
+        if not waiter["event"].wait(timeout=timeout_s):
+            removed = True
+            with self._ready_cv:
+                try:
+                    self._lease_waiters.remove(waiter)
+                except ValueError:
+                    removed = False
+            if not removed:
+                # a granter claimed the waiter concurrently: it WILL set
+                # the result (it already holds the worker + resources) —
+                # block for it; dropping it would leak a leased worker
+                # nobody ever dials
+                waiter["event"].wait(timeout=5.0)
+                if waiter["result"]:
+                    return waiter["result"]
+            return {"retry": True}
+        return waiter["result"]
+
+    def _serve_lease_waiters(self):
+        """Grant parked lease requests FIFO while workers + resources are
+        available (runs on the dispatch thread)."""
+        while True:
+            with self._ready_cv:
+                if not self._lease_waiters:
+                    return
+                waiter = self._lease_waiters[0]
+            worker = self._idle_worker(waiter["runtime_env"])
+            if worker is None:
+                return  # spawn in progress / pool exhausted; kick revisits
+            if worker.push_addr is None:
+                # externally-registered worker with no push port (tests):
+                # unusable for leases, put it back
+                with self._workers_lock:
+                    worker.state = "idle"
+                return
+            if not self._try_acquire(waiter["demand"]):
+                with self._workers_lock:
+                    worker.state = "idle"
+                return  # resources busy; release kick revisits
+            # the waiter may have timed out and removed itself while we
+            # were acquiring — then the grant must be rolled back. The
+            # rollback runs OUTSIDE the cv (lock order: never cv→locks).
+            claimed = True
+            with self._ready_cv:
+                try:
+                    self._lease_waiters.remove(waiter)
+                except ValueError:
+                    claimed = False
+            if not claimed:
+                self._release(waiter["demand"])
+                with self._workers_lock:
+                    worker.state = "idle"
+                continue
+            with self._workers_lock:
+                worker.state = "leased"
+                worker.acquired = dict(waiter["demand"])
+                worker.dispatched_at = time.monotonic()
+            waiter["result"] = {"ok": True,
+                                "worker_addr": list(worker.push_addr),
+                                "worker_id": worker.worker_id,
+                                "node_id": self.node_id}
+            waiter["event"].set()
+
+    def rpc_cancel_leased(self, conn, send_lock, *, worker_id: str,
+                          task: dict, force: bool = False):
+        """Cancel a task running on a LEASED worker. The owner (who alone
+        knows what its lease is executing) names the worker and supplies
+        the task's return oids; this raylet pre-stores the cancel error
+        and interrupts (SIGINT) or kills the worker process."""
+        from ray_tpu.utils import exceptions as exc
+
+        with self._workers_lock:
+            w = self._workers.get(worker_id)
+            if w is None or w.state != "leased" or w.proc is None:
+                return {"found": False}
+        task["cancelled"] = True
+        self._store_task_error(task, exc.TaskCancelledError(
+            f"task {task.get('name')} cancelled while running"))
+        with self._workers_lock:
+            w = self._workers.get(worker_id)
+            if w is None or w.state != "leased" or w.proc is None:
+                return {"found": False}
+            try:
+                if force:
+                    w.proc.kill()
+                elif w.conn is not None:
+                    # targeted: the worker interrupts the task BY ID
+                    # (a raw SIGINT could hit a batchmate in a grouped
+                    # push)
+                    send_msg(w.conn, {"type": "cancel_push",
+                                      "task_id": task.get("task_id", "")},
+                             w.send_lock)
+            except OSError:
+                pass
+        return {"found": True}
+
+    def rpc_worker_death_info(self, conn, send_lock, *, worker_id: str,
+                              timeout_s: float = 2.0):
+        """Why a worker died (lease owners map a broken lease to e.g.
+        OutOfMemoryError instead of a generic crash). The owner's lease
+        connection breaks the instant the process dies — often BEFORE
+        this raylet's channel reader records the death — so this briefly
+        waits for the record instead of returning an empty answer."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._workers_lock:
+                info = self._death_info.get(worker_id)
+            if info is not None:
+                return info
+            if time.monotonic() >= deadline or self._stopping:
+                return {}
+            time.sleep(0.05)
+
+    def rpc_lease_closed(self, conn, send_lock, *, worker_id: str):
+        """The worker's owner-facing connection dropped (lease returned or
+        owner died): the worker and its resources go back to the pool."""
+        with self._workers_lock:
+            w = self._workers.get(worker_id)
+            if w is None or w.state != "leased":
+                return {"ok": False}
+            acquired, w.acquired = w.acquired, {}
+            w.state = "idle"
+        self._release(acquired)
+        self._kick_dispatch()
+        return {"ok": True}
+
     def rpc_node_info(self, conn, send_lock):
         return {"node_id": self.node_id, "store_name": self.store_name,
                 "address": self.address, "resources": self.total_resources,
@@ -1345,12 +1616,21 @@ class Raylet(RpcServer):
                     for w in self._workers.values()
                     if w.state == "busy" and w.current_task is not None
                     and w.proc is not None]
-            if not busy:
+            # leased workers are candidates too: their owner observes the
+            # break, queries worker_death_info, and applies ITS OOM retry
+            # budget (this raylet does not know the task)
+            leased = [(w, None, w.dispatched_at)
+                      for w in self._workers.values()
+                      if w.state == "leased" and w.proc is not None]
+            if not busy and not leased:
                 return False
             busy.sort(key=lambda it: it[2])   # oldest-dispatched first
+            leased.sort(key=lambda it: it[2])
             retriable = [it for it in busy
                          if it[1].get("max_retries", 0) > 0]
-            victim = (retriable or busy)[-1][0]  # newest-dispatched last
+            # newest-dispatched first among: retriable (cheapest safe
+            # re-run), then leased (owner-managed retry), then the rest
+            victim = (retriable or leased or busy)[-1][0]
             victim.oom_killed = True
             try:
                 victim.proc.kill()

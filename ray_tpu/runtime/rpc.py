@@ -177,7 +177,11 @@ class RpcServer:
                     # when the channel is truly finished
                     held = True
                     return
-                send_msg(conn, {"_id": req_id, "result": result}, send_lock)
+                try:
+                    send_msg(conn, {"_id": req_id, "result": result},
+                             send_lock)
+                except OSError:
+                    return  # peer closed mid-reply (e.g. returned lease)
         finally:
             if not held:
                 with self._conns_lock:
@@ -235,6 +239,13 @@ class RpcClient:
                 ev_reply[0].set()
 
     def call(self, method: str, timeout: float | None = None, **kwargs):
+        return self.call_async(method, **kwargs).result(timeout=timeout)
+
+    def call_async(self, method: str, **kwargs) -> "PendingCall":
+        """Send the request and return a handle; multiple in-flight calls
+        pipeline over the one connection (the server processes a
+        connection's requests in order, so pipelining hides the caller's
+        round-trip latency without reordering)."""
         self._ensure_reader()
         with self._pending_lock:
             # _closed must be re-checked INSIDE the lock: the reader's
@@ -250,14 +261,7 @@ class RpcClient:
         kwargs["method"] = method
         kwargs["_id"] = msg_id
         send_msg(self._sock, kwargs, self._send_lock)
-        if not ev_reply[0].wait(timeout=timeout):
-            with self._pending_lock:
-                self._pending.pop(msg_id, None)
-            raise TimeoutError(f"rpc {method} timed out after {timeout}s")
-        reply = ev_reply[1]
-        if "error" in reply:
-            raise reply["error"]
-        return reply["result"]
+        return PendingCall(self, method, msg_id, ev_reply)
 
     def close(self):
         self._closed = True
@@ -272,6 +276,91 @@ class RpcClient:
             self._sock.close()
         except OSError:
             pass
+
+
+class ReconnectingRpcClient:
+    """RpcClient wrapper that redials after connection loss — the client
+    side of control-plane fault tolerance (reference: GCS clients retry
+    through ``gcs_rpc_client.h`` when the GCS restarts). One transparent
+    retry per call after a successful redial; GCS mutations are
+    idempotent (registry upserts), so a request that was applied right
+    before the connection died is safe to repeat."""
+
+    def __init__(self, address: tuple, timeout: float | None = None,
+                 redial_window_s: float = 10.0):
+        self.address = tuple(address)
+        self._timeout = timeout
+        self._window = redial_window_s
+        self._client = RpcClient(self.address, timeout=timeout)
+        self._dial_lock = threading.Lock()
+
+    @property
+    def _closed(self):
+        return self._client._closed
+
+    def _redial(self, failed: RpcClient) -> bool:
+        deadline = time.monotonic() + self._window
+        with self._dial_lock:
+            # compare against the CLIENT THAT FAILED, not _closed: a send
+            # error can precede the reader thread marking the client
+            # closed, and trusting _closed would "retry" on the same dead
+            # socket
+            if self._client is not failed and not self._client._closed:
+                return True  # another caller already reconnected
+            failed.close()
+            while time.monotonic() < deadline:
+                try:
+                    self._client = RpcClient(self.address,
+                                             timeout=self._timeout)
+                    return True
+                except OSError:
+                    time.sleep(0.2)
+        return False
+
+    def call(self, method: str, timeout: float | None = None, **kwargs):
+        client = self._client
+        try:
+            return client.call(method, timeout=timeout, **kwargs)
+        except (ConnectionLost, OSError):
+            if not self._redial(client):
+                raise
+            return self._client.call(method, timeout=timeout, **kwargs)
+
+    def call_async(self, method: str, **kwargs):
+        client = self._client
+        try:
+            return client.call_async(method, **kwargs)
+        except (ConnectionLost, OSError):
+            if not self._redial(client):
+                raise
+            return self._client.call_async(method, **kwargs)
+
+    def close(self):
+        self._client.close()
+
+
+class PendingCall:
+    """Handle for an in-flight pipelined request."""
+
+    __slots__ = ("_client", "_method", "_msg_id", "_ev_reply")
+
+    def __init__(self, client: RpcClient, method: str, msg_id: int,
+                 ev_reply: list):
+        self._client = client
+        self._method = method
+        self._msg_id = msg_id
+        self._ev_reply = ev_reply
+
+    def result(self, timeout: float | None = None):
+        if not self._ev_reply[0].wait(timeout=timeout):
+            with self._client._pending_lock:
+                self._client._pending.pop(self._msg_id, None)
+            raise TimeoutError(
+                f"rpc {self._method} timed out after {timeout}s")
+        reply = self._ev_reply[1]
+        if "error" in reply:
+            raise reply["error"]
+        return reply["result"]
 
 
 class PushSubscriber:

@@ -25,8 +25,70 @@ import cloudpickle
 
 from ray_tpu._private.shm_store import ShmObjectStore
 from ray_tpu.runtime import object_codec
-from ray_tpu.runtime.rpc import RpcClient, recv_msg, send_msg
+from ray_tpu.runtime.rpc import (
+    ReconnectingRpcClient,
+    RpcClient,
+    RpcServer,
+    recv_msg,
+    send_msg,
+)
 from ray_tpu.utils import exceptions as exc
+
+
+class TaskPushServer(RpcServer):
+    """Owner-facing task port (reference: the worker-side gRPC PushTask
+    service the lease protocol pushes to, ``direct_task_transport.cc:234``).
+
+    A lease IS a live connection here: the owner that holds the lease
+    pushes tasks over it and gets the completion as the RPC reply; when
+    the connection drops (owner returned the lease, or died), the worker
+    tells its raylet so the lease's worker+resources return to the pool.
+    """
+
+    def __init__(self, worker: "Worker"):
+        super().__init__("127.0.0.1", 0)
+        self._worker = worker
+
+    def _run_one(self, task: dict):
+        w = self._worker
+        tid = task.get("task_id", "")
+        if task.get("cancelled") or tid in w.cancelled_push_ids:
+            return  # cancel error pre-stored by the raylet
+        w.current_push_task_id = tid
+        try:
+            w._execute(task)
+        finally:
+            w.current_push_task_id = None
+
+    def rpc_push_task(self, conn, send_lock, *, task: dict):
+        # expose the executing thread so the cancel path can interrupt
+        # THIS thread — the main thread only runs the raylet-channel
+        # recv loop
+        self._worker.push_task_thread = threading.current_thread()
+        try:
+            self._run_one(task)
+        finally:
+            self._worker.push_task_thread = None
+        return {"ok": True, "task_id": task.get("task_id")}
+
+    def rpc_push_tasks(self, conn, send_lock, *, tasks: list):
+        """Batched push: one RPC carries several tasks, executed in
+        order (the owner packs bursts of small same-shape tasks — one
+        framed round trip instead of N)."""
+        self._worker.push_task_thread = threading.current_thread()
+        try:
+            for task in tasks:
+                self._run_one(task)
+        finally:
+            self._worker.push_task_thread = None
+        return {"ok": True}
+
+    def on_disconnect(self, conn):
+        try:
+            self._worker.ctrl.call("lease_closed",
+                                   worker_id=self._worker.worker_id)
+        except Exception:  # noqa: BLE001 - raylet is gone; worker will exit
+            pass
 
 
 class Worker:
@@ -51,7 +113,7 @@ class Worker:
         # control client: request/response to the raylet (ensure_local etc.)
         self.ctrl = RpcClient(self.raylet_addr)
         # task-event reporting to the GCS sink (lazy buffer)
-        self._gcs = RpcClient((os.environ["RAY_TPU_GCS_HOST"],
+        self._gcs = ReconnectingRpcClient((os.environ["RAY_TPU_GCS_HOST"],
                                int(os.environ["RAY_TPU_GCS_PORT"])))
         self._event_buf: list[dict] = []
         self._event_lock = threading.Lock()
@@ -60,21 +122,74 @@ class Worker:
         # strands in the buffer until the next task happens to run
         threading.Thread(target=self._flush_loop, daemon=True,
                          name="task-event-flusher").start()
-        # task channel: registered held connection
-        import socket as _socket
-        self.chan = _socket.create_connection(self.raylet_addr)
-        self.chan.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
-        self.chan_lock = threading.Lock()
-        send_msg(self.chan, {"method": "register_worker",
-                             "worker_id": self.worker_id})
-        reply = recv_msg(self.chan)
-        assert reply.get("registered"), reply
         # actor state
         self.actor_instance = None
         self.actor_id = None
         self._seq_lock = threading.Lock()
         self._next_seq = defaultdict(int)       # caller -> next seq
         self._seq_buffer = defaultdict(dict)    # caller -> {seq: task}
+        # cancel routing: SIGINT lands in the main thread; when a pushed
+        # (leased) task is executing on a server thread, re-aim the
+        # KeyboardInterrupt at that thread instead
+        self.push_task_thread: threading.Thread | None = None
+        # targeted cancel of leased tasks: ids to skip if not yet started,
+        # and the id currently executing (so an interrupt only ever hits
+        # the task it was aimed at — never a batchmate)
+        self.current_push_task_id: str | None = None
+        self.cancelled_push_ids: set[str] = set()
+        self._fn_cache: dict[int, tuple] = {}   # hash(blob) -> (blob, fn)
+        self._report_buf: list[tuple[str, int]] = []
+        self._report_cv = threading.Condition()
+        threading.Thread(target=self._report_flush_loop, daemon=True,
+                         name="report-flusher").start()
+        self._install_sigint_router()
+        # Owner-facing push port, then registration — ALL execution state
+        # above must exist first: the instant registration lands, the
+        # raylet may lease this worker and an owner may push a task.
+        self.push_server = TaskPushServer(self).start()
+        import socket as _socket
+        self.chan = _socket.create_connection(self.raylet_addr)
+        self.chan.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        self.chan_lock = threading.Lock()
+        send_msg(self.chan, {"method": "register_worker",
+                             "worker_id": self.worker_id,
+                             "push_addr": list(self.push_server.address)})
+        reply = recv_msg(self.chan)
+        assert reply.get("registered"), reply
+
+    def _cancel_push(self, task_id: str):
+        """Cancel a lease-pushed task BY ID: interrupt only if it is the
+        one currently executing; otherwise flag it so the push loop skips
+        it. (A raw SIGINT would hit whatever batchmate happens to be
+        running.)"""
+        import ctypes
+
+        self.cancelled_push_ids.add(task_id)
+        while len(self.cancelled_push_ids) > 1024:
+            self.cancelled_push_ids.pop()
+        t = self.push_task_thread
+        if (t is not None and t.is_alive()
+                and self.current_push_task_id == task_id):
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(t.ident), ctypes.py_object(KeyboardInterrupt))
+
+    def _install_sigint_router(self):
+        import ctypes
+        import signal
+
+        def _route(signum, frame):
+            t = self.push_task_thread
+            if t is not None and t.is_alive():
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_long(t.ident),
+                    ctypes.py_object(KeyboardInterrupt))
+            else:
+                raise KeyboardInterrupt
+
+        try:
+            signal.signal(signal.SIGINT, _route)
+        except ValueError:
+            pass  # not the main thread (embedded/test use): keep default
 
     # ------------------------------------------------------------------
 
@@ -97,6 +212,8 @@ class Worker:
                 self._create_actor(msg["actor_id"], msg["task"])
             elif kind == "actor_task":
                 self._enqueue_actor_task(msg["task"])
+            elif kind == "cancel_push":
+                self._cancel_push(msg["task_id"])
             elif kind == "exit":
                 return
 
@@ -144,19 +261,43 @@ class Worker:
             self._put_and_report(oid_hex, value)
 
     def _put_and_report(self, oid_hex: str, value, is_error: bool = False):
-        """Put with a held ref, then synchronously report so the raylet
-        pins the primary copy — NO window in which the sealed object is
-        evictable before the pin (reference: plasma seal + raylet
-        PinObjectIDs in the same task-return handshake)."""
+        """Put with a held ref, then report so the raylet pins the primary
+        copy. The seal-HOLD stays live until the (batched) report flush
+        confirms the pin — never a window in which the sealed object is
+        evictable (reference: plasma seal + raylet PinObjectIDs in the
+        task-return handshake). Reports are BATCHED across task returns:
+        one raylet RPC per flush instead of per return keeps the control
+        round trip off the task hot path."""
         oid = bytes.fromhex(oid_hex)
         size = object_codec.put_value_durable(
             self.store, oid, value, is_error=is_error,
             request_space=self._request_space, hold=True)
-        try:
-            self.ctrl.call("report_object", oid=oid_hex, size=size)
-        finally:
-            if size > 0:   # size 0 = lost the first-write race: no hold
-                self.store.release(oid)
+        with self._report_cv:
+            self._report_buf.append((oid_hex, size))
+            self._report_cv.notify()
+
+    def _report_flush_loop(self):
+        import time as _time
+
+        while True:
+            with self._report_cv:
+                while not self._report_buf:
+                    self._report_cv.wait()
+            _time.sleep(0.001)  # linger: coalesce a burst of returns
+            with self._report_cv:
+                batch, self._report_buf = self._report_buf, []
+            try:
+                self.ctrl.call("report_objects",
+                               entries=[(o, s) for o, s in batch])
+            except Exception:  # noqa: BLE001 - raylet gone; exiting soon
+                pass
+            finally:
+                for oid_hex, size in batch:
+                    if size > 0:   # size 0 = lost first-write race: no hold
+                        try:
+                            self.store.release(bytes.fromhex(oid_hex))
+                        except Exception:  # noqa: BLE001
+                            pass
 
     def _request_space(self, nbytes: int):
         self.ctrl.call("request_space", nbytes=nbytes)
@@ -219,12 +360,27 @@ class Worker:
         except (OSError, ConnectionError):
             pass  # observability only; never fail work for it
 
+    def _load_function(self, blob: bytes):
+        """Unpickle-once function cache (reference: executors fetch and
+        register a function ONCE from the function table —
+        ``fetch_and_register_remote_function``); repeated tasks of the
+        same function skip the cloudpickle.loads."""
+        key = hash(blob)
+        hit = self._fn_cache.get(key)
+        if hit is not None and hit[0] == blob:
+            return hit[1]
+        fn = cloudpickle.loads(blob)
+        if len(self._fn_cache) > 256:
+            self._fn_cache.clear()
+        self._fn_cache[key] = (blob, fn)
+        return fn
+
     def _execute(self, task: dict):
         import time as _time
 
         started = _time.monotonic()
         try:
-            fn = cloudpickle.loads(task["function_blob"])
+            fn = self._load_function(task["function_blob"])
             args, kwargs = self._resolve_args(task)
         except BaseException as e:  # noqa: BLE001
             self._store_error(task, e)

@@ -18,6 +18,7 @@ from ray_tpu.serve.api import (
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.ingress import grpc_call, ingress, start_grpc_proxy
 
 __all__ = [
     "AutoscalingConfig",
@@ -28,9 +29,12 @@ __all__ = [
     "deployment",
     "get_deployment_handle",
     "get_multiplexed_model_id",
+    "grpc_call",
+    "ingress",
     "multiplexed",
     "run",
     "shutdown",
+    "start_grpc_proxy",
     "start_http_proxy",
     "status",
 ]

@@ -99,6 +99,9 @@ def run(dep: Deployment, *, name: str | None = None) -> DeploymentHandle:
         "autoscaling": vars(auto) if auto else None,
         "user_config": dep.config.user_config,
         "resources_per_replica": dep.config.resources_per_replica,
+        # ASGI ingress deployments get raw-request forwarding from the
+        # proxies (serve.ingress sets the marker)
+        "asgi": bool(getattr(dep._cls, "_serve_asgi", False)),
     }
     dep_name = name or dep.name
     ray_tpu.get(controller.deploy.remote(
@@ -231,55 +234,100 @@ class _ProxyHandler(BaseHTTPRequestHandler):
     # with a fresh dict (a class-level cache would leak stale controller
     # references across serve.shutdown()/restart cycles)
     handles: dict[str, DeploymentHandle]
+    asgi_flags: dict[str, bool]
 
     def log_message(self, *args):  # silence request logging
         pass
 
-    def do_POST(self):
-        name = self.path.strip("/").split("/")[0]
+    def _resolve(self, name: str):
         handle = self.handles.get(name)
         if handle is None:
-            try:
-                handle = get_deployment_handle(name)
-                handle._refresh(ttl=0)  # raises KeyError if unknown
-                self.handles[name] = handle
-            except Exception:  # noqa: BLE001
-                self.send_error(404, f"no deployment {name!r}")
-                return
-        length = int(self.headers.get("Content-Length", 0))
-        body = self.rfile.read(length) if length else b"{}"
+            handle = get_deployment_handle(name)
+            handle._refresh(ttl=0)  # raises KeyError if unknown
+            self.handles[name] = handle
+        asgi = self.asgi_flags.get(name)
+        if asgi is None:
+            import ray_tpu
+
+            meta = ray_tpu.get(
+                handle._controller.deployment_meta.remote(name))
+            asgi = bool(meta.get("asgi"))
+            self.asgi_flags[name] = asgi
+        return handle, asgi
+
+    def _reply(self, code: int, body: bytes,
+               content_type: str = "application/json", headers=()):
+        self.send_response(code)
+        sent_ct = False
+        for k, v in headers:
+            if k.lower() == "content-length":
+                continue  # we recompute it
+            if k.lower() == "content-type":
+                sent_ct = True
+            self.send_header(k, v)
+        if not sent_ct:
+            self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _route(self):
+        if self.path in ("/-/healthz", "/healthz"):
+            self._reply(200, b"ok", "text/plain")
+            return
+        from urllib.parse import urlsplit
+
+        split = urlsplit(self.path)
+        parts = split.path.strip("/").split("/", 1)
+        name = parts[0]
+        subpath = "/" + (parts[1] if len(parts) > 1 else "")
         try:
+            handle, asgi = self._resolve(name)
+        except Exception:  # noqa: BLE001
+            self.send_error(404, f"no deployment {name!r}")
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        try:
+            if asgi:
+                # raw-request forwarding: the replica's mounted ASGI app
+                # owns routing/methods/content types
+                out = handle.call({
+                    "__raw__": True, "method": self.command,
+                    "path": subpath, "query_string": split.query,
+                    "headers": list(self.headers.items()), "body": body,
+                })
+                self._reply(out.get("status", 500),
+                            out.get("body", b""),
+                            headers=out.get("headers", ()))
+                return
             payload = json.loads(body) if body else {}
             result = handle.call(payload)
-            out = json.dumps({"result": result}).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(out)))
-            self.end_headers()
-            self.wfile.write(out)
+            self._reply(200, json.dumps({"result": result}).encode())
         except Exception as e:  # noqa: BLE001
-            msg = json.dumps({"error": repr(e)}).encode()
-            self.send_response(500)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(msg)))
-            self.end_headers()
-            self.wfile.write(msg)
+            self._reply(500, json.dumps({"error": repr(e)}).encode())
+
+    def do_POST(self):
+        self._route()
 
     def do_GET(self):
-        if self.path in ("/-/healthz", "/healthz"):
-            self.send_response(200)
-            self.send_header("Content-Length", "2")
-            self.end_headers()
-            self.wfile.write(b"ok")
-        else:
-            self.send_error(404)
+        self._route()
+
+    def do_PUT(self):
+        self._route()
+
+    def do_DELETE(self):
+        self._route()
+
+    def do_PATCH(self):
+        self._route()
 
 
 def start_http_proxy(host: str = "127.0.0.1", port: int = 0):
     """Start the HTTP ingress; returns (server, (host, port)). POST
     /<deployment> with a JSON body routes to the deployment's __call__."""
     handler = type("_ProxyHandlerInstance", (_ProxyHandler,),
-                   {"handles": {}})
+                   {"handles": {}, "asgi_flags": {}})
     server = ThreadingHTTPServer((host, port), handler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server, server.server_address

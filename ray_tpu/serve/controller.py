@@ -178,6 +178,16 @@ class ServeController:
         self._loop.start()
 
     # -- deployment API --------------------------------------------------
+    def deployment_meta(self, name: str) -> dict:
+        """Static facts the proxies need (e.g. whether the deployment is
+        an ASGI ingress, which switches the HTTP proxy to raw-request
+        forwarding)."""
+        with self._lock:
+            dep = self._deployments.get(name)
+            if dep is None:
+                return {}
+            return {"asgi": bool(dep["config"].get("asgi"))}
+
     def deploy(self, name: str, cls_blob: bytes, init_args, init_kwargs,
                config: dict):
         with self._lock:

@@ -88,12 +88,11 @@ class Config:
     # Max lineage entries the owner keeps for reconstruction (reference:
     # RAY_max_lineage_bytes); oldest dropped beyond this.
     lineage_max_entries: int = 100_000
-    # A submitted task whose outputs have NO location after this grace is
-    # presumed lost in flight (its node died with the task queued/running
-    # — no object ever existed to tombstone) and is resubmitted from
-    # lineage. First-write-wins makes a false positive (a genuinely slow
-    # task) harmless, just redundant (reference analog: the owner-side
-    # lease protocol detects executor death and retries).
+    # LEGACY-path tasks only (placement-constrained / lease fallbacks —
+    # submitted to the raylet queue, where no lease connection watches
+    # them): outputs with NO location after this grace are presumed lost
+    # in flight and resubmitted from lineage. Lease-path tasks never use
+    # this — their owner observes the lease break synchronously.
     task_pending_resubmit_grace_s: float = 20.0
     actor_max_restarts: int = 0
     health_check_period_s: float = 1.0

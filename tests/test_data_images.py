@@ -1,0 +1,160 @@
+"""Image ingest → device pipeline (VERDICT r1 item 6: the ViT/CLIP
+BASELINE config's input side) + byte-budget backpressure + size-based
+block splitting."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+@pytest.fixture
+def image_dir(tmp_path):
+    d = tmp_path / "imgs"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(12):
+        arr = rng.integers(0, 255, size=(40, 40, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(d / f"img_{i:02d}.png")
+    (d / "notes.txt").write_text("not an image")
+    return str(d)
+
+
+def test_read_images_decodes_and_resizes(image_dir, ray_tpu_start):
+    ds = rdata.read_images(image_dir, size=(32, 32), include_paths=True)
+    rows = ds.take_all() if hasattr(ds, "take_all") else ds.take(100)
+    assert len(rows) == 12          # .txt file filtered out
+    assert rows[0]["image"].shape == (32, 32, 3)
+    assert rows[0]["image"].dtype == np.uint8
+    assert rows[0]["path"].endswith(".png")
+
+
+def test_read_images_grayscale(image_dir, ray_tpu_start):
+    ds = rdata.read_images(image_dir, size=(16, 16), mode="L")
+    row = ds.take(1)[0]
+    assert row["image"].shape == (16, 16)
+
+
+def test_read_images_missing_raises():
+    with pytest.raises(FileNotFoundError):
+        rdata.read_images("/definitely/not/a/dir/xyz")
+
+
+def test_block_splitting_unit():
+    from ray_tpu.data.context import DataContext
+    from ray_tpu.data.execution import _maybe_split
+
+    ctx = DataContext.get_current()
+    old = ctx.target_max_block_size
+    ctx.target_max_block_size = 1000
+    try:
+        rows = [{"x": np.zeros(100, np.float64)} for _ in range(10)]
+        # ~8000 bytes over a 1000-byte target -> several blocks
+        pieces = _maybe_split(rows, 10, 8000)
+        assert len(pieces) > 1
+        assert sum(p[1] for p in pieces) == 10
+    finally:
+        ctx.target_max_block_size = old
+
+
+def test_pipeline_correct_under_tiny_byte_budget(ray_tpu_start):
+    """Semantics survive hard backpressure: a budget far below the data
+    size still yields every row exactly once."""
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    old = ctx.execution_budget_bytes
+    ctx.execution_budget_bytes = 4096   # absurdly small
+    try:
+        ds = rdata.range(200).map(lambda r: {"y": r["id"] * 2})
+        got = sorted(r["y"] for r in ds.take(1000))
+        assert got == [2 * i for i in range(200)]
+    finally:
+        ctx.execution_budget_bytes = old
+
+
+def test_vit_forward_consumes_image_pipeline(image_dir, ray_tpu_start,
+                                             cpu_mesh_devices):
+    """read_images → normalize → iter_jax_batches → sharded ViT forward
+    on the virtual mesh (the r1 done-criterion)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.models import vit
+    from ray_tpu.parallel.mesh import create_mesh
+
+    cfg = vit.vit_tiny(image_size=32, patch_size=8, n_classes=10)
+    params = vit.init_params(cfg, jax.random.key(0))
+    mesh = create_mesh({"dp": 4}, devices=cpu_mesh_devices[:4])
+
+    def normalize(batch):
+        img = batch["image"].astype(np.float32) / 255.0
+        return {"image": img}
+
+    ds = (rdata.read_images(image_dir, size=(32, 32))
+          .map_batches(normalize))
+    fwd = jax.jit(lambda p, x: vit.forward(cfg, p, x))
+    seen = 0
+    for batch in ds.iterator().iter_jax_batches(batch_size=4,
+                                                drop_last=True):
+        x = jax.device_put(
+            batch["image"], NamedSharding(mesh, P("dp", None, None, None)))
+        logits = fwd(params, x)
+        assert logits.shape == (4, 10)
+        assert bool(jnp.isfinite(logits).all())
+        seen += x.shape[0]
+    assert seen >= 8
+
+
+def test_vit_trains_one_step():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import vit
+
+    cfg = vit.vit_tiny(image_size=32, patch_size=8, n_classes=4)
+    params = vit.init_params(cfg, jax.random.key(0))
+    images = jax.random.uniform(jax.random.key(1), (8, 32, 32, 3))
+    labels = jax.random.randint(jax.random.key(2), (8,), 0, 4)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        def loss_fn(p):
+            logits = vit.forward(cfg, p, images)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        upd, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, upd), opt, loss
+
+    losses = []
+    for _ in range(8):
+        params, opt, loss = step(params, opt)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_vit_logical_axes_match_params():
+    import jax
+
+    from ray_tpu.models import vit
+
+    cfg = vit.vit_tiny()
+    params = vit.init_params(cfg, jax.random.key(0))
+    axes = vit.param_logical_axes(cfg)
+    p_leaves = jax.tree.leaves(params)
+    a_leaves = jax.tree.leaves(
+        axes, is_leaf=lambda x: isinstance(x, tuple))
+    assert len(p_leaves) == len(a_leaves)
+    for p, a in zip(p_leaves, a_leaves):
+        assert p.ndim == len(a), (p.shape, a)

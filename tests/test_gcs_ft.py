@@ -1,0 +1,95 @@
+"""GCS fault tolerance (VERDICT r1 item 4): file-backed snapshot + WAL,
+restart reload, reconciliation with re-registering raylets.
+
+Reference: ``store_client/redis_store_client.h:33`` persistence +
+``gcs_init_data.cc`` restart reload (file-backed here; Redis is not in
+the image)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def ft_cluster():
+    ray_tpu.shutdown()
+    c = Cluster(gcs_fault_tolerance=True, heartbeat_timeout_s=2.0)
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_persistence_unit_roundtrip(tmp_path):
+    from ray_tpu.runtime.gcs import GcsPersistence
+
+    p = GcsPersistence(str(tmp_path / "gcs"))
+    p.append(("kv", ("ns", "a"), b"1"))
+    p.append(("kv", ("ns", "b"), b"2"))
+    state, records = p.load()
+    assert state is None and len(records) == 2
+    p.snapshot({"kv": {"ns": {"a": b"1", "b": b"2"}}, "actors": {},
+                "named_actors": {}, "pgs": {}, "jobs": {},
+                "object_dir": {}, "object_meta": {}, "lost_objects": []})
+    p.append(("kv", ("ns", "c"), b"3"))
+    state, records = p.load()
+    assert state["kv"]["ns"]["a"] == b"1"
+    assert records == [("kv", ("ns", "c"), b"3")]
+    p.close()
+
+
+def test_gcs_restart_preserves_named_actors_and_kv(ft_cluster):
+    c = ft_cluster
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    counter = Counter.options(name="survivor").remote()
+    assert ray_tpu.get(counter.add.remote(5)) == 5
+    from ray_tpu.experimental import internal_kv
+
+    internal_kv.internal_kv_put("durable_key", b"durable_value")
+    time.sleep(0.3)   # WAL flush is synchronous; just settle in-flight
+
+    c.kill_gcs()      # crash: no final snapshot — WAL carries the state
+    time.sleep(0.5)
+    c.restart_gcs()
+    c.wait_for_nodes(1, timeout=10)
+
+    # named actor resolvable AND its (never-restarted) instance retains
+    # in-memory state: the worker process outlived the control plane
+    again = ray_tpu.get_actor("survivor")
+    assert ray_tpu.get(again.add.remote(1), timeout=20) == 6
+    assert internal_kv.internal_kv_get("durable_key") == b"durable_value"
+
+
+def test_gcs_restart_pending_task_completes(ft_cluster):
+    """Kill the GCS while tasks are in flight: the data plane (leases,
+    shm, workers) keeps running; after restart everything reconciles and
+    results come back."""
+    c = ft_cluster
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(2.0)
+        return x * 3
+
+    refs = [slow.remote(i) for i in range(4)]
+    time.sleep(0.3)           # tasks now running on leased workers
+    c.kill_gcs()
+    time.sleep(0.5)
+    c.restart_gcs()
+    assert ray_tpu.get(refs, timeout=30) == [0, 3, 6, 9]
+
+    # and NEW work flows after the restart
+    assert ray_tpu.get(slow.remote(10), timeout=30) == 30

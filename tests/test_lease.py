@@ -1,0 +1,129 @@
+"""Owner-side worker-lease protocol (reference:
+``direct_task_transport.cc:134,240`` — lease + direct push + synchronous
+loss detection). VERDICT r1 item 5's done-criterion: in-flight-loss chaos
+with NO grace-period tuning, and no duplicate submissions for slow-but-
+healthy tasks."""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def two_node_cluster():
+    """Head with no CPUs (driver-only) + one worker node: every task
+    leases on the worker node, which the test can kill."""
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=0)            # head: GCS + driver raylet only
+    worker = c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.gcs_address)
+    yield c, worker
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_lease_grants_and_reuses_workers():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.gcs_address)
+    try:
+        @ray_tpu.remote
+        def pid():
+            return os.getpid()
+
+        # many more tasks than workers: leases must be granted AND reused
+        pids = ray_tpu.get([pid.remote() for _ in range(40)])
+        assert len(set(pids)) <= 2, f"more workers than CPUs: {set(pids)}"
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_inflight_loss_recovered_without_grace(two_node_cluster):
+    """Kill the node executing a task mid-flight: the owner's lease
+    connection breaks SYNCHRONOUSLY and the retry lands on a replacement
+    node — well under the old 20s presumed-lost grace."""
+    c, worker = two_node_cluster
+
+    @ray_tpu.remote(max_retries=2)
+    def slowish(x):
+        time.sleep(3)
+        return x * 2
+
+    refs = [slowish.remote(i) for i in range(2)]
+    time.sleep(1.0)              # tasks are now running on `worker`
+    start = time.monotonic()
+    c.remove_node(worker)        # node dies with tasks in flight
+    c.add_node(num_cpus=2)       # replacement capacity
+    out = ray_tpu.get(refs, timeout=30)
+    elapsed = time.monotonic() - start
+    assert out == [0, 2]
+    # recovery = break detection (immediate) + re-run (~3s); the old
+    # heuristic could not even START before its 20s grace
+    assert elapsed < 15, f"recovery took {elapsed:.1f}s"
+
+
+def test_slow_task_never_duplicated(two_node_cluster):
+    """A slow-but-healthy task must run EXACTLY once even with the
+    legacy presumed-lost grace tuned to something absurdly small — the
+    lease path never consults it (ADVICE r1 medium)."""
+    c, worker = two_node_cluster
+    marker = tempfile.mktemp(prefix="lease_effect_")
+
+    rt = ray_tpu.api._runtime()
+    rt._pending_grace_s = 0.2   # old heuristic would re-submit at 0.2s
+
+    @ray_tpu.remote(max_retries=3)
+    def slow_effect(path):
+        with open(path, "a") as f:
+            f.write("ran\n")
+        time.sleep(3)
+        return "ok"
+
+    assert ray_tpu.get(slow_effect.remote(marker), timeout=30) == "ok"
+    time.sleep(0.5)
+    with open(marker) as f:
+        runs = f.readlines()
+    os.unlink(marker)
+    assert len(runs) == 1, f"slow task ran {len(runs)} times"
+
+
+def test_worker_death_retries_via_lease_break(two_node_cluster):
+    """Worker process dies mid-task: the push fails synchronously and the
+    retry budget drives a re-execution."""
+    c, worker = two_node_cluster
+    marker = tempfile.mktemp(prefix="lease_die_once_")
+
+    @ray_tpu.remote(max_retries=1)
+    def die_once(path):
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("x")
+            os._exit(1)          # simulated crash on first attempt
+        return "second attempt"
+
+    assert ray_tpu.get(die_once.remote(marker), timeout=30) == \
+        "second attempt"
+    os.unlink(marker)
+
+
+def test_worker_death_no_retries_fails_fast(two_node_cluster):
+    """max_retries=0 + worker death: the owner seals an error instead of
+    hanging (the old heuristic had no path for this case at all)."""
+    c, worker = two_node_cluster
+
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    start = time.monotonic()
+    with pytest.raises(ray_tpu.exceptions.RayTpuError):
+        ray_tpu.get(die.remote(), timeout=30)
+    assert time.monotonic() - start < 10

@@ -1,0 +1,121 @@
+"""ASGI mounting + gRPC ingress (reference: ``@serve.ingress(app)``
+FastAPI mounting and the gRPC proxy, ``serve/_private/proxy.py:375``)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def rt(ray_tpu_start):
+    yield ray_tpu_start
+    serve.shutdown()
+
+
+async def echo_app(scope, receive, send):
+    """Minimal ASGI app: routes on path/method, echoes the body — stands
+    in for FastAPI/Starlette (any ASGI callable mounts the same way)."""
+    assert scope["type"] == "http"
+    msg = await receive()
+    body = msg.get("body", b"")
+    if scope["path"] == "/status":
+        payload = b'{"status": "healthy"}'
+        code = 200
+    elif scope["method"] == "PUT":
+        payload = b"put:" + body
+        code = 201
+    else:
+        payload = (json.dumps({
+            "method": scope["method"], "path": scope["path"],
+            "echo": body.decode() if body else "",
+        }).encode())
+        code = 200
+    await send({"type": "http.response.start", "status": code,
+                "headers": [(b"content-type", b"application/json")]})
+    await send({"type": "http.response.body", "body": payload})
+
+
+def _http(method, url, body=None):
+    req = urllib.request.Request(url, data=body, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_asgi_ingress_routes_raw_requests(rt):
+    @serve.deployment(name="asgiapp")
+    @serve.ingress(echo_app)
+    class App:
+        pass
+
+    serve.run(App.bind())
+    _, (host, port) = serve.start_http_proxy()
+
+    code, body = _http("GET", f"http://{host}:{port}/asgiapp/status")
+    assert code == 200 and json.loads(body) == {"status": "healthy"}
+
+    code, body = _http("POST", f"http://{host}:{port}/asgiapp/predict",
+                       body=b"data")
+    assert code == 200
+    out = json.loads(body)
+    assert out["method"] == "POST" and out["path"] == "/predict"
+    assert out["echo"] == "data"
+
+    code, body = _http("PUT", f"http://{host}:{port}/asgiapp/thing",
+                       body=b"xyz")
+    assert code == 201 and body == b"put:xyz"
+
+
+def test_asgi_handle_call_becomes_post(rt):
+    @serve.deployment(name="asgih")
+    @serve.ingress(echo_app)
+    class App:
+        pass
+
+    handle = serve.run(App.bind())
+    out = handle.call({"k": 1})
+    assert out["status"] == 200
+    echoed = json.loads(out["body"])
+    assert json.loads(echoed["echo"]) == {"k": 1}
+
+
+def test_plain_deployment_keeps_json_contract(rt):
+    @serve.deployment(name="plainj")
+    class Plain:
+        def __call__(self, payload):
+            return {"doubled": payload.get("x", 0) * 2}
+
+    serve.run(Plain.bind())
+    _, (host, port) = serve.start_http_proxy()
+    code, body = _http("POST", f"http://{host}:{port}/plainj",
+                       body=json.dumps({"x": 21}).encode())
+    assert code == 200
+    assert json.loads(body)["result"] == {"doubled": 42}
+
+
+def test_grpc_ingress(rt):
+    grpc = pytest.importorskip("grpc")
+    from ray_tpu.serve.ingress import GRPC_SERVICE, grpc_call
+
+    @serve.deployment(name="grpcd")
+    class D:
+        def __call__(self, payload):
+            return {"sum": payload["a"] + payload["b"]}
+
+    serve.run(D.bind())
+    server, port = serve.start_grpc_proxy()
+    try:
+        out = grpc_call(port, "grpcd", {"a": 2, "b": 40})
+        assert out["result"] == {"sum": 42}
+
+        with pytest.raises(grpc.RpcError) as err:
+            grpc_call(port, "nope", {})
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        server.stop(0)
