@@ -92,6 +92,8 @@ def _load():
     lib.store_release.argtypes = [p, ctypes.c_char_p]
     lib.store_delete.restype = ctypes.c_int
     lib.store_delete.argtypes = [p, ctypes.c_char_p]
+    lib.store_abort.restype = ctypes.c_int
+    lib.store_abort.argtypes = [p, ctypes.c_char_p]
     lib.store_contains.restype = ctypes.c_int
     lib.store_contains.argtypes = [p, ctypes.c_char_p]
     lib.store_evict_orphans.restype = ctypes.c_int
@@ -231,6 +233,11 @@ class ShmObjectStore:
 
     def delete(self, object_id: bytes) -> bool:
         return self._lib.store_delete(self._h, _key(object_id)) == TS_OK
+
+    def abort(self, object_id: bytes) -> bool:
+        """Free an UNSEALED entry this process created (failed chunked
+        write/pull cleanup); refuses sealed entries and other writers'."""
+        return self._lib.store_abort(self._h, _key(object_id)) == TS_OK
 
     def try_delete(self, object_id: bytes) -> int:
         """Raw delete status: TS_OK, TS_NOT_FOUND (already gone), or

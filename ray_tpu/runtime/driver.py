@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any
 
 import cloudpickle
@@ -59,6 +60,10 @@ class ClusterRuntime:
         self.store = ShmObjectStore(store_name)
         self._actor_locations: dict[str, tuple] = {}   # id -> (addr, incarnation)
         self._actor_seq: dict[str, int] = {}           # id -> next seq
+        # pipelined actor submits: id -> deque[(task, PendingCall, addr)]
+        self._actor_windows: dict[str, deque] = {}
+        self._actor_gap_fillers: dict[str, list] = {}
+        self._actor_reaper_started = False
         self._seq_lock = threading.Lock()
         # per-actor submission locks: seq assignment + send must be atomic
         # per actor or concurrent senders can interleave/retry into
@@ -571,53 +576,178 @@ class ClusterRuntime:
             "caller_id": self.caller_id,
             "trace_ctx": spec.trace_ctx,
         }
-        last_err: BaseException | None = None
-        addr_used = None  # the raylet whose CONNECTION actually failed
-        for attempt in range(2):
+        # Pipelined submission (reference: the async gRPC CallQueue in
+        # DirectActorTaskSubmitter): the send is fired WITHOUT waiting
+        # for the raylet's reply — same-socket ordering preserves seq
+        # order — and replies drain from a per-actor window here and in
+        # the background reaper. Throughput = burst rate, not RTT rate.
+        self._drain_actor_window(actor_hex)
+        self._send_actor_task_async(task, actor_hex)
+
+    ACTOR_WINDOW = 64   # max unacked submits per actor
+
+    def _send_actor_task_async(self, task: dict, actor_hex: str):
+        """Fire one actor-task submit (caller holds the actor's send
+        lock). Immediate failures go through the resend path."""
+        window = self._actor_windows.setdefault(actor_hex, deque())
+        addr_used = None
+        try:
+            addr, incarnation = self._actor_location(actor_hex)
+            with self._seq_lock:
+                seq = self._actor_seq.get(actor_hex, 0)
+                self._actor_seq[actor_hex] = seq + 1
+            task["seq"] = seq
+            task["incarnation"] = incarnation
+            addr_used = tuple(addr)
+            client = self._actor_client(addr)
+            pending = client.call_async("submit_actor_task", task=task)
+        except (exc.ActorDiedError, exc.ActorUnavailableError, OSError,
+                ConnectionLost, LookupError) as e:
+            self._resend_actor_task(task, actor_hex, e, addr_used)
+            return
+        window.append((task, pending, addr_used))
+        self._ensure_actor_reaper()
+
+    def _drain_actor_window(self, actor_hex: str):
+        """Pop completed submits off the window head; on failure, resend
+        the failed submit AND everything after it in order (they shared
+        the dead socket / stale incarnation). Caller holds the actor's
+        send lock. Blocks only when the window is full."""
+        window = self._actor_windows.get(actor_hex)
+        if not window:
+            return
+        while window:
+            task, pending, addr = window[0]
+            if (not pending._ev_reply[0].is_set()
+                    and len(window) < self.ACTOR_WINDOW):
+                return
+            window.popleft()
             try:
-                addr_used = None
-                addr, incarnation = self._actor_location(actor_hex)
-                # seq is assigned per send attempt so a reset (new
-                # incarnation) renumbers this task too
+                pending.result(timeout=60)
+            except (exc.ActorDiedError, exc.ActorUnavailableError, OSError,
+                    ConnectionLost, TimeoutError, LookupError) as e:
+                failed = [(task, addr)]
+                failed += [(t, a) for t, _, a in window]
+                window.clear()
+                for t, a in failed:
+                    self._resend_actor_task(t, actor_hex, e, a)
+                return
+
+    def _resend_actor_task(self, task: dict, actor_hex: str,
+                           first_err: BaseException, addr_used):
+        """One synchronous retry with a refreshed location (reference:
+        client resend protocol on actor restart). Seq handling: same
+        incarnation keeps the ORIGINAL seq (the actor never consumed it;
+        duplicates dedup worker-side), a new incarnation renumbers from
+        the reset counter — either way no gap stalls the actor's ordered
+        queue."""
+        if isinstance(first_err, (OSError, ConnectionLost)) \
+                and addr_used is not None:
+            # transport failure ON THE RAYLET LINK: reconnect on retry.
+            # App-level errors keep the healthy shared connection.
+            try:
+                self._drop_actor_client(addr_used)
+            except Exception:  # noqa: BLE001
+                pass
+        self._actor_locations.pop(actor_hex, None)
+        try:
+            addr, incarnation = self._actor_location(actor_hex)
+            if incarnation != task.get("incarnation"):
                 with self._seq_lock:
                     seq = self._actor_seq.get(actor_hex, 0)
                     self._actor_seq[actor_hex] = seq + 1
                 task["seq"] = seq
                 task["incarnation"] = incarnation
-                addr_used = tuple(addr)
-                client = self._actor_client(addr)
-                client.call("submit_actor_task", task=task)
-                return
-            except (exc.ActorDiedError, exc.ActorUnavailableError, OSError,
-                    ConnectionLost, LookupError) as e:
-                last_err = e
-                if isinstance(e, (OSError, ConnectionLost)) and                         addr_used is not None:
-                    # transport failure ON THE RAYLET LINK: reconnect on
-                    # retry. App-level errors (actor died / incarnation
-                    # mismatch) and GCS-side failures keep the healthy
-                    # shared raylet connection — closing it would kill
-                    # OTHER actors' in-flight calls on that node.
-                    try:
-                        self._drop_actor_client(addr_used)
-                    except Exception:  # noqa: BLE001
-                        pass
-                # the seq was not consumed by the actor — roll it back so
-                # later calls don't leave a gap the actor waits on forever
-                with self._seq_lock:
-                    if self._actor_seq.get(actor_hex) == task.get("seq", -1) + 1:
-                        self._actor_seq[actor_hex] = task["seq"]
-                # refresh location/incarnation and retry once (reference:
-                # client-side resend protocol on actor restart)
-                self._actor_locations.pop(actor_hex, None)
-        err = last_err if isinstance(last_err, exc.RayTpuError) else \
-            exc.ActorDiedError(actor_hex, repr(last_err))
-        for oid in spec.return_ids:
-            if not self.store.contains(oid.binary()):
+            client = self._actor_client(addr)
+            client.call("submit_actor_task", task=task)
+            return
+        except (exc.ActorDiedError, exc.ActorUnavailableError, OSError,
+                ConnectionLost, LookupError, TimeoutError) as e:
+            err = e if isinstance(e, exc.RayTpuError) else \
+                exc.ActorDiedError(actor_hex, repr(e))
+        for oid_hex in task.get("return_oids", ()):
+            oid = bytes.fromhex(oid_hex)
+            if not self.store.contains(oid):
                 try:
-                    object_codec.put_value(self.store, oid.binary(),
-                                           err, is_error=True)
+                    object_codec.put_value(self.store, oid, err,
+                                           is_error=True)
                 except Exception:  # noqa: BLE001
                     pass
+        # The consumed seq would leave a GAP the actor's ordered queue
+        # waits on forever (stalling every later call). Queue a noop
+        # gap-filler: the reaper keeps sending it until it lands or the
+        # actor moves to a new incarnation (which resets numbering).
+        if not task.get("noop"):
+            filler = {"actor_id": actor_hex, "caller_id": self.caller_id,
+                      "task_id": task.get("task_id", ""),
+                      "method_name": "", "args_blob": b"",
+                      "return_oids": [], "noop": True,
+                      "seq": task["seq"],
+                      "incarnation": task.get("incarnation", 0)}
+            with self._seq_lock:
+                self._actor_gap_fillers.setdefault(actor_hex,
+                                                   []).append(filler)
+            self._ensure_actor_reaper()
+
+    def _flush_gap_fillers(self):
+        """Reaper duty: deliver queued seq gap-fillers; drop them once
+        the actor reached a new incarnation (fresh numbering, no gap)."""
+        with self._seq_lock:
+            items = [(a, list(fs)) for a, fs in
+                     self._actor_gap_fillers.items() if fs]
+        for actor_hex, fillers in items:
+            for filler in fillers:
+                delivered = False
+                try:
+                    addr, incarnation = self._actor_location(actor_hex)
+                    if incarnation != filler["incarnation"]:
+                        delivered = True   # numbering reset: gap is moot
+                    else:
+                        self._actor_client(addr).call(
+                            "submit_actor_task", task=filler, timeout=10)
+                        delivered = True
+                except (exc.ActorDiedError, exc.ActorUnavailableError):
+                    delivered = True       # actor gone: nobody waits
+                except Exception:  # noqa: BLE001 - retry next tick
+                    pass
+                if delivered:
+                    with self._seq_lock:
+                        fs = self._actor_gap_fillers.get(actor_hex, [])
+                        if filler in fs:
+                            fs.remove(filler)
+
+    def _ensure_actor_reaper(self):
+        """Background drain: surfaces failures of the LAST submits in a
+        burst even when no further call touches the actor."""
+        if self._actor_reaper_started:
+            return
+        with self._seq_lock:
+            if self._actor_reaper_started:
+                return
+            self._actor_reaper_started = True
+
+        def loop():
+            while not self._closed:
+                time.sleep(0.05)
+                for actor_hex in list(self._actor_windows):
+                    window = self._actor_windows.get(actor_hex)
+                    if not window:
+                        continue
+                    with self._seq_lock:
+                        send_lock = self._actor_send_locks.setdefault(
+                            actor_hex, threading.Lock())
+                    with send_lock:
+                        try:
+                            self._drain_actor_window(actor_hex)
+                        except Exception:  # noqa: BLE001
+                            pass
+                try:
+                    self._flush_gap_fillers()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threading.Thread(target=loop, daemon=True,
+                         name="actor-submit-reaper").start()
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self._gcs.call("kill_actor", actor_id=actor_id.hex(),

@@ -98,7 +98,23 @@ class GcsPersistence:
             self._wal_f.write(struct.pack(">I", len(blob)) + blob)
             self._wal_f.flush()
 
-    def snapshot(self, state: dict):
+    def rotate_wal(self):
+        """Move the live WAL aside (cheap, lock-held by the caller along
+        with the state capture). Records in the rotated file stay
+        replayable until ``commit_snapshot`` lands the state that
+        contains them — a crash in between loses nothing."""
+        import os
+
+        with self._io_lock:
+            if self._wal_f is not None:
+                self._wal_f.close()
+                self._wal_f = None
+            if os.path.exists(self.wal_path):
+                os.replace(self.wal_path, self.wal_path + ".rotated")
+
+    def commit_snapshot(self, state: dict):
+        """Write the snapshot (slow disk IO — caller holds NO state lock)
+        and retire the rotated WAL it supersedes."""
         import os
         import pickle
 
@@ -107,10 +123,15 @@ class GcsPersistence:
             with open(tmp, "wb") as f:
                 pickle.dump(state, f, protocol=5)
             os.replace(tmp, self.snap_path)
-            if self._wal_f is not None:
-                self._wal_f.close()
-                self._wal_f = None
-            open(self.wal_path, "wb").close()   # WAL folded into snapshot
+            try:
+                os.remove(self.wal_path + ".rotated")
+            except OSError:
+                pass
+
+    def snapshot(self, state: dict):
+        """Atomic capture-and-fold (small states / shutdown path)."""
+        self.rotate_wal()
+        self.commit_snapshot(state)
 
     def load(self) -> tuple[dict | None, list]:
         import os
@@ -125,9 +146,13 @@ class GcsPersistence:
             except Exception:  # noqa: BLE001 - torn snapshot: WAL only
                 state = None
         records = []
-        if os.path.exists(self.wal_path):
+        # a .rotated WAL outlives a crash between rotation and snapshot
+        # commit — replay it FIRST (its records predate the live WAL's)
+        for path in (self.wal_path + ".rotated", self.wal_path):
+            if not os.path.exists(path):
+                continue
             try:
-                with open(self.wal_path, "rb") as f:
+                with open(path, "rb") as f:
                     data = f.read()
                 off = 0
                 while off + 4 <= len(data):
@@ -274,12 +299,14 @@ class GcsServer(RpcServer):
             if self._dirty and persist is not None:
                 self._dirty = False
                 try:
-                    # capture + truncate under the GCS lock: every _log
-                    # runs under it, so no WAL record can land between
-                    # the state capture and the truncation (it would be
-                    # silently discarded — the loss the WAL prevents)
+                    # capture + WAL rotation under the GCS lock (cheap —
+                    # no record can land between them and be discarded);
+                    # the snapshot's DISK write runs outside the lock so
+                    # control-plane RPCs never stall behind file IO
                     with self._lock:
-                        persist.snapshot(self._state_dict())
+                        state = self._state_dict()
+                        persist.rotate_wal()
+                    persist.commit_snapshot(state)
                 except OSError:
                     self._dirty = True
 

@@ -156,12 +156,13 @@ class LeaseManager:
                 for t in tasks:
                     self._in_flight.pop(t.get("task_id", ""), None)
 
-        def _break_all(error):
+        def _break_all(error, info=None):
             # the lease died: every group in the window is lost together —
             # ONE death-info query covers them all
             nonlocal lease
             broken, lease = lease, None
-            info = self._death_info(broken)
+            if info is None:
+                info = self._death_info(broken)
             try:
                 broken.close()
             except Exception:  # noqa: BLE001
@@ -224,7 +225,7 @@ class LeaseManager:
                         for t in tasks:
                             self._handle_break(t, e, info)
                         if lease is not None:
-                            _break_all(e)
+                            _break_all(e, info)
                     continue
                 # window empty: need a lease and/or more work
                 task = self._pop(key)
@@ -298,7 +299,16 @@ class LeaseManager:
                         return Lease(resp["worker_addr"], resp["worker_id"],
                                      resp["node_id"], target.address)
                     except OSError:
-                        return None  # worker died between grant and dial
+                        # dial failed (worker died, or owner-side fd
+                        # pressure): hand the grant BACK — an undailed
+                        # lease would leak the worker + its resources
+                        try:
+                            target.call("lease_closed",
+                                        worker_id=resp["worker_id"],
+                                        timeout=5)
+                        except Exception:  # noqa: BLE001
+                            pass
+                        return None
                 if resp.get("redirect") and hops < 4:
                     hops += 1
                     if transient is not None:

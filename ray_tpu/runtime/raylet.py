@@ -149,6 +149,21 @@ class Raylet(RpcServer):
         # buffered object-location registrations (batched to the GCS)
         self._loc_buf: list[tuple[str, int]] = []
         self._loc_cv = threading.Condition()
+        # wakes ensure_local waiters when an object becomes local
+        self._local_cv = threading.Condition()
+        # chunked pull plane (reference: PullManager pull_manager.h:52)
+        from ray_tpu.runtime.pull_manager import PullManager
+        self._pulls = PullManager(
+            fetch_local=self._restore_spilled,
+            peer_addresses=self._peer_addresses_for,
+            store=self.store,
+            on_pulled=self._on_pulled,
+            chunk_size=_cfg.object_transfer_chunk_bytes,
+            max_in_flight_bytes=max(
+                int(store_capacity
+                    * _cfg.object_transfer_inflight_fraction),
+                _cfg.object_transfer_chunk_bytes),
+        )
         # parked worker-lease requests (owner-side lease protocol;
         # reference: the lease queue behind HandleRequestWorkerLease,
         # node_manager.cc:1778). Guarded by _ready_cv.
@@ -244,6 +259,7 @@ class Raylet(RpcServer):
 
     def stop(self):
         super().stop()
+        self._pulls.stop()
         with self._timers_lock:
             timers = list(self._deferred_timers)
             self._deferred_timers.clear()
@@ -1006,6 +1022,10 @@ class Raylet(RpcServer):
     def _track_local(self, oid_hex: str):
         with self._local_objects_lock:
             self._local_objects.add(oid_hex)
+        # wake ensure_local waiters (event-driven instead of polling for
+        # the locally-produced-object case)
+        with self._local_cv:
+            self._local_cv.notify_all()
 
     def _reconcile_locations(self):
         """Deregister objects that silently left the store (LRU-evicted
@@ -1296,10 +1316,56 @@ class Raylet(RpcServer):
                 raise
             return payload
 
+    def rpc_fetch_object_meta(self, conn, send_lock, *, oid: str):
+        """Size probe for the chunked pull path (reference: the object
+        directory carries sizes for PullManager admission)."""
+        oid_b = bytes.fromhex(oid)
+        try:
+            view = self.store.get(oid_b, timeout_ms=0)
+            try:
+                return {"found": True, "size": view.nbytes}
+            finally:
+                view.release()
+                self.store.release(oid_b)
+        except ObjectNotFoundError:
+            with self._spill_lock:
+                entry = self._spilled.get(oid)
+            if entry is not None:
+                try:
+                    return {"found": True, "size": os.path.getsize(entry[0])}
+                except OSError:
+                    pass
+            return {"found": False}
+
+    def rpc_fetch_object_chunk(self, conn, send_lock, *, oid: str,
+                               offset: int, length: int):
+        """One chunk of an object's raw encoding (reference:
+        ObjectManager chunked transfer, 5 MiB default chunks —
+        object_manager.cc:339). Spilled objects are served by file seek —
+        no whole-object restore to answer a remote read."""
+        oid_b = bytes.fromhex(oid)
+        try:
+            view = self.store.get(oid_b, timeout_ms=0)
+            try:
+                return bytes(view[offset:offset + length])
+            finally:
+                view.release()
+                self.store.release(oid_b)
+        except ObjectNotFoundError:
+            with self._spill_lock:
+                entry = self._spilled.get(oid)
+            if entry is None:
+                raise
+            with open(entry[0], "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+
     def rpc_ensure_local(self, conn, send_lock, *, oids: list,
                          timeout_s: float = 30.0):
         """Make objects locally readable, pulling from peers as needed.
-        Returns the list of oids that could NOT be made local in time."""
+        Returns the list of oids that could NOT be made local in time.
+        Waits are event-driven for locally-produced objects (the common
+        case): report_object notifies ``_local_cv``."""
         deadline = time.monotonic() + timeout_s
         missing = [o for o in oids
                    if not self.store.contains(bytes.fromhex(o))]
@@ -1313,38 +1379,33 @@ class Raylet(RpcServer):
                     still.append(oid_hex)
             missing = still
             if missing:
-                time.sleep(0.02)
+                # wake instantly when a local task seals one of ours;
+                # re-check remote locations on a coarser cadence
+                with self._local_cv:
+                    self._local_cv.wait(
+                        timeout=min(0.1, max(deadline - time.monotonic(),
+                                             0.0)))
         return missing
 
-    def _pull(self, oid_hex: str) -> bool:
-        # locally spilled? restore without a network hop
-        if self._restore_spilled(oid_hex):
-            return True
+    def _peer_addresses_for(self, oid_hex: str) -> list:
         with self._gcs_lock:
             locs = self._gcs.call("get_object_locations",
                                   oids=[oid_hex])[oid_hex]
+        out = []
         for node_id in locs:
             if node_id == self.node_id:
-                return self.store.contains(bytes.fromhex(oid_hex))
-            peer = self._peer(node_id)
-            if peer is None:
                 continue
-            try:
-                payload = peer.call("fetch_object", oid=oid_hex)
-            except Exception:  # noqa: BLE001 - peer busy/dead; try next
-                continue
-            oid = bytes.fromhex(oid_hex)
-            if not self.store.contains(oid):
-                try:
-                    object_codec.put_raw(self.store, oid, payload)
-                except Exception:  # noqa: BLE001 - racing pull
-                    pass
-            self._track_local(oid_hex)
-            with self._gcs_lock:
-                self._gcs.call("add_object_location", oid=oid_hex,
-                               node_id=self.node_id, size=len(payload))
-            return True
-        return False
+            addr = self._peer_address(node_id)
+            if addr is not None:
+                out.append((node_id, addr))
+        return out
+
+    def _on_pulled(self, oid_hex: str, size: int):
+        self._track_local(oid_hex)
+        self._queue_location(oid_hex, size)
+
+    def _pull(self, oid_hex: str) -> bool:
+        return self._pulls.pull(oid_hex)
 
     # ------------------------------------------------------------------
     # worker leases (owner-side lease protocol; reference:
@@ -1448,6 +1509,13 @@ class Raylet(RpcServer):
                 worker.state = "leased"
                 worker.acquired = dict(waiter["demand"])
                 worker.dispatched_at = time.monotonic()
+            # arm the worker's never-dialed watchdog BEFORE the owner can
+            # learn the address (guarantees msg-before-dial ordering)
+            try:
+                send_msg(worker.conn, {"type": "lease_granted"},
+                         worker.send_lock)
+            except OSError:
+                pass
             waiter["result"] = {"ok": True,
                                 "worker_addr": list(worker.push_addr),
                                 "worker_id": worker.worker_id,

@@ -83,6 +83,15 @@ class TaskPushServer(RpcServer):
             self._worker.push_task_thread = None
         return {"ok": True}
 
+    def _serve_conn(self, conn):
+        with self._worker._push_conn_lock:
+            self._worker.open_push_conns += 1
+        try:
+            super()._serve_conn(conn)
+        finally:
+            with self._worker._push_conn_lock:
+                self._worker.open_push_conns -= 1
+
     def on_disconnect(self, conn):
         try:
             self._worker.ctrl.call("lease_closed",
@@ -137,6 +146,9 @@ class Worker:
         # the task it was aimed at — never a batchmate)
         self.current_push_task_id: str | None = None
         self.cancelled_push_ids: set[str] = set()
+        self.open_push_conns = 0
+        self._push_conn_lock = threading.Lock()
+        self._lease_watch_gen = 0
         self._fn_cache: dict[int, tuple] = {}   # hash(blob) -> (blob, fn)
         self._report_buf: list[tuple[str, int]] = []
         self._report_cv = threading.Condition()
@@ -156,6 +168,34 @@ class Worker:
                              "push_addr": list(self.push_server.address)})
         reply = recv_msg(self.chan)
         assert reply.get("registered"), reply
+
+    def _arm_lease_watch(self):
+        """The raylet granted a lease on this worker: if the owner never
+        dials the push port (it died, or its dial failed after the
+        grant), hand the lease back — otherwise this worker and its
+        resources leak in 'leased' state forever. The check is on OPEN
+        connections (not connection history), so an owner that dialed
+        before this message was processed is never falsely reclaimed;
+        an owner that dialed and died is covered by on_disconnect."""
+        import time as _time
+
+        self._lease_watch_gen += 1
+        gen = self._lease_watch_gen
+
+        def watch():
+            _time.sleep(10.0)
+            with self._push_conn_lock:
+                active = self.open_push_conns
+            # the gen check keeps a STALE watch (armed for a previous
+            # lease cycle) from reclaiming a newer grant
+            if active == 0 and gen == self._lease_watch_gen:
+                try:
+                    self.ctrl.call("lease_closed", worker_id=self.worker_id)
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threading.Thread(target=watch, daemon=True,
+                         name="lease-watch").start()
 
     def _cancel_push(self, task_id: str):
         """Cancel a lease-pushed task BY ID: interrupt only if it is the
@@ -214,6 +254,8 @@ class Worker:
                 self._enqueue_actor_task(msg["task"])
             elif kind == "cancel_push":
                 self._cancel_push(msg["task_id"])
+            elif kind == "lease_granted":
+                self._arm_lease_watch()
             elif kind == "exit":
                 return
 
@@ -446,6 +488,11 @@ class Worker:
     def _run_actor_task(self, task: dict):
         import time as _time
 
+        if task.get("noop"):
+            # seq gap-filler (owner sealed errors for a submit that never
+            # arrived): advances the ordered queue, executes nothing
+            self._send({"type": "task_done", "task_id": task.get("task_id")})
+            return
         started = _time.monotonic()
         try:
             from ray_tpu.util.tracing import execution_span

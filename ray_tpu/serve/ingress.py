@@ -131,7 +131,6 @@ def ingress(asgi_app_or_factory):
                 super().__init__(*args, **kwargs)
                 app = asgi_app_or_factory
                 target = app() if (callable(app)
-                                   and not hasattr(app, "__call__async__")
                                    and not _looks_like_asgi(app)) else app
                 self._asgi_driver = _ASGIDriver(target)
 

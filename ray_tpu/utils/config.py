@@ -60,6 +60,13 @@ class Config:
     object_spilling_low_fraction: float = 0.5
     # Directory for spilled object files ("" = a per-raylet temp dir).
     object_spilling_directory: str = ""
+    # --- object transfer (reference: ObjectManager chunked push/pull;
+    # chunk size ray_config_def.h:355, PullManager admission control
+    # pull_manager.h:52) ---
+    object_transfer_chunk_bytes: int = 5 << 20
+    # cap on bytes in flight across all pulls, as a fraction of the
+    # destination store's capacity
+    object_transfer_inflight_fraction: float = 0.25
 
     # --- memory monitor (reference: common/memory_monitor.h:52 +
     # raylet/worker_killing_policy*.cc) ---

@@ -624,6 +624,29 @@ int store_release_pid(void* sp, uint64_t pid) {
   return n;
 }
 
+// Abort a CREATED (unsealed) entry owned by the calling writer — the
+// cleanup path for a failed chunked pull/write. Refuses sealed entries
+// and other writers' allocations.
+int store_abort(void* sp, const uint8_t* id) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  lock(h);
+  uint64_t i = find(s, id);
+  if (i == h->table_cap) {
+    unlock(h);
+    return TS_NOT_FOUND;
+  }
+  ObjectEntry& e = s->table[i];
+  if (e.state != kCreated || e.writer_pid != (uint64_t)getpid()) {
+    unlock(h);
+    return TS_ERR;
+  }
+  entry_free(s, e);
+  pthread_cond_broadcast(&h->cv);
+  unlock(h);
+  return TS_OK;
+}
+
 int store_delete(void* sp, const uint8_t* id) {
   Store* s = (Store*)sp;
   Header* h = s->hdr;
