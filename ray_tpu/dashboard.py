@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 import ray_tpu
 from ray_tpu.util import metrics as _metrics
@@ -137,6 +138,22 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(_state.list_objects())
             elif path == "/api/timeline":
                 self._send_json(ray_tpu.timeline())
+            elif path == "/api/stacks":
+                qs = parse_qs(self.path.partition("?")[2])
+                self._send_json(_state.dump_worker_stacks(
+                    node_id=qs.get("node", [None])[0],
+                    worker_id=qs.get("worker", [None])[0]))
+            elif path == "/api/profile":
+                qs = parse_qs(self.path.partition("?")[2])
+                worker = qs.get("worker", [None])[0]
+                if not worker:
+                    self._send_json(
+                        {"error": "profile needs ?worker=<id>"}, 400)
+                else:
+                    self._send_json(_state.profile_worker(
+                        worker,
+                        duration_s=float(qs.get("duration", ["2.0"])[0]),
+                        hz=int(qs.get("hz", ["100"])[0])))
             elif path.startswith("/api/jobs/") and path.endswith("/logs"):
                 from ray_tpu.job_submission import JobSubmissionClient
 

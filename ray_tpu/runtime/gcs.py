@@ -41,6 +41,8 @@ class NodeInfo:
     labels: dict = field(default_factory=dict)
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
+    # latest reporter sample from the node (cpu/mem/spill-disk)
+    host_stats: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -417,13 +419,15 @@ class GcsServer(RpcServer):
         return {"ok": True}
 
     def rpc_heartbeat(self, conn, send_lock, *, node_id, available,
-                      load=None):
+                      load=None, host_stats=None):
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
                 return {"ok": False, "reregister": True}
             node.last_heartbeat = time.monotonic()
             node.available = dict(available)
+            if host_stats:
+                node.host_stats = dict(host_stats)
         return {"ok": True}
 
     def rpc_get_nodes(self, conn, send_lock, *, alive_only: bool = True):
@@ -432,7 +436,7 @@ class GcsServer(RpcServer):
                 {"node_id": n.node_id, "address": n.address,
                  "store_name": n.store_name, "resources": n.resources,
                  "available": n.available, "alive": n.alive,
-                 "labels": n.labels}
+                 "labels": n.labels, "host_stats": n.host_stats}
                 for n in self._nodes.values()
                 if n.alive or not alive_only
             ]

@@ -1585,6 +1585,70 @@ class Raylet(RpcServer):
         self._kick_dispatch()
         return {"ok": True}
 
+    # ------------------------------------------------------------------
+    # per-node observability (reference: the dashboard reporter agent —
+    # psutil stats + py-spy stack dumps/profiles proxied per worker)
+    # ------------------------------------------------------------------
+
+    def _worker_push_targets(self, worker_id: str | None = None):
+        with self._workers_lock:
+            return [(w.worker_id, w.push_addr)
+                    for w in self._workers.values()
+                    if w.push_addr is not None and w.state != "dead"
+                    and (worker_id is None or w.worker_id == worker_id)]
+
+    def rpc_worker_stacks(self, conn, send_lock, *,
+                          worker_id: str | None = None):
+        """Stack dumps of (one or all) local workers, keyed by worker id
+        (py-spy ``dump`` analog via each worker's push port). Workers are
+        queried in PARALLEL with a short timeout so one wedged worker
+        costs 5s, not 5s x workers — and never hides the healthy ones."""
+        out = {}
+        out_lock = threading.Lock()
+
+        def query(wid, addr):
+            client = None
+            try:
+                client = RpcClient(addr, timeout=5)
+                stacks = client.call("dump_stacks")
+            except Exception as e:  # noqa: BLE001 - worker busy/gone
+                stacks = {"error": repr(e)}
+            finally:
+                if client is not None:
+                    client.close()
+            with out_lock:
+                out[wid] = stacks
+
+        threads = [threading.Thread(target=query, args=t, daemon=True)
+                   for t in self._worker_push_targets(worker_id)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=8)
+        return out
+
+    def rpc_profile_worker(self, conn, send_lock, *, worker_id: str,
+                           duration_s: float = 2.0, hz: int = 100):
+        """Sampling CPU profile of one worker (py-spy ``record`` analog;
+        collapsed-stack output for flamegraph tooling)."""
+        targets = self._worker_push_targets(worker_id)
+        if not targets:
+            # sentinel (not a failure): lets cluster-wide callers keep
+            # searching other nodes without conflating "lives elsewhere"
+            # with a genuine profile error
+            return {"not_found": True,
+                    "error": f"no live worker {worker_id!r} here"}
+        _, addr = targets[0]
+        client = None
+        try:
+            client = RpcClient(addr, timeout=duration_s + 30)
+            return client.call("profile", duration_s=duration_s, hz=hz)
+        except Exception as e:  # noqa: BLE001
+            return {"error": repr(e)}
+        finally:
+            if client is not None:
+                client.close()
+
     def rpc_node_info(self, conn, send_lock):
         return {"node_id": self.node_id, "store_name": self.store_name,
                 "address": self.address, "resources": self.total_resources,
@@ -1609,9 +1673,15 @@ class Raylet(RpcServer):
                 except Exception:  # noqa: BLE001 - next tick retries
                     pass
             try:
+                stats = {}
+                if ticks % 4 == 0:   # host sampling is cheap but not free
+                    from ray_tpu.util.profiling import host_stats
+
+                    stats = host_stats(self._spill_dir)
                 with self._gcs_lock:
                     reply = self._gcs.call("heartbeat", node_id=self.node_id,
-                                           available=self._avail_snapshot())
+                                           available=self._avail_snapshot(),
+                                           host_stats=stats or None)
                 if reply.get("reregister"):
                     with self._gcs_lock:
                         self._gcs.call(

@@ -92,6 +92,21 @@ class TaskPushServer(RpcServer):
             with self._worker._push_conn_lock:
                 self._worker.open_push_conns -= 1
 
+    def rpc_dump_stacks(self, conn, send_lock):
+        """Per-thread stack dump (py-spy ``dump`` analog; reference:
+        profile_manager.py) — the raylet proxies these for the dashboard."""
+        from ray_tpu.util.profiling import dump_stacks
+
+        return dump_stacks()
+
+    def rpc_profile(self, conn, send_lock, *, duration_s: float = 2.0,
+                    hz: int = 100):
+        """Sampling CPU profile in collapsed-stack (flamegraph) format."""
+        from ray_tpu.util.profiling import sample_profile
+
+        return sample_profile(duration_s=min(duration_s, 30.0), hz=hz,
+                              exclude_thread=threading.get_ident())
+
     def on_disconnect(self, conn):
         try:
             self._worker.ctrl.call("lease_closed",

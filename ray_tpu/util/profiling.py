@@ -1,0 +1,87 @@
+"""In-process stack dumps + sampling CPU profiler.
+
+Reference analog: the per-node dashboard agent shelling to py-spy for
+stack dumps and flamegraphs (``dashboard/modules/reporter/
+profile_manager.py:11-51``). py-spy is an external process reading
+remote memory; the TPU-native stand-in is cooperative in-process
+sampling over ``sys._current_frames()`` — no ptrace, works in every
+worker, and emits the same collapsed-stack format flamegraph.pl /
+speedscope consume.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+
+
+def dump_stacks() -> dict:
+    """One formatted stack per live thread (py-spy ``dump`` analog)."""
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in frames.items():
+        name = names.get(ident, f"thread-{ident}")
+        out[f"{name} ({ident})"] = "".join(traceback.format_stack(frame))
+    return out
+
+
+def _folded_stack(frame) -> str:
+    parts = []
+    while frame is not None:
+        code = frame.f_code
+        parts.append(f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                     f"{code.co_name}")
+        frame = frame.f_back
+    return ";".join(reversed(parts))
+
+
+def sample_profile(duration_s: float = 2.0, hz: int = 100,
+                   exclude_thread: int | None = None) -> dict:
+    """Sample all threads for ``duration_s`` and aggregate folded stacks
+    (py-spy ``record`` analog). Returns {"folded": "stack count" lines,
+    "samples": N, "duration_s": d} — feed ``folded`` to any flamegraph
+    renderer."""
+    interval = 1.0 / max(hz, 1)
+    counts: Counter = Counter()
+    samples = 0
+    me = threading.get_ident()
+    deadline = time.monotonic() + duration_s
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me or ident == exclude_thread:
+                continue
+            counts[_folded_stack(frame)] += 1
+        samples += 1
+        time.sleep(interval)
+    folded = "\n".join(f"{stack} {n}" for stack, n in counts.most_common())
+    return {"folded": folded, "samples": samples,
+            "duration_s": duration_s}
+
+
+def host_stats(spill_dir: str | None = None) -> dict:
+    """Per-node resource sample (reference: reporter_agent.py psutil
+    collection feeding the dashboard)."""
+    try:
+        import psutil
+    except ImportError:
+        return {}
+    vm = psutil.virtual_memory()
+    out = {
+        "cpu_percent": psutil.cpu_percent(interval=None),
+        "mem_total": vm.total,
+        "mem_available": vm.available,
+        "mem_percent": vm.percent,
+        "num_threads": threading.active_count(),
+    }
+    if spill_dir:
+        try:
+            du = psutil.disk_usage(spill_dir)
+            out["spill_disk_free"] = du.free
+            out["spill_disk_percent"] = du.percent
+        except OSError:
+            pass
+    return out

@@ -109,3 +109,71 @@ def cluster_summary() -> dict:
         "resources_total": rt.cluster_resources(),
         "resources_available": rt.available_resources_snapshot(),
     }
+
+
+# ---------------------------------------------------------------------------
+# profiling / stack introspection (reference: py-spy dump/record through
+# the dashboard reporter agent, profile_manager.py:11-51 — here every
+# raylet proxies its workers' in-process samplers)
+# ---------------------------------------------------------------------------
+
+
+def dump_worker_stacks(node_id: str | None = None,
+                       worker_id: str | None = None) -> dict:
+    """Per-thread stacks of cluster workers, keyed node -> worker ->
+    thread (py-spy ``dump`` analog)."""
+    from ray_tpu.runtime.rpc import RpcClient
+
+    mode, rt = _mode()
+    if mode != "cluster":
+        from ray_tpu.util.profiling import dump_stacks
+
+        return {"local": {"driver": dump_stacks()}}
+    out = {}
+    for node in rt._gcs.call("get_nodes", alive_only=True):
+        if node_id is not None and node["node_id"] != node_id:
+            continue
+        client = None
+        try:
+            client = RpcClient(tuple(node["address"]), timeout=15)
+            out[node["node_id"]] = client.call("worker_stacks",
+                                               worker_id=worker_id)
+        except Exception as e:  # noqa: BLE001
+            out[node["node_id"]] = {"error": repr(e)}
+        finally:
+            if client is not None:
+                client.close()
+    return out
+
+
+def profile_worker(worker_id: str, *, node_id: str | None = None,
+                   duration_s: float = 2.0, hz: int = 100) -> dict:
+    """Sampling CPU profile of one worker in collapsed-stack flamegraph
+    format (py-spy ``record`` analog)."""
+    from ray_tpu.runtime.rpc import RpcClient
+
+    mode, rt = _mode()
+    if mode != "cluster":
+        raise RuntimeError("profile_worker needs a cluster runtime")
+    for node in rt._gcs.call("get_nodes", alive_only=True):
+        if node_id is not None and node["node_id"] != node_id:
+            continue
+        client = None
+        try:
+            client = RpcClient(tuple(node["address"]),
+                               timeout=duration_s + 30)
+            result = client.call("profile_worker", worker_id=worker_id,
+                                 duration_s=duration_s, hz=hz)
+        except Exception:  # noqa: BLE001
+            continue
+        finally:
+            if client is not None:
+                client.close()
+        if result.get("not_found"):
+            continue   # the worker lives on another node; keep looking
+        # genuine outcome from the owning node — success OR its real
+        # error (never swallowed into a misleading "not found")
+        result["worker_id"] = worker_id
+        result["node_id"] = node["node_id"]
+        return result
+    return {"error": f"worker {worker_id!r} not found on any live node"}

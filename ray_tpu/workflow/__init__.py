@@ -2,10 +2,16 @@
 
 ``workflow.run(dag_node, workflow_id=...)`` executes a ``ray_tpu.dag``
 graph with per-step checkpointing: each node's result is persisted under
-the workflow's storage directory keyed by a deterministic step id
-(topological index + function name). ``resume`` re-runs the DAG, skipping
-every step whose checkpoint exists — the saga-style recovery of the
-reference (``workflow_state_from_storage.py``) specialized to DAGs.
+the workflow's storage directory keyed by a CONTENT-ADDRESSED step id —
+a digest over the step's function name and its input lineage (static
+args + the ids of upstream steps). Editing the DAG therefore invalidates
+exactly the steps whose inputs changed: inserting or removing an
+unrelated step never silently remaps another step's checkpoint (the
+round-1 topological-index scheme did), and a step whose upstream chain
+changed re-runs instead of reusing a stale result. ``resume`` re-runs
+the DAG, skipping every step whose checkpoint exists — the saga-style
+recovery of the reference (``workflow_state_from_storage.py``)
+specialized to DAGs.
 """
 
 from __future__ import annotations
@@ -23,8 +29,55 @@ from ray_tpu.dag import DAGNode
 _STORAGE = os.path.join(os.path.expanduser("~"), "ray_tpu_workflows")
 
 
-def _step_id(index: int, node: DAGNode) -> str:
-    return f"{index:04d}_{getattr(node._fn, '__name__', 'step')}"
+def _arg_digest(h, value):
+    import pickle as _pickle
+    import re as _re
+
+    try:
+        h.update(_pickle.dumps(value, protocol=5))
+    except Exception:  # noqa: BLE001 - unpicklable static arg
+        # repr() embeds memory addresses ("<X at 0x7f..>") which would
+        # make the id differ every process and break resume — strip them
+        # (the residual collision risk only affects unpicklable args,
+        # which cluster execution couldn't ship anyway)
+        h.update(_re.sub(r"0x[0-9a-fA-F]+", "0x", repr(value)).encode())
+
+
+def _step_ids(order: list[DAGNode]) -> dict[int, str]:
+    """Content-addressed step ids: digest(fn qualname, static args,
+    upstream step ids). Two identical sub-DAGs share an id — and
+    therefore a checkpoint — which is sound for the deterministic steps
+    workflows assume (and dedups repeated work on resume)."""
+    import hashlib
+
+    ids: dict[int, str] = {}
+    for node in order:           # topo order: parents resolve first
+        h = hashlib.sha256()
+        fn = node._fn
+        # module + qualname alone collide (same-scope lambdas share a
+        # qualname; same-named fns exist across modules) — fold in the
+        # bytecode so different code never shares a step identity
+        h.update(getattr(fn, "__module__", "").encode())
+        h.update(getattr(fn, "__qualname__", "step").encode())
+        code = getattr(fn, "__code__", None)
+        if code is not None:
+            h.update(code.co_code)
+            _arg_digest(h, code.co_consts)
+        for a in node._args:
+            if isinstance(a, DAGNode):
+                h.update(ids[id(a)].encode())
+            else:
+                _arg_digest(h, a)
+        for k in sorted(node._kwargs):
+            v = node._kwargs[k]
+            h.update(k.encode())
+            if isinstance(v, DAGNode):
+                h.update(ids[id(v)].encode())
+            else:
+                _arg_digest(h, v)
+        name = getattr(node._fn, "__name__", "step")
+        ids[id(node)] = f"{name}-{h.hexdigest()[:16]}"
+    return ids
 
 
 def _run_step(node: DAGNode, args, kwargs):
@@ -65,11 +118,12 @@ def run(dag: DAGNode, *, workflow_id: str,
     root = os.path.join(storage or _STORAGE, workflow_id)
     os.makedirs(root, exist_ok=True)
     order = dag.topo_order()
+    step_ids = _step_ids(order)
     results: dict[int, object] = {}
     final = None
     try:
-        for index, node in enumerate(order):
-            path = os.path.join(root, _step_id(index, node) + ".pkl")
+        for node in order:
+            path = os.path.join(root, step_ids[id(node)] + ".pkl")
             if os.path.exists(path):
                 with open(path, "rb") as f:
                     results[id(node)] = pickle.load(f)

@@ -1,0 +1,109 @@
+"""Per-node observability: worker stack dumps, sampling profiles, host
+stats (reference: the dashboard reporter agent + py-spy integration —
+``dashboard/modules/reporter/profile_manager.py:11-51``)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import state as state_api
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_sampler_unit():
+    from ray_tpu.util.profiling import dump_stacks, sample_profile
+
+    stacks = dump_stacks()
+    assert any("MainThread" in k for k in stacks)
+    assert any("test_sampler_unit" in v for v in stacks.values())
+
+    prof = sample_profile(duration_s=0.3, hz=50)
+    assert prof["samples"] > 5
+    assert "profiling.py:sample_profile" not in prof["folded"]
+
+
+def test_host_stats_unit(tmp_path):
+    from ray_tpu.util.profiling import host_stats
+
+    stats = host_stats(str(tmp_path))
+    assert stats["mem_total"] > 0
+    assert "spill_disk_free" in stats
+
+
+def test_worker_stacks_via_state_api(cluster):
+    @ray_tpu.remote
+    def busy_beaver():
+        time.sleep(8)
+        return "done"
+
+    ref = busy_beaver.remote()
+    time.sleep(1.0)   # worker is now inside time.sleep
+    stacks = state_api.dump_worker_stacks()
+    flat = json.dumps(stacks)
+    assert "busy_beaver" in flat, f"task frame missing: {flat[:500]}"
+    ray_tpu.cancel(ref, force=True)
+
+
+def test_profile_worker_flamegraph(cluster):
+    @ray_tpu.remote
+    def spin(seconds):
+        t0 = time.monotonic()
+        n = 0
+        while time.monotonic() - t0 < seconds:
+            n += 1
+        return n
+
+    ref = spin.remote(6)
+    time.sleep(0.8)
+    workers = state_api.dump_worker_stacks()
+    node_id, per_worker = next(iter(workers.items()))
+    victim = next(w for w, s in per_worker.items()
+                  if "spin" in json.dumps(s))
+    prof = state_api.profile_worker(victim, duration_s=1.0, hz=100)
+    assert prof.get("samples", 0) > 10, prof
+    assert "spin" in prof["folded"]
+    assert ray_tpu.get(ref, timeout=30) > 0
+
+
+def test_heartbeat_carries_host_stats(cluster):
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        nodes = state_api.list_nodes()
+        if nodes and nodes[0].get("host_stats", {}).get("mem_total"):
+            return
+        time.sleep(0.25)
+    raise AssertionError(f"no host stats in node table: {nodes}")
+
+
+def test_dashboard_stacks_endpoint(cluster):
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def napper():
+        time.sleep(6)
+
+    ref = napper.remote()
+    time.sleep(1.0)
+    dash = start_dashboard()
+    try:
+        with urllib.request.urlopen(dash.url + "/api/stacks",
+                                    timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert "napper" in json.dumps(body)
+    finally:
+        stop_dashboard()
+        ray_tpu.cancel(ref, force=True)
