@@ -105,10 +105,163 @@ def _ring_body(axis_name: str, n: int, scale: float, causal: bool,
     return o.astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Fused Pallas ring: per-step flash kernels for BOTH directions.
+#
+# Forward: each ring step runs the flash forward kernel (with LSE out) on
+# the resident KV chunk; partial results merge online exactly like the
+# reference-math path. Backward is a custom VJP implementing the ring
+# itself: the flash backward kernels recompute P from the FINAL merged
+# lse (the blockwise-global form — no per-step dlse term exists), dq
+# accumulates locally, and (k, v, dk, dv) travel the ring together so a
+# chunk's grads come home after n hops. Memory stays O(s/n) per device;
+# every matmul is an MXU-tiled Pallas block.
+# ---------------------------------------------------------------------------
+
+
+def _ring_flash_steps(qt, k0, v0, axis_name, n, scale, causal, blocks,
+                      interpret):
+    """Forward ring in kernel layout [b, h, s, d]; returns (o f32, lse
+    f32 [b,h,s])."""
+    from ray_tpu.ops.flash_attention import _fit_block, _flash_fwd
+
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    sc = qt.shape[2]
+    bq = _fit_block(sc, blocks[0])
+    bk = _fit_block(sc, blocks[1])
+
+    # r = 0: the diagonal chunk — STATICALLY causal (kernel-level mask)
+    o, lse8 = _flash_fwd(qt, k0, v0, scale=scale, causal=causal,
+                         block_q=bq, block_k=bk, interpret=interpret,
+                         with_lse=True)
+    o = o.astype(jnp.float32)
+    lse = lse8[..., 0]
+    k = lax.ppermute(k0, axis_name, perm)
+    v = lax.ppermute(v0, axis_name, perm)
+
+    def step(carry, r):
+        o, lse, k, v = carry
+
+        def attend(_):
+            o_r, lse_r = _flash_fwd(qt, k, v, scale=scale, causal=False,
+                                    block_q=bq, block_k=bk,
+                                    interpret=interpret, with_lse=True)
+            return o_r.astype(jnp.float32), lse_r[..., 0]
+
+        def skip(_):
+            return (jnp.zeros_like(o),
+                    jnp.full_like(lse, -jnp.inf))
+
+        if causal:
+            # chunk from src=(idx-r)%n precedes my queries iff idx >= r
+            o_r, lse_r = lax.cond(idx >= r, attend, skip, None)
+        else:
+            o_r, lse_r = attend(None)
+        o, lse = _merge(o, lse, o_r, lse_r)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return (o, lse, k, v), None
+
+    if n > 1:
+        (o, lse, _, _), _ = lax.scan(step, (o, lse, k, v),
+                                     jnp.arange(1, n))
+    return o, lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(qt, k0, v0, axis_name, n, scale, causal, blocks,
+                interpret):
+    o, _ = _ring_flash_steps(qt, k0, v0, axis_name, n, scale, causal,
+                             blocks, interpret)
+    return o.astype(qt.dtype)
+
+
+def _ring_flash_vjp_fwd(qt, k0, v0, axis_name, n, scale, causal, blocks,
+                        interpret):
+    o, lse = _ring_flash_steps(qt, k0, v0, axis_name, n, scale, causal,
+                               blocks, interpret)
+    o = o.astype(qt.dtype)
+    return o, (qt, k0, v0, o, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, n, scale, causal, blocks, interpret,
+                        res, do):
+    from ray_tpu.ops.flash_attention import _fit_block, _flash_bwd
+
+    qt, k0, v0, o, lse = res
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    sc = qt.shape[2]
+    bq = _fit_block(sc, blocks[0])
+    bk = _fit_block(sc, blocks[1])
+    lse8 = jnp.broadcast_to(lse[..., None], (*lse.shape, 8))
+    do = do.astype(qt.dtype)
+
+    # r = 0: own (diagonal) chunk, statically causal kernels
+    dq_acc, dk, dv = _flash_bwd(qt, k0, v0, o, lse8, do, scale=scale,
+                                causal=causal, block_q=bq, block_k=bk,
+                                interpret=interpret)
+    dq_acc = dq_acc.astype(jnp.float32)
+    # (k, v, dk, dv) ride the ring together: after n hops each chunk's
+    # accumulated grads are home
+    k = lax.ppermute(k0, axis_name, perm)
+    v = lax.ppermute(v0, axis_name, perm)
+    dk = lax.ppermute(dk.astype(jnp.float32), axis_name, perm)
+    dv = lax.ppermute(dv.astype(jnp.float32), axis_name, perm)
+
+    def step(carry, r):
+        dq_acc, k, v, dk, dv = carry
+
+        def compute(_):
+            dq_r, dk_r, dv_r = _flash_bwd(
+                qt, k, v, o, lse8, do, scale=scale, causal=False,
+                block_q=bq, block_k=bk, interpret=interpret)
+            return (dq_r.astype(jnp.float32), dk_r.astype(jnp.float32),
+                    dv_r.astype(jnp.float32))
+
+        def skip(_):
+            return (jnp.zeros_like(dq_acc), jnp.zeros_like(dk),
+                    jnp.zeros_like(dv))
+
+        if causal:
+            dq_r, dk_r, dv_r = lax.cond(idx >= r, compute, skip, None)
+        else:
+            dq_r, dk_r, dv_r = compute(None)
+        dq_acc = dq_acc + dq_r
+        dk = lax.ppermute(dk + dk_r, axis_name, perm)
+        dv = lax.ppermute(dv + dv_r, axis_name, perm)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return (dq_acc, k, v, dk, dv), None
+
+    if n > 1:
+        (dq_acc, _, _, dk, dv), _ = lax.scan(
+            step, (dq_acc, k, v, dk, dv), jnp.arange(1, n))
+    return (dq_acc.astype(qt.dtype), dk.astype(k0.dtype),
+            dv.astype(v0.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def _ring_flash_body(axis_name, n, scale, causal, blocks, interpret,
+                     q, k0, v0):
+    """shard_map body adapter: [b, sc, h, d] boundary layout <-> the
+    kernels' [b, h, s, d]."""
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k0.transpose(0, 2, 1, 3)
+    vt = v0.transpose(0, 2, 1, 3)
+    out = _ring_flash(qt, kt, vt, axis_name, n, scale, causal, blocks,
+                      interpret)
+    return out.transpose(0, 2, 1, 3)
+
+
 def ring_attention(
     q, k, v, *, mesh: Mesh, axis: str = "sp", causal: bool = True,
     scale: float | None = None, batch_axes=("dp", "fsdp"),
-    head_axis: str = "tp",
+    head_axis: str = "tp", impl: str = "auto",
+    block_q: int = 512, block_k: int = 1024,
 ):
     """Exact attention with the sequence axis sharded over ``axis``.
 
@@ -145,7 +298,19 @@ def ring_attention(
         else None
     )
 
-    body = partial(_ring_body, axis, n, scale, causal)
+    if impl not in ("auto", "flash", "reference"):
+        raise ValueError(
+            f"ring_attention impl must be 'auto', 'flash' or 'reference', "
+            f"got {impl!r}")
+    use_flash = impl == "flash" or (
+        impl == "auto" and jax.devices()[0].platform == "tpu")
+    if use_flash:
+        # interpret-mode keeps the fused path testable off-TPU
+        interpret = jax.devices()[0].platform != "tpu"
+        body = partial(_ring_flash_body, axis, n, scale, causal,
+                       (block_q, block_k), interpret)
+    else:
+        body = partial(_ring_body, axis, n, scale, causal)
     spec = P(b_ax or None, axis, h_ax, None)
     fn = jax.shard_map(
         body, mesh=mesh,

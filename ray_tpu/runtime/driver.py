@@ -677,7 +677,9 @@ class ClusterRuntime:
         # waits on forever (stalling every later call). Queue a noop
         # gap-filler: the reaper keeps sending it until it lands or the
         # actor moves to a new incarnation (which resets numbering).
-        if not task.get("noop"):
+        # (No "seq" in the task means the failure hit BEFORE numbering —
+        # nothing was consumed, no gap exists.)
+        if not task.get("noop") and "seq" in task:
             filler = {"actor_id": actor_hex, "caller_id": self.caller_id,
                       "task_id": task.get("task_id", ""),
                       "method_name": "", "args_blob": b"",

@@ -129,20 +129,33 @@ def dump_worker_stacks(node_id: str | None = None,
         from ray_tpu.util.profiling import dump_stacks
 
         return {"local": {"driver": dump_stacks()}}
+    import threading
+
     out = {}
-    for node in rt._gcs.call("get_nodes", alive_only=True):
-        if node_id is not None and node["node_id"] != node_id:
-            continue
+    out_lock = threading.Lock()
+
+    def query(node):
         client = None
         try:
             client = RpcClient(tuple(node["address"]), timeout=15)
-            out[node["node_id"]] = client.call("worker_stacks",
-                                               worker_id=worker_id)
+            stacks = client.call("worker_stacks", worker_id=worker_id)
         except Exception as e:  # noqa: BLE001
-            out[node["node_id"]] = {"error": repr(e)}
+            stacks = {"error": repr(e)}
         finally:
             if client is not None:
                 client.close()
+        with out_lock:
+            out[node["node_id"]] = stacks
+
+    # fan out per node (one unresponsive raylet must not serialize the
+    # whole cluster dump behind its timeout)
+    threads = [threading.Thread(target=query, args=(n,), daemon=True)
+               for n in rt._gcs.call("get_nodes", alive_only=True)
+               if node_id is None or n["node_id"] == node_id]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
     return out
 
 
@@ -155,6 +168,7 @@ def profile_worker(worker_id: str, *, node_id: str | None = None,
     mode, rt = _mode()
     if mode != "cluster":
         raise RuntimeError("profile_worker needs a cluster runtime")
+    transport_errors = {}
     for node in rt._gcs.call("get_nodes", alive_only=True):
         if node_id is not None and node["node_id"] != node_id:
             continue
@@ -164,7 +178,9 @@ def profile_worker(worker_id: str, *, node_id: str | None = None,
                                timeout=duration_s + 30)
             result = client.call("profile_worker", worker_id=worker_id,
                                  duration_s=duration_s, hz=hz)
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 - node may not own it;
+            # remember the failure so it is not misreported as not-found
+            transport_errors[node["node_id"]] = repr(e)
             continue
         finally:
             if client is not None:
@@ -176,4 +192,7 @@ def profile_worker(worker_id: str, *, node_id: str | None = None,
         result["worker_id"] = worker_id
         result["node_id"] = node["node_id"]
         return result
+    if transport_errors:
+        return {"error": f"profiling {worker_id!r} failed",
+                "node_errors": transport_errors}
     return {"error": f"worker {worker_id!r} not found on any live node"}

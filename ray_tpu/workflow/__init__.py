@@ -33,6 +33,14 @@ def _arg_digest(h, value):
     import pickle as _pickle
     import re as _re
 
+    # sets pickle in iteration order, which string-hash randomization
+    # reshuffles per process — canonicalize so the SAME set always
+    # digests the same and resume finds its checkpoints
+    if isinstance(value, (set, frozenset)):
+        h.update(b"set:")
+        for item in sorted(value, key=repr):
+            _arg_digest(h, item)
+        return
     try:
         h.update(_pickle.dumps(value, protocol=5))
     except Exception:  # noqa: BLE001 - unpicklable static arg
@@ -55,14 +63,22 @@ def _step_ids(order: list[DAGNode]) -> dict[int, str]:
         h = hashlib.sha256()
         fn = node._fn
         # module + qualname alone collide (same-scope lambdas share a
-        # qualname; same-named fns exist across modules) — fold in the
-        # bytecode so different code never shares a step identity
+        # qualname; same-named fns exist across modules). cloudpickle
+        # serializes the function BY VALUE — bytecode plus captured
+        # closure cells, default args, and referenced globals — so
+        # editing any of those changes the step's identity and the stale
+        # checkpoint is correctly invalidated.
         h.update(getattr(fn, "__module__", "").encode())
         h.update(getattr(fn, "__qualname__", "step").encode())
-        code = getattr(fn, "__code__", None)
-        if code is not None:
-            h.update(code.co_code)
-            _arg_digest(h, code.co_consts)
+        try:
+            import cloudpickle as _cp
+
+            h.update(_cp.dumps(fn, protocol=5))
+        except Exception:  # noqa: BLE001 - fall back to bytecode identity
+            code = getattr(fn, "__code__", None)
+            if code is not None:
+                h.update(code.co_code)
+                _arg_digest(h, code.co_consts)
         for a in node._args:
             if isinstance(a, DAGNode):
                 h.update(ids[id(a)].encode())

@@ -37,6 +37,7 @@ def test_sampler_unit():
 
 
 def test_host_stats_unit(tmp_path):
+    pytest.importorskip("psutil")
     from ray_tpu.util.profiling import host_stats
 
     stats = host_stats(str(tmp_path))
@@ -80,6 +81,7 @@ def test_profile_worker_flamegraph(cluster):
 
 
 def test_heartbeat_carries_host_stats(cluster):
+    pytest.importorskip("psutil")
     deadline = time.monotonic() + 10
     while time.monotonic() < deadline:
         nodes = state_api.list_nodes()

@@ -112,10 +112,13 @@ def test_step_ids_are_content_addressed(tmp_path, ray_tpu_start):
     import ray_tpu.workflow as workflow
     from ray_tpu.dag import DAGNode
 
-    calls = {"expensive": 0}
+    # counting via a FILE: a closed-over counter would itself change the
+    # function's content-addressed identity (captured state is hashed)
+    marker = str(tmp_path / "expensive_calls")
 
-    def expensive(x):
-        calls["expensive"] += 1
+    def expensive(x, _marker=marker):
+        with open(_marker, "a") as f:
+            f.write("x")
         return x * 10
 
     def cheap(x):
@@ -124,10 +127,14 @@ def test_step_ids_are_content_addressed(tmp_path, ray_tpu_start):
     def combine(a, b=0):
         return a + b
 
+    def n_calls():
+        import os
+        return os.path.getsize(marker) if os.path.exists(marker) else 0
+
     store = str(tmp_path)
     dag1 = DAGNode(combine, (DAGNode(expensive, (4,), {}),), {})
     assert workflow.run(dag1, workflow_id="wf_ca", storage=store) == 40
-    assert calls["expensive"] == 1
+    assert n_calls() == 1
 
     # edited DAG: a NEW unrelated step joins; `expensive(4)` keeps its
     # identity and its checkpoint is reused, not remapped or re-run
@@ -135,9 +142,9 @@ def test_step_ids_are_content_addressed(tmp_path, ray_tpu_start):
                    (DAGNode(expensive, (4,), {}),),
                    {"b": DAGNode(cheap, (1,), {})})
     assert workflow.run(dag2, workflow_id="wf_ca", storage=store) == 42
-    assert calls["expensive"] == 1, "checkpoint was not reused"
+    assert n_calls() == 1, "checkpoint was not reused"
 
     # changing a step's INPUT changes its id -> it re-runs
     dag3 = DAGNode(combine, (DAGNode(expensive, (5,), {}),), {})
     assert workflow.run(dag3, workflow_id="wf_ca", storage=store) == 50
-    assert calls["expensive"] == 2
+    assert n_calls() == 2
