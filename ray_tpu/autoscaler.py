@@ -57,6 +57,103 @@ class LocalNodeProvider(NodeProvider):
         return list(self.created)
 
 
+class GKETPUNodeProvider(NodeProvider):
+    """GKE TPU node-pool provider (reference: the cloud NodeProvider
+    plugins — ``autoscaler/_private/gcp/`` and the kuberay
+    batching_node_provider): scale-up resizes a dedicated TPU node pool,
+    scale-down deletes specific nodes from it.
+
+    Contract: raylets on the pool's VMs start with
+    ``RAY_TPU_NODE_ID=<kubernetes node name>`` (the pool's startup
+    DaemonSet sets it), so provider node ids line up with GCS node ids
+    and the autoscaler's reap/idle bookkeeping works unchanged.
+
+    All cloud interaction shells out to ``gcloud``/``kubectl`` through
+    an injectable ``runner`` (tests fake it; real use needs credentials
+    on the head node).
+    """
+
+    LIST_CACHE_TTL_S = 1.0   # one kubectl listing per autoscaler tick,
+                             # not one per call site within the tick
+
+    def __init__(self, *, cluster: str, node_pool: str, zone: str,
+                 project: str | None = None, runner=None):
+        self.cluster = cluster
+        self.node_pool = node_pool
+        self.zone = zone
+        self.project = project
+        self._run = runner or self._subprocess_runner
+        self._listed_at = 0.0
+        self._listed: list[str] = []
+
+    @staticmethod
+    def _subprocess_runner(argv: list[str]) -> str:
+        import subprocess
+
+        return subprocess.run(argv, check=True, capture_output=True,
+                              text=True, timeout=600).stdout
+
+    def _gcloud(self, *args) -> str:
+        argv = ["gcloud", *args, f"--zone={self.zone}", "--quiet"]
+        if self.project:
+            argv.append(f"--project={self.project}")
+        return self._run(argv)
+
+    def create_node(self, resources: dict) -> str:
+        target = len(self.non_terminated_nodes()) + 1
+        self._gcloud("container", "clusters", "resize", self.cluster,
+                     f"--node-pool={self.node_pool}",
+                     f"--num-nodes={target}")
+        self._listed_at = 0.0   # force a fresh listing next call
+        # GKE provisions asynchronously over minutes: the new VM has no
+        # name yet. The autoscaler tracks membership via
+        # non_terminated_nodes(), not this return value (the raylet on
+        # the VM self-registers with RAY_TPU_NODE_ID=<k8s node name>).
+        return ""
+
+    def terminate_node(self, node_id: str) -> None:
+        # drain best-effort (an unreachable/crashed VM fails the drain;
+        # the VM delete below must still run or dead nodes wedge the
+        # autoscaler's reap forever)
+        try:
+            self._run(["kubectl", "drain", node_id,
+                       "--ignore-daemonsets", "--delete-emptydir-data",
+                       "--force", "--timeout=120s"])
+        except Exception:  # noqa: BLE001
+            pass
+        # removing a SPECIFIC VM from a pool = delete it from the pool's
+        # managed instance group (there is no gcloud node-pools
+        # delete-nodes); the MIG url comes from the pool description
+        mig_urls = self._gcloud(
+            "container", "node-pools", "describe", self.node_pool,
+            f"--cluster={self.cluster}",
+            "--format=value(instanceGroupUrls)")
+        for url in mig_urls.replace(";", "\n").split():
+            mig = url.rstrip("/").rsplit("/", 1)[-1]
+            if not mig:
+                continue
+            try:
+                self._gcloud("compute", "instance-groups", "managed",
+                             "delete-instances", mig,
+                             f"--instances={node_id}")
+                break
+            except Exception:  # noqa: BLE001 - wrong MIG for this VM
+                continue
+        self._listed_at = 0.0
+
+    def non_terminated_nodes(self) -> list[str]:
+        now = time.monotonic()
+        if now - self._listed_at < self.LIST_CACHE_TTL_S:
+            return list(self._listed)
+        out = self._run([
+            "kubectl", "get", "nodes",
+            "-l", f"cloud.google.com/gke-nodepool={self.node_pool}",
+            "-o", "jsonpath={.items[*].metadata.name}"])
+        self._listed = [n for n in out.split() if n]
+        self._listed_at = now
+        return list(self._listed)
+
+
 class StandardAutoscaler:
     """Scale up when the cluster cannot satisfy demand; scale down idle
     provider nodes after ``idle_timeout_s``."""

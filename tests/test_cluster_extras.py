@@ -233,3 +233,47 @@ def test_slice_pack_placement_group():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_gke_tpu_node_provider_command_shapes():
+    """GKETPUNodeProvider drives gcloud/kubectl with the right shapes
+    (runner injected — no cloud in CI; reference: cloud NodeProvider
+    plugins)."""
+    from ray_tpu.autoscaler import GKETPUNodeProvider
+
+    calls = []
+    pool_nodes = ["gke-tpu-a"]
+
+    def fake_runner(argv):
+        calls.append(argv)
+        if argv[0] == "kubectl" and argv[1] == "get":
+            return " ".join(pool_nodes)
+        if argv[:3] == ["gcloud", "container", "clusters"]:
+            pool_nodes.append(f"gke-tpu-{chr(ord('a') + len(pool_nodes))}")
+            return ""
+        if "describe" in argv:
+            return ("https://www.googleapis.com/compute/v1/projects/proj/"
+                    "zones/us-central2-b/instanceGroupManagers/mig-tpu-1")
+        if argv[:2] == ["kubectl", "drain"]:
+            raise RuntimeError("node unreachable")   # reap must survive
+        return ""
+
+    p = GKETPUNodeProvider(cluster="c1", node_pool="tpu-pool",
+                          zone="us-central2-b", project="proj",
+                          runner=fake_runner)
+    assert p.non_terminated_nodes() == ["gke-tpu-a"]
+
+    assert p.create_node({"TPU": 4}) == ""   # async provisioning
+    resize = next(c for c in calls if "resize" in c)
+    assert "--node-pool=tpu-pool" in resize
+    assert "--num-nodes=2" in resize
+    assert "--zone=us-central2-b" in resize
+    assert "--project=proj" in resize
+    assert p.non_terminated_nodes() == ["gke-tpu-a", "gke-tpu-b"]
+
+    p.terminate_node("gke-tpu-b")
+    drain = next(c for c in calls if c[:2] == ["kubectl", "drain"])
+    assert "gke-tpu-b" in drain               # attempted (and failed) drain
+    delete = next(c for c in calls if "delete-instances" in c)
+    assert "mig-tpu-1" in delete
+    assert "--instances=gke-tpu-b" in delete
