@@ -422,7 +422,7 @@ def _trace_ctx(function_name: str):
 
 def _normalize_runtime_env(env):
     """Accept RuntimeEnv or plain dict; validate dicts through RuntimeEnv
-    so unsupported fields (pip/conda) fail at submission, not on the
+    so unsupported fields (conda/container) fail at submission, not on the
     worker."""
     if env is None:
         return None

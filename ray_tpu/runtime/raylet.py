@@ -146,6 +146,9 @@ class Raylet(RpcServer):
         # why recent workers died, queried by lease owners on break
         # (bounded FIFO; reference: worker exit detail in death reports)
         self._death_info: dict[str, dict] = {}
+        # env_key -> (error, when): envs whose setup failed — tasks fail
+        # fast instead of driving a spawn/install/crash loop
+        self._bad_envs: dict[str, tuple] = {}
         # buffered object-location registrations (batched to the GCS)
         self._loc_buf: list[tuple[str, int]] = []
         self._loc_cv = threading.Condition()
@@ -325,6 +328,45 @@ class Raylet(RpcServer):
         with self._workers_lock:
             self._workers[worker_id] = handle
         return handle
+
+    BAD_ENV_TTL_S = 60.0
+
+    def rpc_runtime_env_failed(self, conn, send_lock, *, key: str,
+                               error: str):
+        """A worker died setting up its runtime env (e.g. pip install
+        failure): fail every queued task with that env NOW and stop
+        respawning workers for it for a while — otherwise the queue
+        drives an infinite spawn/install/crash loop with the real error
+        trapped in worker stderr."""
+        from ray_tpu.runtime_env import env_key as _env_key
+        from ray_tpu.utils import exceptions as exc
+
+        self._bad_envs[key] = (error, time.monotonic())
+        doomed = []
+        with self._ready_cv:
+            keep = deque()
+            while self._ready:
+                task = self._ready.popleft()
+                if _env_key(task.get("runtime_env")) == key:
+                    doomed.append(task)
+                else:
+                    keep.append(task)
+            self._ready = keep
+        for task in doomed:
+            self._store_task_error(task, exc.RuntimeEnvSetupError(
+                f"runtime env setup failed: {error}"))
+        return {"failed_tasks": len(doomed)}
+
+    def _bad_env_error(self, runtime_env) -> str | None:
+        from ray_tpu.runtime_env import env_key as _env_key
+
+        hit = self._bad_envs.get(_env_key(runtime_env))
+        if hit is None:
+            return None
+        error, at = hit
+        if time.monotonic() - at > self.BAD_ENV_TTL_S:
+            return None   # stale: the env may be fixable (cache purged)
+        return error
 
     def rpc_register_worker(self, conn, send_lock, *, worker_id,
                             push_addr=None):
@@ -647,6 +689,12 @@ class Raylet(RpcServer):
                     if self._dispatch_gen == gen0 and not self._stopping:
                         self._ready_cv.wait(timeout=0.1)
                 continue
+            env_err = self._bad_env_error(task.get("runtime_env"))
+            if env_err is not None:
+                from ray_tpu.utils import exceptions as exc
+                self._store_task_error(task, exc.RuntimeEnvSetupError(
+                    f"runtime env setup failed: {env_err}"))
+                continue
             gen = self._dispatch_gen
             worker = self._idle_worker(task.get("runtime_env"))
             if worker is None:
@@ -775,7 +823,10 @@ class Raylet(RpcServer):
         handle.acquired = dict(demand)
 
         def _deliver():
-            deadline = time.monotonic() + 30
+            # pip envs legitimately take minutes on a cold cache: give
+            # the worker's registration the install window, not 30s
+            renv = (spec.get("runtime_env") or {})
+            deadline = time.monotonic() + (900 if renv.get("pip") else 30)
             while time.monotonic() < deadline and not self._stopping:
                 if handle.conn is not None:
                     try:
@@ -1478,6 +1529,17 @@ class Raylet(RpcServer):
                 if not self._lease_waiters:
                     return
                 waiter = self._lease_waiters[0]
+            env_err = self._bad_env_error(waiter["runtime_env"])
+            if env_err is not None:
+                with self._ready_cv:
+                    try:
+                        self._lease_waiters.remove(waiter)
+                    except ValueError:
+                        continue
+                waiter["result"] = {"infeasible": True,
+                                    "env_error": env_err}
+                waiter["event"].set()
+                continue
             worker = self._idle_worker(waiter["runtime_env"])
             if worker is None:
                 return  # spawn in progress / pool exhausted; kick revisits

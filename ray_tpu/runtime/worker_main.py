@@ -125,9 +125,24 @@ class Worker:
         if renv_raw:
             import json as _json
 
-            from ray_tpu.runtime_env import apply_runtime_env
+            from ray_tpu.runtime_env import apply_runtime_env, env_key
 
-            apply_runtime_env(_json.loads(renv_raw))
+            renv = _json.loads(renv_raw)
+            try:
+                apply_runtime_env(renv)
+            except Exception as e:  # noqa: BLE001
+                # tell the raylet WHY before dying: otherwise the queued
+                # task respawns a fresh worker that re-fails the same
+                # install forever, and the error never leaves stderr
+                try:
+                    failer = RpcClient((os.environ["RAY_TPU_RAYLET_HOST"],
+                                        int(os.environ["RAY_TPU_RAYLET_PORT"])))
+                    failer.call("runtime_env_failed",
+                                key=env_key(renv), error=repr(e))
+                    failer.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
         host = os.environ["RAY_TPU_RAYLET_HOST"]
         port = int(os.environ["RAY_TPU_RAYLET_PORT"])
         self.worker_id = os.environ["RAY_TPU_WORKER_ID"]

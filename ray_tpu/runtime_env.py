@@ -10,13 +10,15 @@ per-node agent). Supported fields:
   and put it on ``sys.path``.
 - ``py_modules``: list of module directories/files added to ``sys.path``
   (cached the same way).
+- ``pip``: list of requirement strings (or ``{"packages": [...]}``) —
+  installed once per requirement set into a cached virtualenv with
+  system-site passthrough (the ``_private/runtime_env/pip.py`` analog);
+  workers activate it by prepending its site-packages to ``sys.path``.
 - ``config``: opaque dict passed through (reference parity; e.g.
   ``{"setup_timeout_seconds": ...}``).
 
-``pip``/``conda`` are intentionally rejected here: this image forbids
-package installation, so the field is validated out loudly rather than
-silently ignored (reference behavior is to build an env — see
-``_private/runtime_env/pip.py``).
+``conda``/``container`` are rejected loudly (no conda/docker in the
+image) rather than silently ignored.
 
 Workers are cached per runtime-env key exactly like the reference's
 (language, runtime_env)-keyed worker pool (``worker_pool.cc``): tasks
@@ -31,7 +33,7 @@ import os
 import shutil
 from typing import Any
 
-_UNSUPPORTED = ("pip", "conda", "container")
+_UNSUPPORTED = ("conda", "container")
 
 
 class RuntimeEnv(dict):
@@ -40,13 +42,14 @@ class RuntimeEnv(dict):
     def __init__(self, *, env_vars: dict | None = None,
                  working_dir: str | None = None,
                  py_modules: list | None = None,
+                 pip: list | dict | None = None,
                  config: dict | None = None, **kwargs):
         for k in _UNSUPPORTED:
             if k in kwargs:
                 raise ValueError(
                     f"runtime_env field {k!r} is not supported in this "
-                    "environment (package installation is disabled); "
-                    "pre-bake dependencies into the image instead")
+                    "environment (use 'pip' for per-env packages, or "
+                    "pre-bake dependencies into the image)")
         if kwargs:
             raise ValueError(f"unknown runtime_env fields: {list(kwargs)}")
         body: dict[str, Any] = {}
@@ -62,6 +65,22 @@ class RuntimeEnv(dict):
             body["working_dir"] = os.path.abspath(working_dir)
         if py_modules:
             body["py_modules"] = [os.path.abspath(p) for p in py_modules]
+        if pip:
+            reqs = pip.get("packages") if isinstance(pip, dict) else pip
+            if not (isinstance(reqs, list)
+                    and all(isinstance(r, str) for r in reqs)):
+                raise TypeError(
+                    "pip must be a list of requirement strings or "
+                    "{'packages': [...]}")
+            # local-path requirements resolve against the DRIVER's cwd
+            # (like working_dir/py_modules) and keep the cache key from
+            # aliasing two different './pkg' paths to one venv
+            body["pip"] = [
+                os.path.abspath(r)
+                if (r.startswith((".", "/", "~")) or os.path.exists(r))
+                else r
+                for r in reqs
+            ]
         if config:
             body["config"] = dict(config)
         super().__init__(body)
@@ -129,6 +148,77 @@ def snapshot_dir(path: str) -> str:
     return dest
 
 
+# ---------------------------------------------------------------------------
+# pip plugin (reference: _private/runtime_env/pip.py — per-env virtualenv
+# with the requirement set as its identity; here venv + system site
+# packages so the baked-in jax stack stays visible underneath)
+# ---------------------------------------------------------------------------
+
+def _pip_env_key(reqs: list[str]) -> str:
+    import sys
+
+    ident = json.dumps([f"py{sys.version_info[0]}.{sys.version_info[1]}",
+                        reqs])
+    return hashlib.sha256(ident.encode()).hexdigest()[:16]
+
+
+def _venv_site_packages(venv_dir: str) -> str:
+    import glob
+
+    hits = glob.glob(os.path.join(venv_dir, "lib", "python*",
+                                  "site-packages"))
+    if not hits:
+        raise FileNotFoundError(f"no site-packages under {venv_dir}")
+    return hits[0]
+
+
+def ensure_pip_env(reqs: list[str]) -> str:
+    """Create (once, cached by requirement set) a venv with the packages
+    installed; returns its site-packages path. Cross-process safe via an
+    exclusive lock; ``--system-site-packages`` keeps the image's baked
+    stack importable beneath the env's additions."""
+    import fcntl
+    import subprocess
+    import sys
+
+    root = os.path.join(_cache_root(), "venvs")
+    os.makedirs(root, exist_ok=True)
+    dest = os.path.join(root, _pip_env_key(reqs))
+    ready = os.path.join(dest, ".ray_tpu_ready")
+    if os.path.exists(ready):
+        return _venv_site_packages(dest)
+    with open(dest + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if os.path.exists(ready):   # another process built it meanwhile
+            return _venv_site_packages(dest)
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages", dest],
+            check=True, capture_output=True, timeout=300)
+        base = [os.path.join(dest, "bin", "python"), "-m", "pip",
+                "install", "--no-input", "--quiet"]
+        last = None
+        # second attempt disables build isolation: air-gapped hosts can
+        # still install local sdists/paths using the system setuptools
+        # (build isolation wants to DOWNLOAD its build backend)
+        for extra in ((), ("--no-build-isolation",)):
+            try:
+                subprocess.run([*base, *extra, *reqs], check=True,
+                               capture_output=True, text=True,
+                               timeout=1800)
+                last = None
+                break
+            except subprocess.CalledProcessError as e:
+                last = e
+        if last is not None:
+            shutil.rmtree(dest, ignore_errors=True)
+            raise RuntimeError(
+                f"runtime_env pip install failed for {reqs}: "
+                f"{last.stderr[-2000:] if last.stderr else last}") \
+                from None
+        open(ready, "w").close()
+    return _venv_site_packages(dest)
+
+
 def apply_runtime_env(runtime_env: dict | None) -> None:
     """Apply an env in-place to THIS process (worker boot path —
     reference: runtime-env agent's GetOrCreateRuntimeEnv result applied
@@ -139,6 +229,15 @@ def apply_runtime_env(runtime_env: dict | None) -> None:
         return
     for k, v in (runtime_env.get("env_vars") or {}).items():
         os.environ[k] = v
+    reqs = runtime_env.get("pip")
+    if reqs:
+        import sys
+
+        site = ensure_pip_env(list(reqs))
+        if site not in sys.path:
+            # FRONT of sys.path: the env's packages shadow same-named
+            # system packages, venv-activation style
+            sys.path.insert(0, site)
     wd = runtime_env.get("working_dir")
     if wd:
         snap = snapshot_dir(wd)
@@ -171,6 +270,13 @@ def apply_paths(runtime_env: dict | None) -> None:
 
     if not runtime_env:
         return
+    reqs = (runtime_env or {}).get("pip")
+    if reqs:
+        import sys
+
+        site = ensure_pip_env(list(reqs))
+        if site not in sys.path:
+            sys.path.insert(0, site)
     key = env_key(runtime_env)
     if key in _applied_path_keys:
         return

@@ -12,8 +12,7 @@ from ray_tpu.runtime_env import RuntimeEnv, env_key, snapshot_dir
 
 
 def test_runtime_env_validation(tmp_path):
-    with pytest.raises(ValueError):
-        RuntimeEnv(pip=["requests"])
+    assert RuntimeEnv(pip=["requests"]) == {"pip": ["requests"]}
     with pytest.raises(ValueError):
         RuntimeEnv(conda="env.yaml")
     with pytest.raises(ValueError):
@@ -91,7 +90,7 @@ def test_unsupported_field_fails_at_submit(ray_tpu_start):
         return 1
 
     with pytest.raises(ValueError):
-        f.options(runtime_env={"pip": ["x"]}).remote()
+        f.options(runtime_env={"conda": {"deps": []}}).remote()
 
 
 def test_cluster_worker_env_isolation(tmp_path):
@@ -284,3 +283,126 @@ def test_cluster_tracing_spans(tmp_path):
         tracing.disable_tracing()
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+@pytest.fixture
+def local_package(tmp_path):
+    """A tiny hand-assembled WHEEL — installs offline with no build
+    backend (this image ships no setuptools, and build isolation would
+    try to download one)."""
+    import base64
+    import hashlib
+    import zipfile
+
+    whl = tmp_path / "tinylib-0.0.1-py3-none-any.whl"
+    files = {
+        "tinylib/__init__.py": b"MAGIC = 'tiny-42'\n",
+        "tinylib-0.0.1.dist-info/METADATA":
+            b"Metadata-Version: 2.1\nName: tinylib\nVersion: 0.0.1\n",
+        "tinylib-0.0.1.dist-info/WHEEL":
+            b"Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true"
+            b"\nTag: py3-none-any\n",
+    }
+    record_rows = []
+    for name, data in files.items():
+        digest = base64.urlsafe_b64encode(
+            hashlib.sha256(data).digest()).rstrip(b"=").decode()
+        record_rows.append(f"{name},sha256={digest},{len(data)}")
+    record_rows.append("tinylib-0.0.1.dist-info/RECORD,,")
+    with zipfile.ZipFile(whl, "w") as z:
+        for name, data in files.items():
+            z.writestr(name, data)
+        z.writestr("tinylib-0.0.1.dist-info/RECORD",
+                   "\n".join(record_rows) + "\n")
+    return str(whl)
+
+
+def test_pip_runtime_env_installs_into_venv(local_package, tmp_path,
+                                            monkeypatch):
+    """The pip plugin builds a cached venv and tasks in that env import
+    the package (reference: _private/runtime_env/pip.py)."""
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_CACHE", str(tmp_path / "cache"))
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=1)
+    ray_tpu.init(address=c.gcs_address)
+    try:
+        @ray_tpu.remote(runtime_env={"pip": [local_package]})
+        def probe():
+            import tinylib
+            return tinylib.MAGIC
+
+        assert ray_tpu.get(probe.remote(), timeout=300) == "tiny-42"
+
+        # plain-env tasks must NOT see the package
+        @ray_tpu.remote
+        def plain():
+            try:
+                import tinylib  # noqa: F401
+                return "leaked"
+            except ImportError:
+                return "isolated"
+
+        assert ray_tpu.get(plain.remote(), timeout=60) == "isolated"
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_pip_env_cached_across_calls(local_package, tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_CACHE", str(tmp_path / "cache"))
+    import time
+
+    from ray_tpu.runtime_env import ensure_pip_env
+
+    t0 = time.monotonic()
+    site1 = ensure_pip_env([local_package])
+    first = time.monotonic() - t0
+    t0 = time.monotonic()
+    site2 = ensure_pip_env([local_package])
+    second = time.monotonic() - t0
+    assert site1 == site2
+    assert second < first / 5, (first, second)
+
+
+def test_pip_env_validation():
+    from ray_tpu.runtime_env import RuntimeEnv
+
+    assert RuntimeEnv(pip=["numpy"]) == {"pip": ["numpy"]}
+    assert RuntimeEnv(pip={"packages": ["x"]}) == {"pip": ["x"]}
+    with pytest.raises(TypeError):
+        RuntimeEnv(pip=[1, 2])
+    with pytest.raises(ValueError, match="conda"):
+        RuntimeEnv(conda={"dependencies": []})
+
+
+def test_bad_pip_env_fails_fast(tmp_path, monkeypatch):
+    """A failing install surfaces as RuntimeEnvSetupError instead of an
+    infinite worker spawn/install/crash loop."""
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_CACHE", str(tmp_path / "cache"))
+    import time
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.utils.exceptions import RayTpuError
+
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=1)
+    ray_tpu.init(address=c.gcs_address)
+    try:
+        @ray_tpu.remote(runtime_env={
+            "pip": ["/definitely/not/a/package/path"]})
+        def broken():
+            return 1
+
+        start = time.monotonic()
+        with pytest.raises(RayTpuError, match="runtime env setup failed"):
+            ray_tpu.get(broken.remote(), timeout=120)
+        assert time.monotonic() - start < 90
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
