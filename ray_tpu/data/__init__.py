@@ -10,6 +10,7 @@ from ray_tpu.data.block import BlockAccessor
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import (
     Dataset,
+    from_arrow,
     from_items,
     from_numpy,
     from_pandas,
@@ -34,6 +35,7 @@ __all__ = [
     "GroupedData",
     "StreamingExecutor",
     "aggregate",
+    "from_arrow",
     "from_items",
     "from_numpy",
     "from_pandas",

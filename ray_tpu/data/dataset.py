@@ -329,6 +329,14 @@ class Dataset:
                 break
         return out
 
+    def to_arrow(self):
+        """Materialize as one pyarrow Table (reference:
+        ``Dataset.to_arrow_refs`` surface, eagerly concatenated)."""
+        import pyarrow as pa
+
+        return pa.Table.from_pandas(self.to_pandas(),
+                                    preserve_index=False)
+
     def to_pandas(self):
         """Materialize as one pandas DataFrame (reference:
         ``Dataset.to_pandas``) — concatenates whole column batches,
@@ -517,6 +525,35 @@ def from_numpy(arrays: dict, *, num_blocks: int = 8) -> Dataset:
     return Dataset(source)
 
 
+def _resolve_fs(path: str):
+    """(filesystem, fs_path) for a possibly-URI path (reference: the
+    pyarrow.fs resolution behind every datasource — ``file://``, S3/GCS
+    URIs included). Plain local paths bypass pyarrow entirely."""
+    if "://" not in path:
+        return None, path
+    from pyarrow import fs as pafs
+
+    return pafs.FileSystem.from_uri(path)
+
+
+def _open_path(path: str, mode: str = "r"):
+    """open() for local paths OR pyarrow.fs URIs — the shared IO hook
+    behind every datasource (reference: pyarrow.fs usage across
+    data/datasource/). Modes: "r" text, "rb" binary, "csv" text with
+    universal-newline handling disabled (the csv module's contract)."""
+    fs, fsp = _resolve_fs(path)
+    if fs is None:
+        if mode == "csv":
+            return open(fsp, newline="")
+        return open(fsp, mode)
+    import io
+
+    stream = fs.open_input_file(fsp)
+    if mode == "rb":
+        return stream
+    return io.TextIOWrapper(stream, newline="" if mode == "csv" else None)
+
+
 def read_json(paths, *, num_blocks: int = 8) -> Dataset:
     """Line-delimited JSON files → row datasets."""
     import json as _json
@@ -546,7 +583,7 @@ def read_csv(paths, *, num_blocks: int = 8) -> Dataset:
     def source():
         rows = []
         for p in paths:
-            with open(p, newline="") as f:
+            with _open_path(p, "csv") as f:
                 rows.extend(dict(r) for r in _csv.DictReader(f))
         return from_items(rows, num_blocks=num_blocks)._source_fn()
     return Dataset(source)
@@ -632,7 +669,8 @@ def _push_shuffle(bundles, seed):
 
 def read_parquet(paths, *, num_blocks: int = 8, columns=None) -> Dataset:
     """Parquet files → column-dict blocks (one or more blocks per file's
-    row groups)."""
+    row groups). Paths may be local or pyarrow.fs URIs (``file://``,
+    ``s3://``, ``gs://`` — credentials per pyarrow)."""
     import pyarrow.parquet as pq
 
     if isinstance(paths, str):
@@ -642,12 +680,28 @@ def read_parquet(paths, *, num_blocks: int = 8, columns=None) -> Dataset:
         out = []
         per_file = max(1, num_blocks // len(paths))
         for p in paths:
-            table = pq.read_table(p, columns=columns)
+            fs, fsp = _resolve_fs(p)
+            table = pq.read_table(fsp, columns=columns, filesystem=fs)
             cols = {name: table.column(name).to_numpy(zero_copy_only=False)
                     for name in table.column_names}
             out.extend(_emit_blocks(cols, per_file))
         return out
     return Dataset(source)
+
+
+def from_arrow(tables, *, num_blocks: int = 8) -> Dataset:
+    """pyarrow Table(s) → column-block dataset (reference:
+    ``data/read_api.py from_arrow``)."""
+    import pyarrow as pa
+
+    if isinstance(tables, pa.Table):
+        tables = [tables]
+    if not tables:
+        return from_items([])
+    table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+    cols = {name: table.column(name).to_numpy(zero_copy_only=False)
+            for name in table.column_names}
+    return from_numpy(cols, num_blocks=num_blocks)
 
 
 def from_pandas(dfs, *, num_blocks: int = 8) -> Dataset:
@@ -678,7 +732,7 @@ def read_text(paths, *, num_blocks: int = 8, drop_empty: bool = True
     def source():
         lines = []
         for p in paths:
-            with open(p) as f:
+            with _open_path(p) as f:
                 for line in f:
                     line = line.rstrip("\r\n")   # CRLF-safe
                     if line or not drop_empty:
@@ -696,7 +750,7 @@ def read_binary_files(paths, *, include_paths: bool = False,
     def source():
         rows = []
         for p in paths:
-            with open(p, "rb") as f:
+            with _open_path(p, "rb") as f:
                 row = {"bytes": f.read()}
                 if include_paths:
                     row["path"] = p

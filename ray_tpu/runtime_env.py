@@ -74,13 +74,17 @@ class RuntimeEnv(dict):
                     "{'packages': [...]}")
             # local-path requirements resolve against the DRIVER's cwd
             # (like working_dir/py_modules) and keep the cache key from
-            # aliasing two different './pkg' paths to one venv
-            body["pip"] = [
-                os.path.abspath(r)
-                if (r.startswith((".", "/", "~")) or os.path.exists(r))
-                else r
-                for r in reqs
-            ]
+            # aliasing two different './pkg' paths to one venv. pip
+            # semantics: a bare name is a REQUIREMENT even if a same-named
+            # directory happens to exist in the cwd — only explicit path
+            # prefixes or separator-containing existing paths are local.
+            def _localize(r: str) -> str:
+                if r.startswith((".", "/", "~")) or (
+                        os.sep in r and os.path.exists(r)):
+                    return os.path.abspath(os.path.expanduser(r))
+                return r
+
+            body["pip"] = [_localize(r) for r in reqs]
         if config:
             body["config"] = dict(config)
         super().__init__(body)
@@ -270,16 +274,14 @@ def apply_paths(runtime_env: dict | None) -> None:
 
     if not runtime_env:
         return
-    reqs = (runtime_env or {}).get("pip")
+    key = env_key(runtime_env)
+    if key in _applied_path_keys:
+        return   # memo covers pip too (the key hashes every field)
+    reqs = runtime_env.get("pip")
     if reqs:
-        import sys
-
         site = ensure_pip_env(list(reqs))
         if site not in sys.path:
             sys.path.insert(0, site)
-    key = env_key(runtime_env)
-    if key in _applied_path_keys:
-        return
     wd = runtime_env.get("working_dir")
     if wd:
         snap = snapshot_dir(wd)
