@@ -169,6 +169,11 @@ class PullManager:
             try:
                 payload = client.call("fetch_object", oid=oid_hex,
                                       timeout=60)
+                if payload is None or len(payload) != size:
+                    # torn source read (e.g. mid-spill transition):
+                    # sealing it would hand readers garbage — fail this
+                    # source and let the caller retry/try another
+                    return False
                 self._write_whole(oid, payload)
             finally:
                 self._release(size)

@@ -56,6 +56,16 @@ def test_multiple_replicas_route(rt):
             return self.id
 
     handle = serve.run(WhoAmI.bind())
+    # serve.run returns at >=1 replica; wait (via the public status API)
+    # for the rest to come up — on a loaded host they start late, and 30
+    # fast calls can otherwise land inside one refresh TTL and only ever
+    # see the first replica
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        dep = serve.status()["deployments"].get("WhoAmI", {})
+        if dep.get("running", 0) >= 2:
+            break
+        time.sleep(0.1)
     seen = {handle.call(None) for _ in range(30)}
     assert len(seen) >= 2, "p2c routing should hit multiple replicas"
 
