@@ -224,7 +224,9 @@ def bench_core() -> dict:
     import ray_tpu
     from ray_tpu.cluster_utils import Cluster
 
-    n = int(os.environ.get("BENCH_STEPS", "500"))
+    # own knob: BENCH_STEPS tunes the train loop; reusing it here would
+    # shrink the op count (noisy rates) whenever train steps are reduced
+    n = int(os.environ.get("BENCH_CORE_OPS", "2000"))
     c = Cluster()
     c.add_node(num_cpus=4)
     ray_tpu.init(address=c.gcs_address)
