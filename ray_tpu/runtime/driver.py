@@ -505,9 +505,12 @@ class ClusterRuntime:
         return actor_id
 
     def _actor_location(self, actor_id_hex: str, timeout: float = 30.0):
-        """(address, incarnation) of an ALIVE actor; caches, and resets the
-        caller-side sequence numbering when a new incarnation is observed
-        (restarted actors start their ordering from 0)."""
+        """(address, incarnation) of an ALIVE actor — the DIRECT worker
+        push port when the actor has one (reference:
+        DirectActorTaskSubmitter dials the actor process, no raylet hop),
+        else its raylet. Caches, and resets the caller-side sequence
+        numbering when a new incarnation is observed (restarted actors
+        start their ordering from 0)."""
         cached = self._actor_locations.get(actor_id_hex)
         if cached is not None:
             return cached
@@ -517,7 +520,8 @@ class ClusterRuntime:
             if info is None:
                 raise exc.ActorDiedError(actor_id_hex, "unknown actor")
             if info["state"] == "ALIVE":
-                entry = (tuple(info["address"]), info.get("num_restarts", 0))
+                addr = info.get("push_addr") or info["address"]
+                entry = (tuple(addr), info.get("num_restarts", 0))
                 with self._seq_lock:
                     old = self._actor_locations.get(actor_id_hex)
                     if old is None or old[1] != entry[1]:
@@ -551,13 +555,26 @@ class ClusterRuntime:
         # connect OUTSIDE the lock: one unreachable raylet (30s connect
         # timeout) must not stall submissions to every other node
         fresh = RpcClient(addr)
+        evicted = None
         with self._actor_clients_lock:
             client = self._actor_clients.get(addr)
             if client is not None and not client._closed:
                 fresh.close()  # lost the race; reuse the winner
                 return client
             self._actor_clients[addr] = fresh
-            return fresh
+            # bounded: with direct actor push, keys are per-worker ports
+            # (one per actor incarnation) — a driver churning actors
+            # would otherwise leak a dead client per retired actor
+            if len(self._actor_clients) > 256:
+                oldest = next(iter(self._actor_clients))
+                if oldest != addr:
+                    evicted = self._actor_clients.pop(oldest)
+        if evicted is not None:
+            try:
+                evicted.close()
+            except Exception:  # noqa: BLE001
+                pass
+        return fresh
 
     def _drop_actor_client(self, addr):
         with self._actor_clients_lock:
@@ -754,7 +771,10 @@ class ClusterRuntime:
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self._gcs.call("kill_actor", actor_id=actor_id.hex(),
                        no_restart=no_restart)
-        self._actor_locations.pop(actor_id.hex(), None)
+        entry = self._actor_locations.pop(actor_id.hex(), None)
+        if entry is not None:
+            # retire the dead incarnation's cached push-port client
+            self._drop_actor_client(entry[0])
 
     def get_actor(self, name: str) -> ActorID:
         info = self._gcs.call("get_actor", name=name)

@@ -58,6 +58,9 @@ class ActorInfo:
     death_reason: str = ""
     # placement constraint recorded so restart honors it
     pg_id: str | None = None
+    # the live worker's owner-facing push port (direct actor submission);
+    # None until ready, reset on restart (stale addrs must not be dialed)
+    push_addr: tuple | None = None
 
 
 @dataclass
@@ -543,13 +546,15 @@ class GcsServer(RpcServer):
         threading.Thread(target=_place, daemon=True).start()
         return node_id
 
-    def rpc_actor_ready(self, conn, send_lock, *, actor_id, node_id):
+    def rpc_actor_ready(self, conn, send_lock, *, actor_id, node_id,
+                        push_addr=None):
         with self._lock:
             actor = self._actors.get(actor_id)
             if actor is None:
                 return {"ok": False}
             actor.state = "ALIVE"
             actor.node_id = node_id
+            actor.push_addr = tuple(push_addr) if push_addr else None
             self._log_actor(actor)
         self.publish(CH_ACTOR, {"event": "alive", "actor_id": actor_id,
                                 "node_id": node_id})
@@ -578,6 +583,7 @@ class GcsServer(RpcServer):
                 actor.num_restarts += 1
                 actor.state = "RESTARTING"
                 actor.node_id = None
+                actor.push_addr = None
                 restarting = True
             else:
                 actor.state = "DEAD"
@@ -611,6 +617,7 @@ class GcsServer(RpcServer):
                 "actor_id": actor.actor_id, "name": actor.name,
                 "state": actor.state, "node_id": actor.node_id,
                 "address": node.address if node else None,
+                "push_addr": actor.push_addr,
                 "death_reason": actor.death_reason,
                 "num_restarts": actor.num_restarts,
             }

@@ -405,8 +405,10 @@ class Raylet(RpcServer):
             self._finish_task(w, msg)
         elif kind == "actor_ready":
             with self._gcs_lock:
-                self._gcs.call("actor_ready", actor_id=msg["actor_id"],
-                               node_id=self.node_id)
+                self._gcs.call(
+                    "actor_ready", actor_id=msg["actor_id"],
+                    node_id=self.node_id,
+                    push_addr=(list(w.push_addr) if w.push_addr else None))
         elif kind == "actor_creation_failed":
             with self._gcs_lock:
                 self._gcs.call("actor_failed", actor_id=msg["actor_id"],
@@ -832,7 +834,9 @@ class Raylet(RpcServer):
                     try:
                         send_msg(handle.conn,
                                  {"type": "create_actor", "actor_id": actor_id,
-                                  "task": spec}, handle.send_lock)
+                                  "task": spec,
+                                  "incarnation": incarnation},
+                                 handle.send_lock)
                     except OSError:
                         self._on_worker_gone(handle)
                     return

@@ -92,6 +92,31 @@ class TaskPushServer(RpcServer):
             with self._worker._push_conn_lock:
                 self._worker.open_push_conns -= 1
 
+    def rpc_submit_actor_task(self, conn, send_lock, *, task: dict):
+        """DIRECT actor-task submission (owner → actor process, no raylet
+        hop — reference: DirectActorTaskSubmitter pushing straight to the
+        actor's gRPC queue). Same method name and semantics as the
+        raylet-mediated path; the per-caller seq buffer keeps ordering
+        across both."""
+        w = self._worker
+        if w.actor_id is None or task.get("actor_id") != w.actor_id:
+            raise LookupError(
+                f"actor {task.get('actor_id')} not hosted by this worker")
+        if task.get("incarnation", 0) != w.actor_incarnation:
+            # caller's numbering belongs to another incarnation — reject
+            # so it refreshes (same contract as the raylet check)
+            raise LookupError(
+                f"actor {w.actor_id} incarnation mismatch "
+                f"(task {task.get('incarnation')} != "
+                f"{w.actor_incarnation})")
+        # ack on ENQUEUE, execute on the actor-executor thread: the
+        # raylet path acks pre-execution too, and an inline execution of
+        # a self-terminating method (os._exit) would swallow the ack —
+        # the owner would then RESEND the killer to the restarted
+        # incarnation and burn its whole restart budget
+        w._enqueue_actor_task(task)
+        return {"ok": True}
+
     def rpc_dump_stacks(self, conn, send_lock):
         """Per-thread stack dump (py-spy ``dump`` analog; reference:
         profile_manager.py) — the raylet proxies these for the dashboard."""
@@ -164,6 +189,14 @@ class Worker:
         # actor state
         self.actor_instance = None
         self.actor_id = None
+        self.actor_incarnation = 0
+        # ONE executor thread runs actor methods in arrival order no
+        # matter which path delivered them (raylet channel or direct
+        # owner push) — actor semantics are one method at a time
+        import queue as _queue
+
+        self._actor_exec_q: _queue.Queue = _queue.Queue()
+        self._actor_exec_started = False
         self._seq_lock = threading.Lock()
         self._next_seq = defaultdict(int)       # caller -> next seq
         self._seq_buffer = defaultdict(dict)    # caller -> {seq: task}
@@ -279,7 +312,8 @@ class Worker:
                 self._send({"type": "task_done",
                             "task_id": msg["task"].get("task_id")})
             elif kind == "create_actor":
-                self._create_actor(msg["actor_id"], msg["task"])
+                self._create_actor(msg["actor_id"], msg["task"],
+                                   msg.get("incarnation", 0))
             elif kind == "actor_task":
                 self._enqueue_actor_task(msg["task"])
             elif kind == "cancel_push":
@@ -478,12 +512,19 @@ class Worker:
             return
         self._report_task_event(task, started, True)
 
-    def _create_actor(self, actor_id: str, task: dict):
+    def _create_actor(self, actor_id: str, task: dict,
+                      incarnation: int = 0):
         try:
             cls = cloudpickle.loads(task["function_blob"])
             args, kwargs = self._resolve_args(task)
             self.actor_instance = cls(*args, **kwargs)
             self.actor_id = actor_id
+            self.actor_incarnation = incarnation
+            if not self._actor_exec_started:
+                self._actor_exec_started = True
+                threading.Thread(target=self._actor_exec_loop,
+                                 daemon=True,
+                                 name="actor-executor").start()
         except BaseException as e:  # noqa: BLE001
             self._send({"type": "actor_creation_failed",
                         "actor_id": actor_id,
@@ -513,7 +554,22 @@ class Worker:
                 self._next_seq[caller] += 1
                 runnable.append(t)
         for t in runnable:
-            self._run_actor_task(t)
+            self._actor_exec_q.put(t)
+
+    def _actor_exec_loop(self):
+        while True:
+            task = self._actor_exec_q.get()
+            try:
+                self._run_actor_task(task)
+            except BaseException:  # noqa: BLE001
+                # _run_actor_task seals task errors itself; anything that
+                # still escapes would silently kill this (sole) executor
+                # thread and turn every future call into an acked-then-
+                # queued-forever hang. Crash the worker instead — the
+                # raylet's death path restarts the actor (the pre-
+                # executor-thread behavior).
+                traceback.print_exc()
+                os._exit(1)
 
     def _run_actor_task(self, task: dict):
         import time as _time
