@@ -48,13 +48,16 @@ class Dataset:
         return Dataset(self._source_fn, self._ops + (factory,), self._options)
 
     def map_batches(self, fn, *, compute: str = "tasks", num_cpus: float = 1,
-                    actor_pool_size: int = 2) -> "Dataset":
+                    actor_pool_size: int = 2,
+                    max_actor_pool_size: int | None = None) -> "Dataset":
         """Apply ``fn(batch_dict) -> batch_dict`` per block.
         ``compute="actors"`` keeps fn state resident (pass a zero-arg
-        factory as ``fn`` to build per-actor state once)."""
+        factory as ``fn`` to build per-actor state once); the pool
+        autoscales between actor_pool_size and max_actor_pool_size."""
         return self._with(lambda: MapOperator(
             "MapBatches", "batches", fn, compute=compute, num_cpus=num_cpus,
-            actor_pool_size=actor_pool_size))
+            actor_pool_size=actor_pool_size,
+            max_actor_pool_size=max_actor_pool_size))
 
     def map(self, fn, **kw) -> "Dataset":
         return self._with(lambda: MapOperator("Map", "rows", fn, **kw))

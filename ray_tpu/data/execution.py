@@ -157,37 +157,77 @@ class _MapWorker:
 
 class MapOperator(PhysicalOperator):
     """Task- or actor-pool map over blocks (MapOperator/TaskPool/ActorPool
-    analogs). compute="tasks" | "actors"."""
+    analogs). compute="tasks" | "actors".
+
+    Actor pools AUTOSCALE: during execution the pool grows from
+    ``actor_pool_size`` up to ``max_actor_pool_size`` while queued work
+    outruns it (reference: ``ActorPoolMapOperator`` +
+    ``AutoscalingPolicy``); once the operator's input is DRAINED, idle
+    actors retire immediately — the pool is ending anyway, and their
+    resources unblock downstream operators."""
 
     def __init__(self, name: str, map_kind: str, fn,
                  compute: str = "tasks", num_cpus: float = 1,
-                 actor_pool_size: int = 2):
+                 actor_pool_size: int = 2,
+                 max_actor_pool_size: int | None = None):
         super().__init__(name)
         self.map_kind = map_kind
         self.fn = fn
         self.compute = compute
         self.num_cpus = num_cpus
         self.actor_pool_size = actor_pool_size
+        self.max_actor_pool_size = (max_actor_pool_size
+                                    or max(actor_pool_size, 8))
         self._active: list[tuple] = []      # (result_ref, bundle)
         self._pool: list = []               # actor handles
-        self._pool_idx = 0
+        self._pool_load: dict = {}          # id(actor) -> in-flight count
 
     def num_active_tasks(self) -> int:
         return len(self._active)
 
     def outstanding_bytes(self) -> int:
         return (super().outstanding_bytes()
-                + sum(b.size_bytes for _, b in self._active))
+                + sum(entry[1].size_bytes for entry in self._active))
+
+    def _spawn_actor(self):
+        worker_cls = ray_tpu.remote(_MapWorker)
+        actor = worker_cls.options(num_cpus=self.num_cpus).remote(
+            self.map_kind, self.fn)
+        self._pool.append(actor)
+        self._pool_load[id(actor)] = 0
+        self.metrics["actors_started"] = (
+            self.metrics.get("actors_started", 0) + 1)
+        return actor
 
     def _ensure_pool(self):
         if self._pool or self.compute != "actors":
             return
-        worker_cls = ray_tpu.remote(_MapWorker)
-        self._pool = [
-            worker_cls.options(num_cpus=self.num_cpus).remote(
-                self.map_kind, self.fn)
-            for _ in range(self.actor_pool_size)
-        ]
+        for _ in range(self.actor_pool_size):
+            self._spawn_actor()
+
+    def _scale_up(self):
+        """Every actor busy AND input still queued → add one (up to
+        max). Runs at dispatch time only."""
+        busy = all(self._pool_load.get(id(a), 0) > 0 for a in self._pool)
+        if (self.input_queue and busy
+                and len(self._pool) < self.max_actor_pool_size):
+            self._spawn_actor()
+
+    def _scale_down(self):
+        """Input drained → retire idle actors (the operator is winding
+        down; resources free up for downstream work). Runs at poll time
+        only — scale-down at dispatch time could empty the pool with a
+        bundle already popped and waiting for an actor."""
+        if not self.all_dispatched():
+            return
+        for actor in [a for a in self._pool
+                      if self._pool_load.get(id(a), 0) == 0]:
+            self._pool.remove(actor)
+            self._pool_load.pop(id(actor), None)
+            try:
+                ray_tpu.kill(actor)
+            except Exception:  # noqa: BLE001
+                pass
 
     def dispatch(self, options: ExecutionOptions):
         if not self.input_queue:
@@ -197,23 +237,32 @@ class MapOperator(PhysicalOperator):
         self.metrics["tasks"] += 1
         if self.compute == "actors":
             self._ensure_pool()
-            actor = self._pool[self._pool_idx % len(self._pool)]
-            self._pool_idx += 1
+            if not self._pool:   # fully retired by a previous drain tick
+                self._spawn_actor()
+            self._scale_up()
+            # least-loaded actor (reference: the pool picks by queue depth)
+            actor = min(self._pool,
+                        key=lambda a: self._pool_load.get(id(a), 0))
+            self._pool_load[id(actor)] = \
+                self._pool_load.get(id(actor), 0) + 1
             ref = actor.apply.remote(*bundle.refs)
-        else:
-            kind, fn = self.map_kind, self.fn
-            apply_remote = ray_tpu.remote(
-                lambda *blocks: _apply_map(kind, fn, list(blocks))
-            ).options(num_cpus=self.num_cpus)
-            ref = apply_remote.remote(*bundle.refs)
-        self._active.append((ref, bundle))
+            self._active.append((ref, bundle, id(actor)))
+            return
+        kind, fn = self.map_kind, self.fn
+        apply_remote = ray_tpu.remote(
+            lambda *blocks: _apply_map(kind, fn, list(blocks))
+        ).options(num_cpus=self.num_cpus)
+        ref = apply_remote.remote(*bundle.refs)
+        self._active.append((ref, bundle, None))
 
     def poll(self):
         still = []
-        for ref, bundle in self._active:
+        for ref, bundle, owner in self._active:
             ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
             if ready:
                 block, rows, nbytes = ray_tpu.get(ref)
+                if owner is not None and owner in self._pool_load:
+                    self._pool_load[owner] -= 1
                 for out_block, out_rows, out_bytes in _maybe_split(
                         block, rows, nbytes):
                     self.output_queue.append(RefBundle(
@@ -221,8 +270,10 @@ class MapOperator(PhysicalOperator):
                         size_bytes=out_bytes))
                 self.metrics["bundles_out"] += 1
             else:
-                still.append((ref, bundle))
+                still.append((ref, bundle, owner))
         self._active = still
+        if self.compute == "actors" and self._pool:
+            self._scale_down()
 
     def shutdown(self):
         for actor in self._pool:

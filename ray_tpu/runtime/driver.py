@@ -564,11 +564,20 @@ class ClusterRuntime:
             self._actor_clients[addr] = fresh
             # bounded: with direct actor push, keys are per-worker ports
             # (one per actor incarnation) — a driver churning actors
-            # would otherwise leak a dead client per retired actor
+            # would otherwise leak a dead client per retired actor.
+            # Prefer CLOSED entries; only a (much higher) hard cap may
+            # evict a live client — evicting live ones at 256 would
+            # thrash drivers legitimately holding many live actors.
             if len(self._actor_clients) > 256:
-                oldest = next(iter(self._actor_clients))
-                if oldest != addr:
-                    evicted = self._actor_clients.pop(oldest)
+                for k, c in list(self._actor_clients.items()):
+                    if c._closed and k != addr:
+                        evicted = self._actor_clients.pop(k)
+                        break
+                else:
+                    if len(self._actor_clients) > 1024:
+                        oldest = next(iter(self._actor_clients))
+                        if oldest != addr:
+                            evicted = self._actor_clients.pop(oldest)
         if evicted is not None:
             try:
                 evicted.close()

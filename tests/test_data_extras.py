@@ -324,3 +324,36 @@ def test_read_csv_and_text_file_uri(tmp_path, ray_tpu_start):
     txt.write_text("hello\nworld\n")
     rows = rdata.read_text(f"file://{txt}").take_all()
     assert sorted(r["text"] for r in rows) == ["hello", "world"]
+
+
+def test_actor_pool_autoscales(ray_tpu_start):
+    """Actor-pool map scales up under queued work and back down when the
+    input drains (reference: ActorPoolMapOperator autoscaling)."""
+    import time
+
+    from ray_tpu import data as rdata
+    from ray_tpu.data.execution import MapOperator
+
+    captured = []
+    orig_init = MapOperator.__init__
+
+    def spy_init(self, *a, **k):
+        orig_init(self, *a, **k)
+        captured.append(self)
+
+    MapOperator.__init__ = spy_init
+    try:
+        def slowish(batch):
+            time.sleep(0.05)
+            return batch
+
+        rows = (rdata.range(400)
+                .map_batches(lambda: slowish, compute="actors",
+                             actor_pool_size=1, max_actor_pool_size=4)
+                .take_all())
+        assert len(rows) == 400
+        op = next(o for o in captured if o.name == "MapBatches")
+        assert op.metrics.get("actors_started", 0) >= 2, op.metrics
+        assert len(op._pool) == 0, "idle actors not retired after drain"
+    finally:
+        MapOperator.__init__ = orig_init
