@@ -163,16 +163,14 @@ class PullManager:
             self._checkin(addr, client)
             return False
         size = int(meta["size"])
+        crc = meta.get("crc32")
         if size <= self.chunk_size:
             # small object: one read, one write
             self._acquire(size)
             try:
                 payload = client.call("fetch_object", oid=oid_hex,
                                       timeout=60)
-                if payload is None or len(payload) != size:
-                    # torn source read (e.g. mid-spill transition):
-                    # sealing it would hand readers garbage — fail this
-                    # source and let the caller retry/try another
+                if not self._verify(oid_hex, payload, size, crc, addr):
                     return False
                 self._write_whole(oid, payload)
             finally:
@@ -181,7 +179,26 @@ class PullManager:
             self._on_pulled(oid_hex, size)
             return True
         self._checkin(addr, client)
-        return self._pull_chunked(oid_hex, oid, addr, size)
+        return self._pull_chunked(oid_hex, oid, addr, size, crc)
+
+    @staticmethod
+    def _verify(oid_hex: str, payload, size: int, crc, addr) -> bool:
+        """Transfer integrity: refuse to SEAL bytes that don't match the
+        source's length+CRC — a torn read must surface as a retried
+        fetch, never as a readable-but-corrupt object."""
+        import sys
+        import zlib
+
+        if payload is None or len(payload) != size:
+            print(f"[pull] length mismatch for {oid_hex[:8]} from {addr}: "
+                  f"got {0 if payload is None else len(payload)} want "
+                  f"{size}", file=sys.stderr)
+            return False
+        if crc is not None and zlib.crc32(payload) != crc:
+            print(f"[pull] CRC mismatch for {oid_hex[:8]} from {addr} "
+                  f"(size {size})", file=sys.stderr)
+            return False
+        return True
 
     def _write_whole(self, oid: bytes, payload: bytes):
         from ray_tpu.runtime import object_codec
@@ -193,7 +210,7 @@ class PullManager:
                 pass
 
     def _pull_chunked(self, oid_hex: str, oid: bytes, addr: tuple,
-                      size: int) -> bool:
+                      size: int, crc=None) -> bool:
         """Parallel chunk reads into a pre-allocated shm buffer."""
         n_chunks = -(-size // self.chunk_size)
         n_workers = min(self._conns_per_peer, n_chunks)
@@ -250,6 +267,10 @@ class PullManager:
             if failed.is_set() or self._stopping:
                 view.release()
                 self._store.abort(oid)   # unsealed: writer-owned free
+                return False
+            if not self._verify(oid_hex, view, size, crc, addr):
+                view.release()
+                self._store.abort(oid)
                 return False
             view.release()
             self._store.seal(oid)

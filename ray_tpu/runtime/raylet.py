@@ -149,6 +149,9 @@ class Raylet(RpcServer):
         # env_key -> (error, when): envs whose setup failed — tasks fail
         # fast instead of driving a spawn/install/crash loop
         self._bad_envs: dict[str, tuple] = {}
+        # oid -> (size, crc32): transfer-integrity probe memo (objects
+        # are immutable; bounded FIFO)
+        self._crc_cache: dict[str, tuple] = {}
         # buffered object-location registrations (batched to the GCS)
         self._loc_buf: list[tuple[str, int]] = []
         self._loc_cv = threading.Condition()
@@ -1372,25 +1375,35 @@ class Raylet(RpcServer):
             return payload
 
     def rpc_fetch_object_meta(self, conn, send_lock, *, oid: str):
-        """Size probe for the chunked pull path (reference: the object
-        directory carries sizes for PullManager admission)."""
+        """Size + CRC probe for the pull path (reference: the object
+        directory carries sizes for PullManager admission; the checksum
+        is transfer integrity — the destination verifies the assembled
+        bytes before SEALING, so a torn read can never become a readable
+        object). Objects are immutable, so size+CRC memoize per oid —
+        repeat probes (N pullers, retries) cost a dict hit, not an
+        O(size) pass on the handler thread."""
+        import zlib
+
+        cached = self._crc_cache.get(oid)
+        if cached is not None:
+            return {"found": True, "size": cached[0], "crc32": cached[1]}
         oid_b = bytes.fromhex(oid)
         try:
             view = self.store.get(oid_b, timeout_ms=0)
             try:
-                return {"found": True, "size": view.nbytes}
+                size, crc = view.nbytes, zlib.crc32(view)
             finally:
                 view.release()
                 self.store.release(oid_b)
         except ObjectNotFoundError:
-            with self._spill_lock:
-                entry = self._spilled.get(oid)
-            if entry is not None:
-                try:
-                    return {"found": True, "size": os.path.getsize(entry[0])}
-                except OSError:
-                    pass
-            return {"found": False}
+            data = self._read_spilled(oid)
+            if data is None:
+                return {"found": False}
+            size, crc = len(data), zlib.crc32(data)
+        self._crc_cache[oid] = (size, crc)
+        while len(self._crc_cache) > 4096:
+            self._crc_cache.pop(next(iter(self._crc_cache)))
+        return {"found": True, "size": size, "crc32": crc}
 
     def rpc_fetch_object_chunk(self, conn, send_lock, *, oid: str,
                                offset: int, length: int):
