@@ -380,15 +380,20 @@ class Raylet(RpcServer):
             if handle is None:   # externally started worker (tests)
                 handle = WorkerHandle(worker_id=worker_id)
                 self._workers[worker_id] = handle
-            handle.conn = conn
-            handle.send_lock = send_lock
             if push_addr is not None:
                 handle.push_addr = tuple(push_addr)
+        # the registration ack MUST be the channel's first message: only
+        # AFTER it is on the wire may other threads see handle.conn —
+        # an actor-delivery thread polling for the conn could otherwise
+        # inject create_actor ahead of the ack and fail the handshake
+        send_msg(conn, {"registered": True}, send_lock)
+        with self._workers_lock:
+            handle.conn = conn
+            handle.send_lock = send_lock
             if handle.state == "starting":
                 # actor-designated workers keep their "actor" state — the
                 # dispatcher must never hand them normal tasks
                 handle.state = "idle"
-        send_msg(conn, {"registered": True}, send_lock)
         self._kick_dispatch()
         try:
             while not self._stopping:
