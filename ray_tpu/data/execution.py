@@ -178,9 +178,14 @@ class MapOperator(PhysicalOperator):
         self.actor_pool_size = actor_pool_size
         self.max_actor_pool_size = (max_actor_pool_size
                                     or max(actor_pool_size, 8))
-        self._active: list[tuple] = []      # (result_ref, bundle)
-        self._pool: list = []               # actor handles
-        self._pool_load: dict = {}          # id(actor) -> in-flight count
+        self._active: list[tuple] = []   # (result_ref, bundle, serial|None)
+        self._pool: list = []               # (serial, actor) entries
+        # load keyed by pool SERIAL, not id(actor): a killed actor's
+        # handle can be garbage-collected and its id() reused by a new
+        # spawn, so a late poll() decrement for the old actor would hit
+        # the new one and drive its in-flight count negative
+        self._pool_load: dict = {}          # serial -> in-flight count
+        self._pool_serial = 0
 
     def num_active_tasks(self) -> int:
         return len(self._active)
@@ -193,8 +198,9 @@ class MapOperator(PhysicalOperator):
         worker_cls = ray_tpu.remote(_MapWorker)
         actor = worker_cls.options(num_cpus=self.num_cpus).remote(
             self.map_kind, self.fn)
-        self._pool.append(actor)
-        self._pool_load[id(actor)] = 0
+        self._pool_serial += 1
+        self._pool.append((self._pool_serial, actor))
+        self._pool_load[self._pool_serial] = 0
         self.metrics["actors_started"] = (
             self.metrics.get("actors_started", 0) + 1)
         return actor
@@ -208,7 +214,7 @@ class MapOperator(PhysicalOperator):
     def _scale_up(self):
         """Every actor busy AND input still queued → add one (up to
         max). Runs at dispatch time only."""
-        busy = all(self._pool_load.get(id(a), 0) > 0 for a in self._pool)
+        busy = all(self._pool_load.get(s, 0) > 0 for s, _ in self._pool)
         if (self.input_queue and busy
                 and len(self._pool) < self.max_actor_pool_size):
             self._spawn_actor()
@@ -220,12 +226,12 @@ class MapOperator(PhysicalOperator):
         bundle already popped and waiting for an actor."""
         if not self.all_dispatched():
             return
-        for actor in [a for a in self._pool
-                      if self._pool_load.get(id(a), 0) == 0]:
-            self._pool.remove(actor)
-            self._pool_load.pop(id(actor), None)
+        for entry in [e for e in self._pool
+                      if self._pool_load.get(e[0], 0) == 0]:
+            self._pool.remove(entry)
+            self._pool_load.pop(entry[0], None)
             try:
-                ray_tpu.kill(actor)
+                ray_tpu.kill(entry[1])
             except Exception:  # noqa: BLE001
                 pass
 
@@ -241,12 +247,11 @@ class MapOperator(PhysicalOperator):
                 self._spawn_actor()
             self._scale_up()
             # least-loaded actor (reference: the pool picks by queue depth)
-            actor = min(self._pool,
-                        key=lambda a: self._pool_load.get(id(a), 0))
-            self._pool_load[id(actor)] = \
-                self._pool_load.get(id(actor), 0) + 1
+            serial, actor = min(
+                self._pool, key=lambda e: self._pool_load.get(e[0], 0))
+            self._pool_load[serial] = self._pool_load.get(serial, 0) + 1
             ref = actor.apply.remote(*bundle.refs)
-            self._active.append((ref, bundle, id(actor)))
+            self._active.append((ref, bundle, serial))
             return
         kind, fn = self.map_kind, self.fn
         apply_remote = ray_tpu.remote(
@@ -276,7 +281,7 @@ class MapOperator(PhysicalOperator):
             self._scale_down()
 
     def shutdown(self):
-        for actor in self._pool:
+        for _, actor in self._pool:
             try:
                 ray_tpu.kill(actor)
             except Exception:  # noqa: BLE001

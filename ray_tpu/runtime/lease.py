@@ -44,6 +44,20 @@ class Lease:
         self.worker_id = worker_id
         self.node_id = node_id
         self.raylet_addr = tuple(raylet_addr)  # the granting raylet
+        # First request on the wire tags this connection as THE lease
+        # channel on the worker side (its push port is shared with
+        # observability and direct-actor clients, whose disconnects must
+        # not release the lease). Fire-and-forget: the server handles a
+        # connection's requests in order, so the tag lands before any
+        # push; the reader thread consumes the reply.
+        try:
+            self.client.call_async("lease_attach")
+        except BaseException:
+            # attach failed (worker died mid-dial): close the dialed
+            # socket + its reader thread before the caller's handback
+            # path discards this half-constructed lease
+            self.client.close()
+            raise
 
     def close(self):
         self.client.close()
@@ -97,8 +111,16 @@ class LeaseManager:
             q = self._queues.setdefault(key, deque())
             q.append(task)
             active = self._pushers.get(key, 0)
+            # at most ONE new pusher per submit: targeting queued+active
+            # uncapped overshoots on bursts (submit i sees i queued AND
+            # i-1 active and spawns i more, ~2x churn of threads that
+            # grab a lease only to return it), while targeting queued
+            # alone serializes drip-fed work (an active pusher absorbs
+            # each arrival into its pipeline window, so len(q) stays at
+            # 1 and the pool never grows past one lease). One-per-submit
+            # converges to one pusher per outstanding task either way.
             want = min(len(q) + active, self._max_per_shape)
-            spawn = want - active
+            spawn = min(want - active, 1)
             if spawn > 0:
                 self._pushers[key] = active + spawn
         for _ in range(max(spawn, 0)):
@@ -298,7 +320,11 @@ class LeaseManager:
                     try:
                         return Lease(resp["worker_addr"], resp["worker_id"],
                                      resp["node_id"], target.address)
-                    except OSError:
+                    except (OSError, ConnectionLost):
+                        # ConnectionLost (not an OSError): the attach
+                        # call_async can raise it when the worker died
+                        # between grant and dial-completion — same
+                        # handback as a failed dial
                         # dial failed (worker died, or owner-side fd
                         # pressure): hand the grant BACK — an undailed
                         # lease would leak the worker + its resources

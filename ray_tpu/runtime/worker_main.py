@@ -60,10 +60,24 @@ class TaskPushServer(RpcServer):
         finally:
             w.current_push_task_id = None
 
+    def rpc_lease_attach(self, conn, send_lock):
+        """Explicit lease handshake: the owner's FIRST request on a lease
+        connection. Only connections tagged here (or by a task push, the
+        fallback) count as lease channels — observability clients
+        (stack dumps, profiles) and direct actor callers share this port,
+        and their disconnects must NOT release the lease."""
+        self._tag_lease_conn(conn)
+        return {"ok": True}
+
+    def _tag_lease_conn(self, conn):
+        with self._worker._push_conn_lock:
+            self._worker.lease_conns.add(conn)
+
     def rpc_push_task(self, conn, send_lock, *, task: dict):
         # expose the executing thread so the cancel path can interrupt
         # THIS thread — the main thread only runs the raylet-channel
         # recv loop
+        self._tag_lease_conn(conn)
         self._worker.push_task_thread = threading.current_thread()
         try:
             self._run_one(task)
@@ -75,6 +89,7 @@ class TaskPushServer(RpcServer):
         """Batched push: one RPC carries several tasks, executed in
         order (the owner packs bursts of small same-shape tasks — one
         framed round trip instead of N)."""
+        self._tag_lease_conn(conn)
         self._worker.push_task_thread = threading.current_thread()
         try:
             for task in tasks:
@@ -82,15 +97,6 @@ class TaskPushServer(RpcServer):
         finally:
             self._worker.push_task_thread = None
         return {"ok": True}
-
-    def _serve_conn(self, conn):
-        with self._worker._push_conn_lock:
-            self._worker.open_push_conns += 1
-        try:
-            super()._serve_conn(conn)
-        finally:
-            with self._worker._push_conn_lock:
-                self._worker.open_push_conns -= 1
 
     def rpc_submit_actor_task(self, conn, send_lock, *, task: dict):
         """DIRECT actor-task submission (owner → actor process, no raylet
@@ -133,6 +139,17 @@ class TaskPushServer(RpcServer):
                               exclude_thread=threading.get_ident())
 
     def on_disconnect(self, conn):
+        # Release the lease only when the LAST lease-tagged connection
+        # drops. A profiler or direct actor caller disconnecting from a
+        # leased worker previously fired lease_closed too, flipping the
+        # worker idle while the owner still held its channel — two tasks
+        # could then run concurrently on a one-slot worker.
+        with self._worker._push_conn_lock:
+            was_lease = conn in self._worker.lease_conns
+            self._worker.lease_conns.discard(conn)
+            any_left = bool(self._worker.lease_conns)
+        if not was_lease or any_left:
+            return
         try:
             self._worker.ctrl.call("lease_closed",
                                    worker_id=self._worker.worker_id)
@@ -209,7 +226,7 @@ class Worker:
         # the task it was aimed at — never a batchmate)
         self.current_push_task_id: str | None = None
         self.cancelled_push_ids: set[str] = set()
-        self.open_push_conns = 0
+        self.lease_conns: set = set()   # open conns tagged as lease channels
         self._push_conn_lock = threading.Lock()
         self._lease_watch_gen = 0
         self._fn_cache: dict[int, tuple] = {}   # hash(blob) -> (blob, fn)
@@ -237,9 +254,11 @@ class Worker:
         dials the push port (it died, or its dial failed after the
         grant), hand the lease back — otherwise this worker and its
         resources leak in 'leased' state forever. The check is on OPEN
-        connections (not connection history), so an owner that dialed
-        before this message was processed is never falsely reclaimed;
-        an owner that dialed and died is covered by on_disconnect."""
+        LEASE-TAGGED connections (not connection history, and not mere
+        open connections — an observability probe must not mask an owner
+        that never dialed), so an owner that attached before this message
+        was processed is never falsely reclaimed; an owner that dialed
+        and died is covered by on_disconnect."""
         import time as _time
 
         self._lease_watch_gen += 1
@@ -248,7 +267,7 @@ class Worker:
         def watch():
             _time.sleep(10.0)
             with self._push_conn_lock:
-                active = self.open_push_conns
+                active = len(self.lease_conns)
             # the gen check keeps a STALE watch (armed for a previous
             # lease cycle) from reclaiming a newer grant
             if active == 0 and gen == self._lease_watch_gen:
