@@ -21,6 +21,7 @@ from ray_tpu.api import (
     wait,
 )
 from ray_tpu.runtime.object_ref import ObjectRef
+from ray_tpu.runtime.streaming import ObjectRefGenerator
 from ray_tpu.runtime_env import RuntimeEnv
 from ray_tpu.utils import exceptions
 
@@ -41,6 +42,7 @@ __all__ = [
     "available_resources",
     "timeline",
     "ObjectRef",
+    "ObjectRefGenerator",
     "RuntimeEnv",
     "exceptions",
     "__version__",

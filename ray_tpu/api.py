@@ -43,6 +43,7 @@ def init(
     num_tpus: float | None = None,
     system_config: dict | None = None,
     ignore_reinit_error: bool = True,
+    namespace: str | None = None,
 ):
     """Start the runtime (reference: ``ray.init``, ``worker.py:1139``).
 
@@ -72,7 +73,7 @@ def init(
                     f"address must be 'host:port' or a (host, port) tuple, "
                     f"got {address!r}")
             address = (host or "127.0.0.1", int(port))
-        rt = ClusterRuntime(address)
+        rt = ClusterRuntime(address, namespace=namespace)
         _core.install_runtime(rt)
         return rt
     from ray_tpu._private.usage_stats import record_extra_usage_tag
@@ -87,7 +88,8 @@ def init(
         res["TPU"] = float(num_tpus)
     else:
         res.setdefault("TPU", float(_autodetect_tpu_count()))
-    return _core.init_runtime(config=config, resources=res)
+    return _core.init_runtime(config=config, resources=res,
+                              namespace=namespace)
 
 
 def _autodetect_tpu_count() -> int:
@@ -202,6 +204,13 @@ class RemoteFunction:
         rt = _runtime()
         opts = self._options
         num_returns = opts.get("num_returns", 1)
+        if not (isinstance(num_returns, int)
+                or num_returns in ("streaming", "dynamic")):
+            # reference: _private/ray_option_utils.py:251-253 accepts an
+            # int or the literals "dynamic" / "streaming"
+            raise ValueError(
+                f'num_returns must be an int, "dynamic" or "streaming", '
+                f"got {num_returns!r}")
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             task_type=TaskType.NORMAL_TASK,
@@ -224,8 +233,8 @@ class RemoteFunction:
         )
         refs = rt.submit_task(spec)
         rt.note_return_owner(spec)
-        if num_returns == 1:
-            return refs[0]
+        if num_returns == 1 or not isinstance(num_returns, int):
+            return refs[0]   # single ref, or the ObjectRefGenerator
         return refs
 
     def bind(self, *args, **kwargs):
@@ -327,7 +336,9 @@ class ActorHandle:
         )
         refs = rt.submit_task(spec)
         rt.note_return_owner(spec)
-        return refs[0] if num_returns == 1 else refs
+        if num_returns == 1 or not isinstance(num_returns, int):
+            return refs[0]   # single ref, or the ObjectRefGenerator
+        return refs
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._class_name))
@@ -360,6 +371,17 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         rt = _runtime()
         opts = self._options
+        max_concurrency = opts.get("max_concurrency")
+        if max_concurrency is None:
+            # reference default: async actors (any ``async def`` method)
+            # get high concurrency (calls interleave at awaits); threaded
+            # actors stay strictly serial
+            import inspect
+
+            is_async = any(
+                inspect.iscoroutinefunction(getattr(self._cls, n, None))
+                for n in dir(self._cls))
+            max_concurrency = 1000 if is_async else 1
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             task_type=TaskType.ACTOR_CREATION_TASK,
@@ -374,11 +396,12 @@ class ActorClass:
                 memory=opts.get("memory"),
                 resources=opts.get("resources"),
             ),
-            max_concurrency=opts.get("max_concurrency", 1),
+            max_concurrency=max_concurrency,
             max_restarts=opts.get("max_restarts", 0),
             runtime_env=_normalize_runtime_env(opts.get("runtime_env")),
         )
-        actor_id = rt.create_actor(spec, name=opts.get("name"))
+        actor_id = rt.create_actor(spec, name=opts.get("name"),
+                                   namespace=opts.get("namespace"))
         return ActorHandle(actor_id, self._cls.__name__)
 
 
@@ -388,9 +411,14 @@ def kill(handle: ActorHandle, *, no_restart: bool = True):
     _runtime().kill_actor(handle.actor_id, no_restart=no_restart)
 
 
-def get_actor(name: str) -> ActorHandle:
+def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
+    """Look up a named actor (reference: ``worker.py:2784`` — scoped to
+    the caller's namespace unless one is given explicitly)."""
     rt = _runtime()
-    actor_id = rt.get_actor(name)
+    try:
+        actor_id = rt.get_actor(name, namespace)
+    except TypeError:
+        actor_id = rt.get_actor(name)   # runtimes without namespaces
     state = rt.actor_state(actor_id)
     cls_name = state.creation_spec.function.__name__ if state else "Actor"
     return ActorHandle(actor_id, cls_name)
@@ -401,8 +429,8 @@ def get_actor(name: str) -> ActorHandle:
 # ---------------------------------------------------------------------------
 
 _ACTOR_OPTION_KEYS = {
-    "name", "max_concurrency", "max_restarts", "num_cpus", "num_tpus",
-    "memory", "resources", "lifetime", "runtime_env",
+    "name", "namespace", "max_concurrency", "max_restarts", "num_cpus",
+    "num_tpus", "memory", "resources", "lifetime", "runtime_env",
 }
 _TASK_OPTION_KEYS = {
     "num_returns", "num_cpus", "num_tpus", "memory", "resources",

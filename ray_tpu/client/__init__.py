@@ -121,10 +121,12 @@ class ClientRuntime:
 
     # -- actors ---------------------------------------------------------
 
-    def create_actor(self, spec: TaskSpec, name: str | None = None):
+    def create_actor(self, spec: TaskSpec, name: str | None = None,
+                     namespace: str | None = None):
         out = self._rpc.call(
             "client_create_actor",
             name=name,
+            namespace=namespace,
             class_name=spec.function_name,
             cls_blob=cloudpickle.dumps(spec.function, protocol=5),
             args_blob=self._wire_args(spec),
@@ -141,8 +143,9 @@ class ClientRuntime:
         self._rpc.call("client_kill_actor", actor_id=actor_id.hex(),
                        no_restart=no_restart)
 
-    def get_actor(self, name: str) -> ActorID:
-        out = self._rpc.call("client_get_actor", name=name)
+    def get_actor(self, name: str, namespace: str | None = None) -> ActorID:
+        out = self._rpc.call("client_get_actor", name=name,
+                             namespace=namespace)
         if out.get("error"):
             raise ValueError(out["error"])
         return ActorID.from_hex(out["actor_id"])

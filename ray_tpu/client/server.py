@@ -139,7 +139,8 @@ class ClientServer(RpcServer):
 
     def rpc_client_create_actor(self, conn, send_lock, *, name, class_name,
                                 cls_blob, args_blob, resources,
-                                max_concurrency, max_restarts, runtime_env):
+                                max_concurrency, max_restarts, runtime_env,
+                                namespace=None):
         args, kwargs = _unwire_args(args_blob)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
@@ -156,7 +157,8 @@ class ClientServer(RpcServer):
             runtime_env=runtime_env,
         )
         try:
-            actor_id = self._rt.create_actor(spec, name=name)
+            actor_id = self._rt.create_actor(spec, name=name,
+                                             namespace=namespace)
         except ValueError as e:
             return {"error": str(e), "actor_id": None}
         return {"error": None, "actor_id": actor_id.hex()}
@@ -167,9 +169,11 @@ class ClientServer(RpcServer):
                             no_restart=no_restart)
         return {"ok": True}
 
-    def rpc_client_get_actor(self, conn, send_lock, *, name):
+    def rpc_client_get_actor(self, conn, send_lock, *, name,
+                             namespace=None):
         try:
-            actor_id = self._rt.get_actor(name)
+            actor_id = self._rt.get_actor(name, namespace) if namespace \
+                else self._rt.get_actor(name)
         except ValueError as e:
             return {"error": str(e), "actor_id": None}
         return {"error": None, "actor_id": actor_id.hex()}

@@ -135,12 +135,14 @@ class Cluster:
             handle.raylet.stop()
         if graceful:
             try:
-                from ray_tpu.runtime.rpc import RpcClient
+                from ray_tpu.runtime.rpc import ConnectionLost, RpcClient
                 c = RpcClient(self.gcs_address)
-                c.call("drain_node", node_id=handle.node_id)
-                c.close()
-            except OSError:
-                pass
+                try:
+                    c.call("drain_node", node_id=handle.node_id)
+                finally:
+                    c.close()
+            except (OSError, ConnectionLost, TimeoutError):
+                pass  # GCS already gone: nothing left to drain from
 
     def wait_for_nodes(self, n: int, timeout: float = 10.0):
         from ray_tpu.runtime.rpc import RpcClient

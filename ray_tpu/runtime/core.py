@@ -32,6 +32,12 @@ from ray_tpu.utils.config import Config, get_config
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, ObjectID, TaskID, _Counter
 
 
+def _isawaitable(x) -> bool:
+    import inspect
+
+    return inspect.isawaitable(x)
+
+
 # ---------------------------------------------------------------------------
 # Actor bookkeeping
 # ---------------------------------------------------------------------------
@@ -40,6 +46,7 @@ from ray_tpu.utils.ids import ActorID, JobID, NodeID, ObjectID, TaskID, _Counter
 class ActorState:
     actor_id: ActorID
     name: str | None
+    namespace: str = "default"
     instance: Any = None
     dead: bool = False
     death_reason: str = ""
@@ -58,6 +65,9 @@ class ActorState:
     seq_buffer: dict[int, TaskSpec] = field(default_factory=dict)
     # Tasks handed to the executor but not yet completed (for kill cleanup).
     in_flight: dict[TaskID, TaskSpec] = field(default_factory=dict)
+    # ASYNC actors: concurrency bound for coroutine methods scheduled on
+    # the runtime's shared event loop (created lazily on that loop)
+    async_sem: Any = None
 
 
 # ---------------------------------------------------------------------------
@@ -103,9 +113,14 @@ class _EnvVarSession:
 class Runtime:
     """Singleton runtime: object store + scheduler + actor registry."""
 
-    def __init__(self, config: Config | None = None, resources: dict | None = None):
+    def __init__(self, config: Config | None = None,
+                 resources: dict | None = None,
+                 namespace: str | None = None):
         self.config = config or get_config()
         self.job_id = JobID.from_random()
+        # named actors scope to a namespace; default = this job's id
+        # (reference: worker.py:1157 — anonymous namespaces isolate jobs)
+        self.namespace = namespace or f"job-{self.job_id.hex()[:12]}"
         self.node_id = NodeID.from_random()
         self.store = ObjectStore()
         self._task_counter = _Counter()
@@ -371,7 +386,17 @@ class Runtime:
                 f"{spec.resources.resources}, which exceeds cluster capacity "
                 f"{self.total_resources}"
             )
-        spec.return_ids = [ObjectID.from_random() for _ in range(spec.num_returns)]
+        streaming = spec.num_returns in ("streaming", "dynamic")
+        if streaming:
+            # the end-of-stream count object IS the declared return id:
+            # every failure path that seals return_ids lands where the
+            # consumer's end check reads (see runtime/streaming.py)
+            from ray_tpu.runtime.streaming import (ObjectRefGenerator,
+                                                   stream_end_ref)
+            spec.return_ids = [stream_end_ref(spec.task_id.binary()).id]
+        else:
+            spec.return_ids = [ObjectID.from_random()
+                               for _ in range(spec.num_returns)]
         spec.submitted_at = time.monotonic()
         if spec.task_type == TaskType.ACTOR_TASK:
             state = self._actors.get(spec.actor_id)
@@ -379,6 +404,8 @@ class Runtime:
                 spec.sequence_number = state.submit_seq.next()
         self.metrics["tasks_submitted"].next()
         self._resolve_or_queue(spec)
+        if streaming:
+            return [ObjectRefGenerator(spec.task_id.binary())]
         return [ObjectRef(oid) for oid in spec.return_ids]
 
     def _task_dependencies(self, spec: TaskSpec) -> set[ObjectID]:
@@ -511,6 +538,16 @@ class Runtime:
         return args, kwargs
 
     def _store_results(self, spec: TaskSpec, result):
+        if spec.num_returns in ("streaming", "dynamic"):
+            from ray_tpu.runtime.streaming import store_stream
+
+            store_stream(
+                result, spec.task_id.binary(),
+                lambda oid, v, er: self.store.put(ObjectID(oid), v,
+                                                  is_error=er),
+                lambda oid, n: self.store.put(ObjectID(oid), n))
+            self._task_done(spec)
+            return
         try:
             if spec.num_returns == 1:
                 self.store.put(spec.return_ids[0], result)
@@ -569,6 +606,8 @@ class Runtime:
                 with execution_span(spec.function_name, spec.trace_ctx):
                     result = self._call_in_runtime_env(
                         spec.runtime_env, spec.function, args, kwargs)
+                    if _isawaitable(result):
+                        result = self._await_on_loop(result)
             except BaseException as e:  # noqa: BLE001
                 if spec.max_retries > 0 and spec.retry_exceptions:
                     spec.max_retries -= 1
@@ -590,12 +629,22 @@ class Runtime:
     # Actors (reference: GcsActorManager + DirectActorTaskSubmitter)
     # ------------------------------------------------------------------
 
-    def create_actor(self, spec: TaskSpec, name: str | None = None) -> ActorID:
+    def _effective_namespace(self, override: str | None = None) -> str:
+        if override:
+            return override
+        from ray_tpu.runtime_context import current_task_namespace
+
+        return current_task_namespace() or self.namespace
+
+    def create_actor(self, spec: TaskSpec, name: str | None = None,
+                     namespace: str | None = None) -> ActorID:
         actor_id = ActorID.from_random()
         spec.actor_id = actor_id
+        ns = self._effective_namespace(namespace)
         state = ActorState(
             actor_id=actor_id,
             name=name,
+            namespace=ns,
             max_restarts=spec.max_restarts,
             creation_spec=spec,
         )
@@ -605,9 +654,15 @@ class Runtime:
         )
         with self._actor_lock:
             if name is not None:
-                if name in self._named_actors:
-                    raise ValueError(f"Actor name {name!r} already taken")
-                self._named_actors[name] = actor_id
+                # registry key carries the namespace (same convention as
+                # the GCS registry, runtime/gcs.py:_ns_key); state.name
+                # stays the bare user-visible name
+                key = f"{ns}\x1f{name}"
+                if key in self._named_actors:
+                    raise ValueError(
+                        f"Actor name {name!r} already taken in namespace "
+                        f"{ns!r}")
+                self._named_actors[key] = actor_id
             self._actors[actor_id] = state
         self.metrics["actors_created"].next()
         self._resolve_or_queue(spec)  # creation waits on arg deps like any task
@@ -706,6 +761,16 @@ class Runtime:
             with execution_span(spec.function_name, spec.trace_ctx):
                 result = self._call_in_runtime_env(renv, method, args,
                                                    kwargs)
+                if _isawaitable(result):
+                    # ASYNC actor method: schedule the coroutine on the
+                    # shared event loop and RETURN the pool thread
+                    # immediately — awaits overlap up to max_concurrency
+                    # (semaphore), and quick sync methods (metrics,
+                    # pings) keep running on free pool threads instead
+                    # of queueing behind slow requests (reference:
+                    # fibers, core_worker/fiber.h:17)
+                    self._spawn_actor_coro(state, spec, result)
+                    return
         except BaseException as e:  # noqa: BLE001
             self.metrics["tasks_failed"].next()
             self._store_error(
@@ -715,11 +780,66 @@ class Runtime:
         self._store_results(spec, result)
         self.metrics["tasks_finished"].next()
 
-    def get_actor(self, name: str) -> ActorID:
+    def _ensure_async_loop(self):
+        import asyncio
+
         with self._actor_lock:
-            if name not in self._named_actors:
+            loop = getattr(self, "_async_loop", None)
+            if loop is None:
+                loop = asyncio.new_event_loop()
+                self._async_loop = loop
+                threading.Thread(target=loop.run_forever, daemon=True,
+                                 name="runtime-asyncio-loop").start()
+        return loop
+
+    def _await_on_loop(self, awaitable):
+        """Run an awaitable to completion on the runtime's shared event
+        loop (started lazily), blocking the calling pool thread."""
+        import asyncio
+
+        loop = self._ensure_async_loop()
+
+        async def drive():
+            return await awaitable
+
+        return asyncio.run_coroutine_threadsafe(drive(), loop).result()
+
+    def _spawn_actor_coro(self, state: ActorState, spec: TaskSpec,
+                          awaitable):
+        """Fire an async actor call onto the shared loop (non-blocking);
+        results/errors are stored from the loop when it finishes."""
+        import asyncio
+
+        loop = self._ensure_async_loop()
+        if state.async_sem is None:
+            # under the lock: two pool threads dispatching concurrently
+            # must share ONE semaphore or max_concurrency isn't enforced
+            with self._actor_lock:
+                if state.async_sem is None:
+                    mc = (state.creation_spec.max_concurrency
+                          if state.creation_spec is not None else 1)
+                    state.async_sem = asyncio.Semaphore(max(1, int(mc or 1)))
+
+        async def drive():
+            async with state.async_sem:
+                try:
+                    result = await awaitable
+                except BaseException as e:  # noqa: BLE001
+                    self.metrics["tasks_failed"].next()
+                    self._store_error(
+                        spec, exc.TaskError(f"{spec.function_name}", e))
+                    return
+                self._store_results(spec, result)
+                self.metrics["tasks_finished"].next()
+
+        asyncio.run_coroutine_threadsafe(drive(), loop)
+
+    def get_actor(self, name: str, namespace: str | None = None) -> ActorID:
+        key = f"{self._effective_namespace(namespace)}\x1f{name}"
+        with self._actor_lock:
+            if key not in self._named_actors:
                 raise ValueError(f"Failed to look up actor with name {name!r}")
-            return self._named_actors[name]
+            return self._named_actors[key]
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         with self._actor_lock:
@@ -730,7 +850,8 @@ class Runtime:
             state.dead = True
             state.death_reason = "killed via kill()"
             if state.name:
-                self._named_actors.pop(state.name, None)
+                self._named_actors.pop(
+                    f"{state.namespace}\x1f{state.name}", None)
         if already_dead:
             return
         if state.executor:
@@ -814,11 +935,14 @@ def is_initialized() -> bool:
     return _runtime is not None
 
 
-def init_runtime(config: Config | None = None, resources: dict | None = None) -> Runtime:
+def init_runtime(config: Config | None = None,
+                 resources: dict | None = None,
+                 namespace: str | None = None) -> Runtime:
     global _runtime
     with _runtime_lock:
         if _runtime is None:
-            _runtime = Runtime(config=config, resources=resources)
+            _runtime = Runtime(config=config, resources=resources,
+                               namespace=namespace)
         return _runtime
 
 

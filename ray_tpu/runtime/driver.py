@@ -34,14 +34,24 @@ from ray_tpu.utils import exceptions as exc
 from ray_tpu.utils.ids import ActorID, ObjectID, WorkerID
 
 
+_SCALAR_TYPES = (type(None), bool, int, float, str, bytes)
+
+
 class ClusterRuntime:
     """Connects ``ray_tpu.api`` to a running cluster (GCS + raylets)."""
 
-    def __init__(self, gcs_address, raylet_address=None):
+    def __init__(self, gcs_address, raylet_address=None,
+                 namespace: str | None = None):
         self.gcs_address = tuple(gcs_address)
         # reconnecting: survives a GCS restart (file-backed recovery)
         self._gcs = ReconnectingRpcClient(self.gcs_address)
         self.caller_id = WorkerID.from_random().hex()
+        # Namespace for named actors (reference: worker.py:1157,1258):
+        # explicit init(namespace=...), else the job's own id — two jobs
+        # on one cluster never collide on actor names by default. Worker-
+        # side implicit runtimes resolve the AMBIENT task namespace first
+        # (runtime_context), so a job's tasks see their job's actors.
+        self.namespace = namespace or f"job-{self.caller_id[:12]}"
         # choose local raylet: given address, or the head node from GCS
         if raylet_address is None:
             nodes = self._gcs.call("get_nodes", alive_only=True)
@@ -60,15 +70,20 @@ class ClusterRuntime:
         self.store = ShmObjectStore(store_name)
         self._actor_locations: dict[str, tuple] = {}   # id -> (addr, incarnation)
         self._actor_seq: dict[str, int] = {}           # id -> next seq
-        # pipelined actor submits: id -> deque[(task, PendingCall, addr)]
+        # pipelined actor submits: id -> deque[(tasks, PendingCall, addr,
+        # sent_at)] — each window entry is one BATCH frame in flight
         self._actor_windows: dict[str, deque] = {}
         self._actor_gap_fillers: dict[str, list] = {}
         self._actor_reaper_started = False
         self._seq_lock = threading.Lock()
-        # per-actor submission locks: seq assignment + send must be atomic
-        # per actor or concurrent senders can interleave/retry into
-        # permanent sequence gaps
-        self._actor_send_locks: dict[str, threading.Lock] = {}
+        # submit-side coalescing: callers enqueue (task, addr) here and the
+        # flusher thread packs consecutive submissions to one actor into a
+        # single submit_actor_tasks frame — one pickle+syscall per BURST,
+        # not per call (reference: the async gRPC CallQueue in
+        # DirectActorTaskSubmitter batches sends on its io thread)
+        self._actor_outbox: dict[str, list] = {}
+        self._actor_unacked: dict[str, int] = {}   # flow control (tasks)
+        self._outbox_cv = threading.Condition()
         self._named_cache: dict[str, str] = {}
         # cached per-address actor-call clients (see _actor_client)
         self._actor_clients: dict[tuple, RpcClient] = {}
@@ -370,13 +385,31 @@ class ClusterRuntime:
     # tasks
     # ------------------------------------------------------------------
 
+    _EMPTY_ARGS_BLOB = cloudpickle.dumps(([], {}), protocol=5)
+
     def _wire_args(self, spec: TaskSpec):
         """Replace top-level ObjectRefs with markers (reference semantics:
-        only top-level args are resolved before execution)."""
+        only top-level args are resolved before execution). Plain-data
+        args take the C pickler (~5x the Python-level cloudpickle
+        Pickler on small payloads — the per-call cost that matters at
+        10k+ submits/s); closures/lambdas in args fall back to
+        cloudpickle."""
+        if not spec.args and not spec.kwargs:
+            return self._EMPTY_ARGS_BLOB
         args = [("__objref__", a.id.hex()) if isinstance(a, ObjectRef) else a
                 for a in spec.args]
         kwargs = {k: ("__objref__", v.id.hex()) if isinstance(v, ObjectRef)
                   else v for k, v in spec.kwargs.items()}
+        # The C pickler fast path is gated to builtin SCALARS only:
+        # stdlib pickle serializes __main__-defined classes by REFERENCE
+        # (workers can't resolve them — their __main__ is worker_main),
+        # and a container could hide one; cloudpickle pickles by value.
+        if all(type(a) in _SCALAR_TYPES
+               or (type(a) is tuple and len(a) == 2 and a[0] == "__objref__")
+               for a in args) and all(
+                   type(v) in _SCALAR_TYPES for v in kwargs.values()):
+            import pickle
+            return pickle.dumps((args, kwargs), protocol=5)
         return cloudpickle.dumps((args, kwargs), protocol=5)
 
     def _function_blob(self, fn) -> bytes:
@@ -395,8 +428,20 @@ class ClusterRuntime:
         return blob
 
     def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
-        spec.return_ids = [ObjectID.from_random()
-                           for _ in range(spec.num_returns)]
+        streaming = spec.num_returns in ("streaming", "dynamic")
+        if streaming:
+            # end-of-stream count object = the declared return id: lease
+            # breaks / worker deaths seal their error exactly where the
+            # consumer's end check reads (runtime/streaming.py). Streams
+            # are not retried (a partially consumed stream is not
+            # idempotently re-runnable), so no lineage entry either.
+            from ray_tpu.runtime.streaming import (ObjectRefGenerator,
+                                                   stream_end_ref)
+            spec.return_ids = [stream_end_ref(spec.task_id.binary()).id]
+            spec.max_retries = 0
+        else:
+            spec.return_ids = [ObjectID.from_random()
+                               for _ in range(spec.num_returns)]
         if spec.task_type == TaskType.ACTOR_TASK:
             self._submit_actor_task(spec)
         else:
@@ -411,7 +456,10 @@ class ClusterRuntime:
                 "max_retries": spec.max_retries,
                 "runtime_env": spec.runtime_env,
                 "trace_ctx": spec.trace_ctx,
+                "namespace": self._effective_namespace(),
             }
+            if streaming:
+                task["streaming"] = True
             if spec.max_retries > 0:
                 deps = [a.id.hex() for a in spec.args
                         if isinstance(a, ObjectRef)]
@@ -429,6 +477,9 @@ class ClusterRuntime:
                     while len(self._lineage) > self._lineage_max:
                         self._lineage.pop(next(iter(self._lineage)))
             self._leases.submit(task)
+        if streaming:
+            from ray_tpu.runtime.streaming import ObjectRefGenerator
+            return [ObjectRefGenerator(spec.task_id.binary())]
         return [ObjectRef(oid) for oid in spec.return_ids]
 
     def _legacy_submit(self, task: dict):
@@ -482,9 +533,18 @@ class ClusterRuntime:
     # actors
     # ------------------------------------------------------------------
 
-    def create_actor(self, spec: TaskSpec, name: str | None = None) -> ActorID:
+    def _effective_namespace(self, override: str | None = None) -> str:
+        if override:
+            return override
+        from ray_tpu.runtime_context import current_task_namespace
+
+        return current_task_namespace() or self.namespace
+
+    def create_actor(self, spec: TaskSpec, name: str | None = None,
+                     namespace: str | None = None) -> ActorID:
         actor_id = ActorID.from_random()
         spec.actor_id = actor_id
+        ns = self._effective_namespace(namespace)
         creation = {
             "task_id": spec.task_id.hex(),
             "name": spec.function_name,
@@ -494,6 +554,7 @@ class ClusterRuntime:
             "resources": dict(spec.resources.resources),
             "max_concurrency": spec.max_concurrency,
             "runtime_env": spec.runtime_env,
+            "namespace": ns,
         }
         strategy = _wire_strategy(spec)
         self._gcs.call(
@@ -501,7 +562,8 @@ class ClusterRuntime:
             creation_spec=creation,
             resources=dict(spec.resources.resources),
             max_restarts=spec.max_restarts,
-            pg_id=strategy.get("pg_id"))
+            pg_id=strategy.get("pg_id"),
+            namespace=ns)
         return actor_id
 
     def _actor_location(self, actor_id_hex: str, timeout: float = 30.0):
@@ -535,13 +597,49 @@ class ClusterRuntime:
         raise exc.ActorUnavailableError(
             f"actor {actor_id_hex[:8]} not ALIVE within {timeout}s")
 
+    ACTOR_WINDOW = 256   # max unacked tasks per actor (outbox + in flight)
+
     def _submit_actor_task(self, spec: TaskSpec):
+        """Enqueue one actor call for the flusher (seq assigned HERE so
+        caller submission order = sequence order; the worker's per-caller
+        seq buffer tolerates wire reordering). Blocks only when the
+        actor's unacked window is full."""
         actor_hex = spec.actor_id.hex()
+        task = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.function_name,
+            "actor_id": actor_hex,
+            "method_name": spec.actor_method_name,
+            "args_blob": self._wire_args(spec),
+            "return_oids": [o.hex() for o in spec.return_ids],
+            "caller_id": self.caller_id,
+            "trace_ctx": spec.trace_ctx,
+        }
+        if spec.num_returns in ("streaming", "dynamic"):
+            # generator METHOD: worker-side _store_returns streams the
+            # yields exactly like a generator task
+            task["streaming"] = True
+        try:
+            addr, incarnation = self._actor_location(actor_hex)
+        except (exc.ActorDiedError, exc.ActorUnavailableError, OSError,
+                ConnectionLost, LookupError) as e:
+            self._resend_actor_task(task, actor_hex, e, None)
+            return
         with self._seq_lock:
-            send_lock = self._actor_send_locks.setdefault(
-                actor_hex, threading.Lock())
-        with send_lock:
-            self._submit_actor_task_locked(spec, actor_hex)
+            seq = self._actor_seq.get(actor_hex, 0)
+            self._actor_seq[actor_hex] = seq + 1
+        task["seq"] = seq
+        task["incarnation"] = incarnation
+        with self._outbox_cv:
+            while (self._actor_unacked.get(actor_hex, 0)
+                   >= self.ACTOR_WINDOW and not self._closed):
+                self._outbox_cv.wait(timeout=0.1)
+            self._actor_outbox.setdefault(actor_hex, []).append(
+                (task, tuple(addr)))
+            self._actor_unacked[actor_hex] = \
+                self._actor_unacked.get(actor_hex, 0) + 1
+            self._outbox_cv.notify_all()
+        self._ensure_actor_reaper()
 
     def _actor_client(self, addr) -> RpcClient:
         """Cached per-address client: a fresh socket + reader thread per
@@ -591,72 +689,81 @@ class ClusterRuntime:
         if client is not None:
             client.close()
 
-    def _submit_actor_task_locked(self, spec: TaskSpec, actor_hex: str):
-        task = {
-            "task_id": spec.task_id.hex(),
-            "name": spec.function_name,
-            "actor_id": actor_hex,
-            "method_name": spec.actor_method_name,
-            "args_blob": self._wire_args(spec),
-            "return_oids": [o.hex() for o in spec.return_ids],
-            "caller_id": self.caller_id,
-            "trace_ctx": spec.trace_ctx,
-        }
-        # Pipelined submission (reference: the async gRPC CallQueue in
-        # DirectActorTaskSubmitter): the send is fired WITHOUT waiting
-        # for the raylet's reply — same-socket ordering preserves seq
-        # order — and replies drain from a per-actor window here and in
-        # the background reaper. Throughput = burst rate, not RTT rate.
-        self._drain_actor_window(actor_hex)
-        self._send_actor_task_async(task, actor_hex)
+    def _flush_actor_outbox(self):
+        """Flusher duty: pack each actor's queued submissions into
+        submit_actor_tasks batch frames (split on address change so a
+        mid-burst relocation never mixes destinations)."""
+        with self._outbox_cv:
+            if not self._actor_outbox:
+                return
+            snapshot = self._actor_outbox
+            self._actor_outbox = {}
+        for actor_hex, items in snapshot.items():
+            window = self._actor_windows.setdefault(actor_hex, deque())
+            i = 0
+            while i < len(items):
+                addr = items[i][1]
+                batch = []
+                while i < len(items) and items[i][1] == addr:
+                    batch.append(items[i][0])
+                    i += 1
+                try:
+                    client = self._actor_client(addr)
+                    if len(batch) == 1:
+                        pending = client.call_async("submit_actor_task",
+                                                    task=batch[0])
+                    else:
+                        pending = client.call_async("submit_actor_tasks",
+                                                    tasks=batch)
+                except (exc.ActorDiedError, exc.ActorUnavailableError,
+                        OSError, ConnectionLost, LookupError) as e:
+                    for t in batch:
+                        self._resend_actor_task(t, actor_hex, e, addr)
+                    self._ack_actor_tasks(actor_hex, len(batch))
+                    continue
+                window.append((batch, pending, addr, time.monotonic()))
 
-    ACTOR_WINDOW = 64   # max unacked submits per actor
-
-    def _send_actor_task_async(self, task: dict, actor_hex: str):
-        """Fire one actor-task submit (caller holds the actor's send
-        lock). Immediate failures go through the resend path."""
-        window = self._actor_windows.setdefault(actor_hex, deque())
-        addr_used = None
-        try:
-            addr, incarnation = self._actor_location(actor_hex)
-            with self._seq_lock:
-                seq = self._actor_seq.get(actor_hex, 0)
-                self._actor_seq[actor_hex] = seq + 1
-            task["seq"] = seq
-            task["incarnation"] = incarnation
-            addr_used = tuple(addr)
-            client = self._actor_client(addr)
-            pending = client.call_async("submit_actor_task", task=task)
-        except (exc.ActorDiedError, exc.ActorUnavailableError, OSError,
-                ConnectionLost, LookupError) as e:
-            self._resend_actor_task(task, actor_hex, e, addr_used)
-            return
-        window.append((task, pending, addr_used))
-        self._ensure_actor_reaper()
+    def _ack_actor_tasks(self, actor_hex: str, n: int):
+        with self._outbox_cv:
+            left = self._actor_unacked.get(actor_hex, 0) - n
+            if left > 0:
+                self._actor_unacked[actor_hex] = left
+            else:
+                self._actor_unacked.pop(actor_hex, None)
+            self._outbox_cv.notify_all()
 
     def _drain_actor_window(self, actor_hex: str):
-        """Pop completed submits off the window head; on failure, resend
-        the failed submit AND everything after it in order (they shared
-        the dead socket / stale incarnation). Caller holds the actor's
-        send lock. Blocks only when the window is full."""
+        """Flusher duty: pop completed batch frames off the window head;
+        on failure, resend the failed batch AND everything after it in
+        order (they shared the dead socket / stale incarnation). Never
+        blocks on an unready head — a stalled frame is failed only past
+        its 60s deadline so one wedged actor cannot stall the flusher."""
         window = self._actor_windows.get(actor_hex)
-        if not window:
-            return
         while window:
-            task, pending, addr = window[0]
-            if (not pending._ev_reply[0].is_set()
-                    and len(window) < self.ACTOR_WINDOW):
-                return
+            tasks, pending, addr, sent_at = window[0]
+            if not pending._ev_reply[0].is_set():
+                if time.monotonic() - sent_at < 60.0:
+                    return
+                err: BaseException = TimeoutError(
+                    f"actor submit unacked for 60s ({actor_hex[:8]})")
+            else:
+                err = None
+                try:
+                    pending.result(timeout=0)
+                except (exc.ActorDiedError, exc.ActorUnavailableError,
+                        OSError, ConnectionLost, TimeoutError,
+                        LookupError) as e:
+                    err = e
             window.popleft()
-            try:
-                pending.result(timeout=60)
-            except (exc.ActorDiedError, exc.ActorUnavailableError, OSError,
-                    ConnectionLost, TimeoutError, LookupError) as e:
-                failed = [(task, addr)]
-                failed += [(t, a) for t, _, a in window]
-                window.clear()
+            self._ack_actor_tasks(actor_hex, len(tasks))
+            if err is not None:
+                failed = [(t, addr) for t in tasks]
+                while window:
+                    ts, _, a, _ = window.popleft()
+                    failed += [(t, a) for t in ts]
+                    self._ack_actor_tasks(actor_hex, len(ts))
                 for t, a in failed:
-                    self._resend_actor_task(t, actor_hex, e, a)
+                    self._resend_actor_task(t, actor_hex, err, a)
                 return
 
     def _resend_actor_task(self, task: dict, actor_hex: str,
@@ -667,6 +774,8 @@ class ClusterRuntime:
         duplicates dedup worker-side), a new incarnation renumbers from
         the reset counter — either way no gap stalls the actor's ordered
         queue."""
+        if self._closed:
+            return  # store may be unmapped mid-shutdown: never touch
         if isinstance(first_err, (OSError, ConnectionLost)) \
                 and addr_used is not None:
             # transport failure ON THE RAYLET LINK: reconnect on retry.
@@ -745,8 +854,10 @@ class ClusterRuntime:
                             fs.remove(filler)
 
     def _ensure_actor_reaper(self):
-        """Background drain: surfaces failures of the LAST submits in a
-        burst even when no further call touches the actor."""
+        """Start the actor submit flusher: the single thread that sends
+        outbox batches, drains reply windows (surfacing failures of the
+        LAST submits in a burst even when no further call touches the
+        actor), and delivers seq gap-fillers."""
         if self._actor_reaper_started:
             return
         with self._seq_lock:
@@ -755,27 +866,45 @@ class ClusterRuntime:
             self._actor_reaper_started = True
 
         def loop():
+            gap_tick = 0.0
             while not self._closed:
-                time.sleep(0.05)
-                for actor_hex in list(self._actor_windows):
-                    window = self._actor_windows.get(actor_hex)
-                    if not window:
-                        continue
-                    with self._seq_lock:
-                        send_lock = self._actor_send_locks.setdefault(
-                            actor_hex, threading.Lock())
-                    with send_lock:
-                        try:
-                            self._drain_actor_window(actor_hex)
-                        except Exception:  # noqa: BLE001
-                            pass
+                linger = False
+                with self._outbox_cv:
+                    if not self._actor_outbox:
+                        # frames in flight need a tight drain cadence (acks
+                        # feed the flow-control window); fully idle can
+                        # sleep longer — a new submit notifies the cv
+                        busy = any(self._actor_windows.values())
+                        self._outbox_cv.wait(timeout=0.002 if busy else 0.05)
+                    else:
+                        linger = any(self._actor_windows.values())
+                if linger:
+                    # mid-burst micro-linger: when the flusher keeps pace
+                    # with the submitter, batches collapse to size 1 and
+                    # throughput falls back to per-call framing. 200us of
+                    # accumulation is hidden behind the frame already in
+                    # flight; isolated single calls (no in-flight frames)
+                    # skip it entirely.
+                    time.sleep(0.0002)
                 try:
-                    self._flush_gap_fillers()
+                    self._flush_actor_outbox()
                 except Exception:  # noqa: BLE001
                     pass
+                for actor_hex in list(self._actor_windows):
+                    try:
+                        self._drain_actor_window(actor_hex)
+                    except Exception:  # noqa: BLE001
+                        pass
+                now = time.monotonic()
+                if now - gap_tick >= 0.05:
+                    gap_tick = now
+                    try:
+                        self._flush_gap_fillers()
+                    except Exception:  # noqa: BLE001
+                        pass
 
         threading.Thread(target=loop, daemon=True,
-                         name="actor-submit-reaper").start()
+                         name="actor-submit-flusher").start()
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self._gcs.call("kill_actor", actor_id=actor_id.hex(),
@@ -785,8 +914,9 @@ class ClusterRuntime:
             # retire the dead incarnation's cached push-port client
             self._drop_actor_client(entry[0])
 
-    def get_actor(self, name: str) -> ActorID:
-        info = self._gcs.call("get_actor", name=name)
+    def get_actor(self, name: str, namespace: str | None = None) -> ActorID:
+        info = self._gcs.call("get_actor", name=name,
+                              namespace=self._effective_namespace(namespace))
         if info is None:
             raise ValueError(f"Failed to look up actor with name {name!r}")
         return ActorID.from_hex(info["actor_id"])

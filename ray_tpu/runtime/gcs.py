@@ -50,6 +50,9 @@ class ActorInfo:
     actor_id: str
     name: str | None
     state: str              # PENDING | ALIVE | RESTARTING | DEAD
+    # logical namespace scoping the name (reference: worker.py:1157 —
+    # named actors are unique PER NAMESPACE, not cluster-global)
+    namespace: str = "default"
     node_id: str | None = None
     creation_spec: bytes | None = None   # pickled wire spec (for restart)
     resources: dict = field(default_factory=dict)
@@ -488,20 +491,25 @@ class GcsServer(RpcServer):
 
     def rpc_register_actor(self, conn, send_lock, *, actor_id, name,
                            creation_spec, resources, max_restarts,
-                           pg_id=None):
+                           pg_id=None, namespace=None):
+        namespace = namespace or "default"
         with self._lock:
             if name is not None:
-                if name in self._named_actors:
-                    raise ValueError(f"Actor name {name!r} already taken")
-                self._named_actors[name] = actor_id
+                key = _ns_key(namespace, name)
+                if key in self._named_actors:
+                    raise ValueError(
+                        f"Actor name {name!r} already taken in namespace "
+                        f"{namespace!r}")
+                self._named_actors[key] = actor_id
             self._actors[actor_id] = ActorInfo(
-                actor_id=actor_id, name=name, state="PENDING",
+                actor_id=actor_id, name=name, namespace=namespace,
+                state="PENDING",
                 creation_spec=creation_spec, resources=dict(resources),
                 max_restarts=max_restarts, pg_id=pg_id,
             )
             self._log_actor(self._actors[actor_id])
             if name is not None:
-                self._log("named", name, actor_id)
+                self._log("named", _ns_key(namespace, name), actor_id)
         node_id = self._schedule_actor(actor_id)
         return {"ok": True, "node_id": node_id}
 
@@ -589,8 +597,9 @@ class GcsServer(RpcServer):
                 actor.state = "DEAD"
                 actor.death_reason = reason
                 if actor.name:
-                    self._named_actors.pop(actor.name, None)
-                    self._log("named", actor.name, None)
+                    key = _ns_key(actor.namespace, actor.name)
+                    self._named_actors.pop(key, None)
+                    self._log("named", key, None)
                 restarting = False
             self._log_actor(actor)
         if restarting:
@@ -603,10 +612,12 @@ class GcsServer(RpcServer):
                                     "actor_id": actor.actor_id,
                                     "reason": reason})
 
-    def rpc_get_actor(self, conn, send_lock, *, actor_id=None, name=None):
+    def rpc_get_actor(self, conn, send_lock, *, actor_id=None, name=None,
+                      namespace=None):
         with self._lock:
             if actor_id is None:
-                actor_id = self._named_actors.get(name)
+                actor_id = self._named_actors.get(
+                    _ns_key(namespace or "default", name))
                 if actor_id is None:
                     return None
             actor = self._actors.get(actor_id)
@@ -913,6 +924,12 @@ class GcsServer(RpcServer):
                 for k, v in n.available.items():
                     avail[k] = avail.get(k, 0.0) + v
         return {"total": total, "available": avail}
+
+
+def _ns_key(namespace: str, name: str) -> str:
+    """Registry key scoping a named actor to its namespace (the unit
+    separator cannot appear in user-visible names by convention)."""
+    return f"{namespace}\x1f{name}"
 
 
 def _fits(demand: dict, supply: dict) -> bool:

@@ -93,6 +93,9 @@ class LeaseManager:
         self._lock = threading.Lock()
         self._queues: dict[tuple, deque] = {}
         self._pushers: dict[tuple, int] = {}
+        # pushers currently HOLDING a lease (vs acquiring/parked): sizes
+        # fair-share grouping and gates spawn growth to actual capacity
+        self._holding: dict[tuple, int] = {}
         self._in_flight: dict[str, tuple] = {}   # task_id -> (task, lease)
         self._stopping = False
 
@@ -111,18 +114,20 @@ class LeaseManager:
             q = self._queues.setdefault(key, deque())
             q.append(task)
             active = self._pushers.get(key, 0)
-            # at most ONE new pusher per submit: targeting queued+active
-            # uncapped overshoots on bursts (submit i sees i queued AND
-            # i-1 active and spawns i more, ~2x churn of threads that
-            # grab a lease only to return it), while targeting queued
-            # alone serializes drip-fed work (an active pusher absorbs
-            # each arrival into its pipeline window, so len(q) stays at
-            # 1 and the pool never grows past one lease). One-per-submit
-            # converges to one pusher per outstanding task either way.
-            want = min(len(q) + active, self._max_per_shape)
-            spawn = min(want - active, 1)
-            if spawn > 0:
-                self._pushers[key] = active + spawn
+            holding = self._holding.get(key, 0)
+            # Spawn at most ONE prober, and only when every active pusher
+            # already holds a lease: pool growth is GRANT-driven (a pusher
+            # that acquires with surplus queue spawns the next prober in
+            # _pusher), so the pool ramps one grant at a time up to the
+            # cluster's real capacity instead of stampeding max_per_shape
+            # threads at 4 lease slots — 60 parked probers per shape turn
+            # the raylet's lease queue into the bottleneck. A drip-fed
+            # shape still grows: each submit seeing all-holders-busy adds
+            # exactly one prober.
+            spawn = 1 if (active < self._max_per_shape
+                          and active - holding <= 0) else 0
+            if spawn:
+                self._pushers[key] = active + 1
         for _ in range(max(spawn, 0)):
             threading.Thread(target=self._pusher, args=(key,),
                              name="ray_tpu-lease-pusher", daemon=True).start()
@@ -152,7 +157,7 @@ class LeaseManager:
             return None
 
     PIPELINE_DEPTH = 2   # in-flight push GROUPS per lease (hides owner RTT)
-    GROUP_SIZE = 8       # max tasks packed into one push RPC
+    GROUP_SIZE = 32      # max tasks packed into one push RPC
 
     def _pop_group(self, key: tuple, limit: int) -> list:
         with self._lock:
@@ -160,14 +165,40 @@ class LeaseManager:
             if not q:
                 return []
             # fair-share grouping: one pusher must not swallow the whole
-            # queue while sibling pushers (= other leases = other workers)
-            # sit idle — group only what exceeds the available parallelism
-            share = max(1, len(q) // max(1, self._pushers.get(key, 1)))
+            # queue while sibling LEASES sit idle — divide by pushers that
+            # actually HOLD a worker (probers parked at a saturated raylet
+            # would otherwise shrink groups to 1 and turn every task into
+            # its own round trip)
+            share = max(1, len(q) // max(1, self._holding.get(key, 1)))
             take = min(limit, share)
             out = []
             while q and len(out) < take:
                 out.append(q.popleft())
             return out
+
+    def _note_acquired(self, key: tuple):
+        """A pusher acquired a lease: count it as a holder, and — grant-
+        driven growth — spawn the NEXT prober while queued work outruns
+        the pool, so the pool ramps to cluster capacity one grant at a
+        time with at most one prober parked at a saturated raylet."""
+        spawn = False
+        with self._lock:
+            self._holding[key] = self._holding.get(key, 0) + 1
+            q = self._queues.get(key)
+            if q and self._pushers.get(key, 0) < self._max_per_shape:
+                self._pushers[key] = self._pushers.get(key, 0) + 1
+                spawn = True
+        if spawn:
+            threading.Thread(target=self._pusher, args=(key,),
+                             name="ray_tpu-lease-pusher", daemon=True).start()
+
+    def _note_released(self, key: tuple):
+        with self._lock:
+            left = self._holding.get(key, 0) - 1
+            if left > 0:
+                self._holding[key] = left
+            else:
+                self._holding.pop(key, None)
 
     def _pusher(self, key: tuple):
         lease: Lease | None = None
@@ -183,6 +214,7 @@ class LeaseManager:
             # ONE death-info query covers them all
             nonlocal lease
             broken, lease = lease, None
+            self._note_released(key)
             if info is None:
                 info = self._death_info(broken)
             try:
@@ -260,6 +292,8 @@ class LeaseManager:
                     self._in_flight[tid] = (task, None)
                 if lease is None:
                     lease = self._acquire_lease(task)
+                    if lease is not None:
+                        self._note_acquired(key)
                 if lease is None:
                     # unplaceable via lease (infeasible / exhausted
                     # retries): the raylet queue owns parking, autoscaler
@@ -278,6 +312,7 @@ class LeaseManager:
         finally:
             if lease is not None:
                 lease.close()
+                self._note_released(key)
             with self._lock:
                 left = self._pushers.get(key, 1) - 1
                 if left <= 0:

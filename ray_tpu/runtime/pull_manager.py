@@ -142,44 +142,57 @@ class PullManager:
     def _do_pull(self, oid_hex: str, oid: bytes) -> bool:
         if self._fetch_local(oid_hex):
             return True
-        for node_id, addr in self._peer_addresses(oid_hex):
-            addr = tuple(addr)
-            try:
-                if self._pull_from(oid_hex, oid, addr):
-                    return True
-            except Exception:  # noqa: BLE001 - next candidate
-                continue
-        return False
-
-    def _pull_from(self, oid_hex: str, oid: bytes, addr: tuple) -> bool:
-        client = self._checkout(addr)
-        try:
-            meta = client.call("fetch_object_meta", oid=oid_hex,
-                               timeout=30)
-        except Exception:
-            client.close()
-            raise
-        if not meta.get("found"):
-            self._checkin(addr, client)
+        addrs = [tuple(a) for _, a in self._peer_addresses(oid_hex)]
+        if not addrs:
             return False
-        size = int(meta["size"])
-        crc = meta.get("crc32")
-        if size <= self.chunk_size:
-            # small object: one read, one write
-            self._acquire(size)
+        # probe candidates for meta; large objects stripe across EVERY
+        # holder that answers (a hot object must not serialize on one
+        # source's NIC — reference: PullManager spreads chunk requests
+        # over the object's location set)
+        sources = []
+        size = crc = None
+        for addr in addrs:
+            client = None
             try:
-                payload = client.call("fetch_object", oid=oid_hex,
-                                      timeout=60)
-                if not self._verify(oid_hex, payload, size, crc, addr):
-                    return False
-                self._write_whole(oid, payload)
-            finally:
-                self._release(size)
-                self._checkin(addr, client)
-            self._on_pulled(oid_hex, size)
-            return True
-        self._checkin(addr, client)
-        return self._pull_chunked(oid_hex, oid, addr, size, crc)
+                client = self._checkout(addr)
+                meta = client.call("fetch_object_meta", oid=oid_hex,
+                                   timeout=30)
+            except Exception:  # noqa: BLE001 - next candidate
+                if client is not None:
+                    client.close()
+                continue
+            self._checkin(addr, client)
+            if not meta.get("found"):
+                continue
+            sources.append(addr)
+            size = int(meta["size"])
+            crc = meta.get("crc32")
+            if size <= self.chunk_size:
+                break   # one source is plenty for a single-chunk object
+        if not sources:
+            return False
+        if size <= self.chunk_size:
+            return self._pull_small(oid_hex, oid, sources[0], size, crc)
+        return self._pull_chunked(oid_hex, oid, sources, size, crc)
+
+    def _pull_small(self, oid_hex: str, oid: bytes, addr: tuple,
+                    size: int, crc) -> bool:
+        client = self._checkout(addr)
+        self._acquire(size)
+        try:
+            payload = client.call("fetch_object", oid=oid_hex,
+                                  timeout=60)
+            if not self._verify(oid_hex, payload, size, crc, addr):
+                return False
+            self._write_whole(oid, payload)
+        except Exception:  # noqa: BLE001
+            client.close()
+            return False
+        finally:
+            self._release(size)
+            self._checkin(addr, client)
+        self._on_pulled(oid_hex, size)
+        return True
 
     @staticmethod
     def _verify(oid_hex: str, payload, size: int, crc, addr) -> bool:
@@ -209,66 +222,121 @@ class PullManager:
             except Exception:  # noqa: BLE001 - racing pull won
                 pass
 
-    def _pull_chunked(self, oid_hex: str, oid: bytes, addr: tuple,
+    REFRESH_EVERY_CHUNKS = 16   # re-resolve holders every N chunks
+
+    def _pull_chunked(self, oid_hex: str, oid: bytes, sources: list,
                       size: int, crc=None) -> bool:
-        """Parallel chunk reads into a pre-allocated shm buffer."""
+        """Parallel chunk reads STRIPED across every known holder, into a
+        pre-allocated shm buffer. While the transfer runs, the holder set
+        is re-resolved periodically: a broadcast-hot object gains sources
+        as other pullers complete and register, and in-flight pulls fan
+        out onto them instead of hammering the origin (reference:
+        spreading pull requests over the location set + proactive Push,
+        object_manager.cc:339 — pull-based here, same effect)."""
         n_chunks = -(-size // self.chunk_size)
-        n_workers = min(self._conns_per_peer, n_chunks)
         try:
             view = self._store.create(oid, size)
         except Exception:  # noqa: BLE001 - exists (racing pull) or OOM
             return self._store.contains(oid)
         next_chunk = [0]
-        idx_lock = threading.Lock()
+        done_chunks = [0]
+        retries: list[int] = []   # chunks dropped by a dying source
+        state_lock = threading.Lock()
+        known: list = list(sources)       # all holders seen so far
         failed = threading.Event()
+        done_workers = threading.Semaphore(0)
+        per_source = max(1, self._conns_per_peer)
 
-        def fetch_range(client):
+        def fetch_range(client, addr):
+            fetched = 0
             while not failed.is_set() and not self._stopping:
-                with idx_lock:
-                    i = next_chunk[0]
-                    if i >= n_chunks:
-                        return
-                    next_chunk[0] = i + 1
+                with state_lock:
+                    if retries:
+                        i = retries.pop()
+                    elif next_chunk[0] < n_chunks:
+                        i = next_chunk[0]
+                        next_chunk[0] += 1
+                    else:
+                        return True
                 off = i * self.chunk_size
                 length = min(self.chunk_size, size - off)
                 self._acquire(length)
                 try:
-                    chunk = client.call("fetch_object_chunk", oid=oid_hex,
-                                        offset=off, length=length,
-                                        timeout=60)
+                    try:
+                        chunk = client.call("fetch_object_chunk",
+                                            oid=oid_hex, offset=off,
+                                            length=length, timeout=60)
+                    except Exception:
+                        # hand the claimed chunk back for a surviving
+                        # source; this worker dies with its connection
+                        with state_lock:
+                            retries.append(i)
+                        raise
                     if chunk is None or len(chunk) != length:
                         failed.set()
-                        return
+                        return False
                     view[off:off + length] = chunk
+                    with state_lock:
+                        done_chunks[0] += 1
                 finally:
                     self._release(length)
+                fetched += 1
+                if fetched % self.REFRESH_EVERY_CHUNKS == 0:
+                    self._maybe_add_sources(oid_hex, known, state_lock,
+                                            spawn)
+            return True
 
-        def run_worker():
+        def run_worker(addr):
             try:
-                client = self._checkout(addr)
-            except OSError:
-                failed.set()
-                return
-            try:
-                fetch_range(client)
-            except Exception:  # noqa: BLE001
-                failed.set()
-                client.close()
-                return
-            self._checkin(addr, client)
+                try:
+                    client = self._checkout(addr)
+                except OSError:
+                    # this source is unreachable; others may carry the
+                    # transfer — only fail the pull if NOBODY can
+                    return
+                try:
+                    fetch_range(client, addr)
+                except Exception:  # noqa: BLE001
+                    client.close()
+                    return
+                self._checkin(addr, client)
+            finally:
+                done_workers.release()
 
-        threads = [threading.Thread(target=run_worker, daemon=True)
-                   for _ in range(n_workers)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        spawned = [0]
+
+        def spawn(addr):
+            workers = min(per_source,
+                          max(1, n_chunks // max(1, len(known))))
+            for _ in range(workers):
+                with state_lock:
+                    spawned[0] += 1
+                threading.Thread(target=run_worker, args=(addr,),
+                                 daemon=True).start()
+
+        for addr in sources:
+            spawn(addr)
+        # wait for EVERY worker, including ones spawned mid-transfer by
+        # the holder refresh (re-read the count each round: sealing
+        # while a late-spawned worker still writes into the view would
+        # be a torn object)
+        finished = 0
+        while True:
+            done_workers.acquire()
+            finished += 1
+            with state_lock:
+                if finished >= spawned[0]:
+                    break
+        # workers may have exited without fetching every chunk (dead
+        # sources): incomplete coverage is a failure
+        with state_lock:
+            complete = done_chunks[0] >= n_chunks and not failed.is_set()
         try:
-            if failed.is_set() or self._stopping:
+            if not complete or self._stopping:
                 view.release()
                 self._store.abort(oid)   # unsealed: writer-owned free
                 return False
-            if not self._verify(oid_hex, view, size, crc, addr):
+            if not self._verify(oid_hex, view, size, crc, sources[0]):
                 view.release()
                 self._store.abort(oid)
                 return False
@@ -278,3 +346,20 @@ class PullManager:
             return False
         self._on_pulled(oid_hex, size)
         return True
+
+    def _maybe_add_sources(self, oid_hex: str, known: list, state_lock,
+                           spawn):
+        """Mid-transfer holder refresh: stripe onto newly registered
+        copies of a hot object."""
+        try:
+            fresh = [tuple(a) for _, a in self._peer_addresses(oid_hex)]
+        except Exception:  # noqa: BLE001 - GCS hiccup; keep pulling
+            return
+        new = []
+        with state_lock:
+            for addr in fresh:
+                if addr not in known:
+                    known.append(addr)
+                    new.append(addr)
+        for addr in new:
+            spawn(addr)

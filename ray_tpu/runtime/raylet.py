@@ -4,6 +4,11 @@ Reference analog: ``src/ray/raylet/`` — ``NodeManager`` (node_manager.h:125)
 on one event loop hosting the local scheduler (``ClusterTaskManager`` /
 ``LocalTaskManager``), the worker pool (``worker_pool.cc``), and the object
 manager (``src/ray/object_manager/`` — pull/push of objects between nodes).
+Like the reference, those are separate components owned by this node
+manager — ``runtime/scheduler.py`` (queue/dispatch/leases/resources),
+``runtime/worker_pool.py`` (spawn/registration/death/OOM policy),
+``runtime/object_manager.py`` (pins/spill/transfer/pulls) — while the
+raylet keeps placement routing, actors, cancellation, and the RPC surface.
 
 Differences by design (TPU-host build, single-controller Python services):
 - workers attach the node's C++ shm store directly (no UDS protocol hop);
@@ -15,53 +20,24 @@ Differences by design (TPU-host build, single-controller Python services):
 
 from __future__ import annotations
 
-import json
 import os
-import shutil
-import subprocess
 import sys
-import tempfile
 import threading
 import time
-from collections import deque
-from dataclasses import dataclass, field
-from typing import Any
 
-from ray_tpu._private.shm_store import ObjectNotFoundError, ShmObjectStore
+from ray_tpu._private.shm_store import ShmObjectStore
 from ray_tpu.runtime import object_codec
 from ray_tpu.runtime.gcs import _fits
+from ray_tpu.runtime.object_manager import LocalObjectManager
 from ray_tpu.runtime.rpc import (
     ReconnectingRpcClient,
     RpcClient,
     RpcServer,
-    recv_msg,
     send_msg,
 )
-from ray_tpu.utils.ids import ObjectID, WorkerID
-
-
-@dataclass
-class WorkerHandle:
-    worker_id: str
-    proc: subprocess.Popen | None = None
-    conn: Any = None            # held task-channel socket
-    send_lock: Any = None
-    state: str = "starting"     # starting | idle | busy | leased | actor | dead
-    # owner-facing task port (worker-lease protocol); leases hand this
-    # address to the owner, which pushes tasks to it directly
-    push_addr: tuple | None = None
-    actor_id: str | None = None
-    incarnation: int = 0
-    current_task: dict | None = None
-    acquired: dict = field(default_factory=dict)
-    # set by the memory monitor right before a pressure kill so the death
-    # handler stores OutOfMemoryError instead of WorkerCrashedError
-    oom_killed: bool = False
-    dispatched_at: float = 0.0   # monotonic time the current task started
-    # runtime-env identity this worker booted with; tasks only run on a
-    # worker with a matching key (reference: (language, runtime_env)-
-    # keyed worker caching in worker_pool.cc)
-    env_key: str = ""
+from ray_tpu.runtime.scheduler import TaskScheduler
+from ray_tpu.runtime.worker_pool import WorkerHandle, WorkerPool  # noqa: F401
+# WorkerHandle is re-exported: it is part of this module's historical API.
 
 
 class Raylet(RpcServer):
@@ -75,10 +51,7 @@ class Raylet(RpcServer):
         self.store_name = f"/raytpu_{os.getpid()}_{node_id[:8]}"
         self.store = ShmObjectStore(self.store_name, capacity=store_capacity,
                                     create=True)
-        self.total_resources = dict(resources)
-        self.available = dict(resources)
         self.labels = labels or {}
-        self._res_lock = threading.Lock()
 
         # reconnecting: survives a GCS restart (file-backed recovery)
         self._gcs = ReconnectingRpcClient(self.gcs_address)
@@ -88,92 +61,53 @@ class Raylet(RpcServer):
         self._peer_addrs: dict[str, tuple] = {}
         self._peers_lock = threading.Lock()
 
-        self._workers: dict[str, WorkerHandle] = {}
-        self._workers_lock = threading.Lock()
-        self._max_workers = max(1, int(resources.get("CPU", 1)))
-        self._ready: deque[dict] = deque()
-        self._ready_cv = threading.Condition()
-        # bumped on every completion/registration: the dispatch loop
-        # re-checks it under the cv so a kick racing the wait is never lost
-        self._dispatch_gen = 0
+        self.workers = WorkerPool(
+            self, max_workers=max(1, int(resources.get("CPU", 1))))
+        self.scheduler = TaskScheduler(
+            self, resources=resources,
+            infeasible_timeout_s=infeasible_timeout_s)
         self._hb_interval = heartbeat_interval_s
         self._threads: list[threading.Thread] = []
-        # --- object spilling (reference: LocalObjectManager::SpillObjects
-        # local_object_manager.h:110 + external_storage.py FileSystemStorage).
-        # Spilled objects leave shm for files in _spill_dir; the GCS
-        # location entry stays (this node can still serve them), and any
-        # local read restores them into shm first.
         from ray_tpu.utils.config import get_config
         _cfg = get_config()
-        self._spill_enabled = _cfg.object_spilling_enabled
         self._mem_threshold = _cfg.memory_usage_threshold
         self._mem_refresh_s = max(_cfg.memory_monitor_refresh_ms, 50) / 1e3
-        self._spill_high = _cfg.object_spilling_high_fraction
-        self._spill_low = _cfg.object_spilling_low_fraction
-        # always a per-raylet SUBdirectory: stop() removes the whole dir,
-        # and a shared configured path must not nuke other raylets' files
-        _spill_base = (_cfg.object_spilling_directory
-                       or tempfile.gettempdir())
-        self._spill_dir = os.path.join(
-            _spill_base, f"raytpu_spill_{os.getpid()}_{node_id[:8]}")
-        # oid hex -> (file path, was_primary): primaries re-pin on
-        # restore; spilled secondaries stay evictable after restore
-        self._spilled: dict[str, tuple[str, bool]] = {}
-        self._spill_lock = threading.Lock()
-        self.spill_stats = {"num_spilled": 0, "bytes_spilled": 0,
-                            "num_restored": 0, "bytes_restored": 0}
-        # Primary-copy pins: every object CREATED on this node is pinned
-        # (one raylet-held read ref) so the store's LRU eviction can never
-        # destroy the sole copy — memory is reclaimed by SPILLING pinned
-        # objects instead (reference: raylet PinObjectIDs + spill-only
-        # reclamation of primaries; secondary/pulled copies stay
-        # unpinned and evictable).
-        self._pinned: set[str] = set()
-        self._pin_lock = threading.Lock()
-        # every object registered with the GCS as located here (primary or
-        # pulled secondary); reconciled against the store so LRU-evicted
-        # secondaries don't leave stale locations in the directory forever
-        # (reference: object-eviction pubsub updating the ObjectDirectory)
-        self._local_objects: set[str] = set()
-        self._local_objects_lock = threading.Lock()
-        # cluster-wide infeasible tasks awaiting capacity (autoscaler)
-        self.infeasible_timeout_s = infeasible_timeout_s
-        self._infeasible: list = []
-        self._infeasible_lock = threading.Lock()
-        # OOM-backoff timers (cancelled by stop())
-        self._deferred_timers: set[threading.Timer] = set()
-        self._timers_lock = threading.Lock()
-        # why recent workers died, queried by lease owners on break
-        # (bounded FIFO; reference: worker exit detail in death reports)
-        self._death_info: dict[str, dict] = {}
-        # env_key -> (error, when): envs whose setup failed — tasks fail
-        # fast instead of driving a spawn/install/crash loop
-        self._bad_envs: dict[str, tuple] = {}
-        # oid -> (size, crc32): transfer-integrity probe memo (objects
-        # are immutable; bounded FIFO)
-        self._crc_cache: dict[str, tuple] = {}
-        # buffered object-location registrations (batched to the GCS)
-        self._loc_buf: list[tuple[str, int]] = []
-        self._loc_cv = threading.Condition()
-        # wakes ensure_local waiters when an object becomes local
-        self._local_cv = threading.Condition()
-        # chunked pull plane (reference: PullManager pull_manager.h:52)
-        from ray_tpu.runtime.pull_manager import PullManager
-        self._pulls = PullManager(
-            fetch_local=self._restore_spilled,
-            peer_addresses=self._peer_addresses_for,
-            store=self.store,
-            on_pulled=self._on_pulled,
-            chunk_size=_cfg.object_transfer_chunk_bytes,
-            max_in_flight_bytes=max(
-                int(store_capacity
-                    * _cfg.object_transfer_inflight_fraction),
-                _cfg.object_transfer_chunk_bytes),
-        )
-        # parked worker-lease requests (owner-side lease protocol;
-        # reference: the lease queue behind HandleRequestWorkerLease,
-        # node_manager.cc:1778). Guarded by _ready_cv.
-        self._lease_waiters: deque[dict] = deque()
+        self.objects = LocalObjectManager(
+            self, store=self.store, store_capacity=store_capacity, cfg=_cfg)
+
+    # component-facing compatibility views (tests, the dashboard, and the
+    # worker pool read these under their historical names)
+    @property
+    def _workers(self):
+        return self.workers.workers
+
+    @property
+    def spill_stats(self):
+        return self.objects.spill_stats
+
+    @property
+    def total_resources(self):
+        return self.scheduler.total_resources
+
+    @property
+    def available(self):
+        return self.scheduler.available
+
+    @property
+    def infeasible_timeout_s(self):
+        return self.scheduler.infeasible_timeout_s
+
+    def _kick_dispatch(self):
+        self.scheduler.kick()
+
+    def _release(self, demand: dict):
+        self.scheduler.release(demand)
+
+    def _enqueue(self, task: dict):
+        self.scheduler.enqueue(task)
+
+    def _avail_snapshot(self) -> dict:
+        return self.scheduler.avail_snapshot()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -186,153 +120,53 @@ class Raylet(RpcServer):
                 "register_node", node_id=self.node_id, address=self.address,
                 store_name=self.store_name, resources=self.total_resources,
                 labels=self.labels)
-        loops = [self._dispatch_loop, self._heartbeat_loop,
-                 self._monitor_loop, self._infeasible_loop,
-                 self._location_flush_loop]
-        if self._spill_enabled:
-            loops.append(self._spill_loop)
+        loops = [self.scheduler.dispatch_loop, self._heartbeat_loop,
+                 self.workers.monitor_loop, self.scheduler.infeasible_loop,
+                 self.objects.location_flush_loop]
+        if self.objects.spill_enabled:
+            loops.append(self.objects.spill_loop)
         if self._mem_threshold > 0:
-            loops.append(self._memory_monitor_loop)
+            loops.append(lambda: self.workers.memory_monitor_loop(
+                self._mem_threshold, self._mem_refresh_s))
         for target in loops:
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
         return self
 
-    # ------------------------------------------------------------------
-    # infeasible-task parking (reference: ClusterTaskManager infeasible
-    # queue + GcsAutoscalerStateManager demand reporting)
-    # ------------------------------------------------------------------
-
-    def _park_infeasible(self, task: dict, demand: dict):
-        deadline = time.monotonic() + self.infeasible_timeout_s
-        with self._infeasible_lock:
-            self._infeasible.append((task, demand, deadline))
-            all_demands = [d for _, d, _ in self._infeasible]
-        try:
-            with self._gcs_lock:
-                # full parked set: a per-task report would overwrite
-                # siblings' demands in the GCS view
-                self._gcs.call("report_demand", node_id=self.node_id,
-                               demands=all_demands)
-        except Exception:  # noqa: BLE001 - advertising only
-            pass
-
-    def _infeasible_loop(self):
-        """Retry parked tasks as capacity appears (a new node registers);
-        error them when the grace window expires."""
-        while not self._stopping:
-            time.sleep(0.25)
-            with self._infeasible_lock:
-                parked, self._infeasible = self._infeasible, []
-            if not parked:
-                continue
-            still: list = []
-            now = time.monotonic()
-            demands_left = []
-            for task, demand, deadline in parked:
-                # this node's capacity is fixed; recovery means a NEW
-                # node registered and the GCS can now place the task
-                placed = False
-                try:
-                    with self._gcs_lock:
-                        target = self._gcs.call(
-                            "pick_node", demand=demand,
-                            exclude=[self.node_id])
-                    if target is not None and self._forward(
-                            task, target, 0):
-                        placed = True
-                except Exception:  # noqa: BLE001
-                    pass
-                if placed:
-                    continue
-                if now > deadline:
-                    self._store_task_error(task, ValueError(
-                        f"task {task.get('name')} demands {demand}: "
-                        f"infeasible (no node satisfied it within "
-                        f"{self.infeasible_timeout_s}s)"))
-                else:
-                    still.append((task, demand, deadline))
-                    demands_left.append(demand)
-            with self._infeasible_lock:
-                self._infeasible.extend(still)
-            try:
-                with self._gcs_lock:
-                    self._gcs.call("report_demand", node_id=self.node_id,
-                                   demands=demands_left)
-            except Exception:  # noqa: BLE001
-                pass
-
     def stop(self):
         super().stop()
-        self._pulls.stop()
-        with self._timers_lock:
-            timers = list(self._deferred_timers)
-            self._deferred_timers.clear()
-        for timer in timers:
-            timer.cancel()
-        # wake parked lease requests so owners fall back instead of
-        # blocking out their full timeout on a dying node
-        with self._ready_cv:
-            waiters = list(self._lease_waiters)
-            self._lease_waiters.clear()
-        for waiter in waiters:
-            waiter["result"] = {"retry": True}
-            waiter["event"].set()
+        self.objects.stop()
+        self.scheduler.stop()
         # join background loops BEFORE closing the store: a mid-tick spill
         # loop dereferencing the munmapped segment is a segfault, not an
         # exception
         for t in self._threads:
             t.join(timeout=2.0)
-        with self._workers_lock:
-            workers = list(self._workers.values())
-        for w in workers:
-            if w.proc is not None and w.proc.poll() is None:
-                w.proc.terminate()
-        for w in workers:
-            if w.proc is not None:
-                try:
-                    w.proc.wait(timeout=2)
-                except subprocess.TimeoutExpired:
-                    w.proc.kill()
+        self.workers.stop()
         self.store.close()
-        shutil.rmtree(self._spill_dir, ignore_errors=True)
+        self.objects.cleanup_disk()
+
+    def _interruptible_sleep(self, seconds: float):
+        """Sleep in small increments so background loops observe
+        ``_stopping`` within ~0.1s — stop() joins them with a short
+        timeout before munmapping the store, and a loop that oversleeps
+        the join touches freed memory (segfault, not an exception)."""
+        deadline = time.monotonic() + seconds
+        while not self._stopping:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                return
+            time.sleep(min(0.1, remain))
 
     # ------------------------------------------------------------------
-    # worker pool (reference: worker_pool.cc — spawn, registration
-    # handshake, idle caching)
+    # worker pool RPC surface (logic: runtime/worker_pool.py)
     # ------------------------------------------------------------------
 
-    def _spawn_worker(self, runtime_env: dict | None = None) -> WorkerHandle:
-        from ray_tpu.runtime_env import env_key as _env_key
-
-        worker_id = WorkerID.from_random().hex()
-        env = dict(os.environ)
-        env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
-        if runtime_env:
-            env["RAY_TPU_RUNTIME_ENV"] = json.dumps(runtime_env)
-        env.update({
-            "RAY_TPU_RAYLET_HOST": self.address[0],
-            "RAY_TPU_RAYLET_PORT": str(self.address[1]),
-            "RAY_TPU_GCS_HOST": self.gcs_address[0],
-            "RAY_TPU_GCS_PORT": str(self.gcs_address[1]),
-            "RAY_TPU_STORE_NAME": self.store_name,
-            "RAY_TPU_WORKER_ID": worker_id,
-            "RAY_TPU_NODE_ID": self.node_id,
-            # workers never touch the TPU tunnel unless told to
-            "JAX_PLATFORMS": env_get_default("JAX_PLATFORMS", "cpu"),
-        })
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.runtime.worker_main"],
-            env=env, cwd=os.getcwd(),
-        )
-        handle = WorkerHandle(worker_id=worker_id, proc=proc,
-                              env_key=_env_key(runtime_env))
-        with self._workers_lock:
-            self._workers[worker_id] = handle
-        return handle
-
-    BAD_ENV_TTL_S = 60.0
+    def rpc_register_worker(self, conn, send_lock, *, worker_id,
+                            push_addr=None):
+        return self.workers.register(conn, send_lock, worker_id=worker_id,
+                                     push_addr=push_addr)
 
     def rpc_runtime_env_failed(self, conn, send_lock, *, key: str,
                                error: str):
@@ -341,168 +175,74 @@ class Raylet(RpcServer):
         respawning workers for it for a while — otherwise the queue
         drives an infinite spawn/install/crash loop with the real error
         trapped in worker stderr."""
-        from ray_tpu.runtime_env import env_key as _env_key
         from ray_tpu.utils import exceptions as exc
 
-        self._bad_envs[key] = (error, time.monotonic())
-        doomed = []
-        with self._ready_cv:
-            keep = deque()
-            while self._ready:
-                task = self._ready.popleft()
-                if _env_key(task.get("runtime_env")) == key:
-                    doomed.append(task)
-                else:
-                    keep.append(task)
-            self._ready = keep
+        self.workers.mark_bad_env(key, error)
+        doomed = self.scheduler.drop_queued_with_env(key)
         for task in doomed:
             self._store_task_error(task, exc.RuntimeEnvSetupError(
                 f"runtime env setup failed: {error}"))
         return {"failed_tasks": len(doomed)}
 
-    def _bad_env_error(self, runtime_env) -> str | None:
-        from ray_tpu.runtime_env import env_key as _env_key
+    def rpc_worker_death_info(self, conn, send_lock, *, worker_id: str,
+                              timeout_s: float = 2.0):
+        """Why a worker died (lease owners map a broken lease to e.g.
+        OutOfMemoryError instead of a generic crash). The owner's lease
+        connection breaks the instant the process dies — often BEFORE
+        this raylet's channel reader records the death — so this briefly
+        waits for the record instead of returning an empty answer."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            info = self.workers.death_info(worker_id)
+            if info is not None:
+                return info
+            if time.monotonic() >= deadline or self._stopping:
+                return {}
+            time.sleep(0.05)
 
-        hit = self._bad_envs.get(_env_key(runtime_env))
-        if hit is None:
-            return None
-        error, at = hit
-        if time.monotonic() - at > self.BAD_ENV_TTL_S:
-            return None   # stale: the env may be fixable (cache purged)
-        return error
+    def _retry_or_fail_dead_worker_task(self, w: WorkerHandle, task: dict):
+        """Retry/error policy for the in-flight task of a dead worker
+        (called by WorkerPool.on_worker_gone)."""
+        decided = all(self.store.contains(bytes.fromhex(o))
+                      for o in task.get("return_oids", ()))
+        if decided or task.get("cancelled"):
+            pass   # cancelled (error pre-stored) or results written:
+                   # a retry would re-run completed/cancelled work
+        elif w.oom_killed:
+            # OOM kills have their OWN budget (config task_oom_retries,
+            # reference RAY_task_oom_retries): host pressure from an
+            # unrelated process must not burn the task's max_retries
+            # lineage budget, and re-dispatch backs off so a
+            # still-pressured node doesn't churn through the budget in
+            # a few monitor ticks.
+            from ray_tpu.utils.config import get_config
 
-    def rpc_register_worker(self, conn, send_lock, *, worker_id,
-                            push_addr=None):
-        """Registration handshake; the connection becomes the raylet→worker
-        task channel and worker→raylet completion stream."""
-        with self._workers_lock:
-            handle = self._workers.get(worker_id)
-            if handle is None:   # externally started worker (tests)
-                handle = WorkerHandle(worker_id=worker_id)
-                self._workers[worker_id] = handle
-            if push_addr is not None:
-                handle.push_addr = tuple(push_addr)
-        # the registration ack MUST be the channel's first message: only
-        # AFTER it is on the wire may other threads see handle.conn —
-        # an actor-delivery thread polling for the conn could otherwise
-        # inject create_actor ahead of the ack and fail the handshake
-        send_msg(conn, {"registered": True}, send_lock)
-        with self._workers_lock:
-            handle.conn = conn
-            handle.send_lock = send_lock
-            if handle.state == "starting":
-                # actor-designated workers keep their "actor" state — the
-                # dispatcher must never hand them normal tasks
-                handle.state = "idle"
-        self._kick_dispatch()
-        try:
-            while not self._stopping:
-                try:
-                    msg = recv_msg(conn)
-                except (OSError, EOFError, Exception):
-                    break
-                self._on_worker_msg(handle, msg)
-        finally:
-            self.release_conn(conn)   # held channel finished
-            self._on_worker_gone(handle)
-        return RpcServer.HELD
-
-    def _on_worker_msg(self, w: WorkerHandle, msg: dict):
-        kind = msg.get("type")
-        if kind == "task_done":
-            self._finish_task(w, msg)
-        elif kind == "actor_ready":
-            with self._gcs_lock:
-                self._gcs.call(
-                    "actor_ready", actor_id=msg["actor_id"],
-                    node_id=self.node_id,
-                    push_addr=(list(w.push_addr) if w.push_addr else None))
-        elif kind == "actor_creation_failed":
-            with self._gcs_lock:
-                self._gcs.call("actor_failed", actor_id=msg["actor_id"],
-                               reason=msg.get("reason", "creation failed"))
-
-    def _finish_task(self, w: WorkerHandle, msg: dict):
-        with self._workers_lock:
-            w.current_task = None
-        if w.state == "busy":
-            # actor workers keep their acquisition for their LIFETIME
-            # (released on death/kill); only per-task resources return here
-            self._release(w.acquired)
-            w.acquired = {}
-            w.state = "idle"
-        self._kick_dispatch()
-
-    def _on_worker_gone(self, w: WorkerHandle):
-        """Worker process/channel died (reference: NodeManager worker failure
-        path — in-flight task gets retried or an error object)."""
-        if self._stopping:
-            return
-        with self._workers_lock:
-            if w.state == "dead":
-                return  # channel reader and monitor both report deaths
-            prior_state = w.state
-            w.state = "dead"
-            self._workers.pop(w.worker_id, None)
-            self._death_info[w.worker_id] = {"oom_killed": w.oom_killed}
-            while len(self._death_info) > 256:
-                self._death_info.pop(next(iter(self._death_info)))
-        # reclaim created-but-unsealed allocations and pinned read refs of
-        # the dead worker only (live writers/readers are untouched)
-        if w.proc is not None and w.proc.pid:
-            self.store.evict_orphans(w.proc.pid)
-            self.store.release_pid(w.proc.pid)
-        task = w.current_task
-        self._release(w.acquired)
-        w.acquired = {}
-        if prior_state == "actor" and w.actor_id is not None:
-            try:
-                with self._gcs_lock:
-                    self._gcs.call(
-                        "actor_failed", actor_id=w.actor_id,
-                        reason=f"actor worker {w.worker_id[:8]} died")
-            except Exception:  # noqa: BLE001 - gcs may be shutting down
-                pass
-        elif task is not None:
-            decided = all(self.store.contains(bytes.fromhex(o))
-                          for o in task.get("return_oids", ()))
-            if decided or task.get("cancelled"):
-                pass   # cancelled (error pre-stored) or results written:
-                       # a retry would re-run completed/cancelled work
-            elif w.oom_killed:
-                # OOM kills have their OWN budget (config task_oom_retries,
-                # reference RAY_task_oom_retries): host pressure from an
-                # unrelated process must not burn the task's max_retries
-                # lineage budget, and re-dispatch backs off so a
-                # still-pressured node doesn't churn through the budget in
-                # a few monitor ticks.
-                from ray_tpu.utils.config import get_config
-
-                total = get_config().task_oom_retries
-                left = task.get("_oom_retries_left", total)
-                if left > 0:
-                    task["_oom_retries_left"] = left - 1
-                    delay = min(8.0, 1.0 * 2 ** (total - left))
-                    self._defer_enqueue(task, delay)
-                else:
-                    from ray_tpu.utils import exceptions as exc
-                    self._store_task_error(task, exc.OutOfMemoryError(
-                        f"task {task.get('name')}: worker killed to relieve "
-                        f"host memory pressure (threshold "
-                        f"{self._mem_threshold}; {total} OOM retries "
-                        f"exhausted)"))
-            elif task.get("max_retries", 0) > 0:
-                task["max_retries"] -= 1
-                self._enqueue(task)
+            total = get_config().task_oom_retries
+            left = task.get("_oom_retries_left", total)
+            if left > 0:
+                task["_oom_retries_left"] = left - 1
+                delay = min(8.0, 1.0 * 2 ** (total - left))
+                self.scheduler.defer_enqueue(task, delay)
             else:
-                self._store_task_error(
-                    task, RuntimeError(
-                        f"worker died executing {task.get('name')}"))
+                from ray_tpu.utils import exceptions as exc
+                self._store_task_error(task, exc.OutOfMemoryError(
+                    f"task {task.get('name')}: worker killed to relieve "
+                    f"host memory pressure (threshold "
+                    f"{self._mem_threshold}; {total} OOM retries "
+                    f"exhausted)"))
+        elif task.get("max_retries", 0) > 0:
+            task["max_retries"] -= 1
+            self._enqueue(task)
+        else:
+            self._store_task_error(
+                task, RuntimeError(
+                    f"worker died executing {task.get('name')}"))
 
     def _store_task_error(self, task: dict, error: BaseException):
         from ray_tpu.utils import exceptions as exc
         err = (error if isinstance(error, exc.RayTpuError)
                else exc.WorkerCrashedError(str(error)))
+        om = self.objects
         for oid_hex in task.get("return_oids", ()):
             oid = bytes.fromhex(oid_hex)
             if not self.store.contains(oid):
@@ -513,12 +253,12 @@ class Raylet(RpcServer):
                     size = object_codec.put_value_durable(
                         self.store, oid, err, is_error=True, hold=True,
                         timeout_s=5.0,
-                        request_space=(self._spill_bytes
-                                       if self._spill_enabled else None))
+                        request_space=(om.spill_bytes
+                                       if om.spill_enabled else None))
                 except Exception:  # noqa: BLE001 - already created etc.
                     continue
-                self._pin_object(oid_hex)
-                self._track_local(oid_hex)
+                om.pin_object(oid_hex)
+                om.track_local(oid_hex)
                 if size > 0:
                     self.store.release(oid)
                 with self._gcs_lock:
@@ -526,8 +266,8 @@ class Raylet(RpcServer):
                                    node_id=self.node_id, size=size)
 
     # ------------------------------------------------------------------
-    # scheduling (reference: ClusterTaskManager::QueueAndScheduleTask +
-    # LocalTaskManager dispatch; spillback via GCS view)
+    # placement routing (reference: ClusterTaskManager spillback policy;
+    # queueing/dispatch live in runtime/scheduler.py)
     # ------------------------------------------------------------------
 
     def rpc_submit_task(self, conn, send_lock, *, task: dict,
@@ -575,7 +315,7 @@ class Raylet(RpcServer):
                 # (reference: infeasible queue feeding
                 # GcsAutoscalerStateManager). Errors only after the grace
                 # window — a fixed cluster still fails fast enough.
-                self._park_infeasible(task, demand)
+                self.scheduler.park_infeasible(task, demand)
                 return {"ok": True, "parked": "infeasible"}
         elif spill_count < 2 and not _fits(demand, self._avail_snapshot()):
             # busy here: one spillback attempt through the GCS view
@@ -623,195 +363,13 @@ class Raylet(RpcServer):
                 return client
         return None
 
-    def _enqueue(self, task: dict):
-        with self._ready_cv:
-            self._ready.append(task)
-            self._ready_cv.notify()
-
-    def _defer_enqueue(self, task: dict, delay: float):
-        """Re-enqueue after a delay (OOM backoff). Timers are tracked so
-        stop() cancels them — an untracked timer firing after the store
-        closes would enqueue into a dead dispatch loop; the task is then
-        lost like any other task queued on a stopping node (cluster-level
-        recovery owns that case)."""
-        timer = threading.Timer(delay, self._timer_enqueue, args=(task,))
-        timer.daemon = True
-        with self._timers_lock:
-            if self._stopping:
-                return
-            self._deferred_timers.add(timer)
-        timer.start()
-
-    def _timer_enqueue(self, task: dict):
-        with self._timers_lock:
-            self._deferred_timers = {t for t in self._deferred_timers
-                                     if t.is_alive()}
-        if not self._stopping:
-            self._enqueue(task)
-
-    def _kick_dispatch(self):
-        with self._ready_cv:
-            self._dispatch_gen += 1
-            self._ready_cv.notify()
-
-    def _avail_snapshot(self) -> dict:
-        with self._res_lock:
-            return dict(self.available)
-
-    def _try_acquire(self, demand: dict) -> bool:
-        with self._res_lock:
-            if not _fits(demand, self.available):
-                return False
-            for k, v in demand.items():
-                self.available[k] = self.available.get(k, 0.0) - v
-            return True
-
-    def _release(self, demand: dict):
-        if not demand:
-            return
-        with self._res_lock:
-            for k, v in demand.items():
-                self.available[k] = self.available.get(k, 0.0) + v
-        # freed capacity may unblock a parked lease request or queued task
-        self._kick_dispatch()
-
-    def _dispatch_loop(self):
-        while not self._stopping:
-            with self._ready_cv:
-                while (not self._ready and not self._lease_waiters
-                       and not self._stopping):
-                    self._ready_cv.wait(timeout=0.2)
-                if self._stopping:
-                    return
-                gen0 = self._dispatch_gen
-                task = None
-                # first task whose resources fit (avoid head-of-line block)
-                for i, t in enumerate(self._ready):
-                    if _fits(t.get("resources", {}), self._avail_snapshot()):
-                        task = t
-                        del self._ready[i]
-                        break
-            self._serve_lease_waiters()
-            if task is None:
-                # only lease waiters, or no fitting task: block until the
-                # next kick (completion/registration/release)
-                with self._ready_cv:
-                    if self._dispatch_gen == gen0 and not self._stopping:
-                        self._ready_cv.wait(timeout=0.1)
-                continue
-            env_err = self._bad_env_error(task.get("runtime_env"))
-            if env_err is not None:
-                from ray_tpu.utils import exceptions as exc
-                self._store_task_error(task, exc.RuntimeEnvSetupError(
-                    f"runtime env setup failed: {env_err}"))
-                continue
-            gen = self._dispatch_gen
-            worker = self._idle_worker(task.get("runtime_env"))
-            if worker is None:
-                self._enqueue(task)
-                # wait for a completion/registration kick instead of a
-                # fixed sleep: task_done latency, not a poll, sets the
-                # dispatch rate when all workers are busy. The generation
-                # check under the cv closes the missed-wakeup race (a
-                # kick between the snapshot above and this wait).
-                with self._ready_cv:
-                    if self._dispatch_gen == gen and not self._stopping:
-                        self._ready_cv.wait(timeout=0.2)
-                continue
-            if not self._try_acquire(task.get("resources", {})):
-                worker.state = "idle"
-                self._enqueue(task)
-                continue
-            cancelled = False
-            with self._workers_lock:
-                # under the lock: cancel_task scans current_task here, and
-                # a cancel that ran between the queue pop and this point
-                # left a flag on the task dict
-                if task.get("cancelled"):
-                    cancelled = True
-                    worker.state = "idle"
-                else:
-                    worker.acquired = dict(task.get("resources", {}))
-                    worker.current_task = task
-                    worker.dispatched_at = time.monotonic()
-            if cancelled:
-                # outside _workers_lock: _release kicks the dispatch cv,
-                # and holding the worker lock across that inverts the
-                # cv→workers lock order used by the lease grant path
-                self._release(task.get("resources", {}))
-                continue
-            try:
-                send_msg(worker.conn, {"type": "task", "task": task},
-                         worker.send_lock)
-            except OSError:
-                self._on_worker_gone(worker)
-                self._enqueue(task)
-
-    def _idle_worker(self, runtime_env: dict | None = None
-                     ) -> WorkerHandle | None:
-        """Grab an idle registered worker WITH a matching runtime-env
-        key; spawn one for this env when under the cap. At the cap, an
-        idle worker with a DIFFERENT env key is evicted to make room —
-        otherwise a full pool of mismatched-env workers starves the task
-        forever (reference: worker_pool.cc kills idle workers beyond the
-        cached-soft-limit when a lease needs a different runtime_env)."""
-        from ray_tpu.runtime_env import env_key as _env_key
-
-        key = _env_key(runtime_env)
-        evict = None
-        with self._workers_lock:
-            n_alive = 0
-            incoming = False  # replacement with this env already booting?
-            for w in self._workers.values():
-                if w.state in ("idle", "busy", "starting", "actor",
-                               "leased"):
-                    n_alive += 1
-                if w.state == "starting" and w.env_key == key:
-                    incoming = True
-                if (w.state == "idle" and w.conn is not None
-                        and w.env_key == key):
-                    w.state = "busy"
-                    return w
-            if incoming:
-                # a matching worker is already on its way — evicting more
-                # warm workers per dispatch retry would drain the whole
-                # pool for one task
-                return None
-            spawn = n_alive < self._max_workers
-            if not spawn:
-                for w in self._workers.values():
-                    if (w.state == "idle" and w.conn is not None
-                            and w.env_key != key):
-                        # not "dead": _on_worker_gone must still run its
-                        # cleanup (pop from registry, store refs, zombie
-                        # reap) when the channel closes
-                        w.state = "evicting"
-                        evict = w
-                        spawn = True
-                        break
-        if evict is not None:
-            # off the dispatch thread: a worker slow to honor SIGTERM
-            # must not stall dispatch for every other queued task
-            def _reap(w=evict):
-                try:
-                    if w.proc is not None:
-                        w.proc.terminate()
-                    if w.conn is not None:
-                        w.conn.close()
-                except OSError:
-                    pass
-                self._on_worker_gone(w)
-                if w.proc is not None:
-                    try:
-                        w.proc.wait(timeout=5)
-                    except subprocess.TimeoutExpired:
-                        w.proc.kill()
-
-            threading.Thread(target=_reap, name="ray_tpu-evict",
-                             daemon=True).start()
-        if spawn:
-            self._spawn_worker(runtime_env)
-        return None
+    def _peer_address(self, node_id) -> tuple | None:
+        if node_id is None or node_id == self.node_id:
+            return None
+        if self._peer(node_id) is None:
+            return None
+        with self._peers_lock:
+            return self._peer_addrs.get(node_id)
 
     # ------------------------------------------------------------------
     # actors (GCS calls host_actor; raylet dedicates a worker)
@@ -823,10 +381,10 @@ class Raylet(RpcServer):
         task (reference: GcsActorScheduler::LeaseWorkerFromNode + the
         worker-lease machinery in node_manager.cc:1778)."""
         demand = spec.get("resources", {})
-        if not self._try_acquire(demand):
+        if not self.scheduler.try_acquire(demand):
             raise RuntimeError(
                 f"node {self.node_id} cannot host actor: {demand} unavailable")
-        handle = self._spawn_worker(spec.get("runtime_env"))
+        handle = self.workers.spawn(spec.get("runtime_env"))
         handle.state = "actor"
         handle.actor_id = actor_id
         handle.incarnation = incarnation
@@ -846,7 +404,7 @@ class Raylet(RpcServer):
                                   "incarnation": incarnation},
                                  handle.send_lock)
                     except OSError:
-                        self._on_worker_gone(handle)
+                        self.workers.on_worker_gone(handle)
                     return
                 if handle.proc is not None and handle.proc.poll() is not None:
                     break
@@ -859,9 +417,9 @@ class Raylet(RpcServer):
 
     def rpc_submit_actor_task(self, conn, send_lock, *, task: dict):
         actor_id = task["actor_id"]
-        with self._workers_lock:
+        with self.workers.lock:
             target = None
-            for w in self._workers.values():
+            for w in self.workers.workers.values():
                 if w.actor_id == actor_id and w.state == "actor":
                     target = w
                     break
@@ -877,6 +435,29 @@ class Raylet(RpcServer):
                  target.send_lock)
         return {"ok": True}
 
+    def rpc_submit_actor_tasks(self, conn, send_lock, *, tasks: list):
+        """Batched actor submission for actors served via this raylet
+        (no direct push port): validates and forwards each task over the
+        worker channel; one reply per frame."""
+        for task in tasks:
+            self.rpc_submit_actor_task(conn, send_lock, task=task)
+        return {"ok": True}
+
+    def rpc_kill_actor_worker(self, conn, send_lock, *, actor_id):
+        with self.workers.lock:
+            target = None
+            for w in self.workers.workers.values():
+                if w.actor_id == actor_id:
+                    target = w
+                    break
+        if target is not None and target.proc is not None:
+            target.proc.terminate()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # cancellation + explicit free
+    # ------------------------------------------------------------------
+
     def rpc_free_objects(self, conn, send_lock, *, oids: list,
                          broadcast: bool = True):
         """Explicitly release object copies on this node (reference:
@@ -884,60 +465,7 @@ class Raylet(RpcServer):
         deregister the location. Owners drop lineage separately so a
         subsequent ``get`` raises ObjectLostError instead of
         resurrecting the object."""
-        from ray_tpu._private.shm_store import TS_ERR, TS_OK
-
-        freed = 0
-        pending: list[tuple[str, bool, bool]] = []  # (oid, was_pinned, spilled)
-        for oid_hex in oids:
-            with self._pin_lock:
-                was_pinned = oid_hex in self._pinned
-            self._unpin_object(oid_hex)
-            with self._spill_lock:
-                entry = self._spilled.pop(oid_hex, None)
-            if entry is not None:
-                try:
-                    os.unlink(entry[0])
-                except OSError:
-                    pass
-                freed += 1
-            pending.append((oid_hex, was_pinned, entry is not None))
-        # drain in-flight refs (a writer's seal-hold released right after
-        # its report RPC, or a reader mid-get) with ONE shared ~200ms
-        # budget across all oids, not per object
-        done: list[tuple[str, bool, int]] = []
-        deadline = time.monotonic() + 0.2
-        while pending:
-            still = []
-            for oid_hex, was_pinned, had_spill in pending:
-                rc = self.store.try_delete(bytes.fromhex(oid_hex))
-                if rc == TS_ERR and time.monotonic() < deadline:
-                    still.append((oid_hex, was_pinned, had_spill))
-                else:
-                    done.append((oid_hex, had_spill, rc))
-                    if rc == TS_ERR and was_pinned:
-                        # a reader outlived the drain: the surviving
-                        # primary stays authoritative — re-pin it so LRU
-                        # eviction cannot silently orphan the stale GCS
-                        # location (same rule as _spill_one)
-                        self._pin_object(oid_hex)
-            pending = still
-            if pending:
-                time.sleep(0.01)
-        for oid_hex, had_spill, rc in done:
-            if rc == TS_OK and not had_spill:
-                freed += 1
-            if rc == TS_ERR:
-                continue   # copy stays: tracked, registered, re-pinned
-            with self._local_objects_lock:
-                was_local = oid_hex in self._local_objects
-                self._local_objects.discard(oid_hex)
-            if was_local or had_spill:
-                try:
-                    with self._gcs_lock:
-                        self._gcs.call("remove_object_location",
-                                       oid=oid_hex, node_id=self.node_id)
-                except Exception:  # noqa: BLE001 - best-effort
-                    pass
+        freed = self.objects.free_objects(oids)
         if broadcast:
             with self._gcs_lock:
                 nodes = self._gcs.call("get_nodes", alive_only=True)
@@ -971,27 +499,21 @@ class Raylet(RpcServer):
         def matches(task):
             return task and targets & set(task.get("return_oids", ()))
 
-        # queued here? Flag + dequeue under the cv; the error store (a
+        # queued here? Dequeued under the scheduler cv; the error store (a
         # durable put + GCS RPC) runs OUTSIDE the cv so dispatch/enqueue
-        # never stall behind it. The flag also covers a task already
-        # popped by the dispatch loop but not yet assigned to a worker.
-        queued = None
-        with self._ready_cv:
-            for i, t in enumerate(self._ready):
-                if matches(t):
-                    queued = t
-                    del self._ready[i]
-                    break
+        # never stall behind it. The cancelled flag also covers a task
+        # already popped by the dispatch loop but not yet assigned.
+        queued = self.scheduler.take_queued_matching(matches)
         if queued is not None:
             queued["cancelled"] = True
             self._store_task_error(queued, exc.TaskCancelledError(
                 f"task {queued.get('name')} cancelled while queued"))
             return {"found": True, "state": "queued"}
         # running here?
-        with self._workers_lock:
+        with self.workers.lock:
             victim = None
             task = None
-            for w in self._workers.values():
+            for w in self.workers.workers.values():
                 if w.state == "busy" and matches(w.current_task):
                     victim = w
                     task = w.current_task   # captured under the lock
@@ -1009,11 +531,11 @@ class Raylet(RpcServer):
             # task may have partially run".
             self._store_task_error(task, exc.TaskCancelledError(
                 f"task {task.get('name')} cancelled while running"))
-            with self._workers_lock:
+            with self.workers.lock:
                 # re-verify AND signal under the lock: the worker may
                 # have finished the target and been handed new work —
                 # never deliver the kill/interrupt over someone else's
-                # task (_finish_task and dispatch both mutate
+                # task (finish_task and dispatch both mutate
                 # current_task under this lock)
                 if victim.current_task is not task:
                     return {"found": True, "state": "running"}
@@ -1033,15 +555,10 @@ class Raylet(RpcServer):
                     except OSError:
                         pass
             return {"found": True, "state": "running"}
-        # parked infeasible here? (pop under the lock; the durable error
-        # store runs outside it — _park_infeasible on the submit path
-        # contends for this lock)
-        parked = None
-        with self._infeasible_lock:
-            for i, (t, _, _) in enumerate(self._infeasible):
-                if matches(t):
-                    parked = self._infeasible.pop(i)[0]
-                    break
+        # parked infeasible here? (popped under the scheduler lock; the
+        # durable error store runs outside it — park_infeasible on the
+        # submit path contends for that lock)
+        parked = self.scheduler.take_infeasible_matching(matches)
         if parked is not None:
             parked["cancelled"] = True
             self._store_task_error(parked, exc.TaskCancelledError(
@@ -1065,102 +582,13 @@ class Raylet(RpcServer):
                     continue
         return {"found": False}
 
-    def rpc_kill_actor_worker(self, conn, send_lock, *, actor_id):
-        with self._workers_lock:
-            target = None
-            for w in self._workers.values():
-                if w.actor_id == actor_id:
-                    target = w
-                    break
-        if target is not None and target.proc is not None:
-            target.proc.terminate()
-        return {"ok": True}
-
     # ------------------------------------------------------------------
-    # object spilling (reference: LocalObjectManager + ExternalStorage —
-    # spill LRU-cold objects to files under memory pressure, restore on
-    # read; the GCS object directory keeps this node as a location)
+    # object manager RPC surface (logic: runtime/object_manager.py)
     # ------------------------------------------------------------------
-
-    def _track_local(self, oid_hex: str):
-        with self._local_objects_lock:
-            self._local_objects.add(oid_hex)
-        # wake ensure_local waiters (event-driven instead of polling for
-        # the locally-produced-object case)
-        with self._local_cv:
-            self._local_cv.notify_all()
-
-    def _reconcile_locations(self):
-        """Deregister objects that silently left the store (LRU-evicted
-        secondaries): a stale directory entry would make owners pull from
-        a node that cannot serve, and would mask true object loss from
-        the lineage-reconstruction path."""
-        with self._local_objects_lock:
-            snapshot = list(self._local_objects)
-        gone = []
-        for oid_hex in snapshot:
-            # _spilled FIRST, store second: a concurrent restore pops
-            # _spilled only AFTER the shm copy is secured+pinned, so this
-            # order can never classify a mid-restore object as gone
-            # (store-first could: miss the store, then miss _spilled
-            # right after the restore completed)
-            with self._spill_lock:
-                if oid_hex in self._spilled:
-                    continue   # spilled = still servable from disk
-            if self.store.contains(bytes.fromhex(oid_hex)):
-                continue
-            gone.append(oid_hex)
-        if not gone:
-            return
-        with self._local_objects_lock:
-            self._local_objects.difference_update(gone)
-        with self._pin_lock:
-            self._pinned.difference_update(gone)
-        for oid_hex in gone:
-            try:
-                with self._gcs_lock:
-                    self._gcs.call("remove_object_location", oid=oid_hex,
-                                   node_id=self.node_id)
-            except Exception:  # noqa: BLE001 - gcs down; retried next tick
-                with self._local_objects_lock:
-                    self._local_objects.add(oid_hex)
-
-    def _pin_object(self, oid_hex: str):
-        """Pin a newly created primary copy (idempotent)."""
-        with self._pin_lock:
-            if oid_hex in self._pinned:
-                return
-            if self.store.pin(bytes.fromhex(oid_hex)):
-                self._pinned.add(oid_hex)
-
-    def _unpin_object(self, oid_hex: str):
-        with self._pin_lock:
-            if oid_hex in self._pinned:
-                self._pinned.discard(oid_hex)
-                self.store.unpin(bytes.fromhex(oid_hex))
 
     def rpc_report_object(self, conn, send_lock, *, oid: str, size: int = 0):
-        """A local process created an object: pin the primary copy and
-        register the location with the GCS (reference: the Put path's
-        PinObjectIDs + object directory update). Callers seal with a held
-        ref (``seal(hold=True)``) so the object cannot vanish before the
-        pin lands here.
-
-        The PIN is synchronous (it is what makes the object durable); the
-        GCS directory registration is BUFFERED and flushed in batches —
-        one directory RPC per flush, not per task return, keeping the
-        head-node round trip off the task hot path (reference: the
-        ownership-based object directory is similarly not on the task
-        completion critical path)."""
-        self._pin_object(oid)
-        with self._pin_lock:
-            pinned = oid in self._pinned
-        if not pinned and not self.store.contains(bytes.fromhex(oid)):
-            # should be unreachable under the hold protocol; never
-            # advertise a location that cannot serve the object
+        if not self.objects.report_object(oid, size):
             return {"ok": False, "reason": "object not present to pin"}
-        self._track_local(oid)
-        self._queue_location(oid, size)
         return {"ok": True}
 
     def rpc_report_objects(self, conn, send_lock, *, entries: list):
@@ -1169,442 +597,76 @@ class Raylet(RpcServer):
         writer's seal-hold until the pin lands here)."""
         ok = []
         for oid, size in entries:
-            self._pin_object(oid)
-            with self._pin_lock:
-                pinned = oid in self._pinned
-            if pinned or self.store.contains(bytes.fromhex(oid)):
-                self._track_local(oid)
-                self._queue_location(oid, size)
+            if self.objects.report_object(oid, size):
                 ok.append(oid)
         return {"ok": ok}
 
-    def _queue_location(self, oid: str, size: int):
-        with self._loc_cv:
-            self._loc_buf.append((oid, size))
-            self._loc_cv.notify()
-
-    def _location_flush_loop(self):
-        """Drain the location buffer into batched GCS registrations. A
-        short linger coalesces bursts; an empty buffer blocks on the cv
-        (no polling)."""
-        while not self._stopping:
-            with self._loc_cv:
-                if not self._loc_buf:
-                    self._loc_cv.wait(timeout=0.2)
-                if not self._loc_buf:
-                    continue
-                time_to_linger = 0.002
-            time.sleep(time_to_linger)  # let the burst accumulate
-            with self._loc_cv:
-                batch, self._loc_buf = self._loc_buf, []
-            if not batch:
-                continue
-            try:
-                with self._gcs_lock:
-                    self._gcs.call("add_object_locations",
-                                   node_id=self.node_id, entries=batch)
-            except Exception:  # noqa: BLE001 - GCS down; heartbeat
-                pass           # reconciliation re-registers local objects
-
     def rpc_request_space(self, conn, send_lock, *, nbytes: int = 0):
-        """A writer hit store-OOM: synchronously spill pinned-idle objects
-        to make room (reference: CreateRequestQueue retry + triggered
-        spill). Returns the number of objects spilled."""
-        if not self._spill_enabled:
-            return {"spilled": 0}  # honor the no-disk-writes contract
-        # floor scaled to the allocation (2x for headroom) and the store
-        # (1/8 capacity) — a fixed large floor would thrash small stores
-        cap = self.store.capacity
-        target = min(max(2 * int(nbytes), cap // 8), cap)
-        n = self._spill_bytes(target)
-        if n == 0:
-            # nothing pinned-idle; last resort, spill unpinned cold
-            # entries too (they are evictable anyway — spilling keeps
-            # them readable instead of destroying them)
-            for oid in self.store.spill_candidates(target, pin_pid=0):
-                n += bool(self._spill_one(oid[:ObjectID.SIZE]))
-        return {"spilled": n}
-
-    def _spill_bytes(self, target: int) -> int:
-        n = 0
-        for oid in self.store.spill_candidates(target,
-                                               pin_pid=os.getpid()):
-            n += bool(self._spill_one(oid[:ObjectID.SIZE]))
-        return n
-
-    def _spill_loop(self):
-        while not self._stopping:
-            time.sleep(0.2)
-            try:
-                st = self.store.stats()
-            except Exception:  # noqa: BLE001 - store closing
-                return
-            cap = st["capacity"] or 1
-            if st["bytes_allocated"] <= self._spill_high * cap:
-                continue
-            self._spill_bytes(
-                st["bytes_allocated"] - int(self._spill_low * cap))
-
-    def _spill_one(self, oid: bytes) -> bool:
-        """Copy one sealed object out to a file, then drop it from shm."""
-        oid_hex = oid.hex()
-        try:
-            payload = object_codec.raw_bytes(self.store, oid, timeout_ms=0)
-        except Exception:  # noqa: BLE001 - vanished (freed/evicted) — fine
-            return False
-        os.makedirs(self._spill_dir, exist_ok=True)
-        path = os.path.join(self._spill_dir, oid_hex)
-        tmp = path + ".tmp"
-        try:
-            with open(tmp, "wb") as f:
-                f.write(payload)
-            os.replace(tmp, path)
-        except OSError:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            return False
-        from ray_tpu._private.shm_store import TS_ERR, TS_OK
-
-        with self._pin_lock:
-            was_primary = oid_hex in self._pinned
-        with self._spill_lock:
-            self._spilled[oid_hex] = (path, was_primary)
-        self._unpin_object(oid_hex)
-        rc = self.store.try_delete(oid)
-        if rc == TS_ERR:
-            # a reader still holds a ref: keep the shm copy authoritative —
-            # re-pin, discard the file
-            self._pin_object(oid_hex)
-            with self._spill_lock:
-                self._spilled.pop(oid_hex, None)
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-            return False
-        # TS_OK: we removed it. TS_NOT_FOUND: a concurrent evict/spill beat
-        # us to it — the file we just wrote may now be the ONLY copy, so it
-        # must stay registered either way.
-        self.spill_stats["num_spilled"] += 1
-        self.spill_stats["bytes_spilled"] += len(payload)
-        return rc == TS_OK
-
-    def _restore_spilled(self, oid_hex: str) -> bool:
-        """Load a locally-spilled object back into shm (for readers)."""
-        with self._spill_lock:
-            entry = self._spilled.get(oid_hex)
-        if entry is None:
-            return False
-        path, was_primary = entry
-        try:
-            with open(path, "rb") as f:
-                payload = f.read()
-        except OSError:
-            with self._spill_lock:
-                self._spilled.pop(oid_hex, None)
-            return False
-        from ray_tpu._private.shm_store import (ObjectExistsError,
-                                                StoreFullError)
-
-        oid = bytes.fromhex(oid_hex)
-        held = False
-        for _ in range(8):
-            try:
-                # hold through the seal: the restored entry must never sit
-                # at refcount 0 where eviction/spill could destroy it
-                # before we pin + unlink the file
-                object_codec.put_raw(self.store, oid, payload, hold=True)
-                held = True
-                break
-            except ObjectExistsError:
-                break  # racing restore won; theirs is pinned
-            except StoreFullError:
-                # make room by spilling OTHER pinned-idle objects
-                if self._spill_bytes(len(payload)) == 0:
-                    time.sleep(0.05)  # wait for readers to release
-            except Exception:  # noqa: BLE001 - racing restore
-                break
-        if was_primary:
-            self._pin_object(oid_hex)   # restored primary: pin again
-        if held:
-            self.store.release(oid)
-        if was_primary:
-            with self._pin_lock:
-                ok = oid_hex in self._pinned
-        else:
-            # secondary: stays unpinned/evictable; success = it is present
-            ok = held or self.store.contains(oid)
-        if not ok:
-            # could not secure the shm copy — the file stays the
-            # authoritative copy; do NOT unlink
-            return self.store.contains(oid)
-        with self._spill_lock:
-            self._spilled.pop(oid_hex, None)
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
-        self.spill_stats["num_restored"] += 1
-        self.spill_stats["bytes_restored"] += len(payload)
-        return True
-
-    def _read_spilled(self, oid_hex: str) -> bytes | None:
-        """Read a spilled object's bytes without restoring it to shm
-        (serving a remote fetch should not churn local memory)."""
-        with self._spill_lock:
-            entry = self._spilled.get(oid_hex)
-        if entry is None:
-            return None
-        try:
-            with open(entry[0], "rb") as f:
-                return f.read()
-        except OSError:
-            return None
-
-    # ------------------------------------------------------------------
-    # object manager (reference: object_manager.cc Push/HandlePush +
-    # PullManager; pull-only here)
-    # ------------------------------------------------------------------
+        return {"spilled": self.objects.request_space(nbytes)}
 
     def rpc_fetch_object(self, conn, send_lock, *, oid: str):
-        """Return the encoded object bytes from the local store."""
-        try:
-            return object_codec.raw_bytes(self.store, bytes.fromhex(oid),
-                                          timeout_ms=0)
-        except ObjectNotFoundError:
-            payload = self._read_spilled(oid)
-            if payload is None:
-                raise
-            return payload
+        return self.objects.fetch_object(oid)
 
     def rpc_fetch_object_meta(self, conn, send_lock, *, oid: str):
-        """Size + CRC probe for the pull path (reference: the object
-        directory carries sizes for PullManager admission; the checksum
-        is transfer integrity — the destination verifies the assembled
-        bytes before SEALING, so a torn read can never become a readable
-        object). Objects are immutable, so size+CRC memoize per oid —
-        repeat probes (N pullers, retries) cost a dict hit, not an
-        O(size) pass on the handler thread."""
-        import zlib
-
-        cached = self._crc_cache.get(oid)
-        if cached is not None:
-            return {"found": True, "size": cached[0], "crc32": cached[1]}
-        oid_b = bytes.fromhex(oid)
-        try:
-            view = self.store.get(oid_b, timeout_ms=0)
-            try:
-                size, crc = view.nbytes, zlib.crc32(view)
-            finally:
-                view.release()
-                self.store.release(oid_b)
-        except ObjectNotFoundError:
-            data = self._read_spilled(oid)
-            if data is None:
-                return {"found": False}
-            size, crc = len(data), zlib.crc32(data)
-        self._crc_cache[oid] = (size, crc)
-        while len(self._crc_cache) > 4096:
-            self._crc_cache.pop(next(iter(self._crc_cache)))
-        return {"found": True, "size": size, "crc32": crc}
+        return self.objects.fetch_object_meta(oid)
 
     def rpc_fetch_object_chunk(self, conn, send_lock, *, oid: str,
                                offset: int, length: int):
-        """One chunk of an object's raw encoding (reference:
-        ObjectManager chunked transfer, 5 MiB default chunks —
-        object_manager.cc:339). Spilled objects are served by file seek —
-        no whole-object restore to answer a remote read."""
-        oid_b = bytes.fromhex(oid)
-        try:
-            view = self.store.get(oid_b, timeout_ms=0)
-            try:
-                return bytes(view[offset:offset + length])
-            finally:
-                view.release()
-                self.store.release(oid_b)
-        except ObjectNotFoundError:
-            with self._spill_lock:
-                entry = self._spilled.get(oid)
-            if entry is None:
-                raise
-            with open(entry[0], "rb") as f:
-                f.seek(offset)
-                return f.read(length)
+        return self.objects.fetch_object_chunk(oid, offset, length)
 
     def rpc_ensure_local(self, conn, send_lock, *, oids: list,
                          timeout_s: float = 30.0):
-        """Make objects locally readable, pulling from peers as needed.
-        Returns the list of oids that could NOT be made local in time.
-        Waits are event-driven for locally-produced objects (the common
-        case): report_object notifies ``_local_cv``."""
-        deadline = time.monotonic() + timeout_s
-        missing = [o for o in oids
-                   if not self.store.contains(bytes.fromhex(o))]
-        while missing and time.monotonic() < deadline:
-            still = []
-            for oid_hex in missing:
-                oid = bytes.fromhex(oid_hex)
-                if self.store.contains(oid):
-                    continue
-                if not self._pull(oid_hex):
-                    still.append(oid_hex)
-            missing = still
-            if missing:
-                # wake instantly when a local task seals one of ours;
-                # re-check remote locations on a coarser cadence
-                with self._local_cv:
-                    self._local_cv.wait(
-                        timeout=min(0.1, max(deadline - time.monotonic(),
-                                             0.0)))
-        return missing
-
-    def _peer_addresses_for(self, oid_hex: str) -> list:
-        with self._gcs_lock:
-            locs = self._gcs.call("get_object_locations",
-                                  oids=[oid_hex])[oid_hex]
-        out = []
-        for node_id in locs:
-            if node_id == self.node_id:
-                continue
-            addr = self._peer_address(node_id)
-            if addr is not None:
-                out.append((node_id, addr))
-        return out
-
-    def _on_pulled(self, oid_hex: str, size: int):
-        self._track_local(oid_hex)
-        self._queue_location(oid_hex, size)
-
-    def _pull(self, oid_hex: str) -> bool:
-        return self._pulls.pull(oid_hex)
+        return self.objects.ensure_local(oids, timeout_s)
 
     # ------------------------------------------------------------------
-    # worker leases (owner-side lease protocol; reference:
-    # NodeManager::HandleRequestWorkerLease node_manager.cc:1778 +
-    # CoreWorkerDirectTaskSubmitter direct_task_transport.cc:134,240)
+    # cross-language object plane (reference: the C++/Java clients'
+    # msgpack serialization — values cross here as plain data; the RPC
+    # layer decodes/encodes the msgpack frames, runtime/xlang.py)
     # ------------------------------------------------------------------
 
-    def _peer_address(self, node_id) -> tuple | None:
-        if node_id is None or node_id == self.node_id:
-            return None
-        if self._peer(node_id) is None:
-            return None
-        with self._peers_lock:
-            return self._peer_addrs.get(node_id)
+    def rpc_xlang_put(self, conn, send_lock, *, value):
+        """Store a plain-data value from an external-language client;
+        returns the new object id (hex). The object is a normal store
+        object (Python tasks read it natively)."""
+        from ray_tpu.utils.ids import ObjectID
+
+        oid = ObjectID.from_random()
+        size = object_codec.put_value_durable(
+            self.store, oid.binary(), value, hold=True,
+            request_space=(self.objects.spill_bytes
+                           if self.objects.spill_enabled else None))
+        self.objects.pin_object(oid.hex())
+        self.objects.track_local(oid.hex())
+        if size > 0:
+            self.store.release(oid.binary())
+        self.objects.queue_location(oid.hex(), size)
+        return {"oid": oid.hex()}
+
+    def rpc_xlang_get(self, conn, send_lock, *, oid: str,
+                      timeout_s: float = 30.0):
+        """Resolve an object to a plain-data value for an external-
+        language client: waits/pulls via ensure_local, decodes the stored
+        object, and ships it back on the msgpack reply (values outside
+        the cross-language domain fail the call, not the server)."""
+        missing = self.objects.ensure_local([oid], timeout_s)
+        if missing:
+            raise TimeoutError(f"object {oid[:8]} not available within "
+                               f"{timeout_s}s")
+        value, is_error = object_codec.get_value(
+            self.store, bytes.fromhex(oid), timeout_ms=0)
+        if is_error:
+            raise value
+        return {"value": value}
+
+    # ------------------------------------------------------------------
+    # worker lease RPC surface (logic: runtime/scheduler.py)
+    # ------------------------------------------------------------------
 
     def rpc_request_lease(self, conn, send_lock, *, demand: dict,
                           runtime_env: dict | None = None,
                           timeout_s: float = 10.0, spill_count: int = 0):
-        """Grant a worker lease: the reply carries the worker's push
-        address, and the owner pushes tasks to it directly for as long as
-        it holds the lease (= keeps its connection to the worker open).
-        Replies: {ok, worker_addr, worker_id, node_id} | {redirect: addr}
-        (spillback — caller retries there) | {retry: True} (parked past
-        timeout_s — caller may re-request) | {infeasible: True}."""
-        if not _fits(demand, self.total_resources):
-            with self._gcs_lock:
-                target = self._gcs.call("pick_node", demand=demand,
-                                        exclude=[self.node_id])
-            addr = self._peer_address(target)
-            if addr:
-                return {"redirect": list(addr), "node_id": target}
-            return {"infeasible": True}
-        if spill_count < 1 and not _fits(demand, self._avail_snapshot()):
-            # busy here: one spillback attempt through the GCS view
-            # (mirror of rpc_submit_task's policy)
-            with self._gcs_lock:
-                target = self._gcs.call("pick_node", demand=demand,
-                                        exclude=[self.node_id])
-            addr = self._peer_address(target)
-            if addr:
-                return {"redirect": list(addr), "node_id": target}
-        waiter = {"demand": demand, "runtime_env": runtime_env,
-                  "event": threading.Event(), "result": None}
-        with self._ready_cv:
-            self._lease_waiters.append(waiter)
-            self._ready_cv.notify()
-        if not waiter["event"].wait(timeout=timeout_s):
-            removed = True
-            with self._ready_cv:
-                try:
-                    self._lease_waiters.remove(waiter)
-                except ValueError:
-                    removed = False
-            if not removed:
-                # a granter claimed the waiter concurrently: it WILL set
-                # the result (it already holds the worker + resources) —
-                # block for it; dropping it would leak a leased worker
-                # nobody ever dials
-                waiter["event"].wait(timeout=5.0)
-                if waiter["result"]:
-                    return waiter["result"]
-            return {"retry": True}
-        return waiter["result"]
-
-    def _serve_lease_waiters(self):
-        """Grant parked lease requests FIFO while workers + resources are
-        available (runs on the dispatch thread)."""
-        while True:
-            with self._ready_cv:
-                if not self._lease_waiters:
-                    return
-                waiter = self._lease_waiters[0]
-            env_err = self._bad_env_error(waiter["runtime_env"])
-            if env_err is not None:
-                with self._ready_cv:
-                    try:
-                        self._lease_waiters.remove(waiter)
-                    except ValueError:
-                        continue
-                waiter["result"] = {"infeasible": True,
-                                    "env_error": env_err}
-                waiter["event"].set()
-                continue
-            worker = self._idle_worker(waiter["runtime_env"])
-            if worker is None:
-                return  # spawn in progress / pool exhausted; kick revisits
-            if worker.push_addr is None:
-                # externally-registered worker with no push port (tests):
-                # unusable for leases, put it back
-                with self._workers_lock:
-                    worker.state = "idle"
-                return
-            if not self._try_acquire(waiter["demand"]):
-                with self._workers_lock:
-                    worker.state = "idle"
-                return  # resources busy; release kick revisits
-            # the waiter may have timed out and removed itself while we
-            # were acquiring — then the grant must be rolled back. The
-            # rollback runs OUTSIDE the cv (lock order: never cv→locks).
-            claimed = True
-            with self._ready_cv:
-                try:
-                    self._lease_waiters.remove(waiter)
-                except ValueError:
-                    claimed = False
-            if not claimed:
-                self._release(waiter["demand"])
-                with self._workers_lock:
-                    worker.state = "idle"
-                continue
-            with self._workers_lock:
-                worker.state = "leased"
-                worker.acquired = dict(waiter["demand"])
-                worker.dispatched_at = time.monotonic()
-            # arm the worker's never-dialed watchdog BEFORE the owner can
-            # learn the address (guarantees msg-before-dial ordering)
-            try:
-                send_msg(worker.conn, {"type": "lease_granted"},
-                         worker.send_lock)
-            except OSError:
-                pass
-            waiter["result"] = {"ok": True,
-                                "worker_addr": list(worker.push_addr),
-                                "worker_id": worker.worker_id,
-                                "node_id": self.node_id}
-            waiter["event"].set()
+        return self.scheduler.request_lease(demand, runtime_env, timeout_s,
+                                            spill_count)
 
     def rpc_cancel_leased(self, conn, send_lock, *, worker_id: str,
                           task: dict, force: bool = False):
@@ -1614,15 +676,15 @@ class Raylet(RpcServer):
         and interrupts (SIGINT) or kills the worker process."""
         from ray_tpu.utils import exceptions as exc
 
-        with self._workers_lock:
-            w = self._workers.get(worker_id)
+        with self.workers.lock:
+            w = self.workers.workers.get(worker_id)
             if w is None or w.state != "leased" or w.proc is None:
                 return {"found": False}
         task["cancelled"] = True
         self._store_task_error(task, exc.TaskCancelledError(
             f"task {task.get('name')} cancelled while running"))
-        with self._workers_lock:
-            w = self._workers.get(worker_id)
+        with self.workers.lock:
+            w = self.workers.workers.get(worker_id)
             if w is None or w.state != "leased" or w.proc is None:
                 return {"found": False}
             try:
@@ -1639,28 +701,11 @@ class Raylet(RpcServer):
                 pass
         return {"found": True}
 
-    def rpc_worker_death_info(self, conn, send_lock, *, worker_id: str,
-                              timeout_s: float = 2.0):
-        """Why a worker died (lease owners map a broken lease to e.g.
-        OutOfMemoryError instead of a generic crash). The owner's lease
-        connection breaks the instant the process dies — often BEFORE
-        this raylet's channel reader records the death — so this briefly
-        waits for the record instead of returning an empty answer."""
-        deadline = time.monotonic() + timeout_s
-        while True:
-            with self._workers_lock:
-                info = self._death_info.get(worker_id)
-            if info is not None:
-                return info
-            if time.monotonic() >= deadline or self._stopping:
-                return {}
-            time.sleep(0.05)
-
     def rpc_lease_closed(self, conn, send_lock, *, worker_id: str):
         """The worker's owner-facing connection dropped (lease returned or
         owner died): the worker and its resources go back to the pool."""
-        with self._workers_lock:
-            w = self._workers.get(worker_id)
+        with self.workers.lock:
+            w = self.workers.workers.get(worker_id)
             if w is None or w.state != "leased":
                 return {"ok": False}
             acquired, w.acquired = w.acquired, {}
@@ -1673,13 +718,6 @@ class Raylet(RpcServer):
     # per-node observability (reference: the dashboard reporter agent —
     # psutil stats + py-spy stack dumps/profiles proxied per worker)
     # ------------------------------------------------------------------
-
-    def _worker_push_targets(self, worker_id: str | None = None):
-        with self._workers_lock:
-            return [(w.worker_id, w.push_addr)
-                    for w in self._workers.values()
-                    if w.push_addr is not None and w.state != "dead"
-                    and (worker_id is None or w.worker_id == worker_id)]
 
     def rpc_worker_stacks(self, conn, send_lock, *,
                           worker_id: str | None = None):
@@ -1704,7 +742,7 @@ class Raylet(RpcServer):
                 out[wid] = stacks
 
         threads = [threading.Thread(target=query, args=t, daemon=True)
-                   for t in self._worker_push_targets(worker_id)]
+                   for t in self.workers.push_targets(worker_id)]
         for t in threads:
             t.start()
         for t in threads:
@@ -1715,7 +753,7 @@ class Raylet(RpcServer):
                            duration_s: float = 2.0, hz: int = 100):
         """Sampling CPU profile of one worker (py-spy ``record`` analog;
         collapsed-stack output for flamegraph tooling)."""
-        targets = self._worker_push_targets(worker_id)
+        targets = self.workers.push_targets(worker_id)
         if not targets:
             # sentinel (not a failure): lets cluster-wide callers keep
             # searching other nodes without conflating "lives elsewhere"
@@ -1737,11 +775,11 @@ class Raylet(RpcServer):
         return {"node_id": self.node_id, "store_name": self.store_name,
                 "address": self.address, "resources": self.total_resources,
                 "available": self._avail_snapshot(),
-                "num_workers": len(self._workers),
-                "spill_stats": dict(self.spill_stats)}
+                "num_workers": len(self.workers.workers),
+                "spill_stats": dict(self.objects.spill_stats)}
 
     # ------------------------------------------------------------------
-    # background loops
+    # heartbeat
     # ------------------------------------------------------------------
 
     def _heartbeat_loop(self):
@@ -1753,7 +791,7 @@ class Raylet(RpcServer):
             ticks += 1
             if ticks % 2 == 0:
                 try:
-                    self._reconcile_locations()
+                    self.objects.reconcile_locations()
                 except Exception:  # noqa: BLE001 - next tick retries
                     pass
             try:
@@ -1761,7 +799,9 @@ class Raylet(RpcServer):
                 if ticks % 4 == 0:   # host sampling is cheap but not free
                     from ray_tpu.util.profiling import host_stats
 
-                    stats = host_stats(self._spill_dir)
+                    stats = host_stats(
+                        self.objects.spill_dir
+                        if self.objects.spill_is_local else None)
                 with self._gcs_lock:
                     reply = self._gcs.call("heartbeat", node_id=self.node_id,
                                            available=self._avail_snapshot(),
@@ -1775,130 +815,6 @@ class Raylet(RpcServer):
                             labels=self.labels)
             except Exception:  # noqa: BLE001 - gcs down; keep trying
                 pass
-
-    # ------------------------------------------------------------------
-    # memory monitor (reference: MemoryMonitor common/memory_monitor.h:52
-    # driving the raylet's WorkerKillingPolicy — kill the newest retriable
-    # task's worker first so forward progress is preserved)
-    # ------------------------------------------------------------------
-
-    @staticmethod
-    def _host_memory_fraction() -> float:
-        """Used fraction of host memory from /proc/meminfo (the reference
-        also honors cgroup limits; host-level covers TPU-VM deployments)."""
-        total = avail = None
-        try:
-            with open("/proc/meminfo") as f:
-                for line in f:
-                    if line.startswith("MemTotal:"):
-                        total = int(line.split()[1])
-                    elif line.startswith("MemAvailable:"):
-                        avail = int(line.split()[1])
-                    if total is not None and avail is not None:
-                        break
-        except OSError:
-            return 0.0
-        if not total or avail is None:
-            return 0.0
-        return 1.0 - avail / total
-
-    def _interruptible_sleep(self, seconds: float):
-        """Sleep in small increments so background loops observe
-        ``_stopping`` within ~0.1s — stop() joins them with a short
-        timeout before munmapping the store, and a loop that oversleeps
-        the join touches freed memory (segfault, not an exception)."""
-        deadline = time.monotonic() + seconds
-        while not self._stopping:
-            remain = deadline - time.monotonic()
-            if remain <= 0:
-                return
-            time.sleep(min(0.1, remain))
-
-    def _memory_monitor_loop(self):
-        while not self._stopping:
-            self._interruptible_sleep(self._mem_refresh_s)
-            if self._stopping:
-                return
-            if self._host_memory_fraction() < self._mem_threshold:
-                continue
-            if self._kill_one_for_memory():
-                self._interruptible_sleep(1.0)  # let the kill take effect
-
-    def _kill_one_for_memory(self) -> bool:
-        """Pick and kill one worker to relieve pressure. Policy (reference
-        worker_killing_policy_retriable_fifo.cc): newest-started RETRIABLE
-        task first (its re-execution is cheapest and guaranteed safe),
-        then newest non-retriable task worker; actors are never chosen —
-        their state is not re-executable (the reference's group-by-owner
-        policy similarly deprioritizes them)."""
-        with self._workers_lock:
-            # select AND kill inside the lock: a victim finishing its task
-            # in between would take the SIGKILL for a brand-new task
-            busy = [(w, w.current_task, w.dispatched_at)
-                    for w in self._workers.values()
-                    if w.state == "busy" and w.current_task is not None
-                    and w.proc is not None]
-            # leased workers are candidates too: their owner observes the
-            # break, queries worker_death_info, and applies ITS OOM retry
-            # budget (this raylet does not know the task)
-            leased = [(w, None, w.dispatched_at)
-                      for w in self._workers.values()
-                      if w.state == "leased" and w.proc is not None]
-            if not busy and not leased:
-                return False
-            busy.sort(key=lambda it: it[2])   # oldest-dispatched first
-            leased.sort(key=lambda it: it[2])
-            retriable = [it for it in busy
-                         if it[1].get("max_retries", 0) > 0]
-            # newest-dispatched first among: retriable (cheapest safe
-            # re-run), then leased (owner-managed retry), then the rest
-            victim = (retriable or leased or busy)[-1][0]
-            victim.oom_killed = True
-            try:
-                victim.proc.kill()
-            except OSError:
-                victim.oom_killed = False  # a later crash is NOT an OOM
-                return False
-        return True
-
-    def _monitor_loop(self):
-        """Reap dead worker processes (reference: worker failure detection
-        via socket + SIGCHLD in NodeManager)."""
-        while not self._stopping:
-            time.sleep(0.1)
-            with self._workers_lock:
-                dead = [w for w in self._workers.values()
-                        if w.proc is not None and w.proc.poll() is not None
-                        and w.state != "dead"]
-            for w in dead:
-                self._on_worker_gone(w)
-
-
-def env_get_default(key: str, default: str) -> str:
-    v = os.environ.get(key)
-    return v if v else default
-
-
-def _worker_pythonpath(current: str) -> str:
-    """PYTHONPATH for spawned workers: the ray_tpu package root plus the
-    inherited entries, minus directories that install a ``sitecustomize``
-    hook — such hooks (e.g. a driver-side TPU tunnel plugin) eagerly import
-    heavyweight runtimes and add seconds to EVERY worker spawn. Set
-    RAY_TPU_WORKER_KEEP_SITE=1 to keep them (workers that must dial the
-    TPU backend through the site hook)."""
-    import ray_tpu
-    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
-        ray_tpu.__file__)))
-    entries = [pkg_root]
-    keep_site = os.environ.get("RAY_TPU_WORKER_KEEP_SITE") == "1"
-    for p in current.split(os.pathsep):
-        if not p or p == pkg_root:
-            continue
-        if not keep_site and os.path.exists(
-                os.path.join(p, "sitecustomize.py")):
-            continue
-        entries.append(p)
-    return os.pathsep.join(entries)
 
 
 def main():  # runs a raylet as a standalone process (cluster_utils spawns it)

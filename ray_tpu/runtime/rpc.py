@@ -30,8 +30,25 @@ class ConnectionLost(Exception):
     pass
 
 
-def send_msg(sock: socket.socket, obj: Any, lock: threading.Lock | None = None):
-    data = pickle.dumps(obj, protocol=5)
+# Cross-language frames: a payload starting with b"M" is msgpack (the
+# C++ client's wire — see runtime/xlang.py); pickled payloads start with
+# the PROTO opcode 0x80, so the marker never collides. Servers answer
+# each request in the format it arrived in.
+_MSGPACK_MARK = 0x4D  # "M"
+
+
+def send_msg(sock: socket.socket, obj: Any,
+             lock: threading.Lock | None = None, fmt: str = "pickle"):
+    if fmt == "msgpack":
+        from ray_tpu.runtime import xlang
+
+        if isinstance(obj, dict) and isinstance(obj.get("error"),
+                                                BaseException):
+            # exceptions don't cross the language boundary as objects
+            obj = {**obj, "error": repr(obj["error"])}
+        data = bytes((_MSGPACK_MARK,)) + xlang.dumps(obj)
+    else:
+        data = pickle.dumps(obj, protocol=5)
     frame = _LEN.pack(len(data)) + data
     if lock:
         with lock:
@@ -41,9 +58,19 @@ def send_msg(sock: socket.socket, obj: Any, lock: threading.Lock | None = None):
 
 
 def recv_msg(sock: socket.socket) -> Any:
+    return recv_msg_any(sock)[0]
+
+
+def recv_msg_any(sock: socket.socket) -> tuple[Any, str]:
+    """Receive one frame, returning (message, format)."""
     header = _recv_exact(sock, _LEN.size)
     (length,) = _LEN.unpack(header)
-    return pickle.loads(_recv_exact(sock, length))
+    payload = _recv_exact(sock, length)
+    if payload and payload[0] == _MSGPACK_MARK:
+        from ray_tpu.runtime import xlang
+
+        return xlang.loads(payload[1:]), "msgpack"
+    return pickle.loads(payload), "pickle"
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -84,6 +111,20 @@ class RpcServer:
 
     def stop(self):
         self._stopping = True
+        # Wake the accept thread and JOIN it BEFORE closing the listener:
+        # close() frees the fd NUMBER for the kernel to reuse, and a
+        # thread still parked in (or retrying) accept() on that number
+        # would accept on whatever socket inherits it — observed stealing
+        # a freshly-bound server's connections in back-to-back test
+        # clusters and closing them (spurious ConnectionLost on clients
+        # of the NEW server). shutdown() makes the parked accept return
+        # EINVAL deterministically.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        if self._accept_thread.is_alive():
+            self._accept_thread.join(timeout=2.0)
         try:
             self._sock.close()
         except OSError:
@@ -134,10 +175,11 @@ class RpcServer:
     def _serve_conn(self, conn: socket.socket):
         send_lock = threading.Lock()
         held = False
+        fmt = "pickle"
         try:
             while not self._stopping:
                 try:
-                    req = recv_msg(conn)
+                    req, fmt = recv_msg_any(conn)
                 except (ConnectionLost, OSError, EOFError):
                     return
                 if self._stopping:
@@ -146,7 +188,8 @@ class RpcServer:
                     try:
                         send_msg(conn, {"_id": req.get("_id"),
                                         "error": ConnectionLost(
-                                            "server stopping")}, send_lock)
+                                            "server stopping")}, send_lock,
+                                 fmt=fmt)
                     except (OSError, Exception):  # noqa: BLE001
                         pass
                     return
@@ -159,7 +202,8 @@ class RpcServer:
                     result = handler(conn, send_lock, **req)
                 except BaseException as e:  # noqa: BLE001 - ship to caller
                     try:
-                        send_msg(conn, {"_id": req_id, "error": e}, send_lock)
+                        send_msg(conn, {"_id": req_id, "error": e},
+                                 send_lock, fmt=fmt)
                     except OSError:
                         return  # peer gone; nothing to reply to
                     except Exception:  # unpicklable exception payload
@@ -167,7 +211,7 @@ class RpcServer:
                             send_msg(conn,
                                      {"_id": req_id,
                                       "error": RuntimeError(repr(e))},
-                                     send_lock)
+                                     send_lock, fmt=fmt)
                         except OSError:
                             return
                     continue
@@ -179,9 +223,16 @@ class RpcServer:
                     return
                 try:
                     send_msg(conn, {"_id": req_id, "result": result},
-                             send_lock)
+                             send_lock, fmt=fmt)
                 except OSError:
                     return  # peer closed mid-reply (e.g. returned lease)
+                except Exception as e:  # noqa: BLE001 - unencodable result
+                    try:
+                        send_msg(conn, {"_id": req_id,
+                                        "error": RuntimeError(repr(e))},
+                                 send_lock, fmt=fmt)
+                    except OSError:
+                        return
         finally:
             if not held:
                 with self._conns_lock:

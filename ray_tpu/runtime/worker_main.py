@@ -120,7 +120,28 @@ class TaskPushServer(RpcServer):
         # a self-terminating method (os._exit) would swallow the ack —
         # the owner would then RESEND the killer to the restarted
         # incarnation and burn its whole restart budget
+        task["_direct"] = True   # no raylet bookkeeping: skip task_done
         w._enqueue_actor_task(task)
+        return {"ok": True}
+
+    def rpc_submit_actor_tasks(self, conn, send_lock, *, tasks: list):
+        """Batched direct actor submission: the owner's flusher packs a
+        burst of calls into one frame (one pickle+syscall per burst).
+        Validation matches the singular path; a mismatch fails the whole
+        frame and the owner resends task-by-task (worker-side seq dedup
+        makes re-delivery of the already-enqueued prefix harmless)."""
+        w = self._worker
+        for task in tasks:
+            if w.actor_id is None or task.get("actor_id") != w.actor_id:
+                raise LookupError(
+                    f"actor {task.get('actor_id')} not hosted by this worker")
+            if task.get("incarnation", 0) != w.actor_incarnation:
+                raise LookupError(
+                    f"actor {w.actor_id} incarnation mismatch "
+                    f"(task {task.get('incarnation')} != "
+                    f"{w.actor_incarnation})")
+            task["_direct"] = True   # no raylet bookkeeping: skip task_done
+            w._enqueue_actor_task(task)
         return {"ok": True}
 
     def rpc_dump_stacks(self, conn, send_lock):
@@ -207,6 +228,12 @@ class Worker:
         self.actor_instance = None
         self.actor_id = None
         self.actor_incarnation = 0
+        self.actor_namespace = None
+        # asyncio mode (reference: async actors run coroutine methods on
+        # fibers — core_worker/fiber.h:17; here: one event loop thread,
+        # concurrency bounded by an asyncio.Semaphore(max_concurrency))
+        self._actor_loop = None
+        self._actor_sem = None
         # ONE executor thread runs actor methods in arrival order no
         # matter which path delivered them (raylet channel or direct
         # owner push) — actor semantics are one method at a time
@@ -373,6 +400,18 @@ class Worker:
         return args, kwargs
 
     def _store_returns(self, task: dict, result):
+        if task.get("streaming"):
+            # generator task: seal each yield at its derived oid AS IT IS
+            # PRODUCED (consumers iterate while this loop still runs),
+            # then the count object (= the declared return oid)
+            from ray_tpu.runtime.streaming import store_stream
+
+            store_stream(
+                result, bytes.fromhex(task["task_id"]),
+                lambda oid, v, er: self._put_and_report(oid.hex(), v,
+                                                        is_error=er),
+                lambda oid, n: self._put_and_report(oid.hex(), n))
+            return
         return_oids = task["return_oids"]
         if len(return_oids) == 1:
             values = [result]
@@ -461,7 +500,10 @@ class Worker:
                 "state": "FINISHED" if ok else "FAILED",
                 "thread": f"worker-{self.worker_id[:8]}",
             })
-            full = len(self._event_buf) >= 8
+            # large batch threshold: at 10k+ calls/s a flush-per-8 means
+            # >1k GCS RPCs/s of pure observability; the 1s timer flusher
+            # bounds staleness for sparse workloads
+            full = len(self._event_buf) >= 128
         if full or _time.monotonic() - self._last_flush > 2.0:
             self._flush_task_events()
 
@@ -501,12 +543,33 @@ class Worker:
         return fn
 
     def _execute(self, task: dict):
+        from ray_tpu.runtime_context import (reset_task_namespace,
+                                             set_task_namespace)
+
+        ns_token = set_task_namespace(task.get("namespace"))
+        try:
+            self._execute_inner(task)
+        finally:
+            reset_task_namespace(ns_token)
+
+    def _execute_inner(self, task: dict):
         import time as _time
 
         started = _time.monotonic()
         try:
-            fn = self._load_function(task["function_blob"])
-            args, kwargs = self._resolve_args(task)
+            if "function_ref" in task:
+                # cross-language task (C++/external client): the function
+                # is a DESCRIPTOR resolved by import, args are plain data
+                # already decoded from the msgpack frame (runtime/xlang.py
+                # — reference: cross-language function descriptors)
+                from ray_tpu.runtime.xlang import resolve_function_ref
+
+                fn = resolve_function_ref(task["function_ref"])
+                args = list(task.get("args") or [])
+                kwargs = dict(task.get("kwargs") or {})
+            else:
+                fn = self._load_function(task["function_blob"])
+                args, kwargs = self._resolve_args(task)
         except BaseException as e:  # noqa: BLE001
             self._store_error(task, e)
             self._report_task_event(task, started, False)
@@ -517,6 +580,13 @@ class Worker:
             with execution_span(task.get("name", "?"),
                                 task.get("trace_ctx")):
                 result = fn(*args, **kwargs)
+                if _iscoroutine(result):
+                    # async def remote function: drive it to completion
+                    # on a per-task loop (reference: async tasks run on
+                    # the worker's event loop)
+                    import asyncio
+
+                    result = asyncio.run(result)
         except BaseException as e:  # noqa: BLE001
             self._store_error(
                 task, exc.TaskError(task.get("name", "?"), e,
@@ -535,10 +605,32 @@ class Worker:
                       incarnation: int = 0):
         try:
             cls = cloudpickle.loads(task["function_blob"])
+            # the actor lives in its creator's namespace: every method
+            # execution (and nested get_actor/create_actor from methods)
+            # resolves names there
+            self.actor_namespace = task.get("namespace")
+            from ray_tpu.runtime_context import set_task_namespace
+
+            set_task_namespace(self.actor_namespace)
             args, kwargs = self._resolve_args(task)
             self.actor_instance = cls(*args, **kwargs)
             self.actor_id = actor_id
             self.actor_incarnation = incarnation
+            import inspect
+
+            if any(inspect.iscoroutinefunction(getattr(cls, n, None))
+                   for n in dir(cls)):
+                # ASYNC actor: methods are scheduled onto this loop (the
+                # executor thread posts, never waits), so awaits overlap
+                # up to max_concurrency in-flight calls
+                import asyncio
+
+                self._actor_loop = asyncio.new_event_loop()
+                self._actor_sem = asyncio.Semaphore(
+                    max(1, int(task.get("max_concurrency") or 1)))
+                threading.Thread(target=self._actor_loop.run_forever,
+                                 daemon=True,
+                                 name="actor-asyncio-loop").start()
             if not self._actor_exec_started:
                 self._actor_exec_started = True
                 threading.Thread(target=self._actor_exec_loop,
@@ -576,10 +668,20 @@ class Worker:
             self._actor_exec_q.put(t)
 
     def _actor_exec_loop(self):
+        from ray_tpu.runtime_context import set_task_namespace
+
         while True:
             task = self._actor_exec_q.get()
+            # per-thread contextvar: the creator's namespace must be set
+            # HERE (and is captured by run_coroutine_threadsafe for async
+            # calls), not just on the channel thread that created the
+            # actor
+            set_task_namespace(getattr(self, "actor_namespace", None))
             try:
-                self._run_actor_task(task)
+                if self._actor_loop is not None and not task.get("noop"):
+                    self._post_async_actor_task(task)
+                else:
+                    self._run_actor_task(task)
             except BaseException:  # noqa: BLE001
                 # _run_actor_task seals task errors itself; anything that
                 # still escapes would silently kill this (sole) executor
@@ -590,13 +692,82 @@ class Worker:
                 traceback.print_exc()
                 os._exit(1)
 
+    def _post_async_actor_task(self, task: dict):
+        """Async-actor dispatch: resolve args on THIS thread (dependency
+        pulls are blocking control RPCs that must not stall the event
+        loop), then fire the call onto the loop and move to the next
+        queued task — calls START in per-caller submission order and
+        interleave at await points (reference async-actor semantics)."""
+        import asyncio
+        import time as _time
+
+        started = _time.monotonic()
+        try:
+            args, kwargs = self._resolve_args(task)
+        except BaseException as e:  # noqa: BLE001
+            self._store_error(
+                task, exc.TaskError(task.get("name", "?"), e,
+                                    tb=traceback.format_exc()))
+            self._report_task_event(task, started, False)
+            if not task.get("_direct"):
+                self._send({"type": "task_done",
+                            "task_id": task.get("task_id")})
+            return
+        asyncio.run_coroutine_threadsafe(
+            self._run_actor_coro(task, args, kwargs), self._actor_loop)
+
+    async def _run_actor_coro(self, task: dict, args, kwargs):
+        """One async-actor call, bounded by the concurrency semaphore.
+        Sync methods of an async actor run inline ON the loop (they
+        block it — reference behavior: everything posts to the loop)."""
+        import inspect
+        import time as _time
+
+        async with self._actor_sem:
+            started = _time.monotonic()
+            done = (lambda: None) if task.get("_direct") else (
+                lambda: self._send({"type": "task_done",
+                                    "task_id": task.get("task_id")}))
+            try:
+                from ray_tpu.util.tracing import execution_span
+
+                method = getattr(self.actor_instance, task["method_name"])
+                with execution_span(task.get("name", "?"),
+                                    task.get("trace_ctx")):
+                    result = method(*args, **kwargs)
+                    if inspect.isawaitable(result):
+                        result = await result
+            except BaseException as e:  # noqa: BLE001
+                self._store_error(
+                    task, exc.TaskError(task.get("name", "?"), e,
+                                        tb=traceback.format_exc()))
+                self._report_task_event(task, started, False)
+                done()
+                return
+            try:
+                self._store_returns(task, result)
+            except BaseException as e:  # noqa: BLE001
+                self._store_error(task, e)
+                self._report_task_event(task, started, False)
+                done()
+                return
+            self._report_task_event(task, started, True)
+            done()
+
     def _run_actor_task(self, task: dict):
         import time as _time
 
+        # direct-pushed tasks (owner -> this worker, no raylet hop) need
+        # no task_done: the raylet tracked nothing for them, and at 10k+
+        # calls/s the per-call frame to the raylet channel is pure GIL
+        # and syscall overhead on both ends
+        done = (lambda: None) if task.get("_direct") else (
+            lambda: self._send({"type": "task_done",
+                                "task_id": task.get("task_id")}))
         if task.get("noop"):
             # seq gap-filler (owner sealed errors for a submit that never
             # arrived): advances the ordered queue, executes nothing
-            self._send({"type": "task_done", "task_id": task.get("task_id")})
+            done()
             return
         started = _time.monotonic()
         try:
@@ -612,17 +783,23 @@ class Worker:
                 task, exc.TaskError(task.get("name", "?"), e,
                                     tb=traceback.format_exc()))
             self._report_task_event(task, started, False)
-            self._send({"type": "task_done", "task_id": task.get("task_id")})
+            done()
             return
         try:
             self._store_returns(task, result)
         except BaseException as e:  # noqa: BLE001
             self._store_error(task, e)
             self._report_task_event(task, started, False)
-            self._send({"type": "task_done", "task_id": task.get("task_id")})
+            done()
             return
         self._report_task_event(task, started, True)
-        self._send({"type": "task_done", "task_id": task.get("task_id")})
+        done()
+
+
+def _iscoroutine(x) -> bool:
+    import inspect
+
+    return inspect.iscoroutine(x)
 
 
 def _is_marker(x) -> bool:

@@ -3,8 +3,30 @@
 
 from __future__ import annotations
 
+import contextvars
 import os
 from dataclasses import dataclass
+
+# Ambient namespace of the currently executing task/actor (reference:
+# workers inherit the submitting job's namespace —
+# ``_private/worker.py:1157``). Set by the worker around task execution;
+# read by get_actor()/named-actor creation when the runtime has no
+# explicit ``init(namespace=...)``.
+_task_namespace: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "ray_tpu_task_namespace", default=None)
+
+
+def current_task_namespace() -> str | None:
+    return _task_namespace.get()
+
+
+def set_task_namespace(ns: str | None):
+    """Returns a reset token."""
+    return _task_namespace.set(ns)
+
+
+def reset_task_namespace(token):
+    _task_namespace.reset(token)
 
 
 @dataclass
@@ -13,6 +35,7 @@ class RuntimeContext:
     worker_id: str
     job_id: str
     gcs_address: str | None
+    namespace: str = ""
 
     def get_node_id(self) -> str:
         return self.node_id
@@ -41,5 +64,8 @@ def get_runtime_context() -> RuntimeContext:
             node_id = node_id.hex()
         job = getattr(rt, "job_id", None)
         job_id = job.hex() if hasattr(job, "hex") else str(job or "")
+    ns = _task_namespace.get() or ""
+    if not ns and _core.is_initialized():
+        ns = getattr(_core.get_runtime(), "namespace", "") or ""
     return RuntimeContext(node_id=str(node_id), worker_id=worker_id,
-                          job_id=job_id, gcs_address=gcs)
+                          job_id=job_id, gcs_address=gcs, namespace=ns)

@@ -28,9 +28,26 @@ class BaseID:
         self._bytes = id_bytes
         self._hash = hash(id_bytes)
 
+    # Entropy pool: one urandom syscall buys ~256 IDs. from_random is on
+    # the per-task submit hot path (2+ IDs per call at 10k+ calls/s), and
+    # a 3-4us syscall per ID is real money there. Fork safety: the pool
+    # is keyed by pid so children never replay the parent's bytes.
+    _pool = b""
+    _pool_off = 0
+    _pool_pid = 0
+    _pool_lock = threading.Lock()
+
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(cls.SIZE))
+        with BaseID._pool_lock:
+            off = BaseID._pool_off
+            pid = os.getpid()
+            if off + cls.SIZE > len(BaseID._pool) or BaseID._pool_pid != pid:
+                BaseID._pool = os.urandom(4096)
+                BaseID._pool_pid = pid
+                off = 0
+            BaseID._pool_off = off + cls.SIZE
+            return cls(BaseID._pool[off:off + cls.SIZE])
 
     @classmethod
     def from_hex(cls, hex_str: str):

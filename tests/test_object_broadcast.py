@@ -1,0 +1,170 @@
+"""Broadcast-capable object plane: multi-source striped pulls + cloud
+(pyarrow.fs URI) spill targets.
+
+Reference analog: ``ObjectManager::Push`` (object_manager.cc:339 —
+proactive chunk spreading; pull-based here: chunks stripe across every
+registered holder and the holder set refreshes mid-transfer) and
+``_private/external_storage.py`` (smart_open/S3 spilling; pyarrow.fs
+URIs here).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.utils.config import reset_config
+
+
+@pytest.fixture
+def bcast_cluster(monkeypatch):
+    # small chunks so striping/refresh paths actually run
+    monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES", str(1 << 20))
+    reset_config()
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=1, store_capacity=512 << 20)   # head/driver
+    for _ in range(4):                                  # consumers
+        c.add_node(num_cpus=1, store_capacity=512 << 20)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+    reset_config()
+
+
+def test_broadcast_fans_out_across_holders(bcast_cluster):
+    """One hot object consumed on every node: pulls stripe across
+    holders (the holder set grows as consumers finish), and all copies
+    are intact."""
+    c = bcast_cluster
+
+    @ray_tpu.remote
+    def produce():
+        return np.arange((48 << 20) // 8, dtype=np.float64)   # 48 MiB
+
+    ref = produce.remote()
+    expect = np.arange((48 << 20) // 8, dtype=np.float64)
+    np.testing.assert_array_equal(ray_tpu.get(ref, timeout=60), expect)
+
+    # every node pulls a copy (node-affinity pins consumers per node)
+    from ray_tpu.api import _parse_strategy  # noqa: F401 - api import ok
+    from ray_tpu.runtime.task_spec import SchedulingStrategy
+
+    @ray_tpu.remote
+    def consume(x):
+        return float(x[0]) + float(x[-1])
+
+    t0 = time.monotonic()
+    refs = []
+    for node_id in list(c.nodes):
+        strat = SchedulingStrategy(kind="NODE_AFFINITY", node_id=node_id)
+        refs.append(consume.options(
+            scheduling_strategy=strat).remote(ref))
+    out = ray_tpu.get(refs, timeout=120)
+    elapsed = time.monotonic() - t0
+    assert all(abs(v - out[0]) < 1e-9 for v in out)
+    # the whole broadcast (5 consumers) must beat 5x a serial transfer
+    # budget; generous bound — the point is no pathological serialization
+    assert elapsed < 60, elapsed
+    # the object is now registered on multiple nodes (fan-out sources)
+    from ray_tpu.runtime.rpc import RpcClient
+
+    gcs = RpcClient(c.gcs_address)
+    locs = gcs.call("get_object_locations", oids=[ref.id.hex()])
+    gcs.close()
+    assert len(locs[ref.id.hex()]) >= 3, locs
+
+
+def test_multi_source_striping_direct():
+    """PullManager stripes chunks across several live sources and
+    completes when one source dies mid-transfer (chunk retry)."""
+    from ray_tpu.runtime.pull_manager import PullManager
+
+    chunk = 4
+    size = 10 * chunk
+    blob = bytes(range(10)) * chunk   # 40 bytes
+
+    class FakeStore:
+        def __init__(self):
+            self.data = {}
+            self.raw = None
+
+        def contains(self, oid):
+            return oid in self.data
+
+        def create(self, oid, n):
+            self.raw = bytearray(n)
+            return memoryview(self.raw)
+
+        def seal(self, oid):
+            self.data[b"x"] = bytes(self.raw)
+
+        def abort(self, oid):
+            self.raw = None
+
+    class FakeClient:
+        def __init__(self, fail_after=None):
+            self.calls = 0
+            self.fail_after = fail_after
+            self._closed = False
+
+        def call(self, method, timeout=None, **kw):
+            self.calls += 1
+            if self.fail_after is not None and self.calls > self.fail_after:
+                raise OSError("source died")
+            off, length = kw["offset"], kw["length"]
+            return blob[off:off + length]
+
+        def close(self):
+            self._closed = True
+
+    store = FakeStore()
+    clients = {("a", 1): FakeClient(), ("b", 2): FakeClient(fail_after=1)}
+    pm = PullManager(fetch_local=lambda o: False,
+                     peer_addresses=lambda o: [],
+                     store=store, on_pulled=lambda o, s: None,
+                     chunk_size=chunk, max_in_flight_bytes=1 << 20,
+                     conns_per_peer=1)
+    pm._checkout = lambda addr: clients[addr]
+    pm._checkin = lambda addr, c: None
+
+    class FakeView:
+        pass
+
+    # monkeypatch _verify to skip CRC (no codec header in this fake)
+    pm._verify = staticmethod(lambda *a: True)
+    ok = pm._pull_chunked("aa", b"x", [("a", 1), ("b", 2)], size, None)
+    assert ok
+    assert store.data[b"x"] == blob
+    assert clients[("a", 1)].calls >= 8   # surviving source carried it
+
+
+def test_uri_spill_roundtrip(tmp_path, monkeypatch):
+    """Spill + restore through a pyarrow.fs file:// URI target."""
+    monkeypatch.setenv("RAY_TPU_OBJECT_SPILLING_DIRECTORY",
+                       f"file://{tmp_path}/spill")
+    reset_config()
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=1, store_capacity=64 << 20)
+    ray_tpu.init(address=c.gcs_address)
+    try:
+        node = next(iter(c.nodes.values())).raylet
+        assert not node.objects.spill_is_local
+        payload = np.ones((8 << 20) // 8)        # 8 MiB
+        ref = ray_tpu.put(payload)
+        spilled = node.objects.spill_bytes(64 << 20)
+        assert spilled >= 1, "nothing spilled to the URI target"
+        files = list((tmp_path / "spill").rglob("*"))
+        assert any(f.is_file() for f in files), "no spill file on target"
+        # restore on read
+        np.testing.assert_array_equal(ray_tpu.get(ref, timeout=30),
+                                      payload)
+        assert node.objects.spill_stats["num_restored"] >= 1
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+        reset_config()

@@ -93,7 +93,7 @@ def test_spilled_object_served_by_chunk_seek(two_node):
     oid = ref.id.binary()
     while time.monotonic() < deadline and not producer.store.contains(oid):
         time.sleep(0.05)
-    spilled = producer._spill_bytes(64 << 20)
+    spilled = producer.objects.spill_bytes(64 << 20)
     out = ray_tpu.get(ref, timeout=60)
     np.testing.assert_array_equal(out, np.ones((8 << 20) // 8))
     assert spilled >= 0   # spill path exercised (0 if already pulled)

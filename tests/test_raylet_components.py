@@ -1,0 +1,222 @@
+"""Unit tests for the raylet's split-out components (worker pool,
+scheduler, local object manager) — exercised against stub nodes, no
+cluster boot. Reference test analog: the C++ unit suites
+``worker_pool_test.cc`` / ``cluster_task_manager_test.cc`` /
+``local_object_manager_test.cc`` that test these pieces in isolation."""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.runtime.scheduler import TaskScheduler
+from ray_tpu.runtime.worker_pool import WorkerHandle, WorkerPool
+
+
+class StubNode:
+    """Minimal raylet stand-in for component unit tests."""
+
+    def __init__(self):
+        self.node_id = "a" * 32
+        self._stopping = False
+        self.kicked = 0
+        self.released = []
+        self.errors = []
+
+    def _kick_dispatch(self):
+        self.kicked += 1
+
+    def _release(self, demand):
+        self.released.append(dict(demand))
+
+    def _store_task_error(self, task, error):
+        self.errors.append((task, error))
+
+    def _forward(self, task, node_id, spill_count):
+        return False
+
+
+class FakeProc:
+    def __init__(self):
+        self.killed = False
+        self.pid = 0
+
+    def kill(self):
+        self.killed = True
+
+    def poll(self):
+        return None
+
+
+# ----------------------------------------------------------------------
+# WorkerPool
+# ----------------------------------------------------------------------
+
+def test_bad_env_registry_ttl(monkeypatch):
+    pool = WorkerPool(StubNode(), max_workers=2)
+    pool.mark_bad_env("envkey", "pip exploded")
+    assert pool.bad_env_error(None) is None
+    # matching env key (env_key(None) != "envkey"; probe directly)
+    pool._bad_envs["k2"] = ("boom", time.monotonic() - 120)
+    from ray_tpu.runtime_env import env_key
+    pool._bad_envs[env_key(None)] = ("fresh", time.monotonic())
+    assert pool.bad_env_error(None) == "fresh"
+    # expired entries are ignored
+    pool._bad_envs[env_key(None)] = ("stale", time.monotonic() - 120)
+    assert pool.bad_env_error(None) is None
+
+
+def test_kill_policy_prefers_newest_retriable():
+    pool = WorkerPool(StubNode(), max_workers=4)
+    old_retriable = WorkerHandle(worker_id="w1", proc=FakeProc(),
+                                 state="busy",
+                                 current_task={"max_retries": 2},
+                                 dispatched_at=1.0)
+    new_retriable = WorkerHandle(worker_id="w2", proc=FakeProc(),
+                                 state="busy",
+                                 current_task={"max_retries": 2},
+                                 dispatched_at=5.0)
+    non_retriable = WorkerHandle(worker_id="w3", proc=FakeProc(),
+                                 state="busy",
+                                 current_task={"max_retries": 0},
+                                 dispatched_at=9.0)
+    actor = WorkerHandle(worker_id="w4", proc=FakeProc(), state="actor",
+                         dispatched_at=10.0)
+    pool.workers = {w.worker_id: w
+                    for w in (old_retriable, new_retriable,
+                              non_retriable, actor)}
+    assert pool.kill_one_for_memory()
+    assert new_retriable.proc.killed and new_retriable.oom_killed
+    assert not old_retriable.proc.killed
+    assert not actor.proc.killed          # actors never chosen
+
+
+def test_kill_policy_falls_back_to_leased_then_busy():
+    pool = WorkerPool(StubNode(), max_workers=4)
+    leased = WorkerHandle(worker_id="w1", proc=FakeProc(), state="leased",
+                          dispatched_at=2.0)
+    non_retriable = WorkerHandle(worker_id="w2", proc=FakeProc(),
+                                 state="busy",
+                                 current_task={"max_retries": 0},
+                                 dispatched_at=3.0)
+    pool.workers = {w.worker_id: w for w in (leased, non_retriable)}
+    assert pool.kill_one_for_memory()
+    assert leased.proc.killed             # leased preferred over busy
+    assert not non_retriable.proc.killed
+
+
+def test_kill_policy_nothing_to_kill():
+    pool = WorkerPool(StubNode(), max_workers=4)
+    idle = WorkerHandle(worker_id="w1", proc=FakeProc(), state="idle")
+    pool.workers = {"w1": idle}
+    assert not pool.kill_one_for_memory()
+    assert not idle.proc.killed
+
+
+def test_death_info_bounded():
+    node = StubNode()
+
+    class NoStoreNode(StubNode):
+        class store:  # noqa: N801 - stub namespace
+            @staticmethod
+            def evict_orphans(pid):
+                pass
+
+            @staticmethod
+            def release_pid(pid):
+                pass
+
+    node = NoStoreNode()
+    pool = WorkerPool(node, max_workers=1)
+    for i in range(300):
+        w = WorkerHandle(worker_id=f"w{i}", state="idle")
+        pool.workers[w.worker_id] = w
+        pool.on_worker_gone(w)
+    assert len(pool._death_info) <= 256
+    assert pool.death_info("w299") == {"oom_killed": False}
+    assert pool.death_info("w0") is None   # evicted from the FIFO
+
+
+# ----------------------------------------------------------------------
+# TaskScheduler
+# ----------------------------------------------------------------------
+
+def make_sched(cpu=4.0):
+    node = StubNode()
+    sched = TaskScheduler(node, resources={"CPU": cpu},
+                          infeasible_timeout_s=1.0)
+    return node, sched
+
+
+def test_resource_accounting_acquire_release():
+    node, sched = make_sched(cpu=2.0)
+    assert sched.try_acquire({"CPU": 1.5})
+    assert not sched.try_acquire({"CPU": 1.0})
+    assert sched.avail_snapshot()["CPU"] == pytest.approx(0.5)
+    sched.release({"CPU": 1.5})
+    assert sched.avail_snapshot()["CPU"] == pytest.approx(2.0)
+    # release kicks the dispatch generation
+    assert sched._dispatch_gen > 0
+
+
+def test_take_queued_matching():
+    _, sched = make_sched()
+    t1 = {"name": "a", "return_oids": ["aa"]}
+    t2 = {"name": "b", "return_oids": ["bb"]}
+    sched.enqueue(t1)
+    sched.enqueue(t2)
+    hit = sched.take_queued_matching(
+        lambda t: "bb" in t.get("return_oids", ()))
+    assert hit is t2
+    assert list(sched.ready) == [t1]
+    assert sched.take_queued_matching(lambda t: False) is None
+
+
+def test_drop_queued_with_env():
+    _, sched = make_sched()
+    from ray_tpu.runtime_env import env_key
+    bad = {"name": "bad", "runtime_env": {"env_vars": {"X": "1"}}}
+    good = {"name": "good"}
+    sched.enqueue(bad)
+    sched.enqueue(good)
+    doomed = sched.drop_queued_with_env(env_key(bad["runtime_env"]))
+    assert doomed == [bad]
+    assert list(sched.ready) == [good]
+
+
+def test_stop_fails_parked_lease_waiters():
+    _, sched = make_sched()
+    waiter = {"demand": {"CPU": 1}, "runtime_env": None,
+              "event": threading.Event(), "result": None}
+    with sched.cv:
+        sched.lease_waiters.append(waiter)
+    sched.stop()
+    assert waiter["event"].is_set()
+    assert waiter["result"] == {"retry": True}
+
+
+def test_deferred_enqueue_fires():
+    node, sched = make_sched()
+    task = {"name": "t"}
+    sched.defer_enqueue(task, 0.05)
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline and not sched.ready:
+        time.sleep(0.01)
+    assert list(sched.ready) == [task]
+
+
+def test_infeasible_park_and_take():
+    node, sched = make_sched()
+
+    class GcsStub:
+        def call(self, *a, **k):
+            return None
+
+    node._gcs = GcsStub()
+    node._gcs_lock = threading.Lock()
+    task = {"name": "big", "return_oids": ["cc"]}
+    sched.park_infeasible(task, {"CPU": 64})
+    hit = sched.take_infeasible_matching(
+        lambda t: "cc" in t.get("return_oids", ()))
+    assert hit is task
+    assert sched.take_infeasible_matching(lambda t: True) is None
