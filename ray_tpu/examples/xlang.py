@@ -1,0 +1,16 @@
+"""Functions callable from external-language clients by descriptor
+(``ray_tpu.examples.xlang:add`` etc. — see ``runtime/xlang.py`` and the
+C++ API in ``src/capi/``)."""
+
+
+def add(a, b):
+    return a + b
+
+
+def concat(parts):
+    return "".join(parts)
+
+
+def stats(xs):
+    return {"n": len(xs), "sum": float(sum(xs)),
+            "max": float(max(xs)), "min": float(min(xs))}
