@@ -179,45 +179,65 @@ def batch(_fn=None, *, max_batch_size: int = 8,
     def wrap(fn):
         # batching state lives on the replica INSTANCE, created lazily —
         # the decorator closure must stay pickle-clean (the deployment
-        # class ships to replicas via cloudpickle)
+        # class ships to replicas via cloudpickle). The wrapper is a
+        # COROUTINE: replicas are asyncio actors, so concurrent callers
+        # are coroutines on one loop — accumulation is cooperative
+        # (futures + a timed shield), no threads or locks.
         attr = f"__serve_batch_state_{fn.__name__}"
 
         @functools.wraps(fn)
-        def wrapper(self, item):
-            # dict.setdefault is atomic under the GIL — both racing
-            # creators observe the same winning state dict
-            state = self.__dict__.setdefault(
-                attr, {"queue": [], "cv": threading.Condition()})
-            entry = {"item": item, "done": threading.Event(),
-                     "result": None, "error": None}
-            with state["cv"]:
-                state["queue"].append(entry)
-                if len(state["queue"]) >= max_batch_size:
-                    state["cv"].notify_all()
-            entry["done"].wait(timeout=batch_wait_timeout_s)  # accumulate
+        async def wrapper(self, item):
+            import asyncio
+            import inspect
+
+            state = self.__dict__.setdefault(attr, {"queue": []})
+            entry = {"item": item,
+                     "fut": asyncio.get_running_loop().create_future()}
+            state["queue"].append(entry)
+            if len(state["queue"]) < max_batch_size:
+                # linger for batchmates; shield() keeps a timeout from
+                # cancelling a future another flusher may yet complete
+                try:
+                    await asyncio.wait_for(asyncio.shield(entry["fut"]),
+                                           timeout=batch_wait_timeout_s)
+                except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+                    pass
             # Flush until OUR entry completes: a caller may flush batches
             # that don't contain its own entry (they were queued first);
             # it then loops and flushes the next batch rather than
             # stranding itself.
-            while not entry["done"].is_set():
-                with state["cv"]:
-                    batch_entries = state["queue"][:max_batch_size]
-                    state["queue"] = state["queue"][max_batch_size:]
+            while not entry["fut"].done():
+                batch_entries = state["queue"][:max_batch_size]
+                state["queue"] = state["queue"][max_batch_size:]
                 if not batch_entries:
-                    entry["done"].wait(timeout=0.01)
+                    await asyncio.sleep(0.005)
                     continue
                 try:
-                    results = fn(self, [e["item"] for e in batch_entries])
+                    if inspect.iscoroutinefunction(fn):
+                        results = await fn(
+                            self, [e["item"] for e in batch_entries])
+                    else:
+                        # sync batch fn (the common case: a blocking
+                        # model call) runs OFF the loop — freezing the
+                        # replica's loop for a whole batch would stall
+                        # accumulation of the next batch and every other
+                        # call on the replica
+                        import functools as _ft
+
+                        results = await asyncio.get_running_loop() \
+                            .run_in_executor(None, _ft.partial(
+                                fn, self,
+                                [e["item"] for e in batch_entries]))
+                        if inspect.isawaitable(results):
+                            results = await results
                     for e, r in zip(batch_entries, results):
-                        e["result"] = r
-                        e["done"].set()
+                        if not e["fut"].done():
+                            e["fut"].set_result(r)
                 except BaseException as err:  # noqa: BLE001
                     for e in batch_entries:
-                        e["error"] = err
-                        e["done"].set()
-            if entry["error"] is not None:
-                raise entry["error"]
-            return entry["result"]
+                        if not e["fut"].done():
+                            e["fut"].set_exception(err)
+            return await entry["fut"]   # done: value or raise
 
         wrapper.__wrapped_batch__ = fn
         return wrapper

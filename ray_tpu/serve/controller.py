@@ -36,7 +36,15 @@ class _Replica:
         self._streams: dict = {}
         self._stream_errors: dict = {}
 
-    def handle_request(self, method_name, args, kwargs):
+    async def handle_request(self, method_name, args, kwargs):
+        """ASYNC handler: replicas are asyncio actors (the coroutine here
+        puts the hosting worker in async mode), so up to
+        max_concurrent_queries requests overlap at await points — async
+        deployment methods and ASGI apps get real concurrency per
+        replica; sync methods run serially on the loop exactly as they
+        did on the old single executor thread."""
+        import inspect
+
         from ray_tpu.serve.multiplex import (MODEL_ID_KWARG,
                                              set_request_model_id)
 
@@ -48,7 +56,30 @@ class _Replica:
         try:
             target = (self._instance if method_name == "__call__"
                       else getattr(self._instance, method_name))
-            return target(*args, **kwargs)
+            fn = target if (inspect.isfunction(target)
+                            or inspect.ismethod(target)) \
+                else getattr(target, "__call__", target)
+            if inspect.iscoroutinefunction(fn):
+                result = await target(*args, **kwargs)
+            else:
+                # SYNC handler: off the loop (reference: replica runs
+                # sync user code in a thread executor) — a blocking
+                # model call must not freeze the metrics/other requests
+                import asyncio
+                import contextvars
+                import functools as _ft
+
+                # copy_context: executor threads don't inherit this
+                # coroutine's contextvars (the multiplex model id rides
+                # on one)
+                ctx = contextvars.copy_context()
+                result = await asyncio.get_running_loop().run_in_executor(
+                    None, _ft.partial(ctx.run, target, *args, **kwargs))
+                if inspect.isawaitable(result):
+                    # sync wrapper returned a coroutine (e.g. a
+                    # @serve.batch-wrapped call): drive it here
+                    result = await result
+            return result
         finally:
             from ray_tpu.serve.multiplex import _request_model_id
 

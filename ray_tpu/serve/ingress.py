@@ -111,6 +111,12 @@ class _ASGIDriver:
                                                self._loop)
         return fut.result(timeout=request.get("timeout_s", 60))
 
+    async def ahandle(self, request: dict) -> dict:
+        """Await the app (on its dedicated loop) from ANOTHER loop."""
+        fut = asyncio.run_coroutine_threadsafe(self._run(request),
+                                               self._loop)
+        return await asyncio.wrap_future(fut)
+
 
 def ingress(asgi_app_or_factory):
     """Class decorator: the deployment serves the given ASGI app.
@@ -134,14 +140,18 @@ def ingress(asgi_app_or_factory):
                                    and not _looks_like_asgi(app)) else app
                 self._asgi_driver = _ASGIDriver(target)
 
-            def __call__(self, request: dict):
+            async def __call__(self, request: dict):
+                # async: the replica's event loop awaits the app's own
+                # loop WITHOUT blocking, so concurrent HTTP requests
+                # overlap per replica (the app keeps its dedicated loop —
+                # lifespan-created state stays loop-consistent)
                 if isinstance(request, dict) and request.get("__raw__"):
-                    return self._asgi_driver.handle(request)
+                    return await self._asgi_driver.ahandle(request)
                 # non-raw payloads (handle.call) become a POST /
                 body = json.dumps(request).encode() \
                     if not isinstance(request, (bytes, bytearray)) \
                     else bytes(request)
-                return self._asgi_driver.handle({
+                return await self._asgi_driver.ahandle({
                     "__raw__": True, "method": "POST", "path": "/",
                     "headers": [("content-type", "application/json")],
                     "body": body})
