@@ -39,10 +39,11 @@ class _Replica:
     async def handle_request(self, method_name, args, kwargs):
         """ASYNC handler: replicas are asyncio actors (the coroutine here
         puts the hosting worker in async mode), so up to
-        max_concurrent_queries requests overlap at await points — async
-        deployment methods and ASGI apps get real concurrency per
-        replica; sync methods run serially on the loop exactly as they
-        did on the old single executor thread."""
+        max_concurrent_queries requests overlap — async deployment
+        methods and ASGI apps at await points, and SYNC handlers in a
+        thread executor (the reference replica runs sync user code in a
+        thread pool too; a deployment that needs strictly serial
+        execution sets max_concurrent_queries=1)."""
         import inspect
 
         from ray_tpu.serve.multiplex import (MODEL_ID_KWARG,
@@ -135,12 +136,23 @@ class _Replica:
         threading.Thread(target=pump, daemon=True).start()
         return stream_id
 
-    def next_chunks(self, stream_id: str, max_chunks: int = 16,
-                    timeout_s: float = 10.0):
+    async def next_chunks(self, stream_id: str, max_chunks: int = 16,
+                          timeout_s: float = 10.0):
         """Up to max_chunks buffered items; final state signals end. A
         generator error is delivered AFTER its preceding chunks: chunks
         already accumulated return normally and the error re-raises on
-        the next call."""
+        the next call. ASYNC wrapper: the blocking queue wait runs in
+        the executor — a slow stream poll must not freeze the replica's
+        event loop (and with it every overlapped request + metrics)."""
+        import asyncio
+        import functools as _ft
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, _ft.partial(self._next_chunks_sync, stream_id,
+                              max_chunks, timeout_s))
+
+    def _next_chunks_sync(self, stream_id: str, max_chunks: int,
+                          timeout_s: float):
         import queue as _q
 
         pending_err = self._stream_errors.pop(stream_id, None)
@@ -178,10 +190,16 @@ class _Replica:
             except _q.Empty:
                 return ("more", out)
 
-    def reconfigure(self, user_config):
-        if hasattr(self._instance, "reconfigure"):
-            self._instance.reconfigure(user_config)
-        return True
+    async def reconfigure(self, user_config):
+        # off the loop: user reconfigure code may block (model reload)
+        import asyncio
+
+        def apply():
+            if hasattr(self._instance, "reconfigure"):
+                self._instance.reconfigure(user_config)
+            return True
+
+        return await asyncio.get_running_loop().run_in_executor(None, apply)
 
     def metrics(self):
         with self._lock:

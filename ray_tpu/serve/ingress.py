@@ -112,10 +112,21 @@ class _ASGIDriver:
         return fut.result(timeout=request.get("timeout_s", 60))
 
     async def ahandle(self, request: dict) -> dict:
-        """Await the app (on its dedicated loop) from ANOTHER loop."""
+        """Await the app (on its dedicated loop) from ANOTHER loop,
+        with the same per-request timeout the sync path enforces — a
+        hung app must surface an error, not hold a concurrency slot
+        forever."""
         fut = asyncio.run_coroutine_threadsafe(self._run(request),
                                                self._loop)
-        return await asyncio.wrap_future(fut)
+        try:
+            return await asyncio.wait_for(
+                asyncio.wrap_future(fut),
+                timeout=request.get("timeout_s", 60))
+        except asyncio.TimeoutError:
+            fut.cancel()
+            raise TimeoutError(
+                f"ASGI app did not answer within "
+                f"{request.get('timeout_s', 60)}s") from None
 
 
 def ingress(asgi_app_or_factory):
