@@ -189,16 +189,15 @@ def bench_serve() -> dict:
     n_params = llama.num_params(params)
     eng = LLMEngine(params=params, cfg=model_cfg, max_batch=max_batch,
                     max_len=max_len)
+    # deterministic warmup BEFORE the loop starts: every prefill group
+    # size + both decode programs compile now, so no JIT lands inside
+    # the measured window no matter how the burst gets admitted
+    eng.warmup(prompt_len)
     eng.start()
     rng = np.random.default_rng(0)
-
-    # warmup: compile every program the measured burst will hit — the
-    # batched prefill at the burst's group size, both decode chunk
-    # programs (the drain chunk runs while requests are waiting)
-    warm = [eng.submit(rng.integers(1, model_cfg.vocab_size, prompt_len),
-                       max_new_tokens=8) for _ in range(n_requests)]
-    for w in warm:
-        list(w.tokens())
+    w = eng.submit(rng.integers(1, model_cfg.vocab_size, prompt_len),
+                   max_new_tokens=4)
+    list(w.tokens())
 
     t0 = time.perf_counter()
     reqs = [

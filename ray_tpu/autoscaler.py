@@ -163,8 +163,14 @@ class StandardAutoscaler:
                  max_nodes: int = 4, idle_timeout_s: float = 5.0,
                  poll_interval_s: float = 0.5,
                  utilization_threshold: float = 0.9):
+        from ray_tpu.instance_manager import InstanceManager
+
         self.gcs = RpcClient(tuple(gcs_address))
         self.provider = provider
+        # v2-style bookkeeping: launches/terminations become versioned
+        # instance records; the reconciler (not this policy code) owns
+        # lifecycle transitions against the provider + GCS views
+        self.im = InstanceManager(provider)
         self.node_resources = node_resources or {"CPU": 2}
         self.max_nodes = max_nodes
         self.idle_timeout_s = idle_timeout_s
@@ -201,18 +207,18 @@ class StandardAutoscaler:
         all_nodes = {n["node_id"]: n
                      for n in self.gcs.call("get_nodes", alive_only=False)}
         alive = {nid for nid, n in all_nodes.items() if n.get("alive")}
+        self.im.reconcile(gcs_alive=alive)
         # reap provider nodes the GCS declared dead — left in place they
         # count as "provisioning" forever and wedge demand-driven scaling
         for nid in list(self.provider.non_terminated_nodes()):
             if nid in all_nodes and not all_nodes[nid].get("alive"):
-                self.provider.terminate_node(nid)
+                self.im.terminate(nid)
                 self._idle_since.pop(nid, None)
-        provisioning = [n for n in self.provider.non_terminated_nodes()
-                        if n not in alive]
+        self.im.reconcile(gcs_alive=alive)
+        provisioning = self.im.provisioning()
         # capacity AFTER the reap: the cycle that frees a dead node's
         # slot must be able to provision its replacement immediately
-        under_cap = (len(self.provider.non_terminated_nodes())
-                     < self.max_nodes)
+        under_cap = self.im.live_count() < self.max_nodes
         if under_cap and not provisioning:
             try:
                 pending = self.gcs.call("get_pending_demand")
@@ -222,7 +228,8 @@ class StandardAutoscaler:
                            if all(self.node_resources.get(k, 0) >= v
                                   for k, v in d.items())]
             if satisfiable:
-                self.provider.create_node(dict(self.node_resources))
+                self.im.launch(dict(self.node_resources))
+                self.im.reconcile(gcs_alive=alive)
                 return
         # scale up (2): demanded resource classes nearly exhausted
         busy = any(
@@ -231,7 +238,8 @@ class StandardAutoscaler:
             >= self.utilization_threshold
             for k in ("CPU", "TPU") if total.get(k))
         if busy and under_cap:
-            self.provider.create_node(dict(self.node_resources))
+            self.im.launch(dict(self.node_resources))
+            self.im.reconcile(gcs_alive=alive)
             return
         # scale down: provider nodes fully idle past the timeout
         nodes = {n["node_id"]: n
@@ -247,6 +255,6 @@ class StandardAutoscaler:
                 continue
             since = self._idle_since.setdefault(node_id, now)
             if now - since > self.idle_timeout_s:
-                self.provider.terminate_node(node_id)
+                self.im.terminate(node_id)
                 self._idle_since.pop(node_id, None)
                 return

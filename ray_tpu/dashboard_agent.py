@@ -1,0 +1,136 @@
+"""Per-node dashboard agent: a separate observability process.
+
+Reference analog: ``dashboard/agent.py:32`` +
+``dashboard/modules/reporter/reporter_agent.py`` — every node runs an
+agent process next to its raylet that samples host stats (psutil) and
+serves profiling, so observability traffic (stack dumps, flamegraph
+sampling, host metrics) does NOT ride the raylet's data plane. The head
+dashboard and the state API query agents directly via the agent address
+each node registers in the GCS node table.
+
+The agent's only raylet dependency is the worker LIST (one lightweight
+RPC per query — the raylet owns the pool); stacks/profiles then dial
+each worker's push port directly. The agent holds a blocking connection
+to its raylet and exits when it drops, so a dead node never leaves an
+orphan agent.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+
+from ray_tpu.runtime.rpc import ReconnectingRpcClient, RpcClient, RpcServer
+
+
+class DashboardAgent(RpcServer):
+    def __init__(self, *, node_id: str, raylet_address, gcs_address,
+                 spill_dir: str | None = None, host: str = "127.0.0.1"):
+        super().__init__(host, 0)
+        self.node_id = node_id
+        self.raylet_address = tuple(raylet_address)
+        self.gcs_address = tuple(gcs_address)
+        self.spill_dir = spill_dir
+        self._raylet = ReconnectingRpcClient(self.raylet_address)
+
+    def start(self):
+        super().start()
+        try:
+            gcs = RpcClient(self.gcs_address)
+            gcs.call("register_agent", node_id=self.node_id,
+                     address=list(self.address))
+            gcs.close()
+        except Exception:  # noqa: BLE001 - head queries fall back to raylet
+            pass
+        return self
+
+    # -- host metrics (psutil sampling lives HERE, not in the raylet) --
+
+    def rpc_host_stats(self, conn, send_lock):
+        from ray_tpu.util.profiling import host_stats
+
+        return host_stats(self.spill_dir)
+
+    def rpc_agent_info(self, conn, send_lock):
+        import os
+
+        return {"node_id": self.node_id, "pid": os.getpid(),
+                "raylet_address": list(self.raylet_address)}
+
+    # -- worker observability (direct dials to worker push ports) ------
+
+    def _targets(self, worker_id: str | None):
+        return self._raylet.call("worker_targets", worker_id=worker_id,
+                                 timeout=10) or []
+
+    def rpc_worker_stacks(self, conn, send_lock, *,
+                          worker_id: str | None = None):
+        out = {}
+        out_lock = threading.Lock()
+
+        def query(wid, addr):
+            client = None
+            try:
+                client = RpcClient(tuple(addr), timeout=5)
+                stacks = client.call("dump_stacks")
+            except Exception as e:  # noqa: BLE001 - worker busy/gone
+                stacks = {"error": repr(e)}
+            finally:
+                if client is not None:
+                    client.close()
+            with out_lock:
+                out[wid] = stacks
+
+        threads = [threading.Thread(target=query, args=tuple(t),
+                                    daemon=True)
+                   for t in self._targets(worker_id)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=8)
+        return out
+
+    def rpc_profile_worker(self, conn, send_lock, *, worker_id: str,
+                           duration_s: float = 2.0, hz: int = 100):
+        targets = self._targets(worker_id)
+        if not targets:
+            return {"not_found": True,
+                    "error": f"no live worker {worker_id!r} on this node"}
+        _, addr = targets[0]
+        client = None
+        try:
+            client = RpcClient(tuple(addr), timeout=duration_s + 30)
+            return client.call("profile", duration_s=duration_s, hz=hz)
+        except Exception as e:  # noqa: BLE001
+            return {"error": repr(e)}
+        finally:
+            if client is not None:
+                client.close()
+
+
+def main():
+    import socket
+
+    cfg = json.loads(sys.argv[1])
+    agent = DashboardAgent(
+        node_id=cfg["node_id"],
+        raylet_address=tuple(cfg["raylet_address"]),
+        gcs_address=tuple(cfg["gcs_address"]),
+        spill_dir=cfg.get("spill_dir"),
+    ).start()
+    print(json.dumps({"address": agent.address}), flush=True)
+    # lifetime = the raylet's: block on a dedicated connection and exit
+    # the moment it drops (no orphan agents after node death)
+    try:
+        watch = socket.create_connection(tuple(cfg["raylet_address"]))
+        while True:
+            if not watch.recv(1 << 12):
+                break
+    except OSError:
+        pass
+    agent.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,9 @@ from ray_tpu.data.dataset import (
     read_json,
     read_parquet,
     read_text,
+    read_tfrecords,
+    read_webdataset,
+    write_tfrecords_file,
 )
 from ray_tpu.data.execution import ExecutionOptions, StreamingExecutor
 from ray_tpu.data.grouped import GroupedData
@@ -47,4 +50,7 @@ __all__ = [
     "read_json",
     "read_parquet",
     "read_text",
+    "read_tfrecords",
+    "read_webdataset",
+    "write_tfrecords_file",
 ]

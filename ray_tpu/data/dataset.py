@@ -309,8 +309,22 @@ class Dataset:
         return ops
 
     def iter_bundles(self) -> Iterator[RefBundle]:
-        yield from StreamingExecutor(self._build_ops(),
-                                     self._options).execute()
+        executor = StreamingExecutor(self._build_ops(), self._options)
+        self._last_stats = executor.stats
+        yield from executor.execute()
+
+    def stats(self):
+        """Per-operator execution breakdown (reference: ``ds.stats()``
+        — ``data/_internal/stats.py``): wall time, bundles/bytes/rows in
+        and out, and task wall-time distribution. Uses the LAST
+        execution's stats when this dataset has been consumed; executes
+        once otherwise. The returned DatasetStats prints the summary and
+        indexes per-operator metrics by name (``stats()["Map"]``)."""
+        stats = getattr(self, "_last_stats", None)
+        if stats is None or stats.end_t is None:
+            list(self.iter_bundles())
+            stats = self._last_stats
+        return stats
 
     def iter_batches(self) -> Iterator[dict]:
         for bundle in self.iter_bundles():
@@ -368,10 +382,6 @@ class Dataset:
         bundles = list(self.iter_bundles())
         return Dataset(lambda: bundles, (), self._options)
 
-    def stats(self) -> dict:
-        ops = self._build_ops()
-        list(StreamingExecutor(ops, self._options).execute())
-        return {op.name: dict(op.metrics) for op in ops}
 
     # ------------------------------------------------------------------
     # consumption for training (reference: streaming_split:1149)
@@ -758,6 +768,88 @@ def read_binary_files(paths, *, include_paths: bool = False,
                 if include_paths:
                     row["path"] = p
                 rows.append(row)
+        return from_items(rows, num_blocks=num_blocks)._source_fn()
+    return Dataset(source)
+
+
+def read_tfrecords(paths, *, num_blocks: int = 8) -> Dataset:
+    """TFRecord files of ``tf.train.Example`` records → one dict row per
+    record (reference: ``datasource/tfrecords_datasource.py``). Parsed
+    WITHOUT tensorflow — see ``ray_tpu.data.tfrecord`` for the wire
+    codecs. pyarrow.fs URIs work like every other reader."""
+    from ray_tpu.data import tfrecord as _tfr
+
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def source():
+        rows = []
+        for p in paths:
+            with _open_path(p, "rb") as f:
+                data = f.read()
+            for record in _tfr.iter_records(data):
+                rows.append(_tfr.parse_example(record))
+        return from_items(rows, num_blocks=num_blocks)._source_fn()
+    return Dataset(source)
+
+
+def write_tfrecords_file(rows, path: str):
+    """Write dict rows to ONE TFRecord file of tf.train.Example records
+    (the reference's ``write_tfrecords`` writes a file per block; a
+    single-file helper keeps the API honest without a writer plan)."""
+    from ray_tpu.data import tfrecord as _tfr
+
+    with _open_path(path, "wb") as f:
+        for row in rows:
+            f.write(_tfr.frame_record(_tfr.build_example(row)))
+
+
+def read_webdataset(paths, *, num_blocks: int = 8) -> Dataset:
+    """WebDataset tar shards → one dict row per sample (reference:
+    ``datasource/webdataset_datasource.py``): files grouped by basename
+    before the first extension dot; row keys are the extensions plus
+    ``__key__``. ``.cls`` decodes to int, ``.txt``/``.json`` to
+    str/object; other extensions stay raw bytes."""
+    import io
+    import json as _json
+    import tarfile
+
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def _decode(ext: str, data: bytes):
+        if ext == "cls":
+            return int(data.decode("utf-8").strip())
+        if ext in ("txt", "text"):
+            return data.decode("utf-8")
+        if ext == "json":
+            return _json.loads(data.decode("utf-8"))
+        return data
+
+    def source():
+        rows = []
+        for p in paths:
+            with _open_path(p, "rb") as f:
+                blob = f.read()
+            with tarfile.open(fileobj=io.BytesIO(blob)) as tar:
+                current_key = None
+                row: dict = {}
+                for member in tar:
+                    if not member.isfile():
+                        continue
+                    # key = FULL path before the first extension dot
+                    # (webdataset convention): same basenames in
+                    # different tar directories are DIFFERENT samples
+                    key, _, ext = member.name.partition(".")
+                    if key != current_key:
+                        if row:
+                            rows.append(row)
+                        current_key = key
+                        row = {"__key__": key}
+                    row[ext] = _decode(
+                        ext, tar.extractfile(member).read())
+                if row:
+                    rows.append(row)
         return from_items(rows, num_blocks=num_blocks)._source_fn()
     return Dataset(source)
 

@@ -67,11 +67,17 @@ class PhysicalOperator:
     """Base: pull input bundles, produce output bundles."""
 
     def __init__(self, name: str):
+        from ray_tpu.data.stats import OperatorStats
+
         self.name = name
         self.input_queue: deque[RefBundle] = deque()
         self.output_queue: deque[RefBundle] = deque()
         self.inputs_done = False
         self.metrics = {"bundles_in": 0, "bundles_out": 0, "tasks": 0}
+        # per-operator execution stats (reference: DatasetStats,
+        # data/_internal/stats.py) — filled by the executor as bundles
+        # move, and by operators for task wall times
+        self.stats = OperatorStats(name)
 
     # -- scheduling interface -------------------------------------------
     def can_accept_work(self, options: ExecutionOptions) -> bool:
@@ -251,21 +257,24 @@ class MapOperator(PhysicalOperator):
                 self._pool, key=lambda e: self._pool_load.get(e[0], 0))
             self._pool_load[serial] = self._pool_load.get(serial, 0) + 1
             ref = actor.apply.remote(*bundle.refs)
-            self._active.append((ref, bundle, serial))
+            self._active.append((ref, bundle, serial, time.monotonic()))
             return
         kind, fn = self.map_kind, self.fn
         apply_remote = ray_tpu.remote(
             lambda *blocks: _apply_map(kind, fn, list(blocks))
         ).options(num_cpus=self.num_cpus)
         ref = apply_remote.remote(*bundle.refs)
-        self._active.append((ref, bundle, None))
+        self._active.append((ref, bundle, None, time.monotonic()))
 
     def poll(self):
         still = []
-        for ref, bundle, owner in self._active:
+        for ref, bundle, owner, submit_t in self._active:
             ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
             if ready:
                 block, rows, nbytes = ray_tpu.get(ref)
+                if len(self.stats.task_wall_s) < 10_000:
+                    self.stats.task_wall_s.append(
+                        time.monotonic() - submit_t)
                 if owner is not None and owner in self._pool_load:
                     self._pool_load[owner] -= 1
                 for out_block, out_rows, out_bytes in _maybe_split(
@@ -275,7 +284,7 @@ class MapOperator(PhysicalOperator):
                         size_bytes=out_bytes))
                 self.metrics["bundles_out"] += 1
             else:
-                still.append((ref, bundle, owner))
+                still.append((ref, bundle, owner, submit_t))
         self._active = still
         if self.compute == "actors" and self._pool:
             self._scale_down()
@@ -376,9 +385,32 @@ class StreamingExecutor:
 
     def __init__(self, operators: list[PhysicalOperator],
                  options: ExecutionOptions | None = None):
+        from ray_tpu.data.stats import DatasetStats
+
         self.operators = operators
         self.options = options or ExecutionOptions()
         self._byte_budget = _resolve_byte_budget(self.options)
+        self.stats = DatasetStats()
+        self.stats.operators = [op.stats for op in operators]
+
+    @staticmethod
+    def _note_moved(up: PhysicalOperator, down: PhysicalOperator | None,
+                    bundle: RefBundle):
+        now = time.monotonic()
+        s = up.stats
+        if s.first_activity is None:
+            s.first_activity = now
+        s.last_activity = now
+        s.bundles_out += 1
+        s.bytes_out += bundle.size_bytes
+        s.rows_out += bundle.num_rows
+        if down is not None:
+            d = down.stats
+            if d.first_activity is None:
+                d.first_activity = now
+            d.last_activity = now
+            d.bundles_in += 1
+            d.bytes_in += bundle.size_bytes
 
     def execute(self) -> Iterator[RefBundle]:
         ops = self.operators
@@ -389,7 +421,9 @@ class StreamingExecutor:
                 for i in range(len(ops) - 1):
                     up, down = ops[i], ops[i + 1]
                     while up.output_queue:
-                        down.input_queue.append(up.output_queue.popleft())
+                        bundle = up.output_queue.popleft()
+                        self._note_moved(up, down, bundle)
+                        down.input_queue.append(bundle)
                         progressed = True
                     if up.is_done() and not down.inputs_done:
                         down.inputs_done = True
@@ -398,7 +432,9 @@ class StreamingExecutor:
                 tail = ops[-1]
                 while tail.output_queue:
                     progressed = True
-                    yield tail.output_queue.popleft()
+                    bundle = tail.output_queue.popleft()
+                    self._note_moved(tail, None, bundle)
+                    yield bundle
                 if tail.is_done():
                     return
                 # pick operators to run: furthest-downstream first
@@ -421,5 +457,7 @@ class StreamingExecutor:
                 if not progressed:
                     time.sleep(0.002)
         finally:
+            self.stats.end_t = time.monotonic()
             for op in ops:
+                op.stats.tasks = op.metrics.get("tasks", 0)
                 op.shutdown()

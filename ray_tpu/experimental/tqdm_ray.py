@@ -44,8 +44,12 @@ def _aggregator():
     except ValueError:
         cls = ray_tpu.remote(_Aggregator)
         try:
-            return cls.options(name=_AGGREGATOR_NAME,
-                               max_concurrency=8).remote()
+            # SERIAL actor: per-caller submission order then becomes
+            # execution order, so a snapshot() submitted after a burst
+            # of fire-and-forget update()s observes all of them — with
+            # a concurrency pool a snapshot can overtake in-flight
+            # updates under CPU load (observed as a count-short flake).
+            return cls.options(name=_AGGREGATOR_NAME).remote()
         except ValueError:
             return ray_tpu.get_actor(_AGGREGATOR_NAME)
 

@@ -1,0 +1,190 @@
+"""Autoscaler v2-style instance manager: versioned instance storage plus
+a reconciler that converges instance records against the provider's and
+the GCS's views.
+
+Reference analog: ``autoscaler/v2/instance_manager/instance_storage.py``
+(versioned records, compare-and-swap upserts) and the v2 reconciler
+(``instance_manager.py``) driving the instance lifecycle::
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
+                   \\__________________/      |
+                    (provider lost it)        v
+                       TERMINATED  <-  TERMINATING
+
+The autoscaler's decisions (launch/terminate) become instance records;
+the reconciler — not the decision code — owns state transitions, so a
+crash or a slow cloud never leaves bookkeeping about what exists to the
+scaling policy's imagination.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+
+# lifecycle states (reference: instance_manager.proto Instance.Status)
+QUEUED = "QUEUED"                  # decided, not yet sent to the provider
+REQUESTED = "REQUESTED"            # provider call made; VM not visible yet
+ALLOCATED = "ALLOCATED"            # provider lists it; raylet not yet up
+RAY_RUNNING = "RAY_RUNNING"        # GCS sees the node alive
+TERMINATING = "TERMINATING"        # terminate sent to the provider
+TERMINATED = "TERMINATED"          # gone from the provider view
+
+LIVE_STATES = (QUEUED, REQUESTED, ALLOCATED, RAY_RUNNING, TERMINATING)
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    status: str = QUEUED
+    node_id: str | None = None     # cloud/provider node id once known
+    resources: dict = field(default_factory=dict)
+    requested_at: float | None = None
+    running_at: float | None = None
+    terminated_at: float | None = None
+    version: int = 0
+    status_history: list = field(default_factory=list)
+
+
+class VersionConflict(Exception):
+    pass
+
+
+class InstanceStorage:
+    """Versioned store (reference: ``instance_storage.py:31``): every
+    upsert names the version it read; a mismatch is a conflict the
+    caller retries against fresh state. Single-process here, but the
+    contract keeps reconciler and decision code from clobbering each
+    other's transitions."""
+
+    def __init__(self):
+        self._instances: dict[str, Instance] = {}
+        self._ids = itertools.count(1)
+
+    def create(self, resources: dict) -> Instance:
+        inst = Instance(instance_id=f"i-{next(self._ids):05d}",
+                        resources=dict(resources))
+        inst.status_history.append((QUEUED, time.monotonic()))
+        self._instances[inst.instance_id] = inst
+        return inst
+
+    def get(self, instance_id: str) -> Instance | None:
+        return self._instances.get(instance_id)
+
+    def delete(self, instance_id: str):
+        self._instances.pop(instance_id, None)
+
+    def list(self, statuses: tuple | None = None) -> list[Instance]:
+        out = list(self._instances.values())
+        if statuses is not None:
+            out = [i for i in out if i.status in statuses]
+        return out
+
+    def update_status(self, instance_id: str, status: str,
+                      expected_version: int, **fields) -> Instance:
+        inst = self._instances[instance_id]
+        if inst.version != expected_version:
+            raise VersionConflict(
+                f"{instance_id}: version {inst.version} != expected "
+                f"{expected_version}")
+        inst.status = status
+        inst.version += 1
+        inst.status_history.append((status, time.monotonic()))
+        for k, v in fields.items():
+            setattr(inst, k, v)
+        return inst
+
+
+class InstanceManager:
+    """Decision intake + reconciliation over an InstanceStorage."""
+
+    KEEP_TERMINATED = 128   # recent dead records kept for observability
+
+    def __init__(self, provider):
+        self.provider = provider
+        self.storage = InstanceStorage()
+
+    # -- decisions (the scaling policy calls these) --------------------
+
+    def launch(self, resources: dict) -> Instance:
+        return self.storage.create(resources)
+
+    def terminate(self, node_id: str):
+        for inst in self.storage.list(LIVE_STATES):
+            if inst.node_id == node_id:
+                self.storage.update_status(inst.instance_id, TERMINATING,
+                                           inst.version)
+                break
+        self.provider.terminate_node(node_id)
+
+    # -- views ----------------------------------------------------------
+
+    def live_count(self) -> int:
+        return len(self.storage.list(LIVE_STATES))
+
+    def provisioning(self) -> list[Instance]:
+        return self.storage.list((QUEUED, REQUESTED, ALLOCATED))
+
+    # -- reconciliation --------------------------------------------------
+
+    def reconcile(self, gcs_alive: set[str] | None = None):
+        """One pass: push QUEUED launches to the provider, then converge
+        records against the provider listing (ALLOCATED/TERMINATED) and
+        the GCS alive set (RAY_RUNNING)."""
+        gcs_alive = gcs_alive or set()
+        for inst in self.storage.list((QUEUED,)):
+            try:
+                node_id = self.provider.create_node(dict(inst.resources))
+            except Exception:  # noqa: BLE001 - cloud hiccup: retry next tick
+                continue
+            self.storage.update_status(
+                inst.instance_id, REQUESTED, inst.version,
+                node_id=node_id or None,
+                requested_at=time.monotonic())
+        provider_nodes = set(self.provider.non_terminated_nodes())
+        unclaimed = provider_nodes - {
+            i.node_id for i in self.storage.list(LIVE_STATES)
+            if i.node_id}
+        for inst in self.storage.list((REQUESTED,)):
+            if inst.node_id and inst.node_id in provider_nodes:
+                self.storage.update_status(inst.instance_id, ALLOCATED,
+                                           inst.version)
+            elif not inst.node_id and unclaimed:
+                # async providers (GKE) return no id at request time: the
+                # next new provider node claims the oldest such request
+                node_id = sorted(unclaimed)[0]
+                unclaimed.discard(node_id)
+                self.storage.update_status(inst.instance_id, ALLOCATED,
+                                           inst.version, node_id=node_id)
+        for inst in self.storage.list((ALLOCATED, RAY_RUNNING)):
+            if inst.node_id not in provider_nodes:
+                self.storage.update_status(
+                    inst.instance_id, TERMINATED, inst.version,
+                    terminated_at=time.monotonic())
+            elif inst.status == ALLOCATED and inst.node_id in gcs_alive:
+                self.storage.update_status(
+                    inst.instance_id, RAY_RUNNING, inst.version,
+                    running_at=time.monotonic())
+        for inst in self.storage.list((TERMINATING,)):
+            if inst.node_id not in provider_nodes:
+                self.storage.update_status(
+                    inst.instance_id, TERMINATED, inst.version,
+                    terminated_at=time.monotonic())
+        # prune old TERMINATED records: a long-running autoscaler churns
+        # nodes for weeks, and keeping every dead record makes each
+        # reconcile O(total-ever-launched) and memory unbounded — keep a
+        # recent tail for observability
+        dead = self.storage.list((TERMINATED,))
+        if len(dead) > self.KEEP_TERMINATED:
+            dead.sort(key=lambda i: i.terminated_at or 0.0)
+            for inst in dead[:-self.KEEP_TERMINATED]:
+                self.storage.delete(inst.instance_id)
+        # ADOPT provider nodes nobody requested (pre-existing pool VMs,
+        # out-of-band scale-ups): unrecorded capacity would make
+        # live_count() undercount and the policy over-provision past its
+        # cap
+        for node_id in sorted(unclaimed):
+            inst = self.storage.create({})
+            self.storage.update_status(inst.instance_id, ALLOCATED,
+                                       inst.version, node_id=node_id)

@@ -6,6 +6,16 @@ _rlu("rllib")
 
 
 from ray_tpu.rllib.appo import APPO, APPOConfig
+from ray_tpu.rllib.connectors import (
+    ClipActions,
+    Connector,
+    ConnectorEnv,
+    ConnectorPipeline,
+    FlattenObs,
+    FrameStack,
+    NormalizeObs,
+    UnsquashActions,
+)
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env import (
     BanditEnv,
@@ -16,6 +26,11 @@ from ray_tpu.rllib.env import (
 )
 from ray_tpu.rllib.gym_env import GymEnvAdapter
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.estimators import (
+    ImportanceSampling,
+    WeightedImportanceSampling,
+    episodes_from_dataset,
+)
 from ray_tpu.rllib.offline import (
     BC,
     BCConfig,

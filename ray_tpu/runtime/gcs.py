@@ -43,6 +43,9 @@ class NodeInfo:
     last_heartbeat: float = field(default_factory=time.monotonic)
     # latest reporter sample from the node (cpu/mem/spill-disk)
     host_stats: dict = field(default_factory=dict)
+    # per-node dashboard agent RPC address (reference: dashboard/agent.py
+    # — observability decoupled from the raylet data plane)
+    agent_addr: tuple | None = None
 
 
 @dataclass
@@ -424,6 +427,14 @@ class GcsServer(RpcServer):
                                "address": tuple(address)})
         return {"ok": True}
 
+    def rpc_register_agent(self, conn, send_lock, *, node_id, address):
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return {"ok": False}
+            node.agent_addr = tuple(address)
+        return {"ok": True}
+
     def rpc_heartbeat(self, conn, send_lock, *, node_id, available,
                       load=None, host_stats=None):
         with self._lock:
@@ -442,7 +453,8 @@ class GcsServer(RpcServer):
                 {"node_id": n.node_id, "address": n.address,
                  "store_name": n.store_name, "resources": n.resources,
                  "available": n.available, "alive": n.alive,
-                 "labels": n.labels, "host_stats": n.host_stats}
+                 "labels": n.labels, "host_stats": n.host_stats,
+                 "agent_addr": n.agent_addr}
                 for n in self._nodes.values()
                 if n.alive or not alive_only
             ]

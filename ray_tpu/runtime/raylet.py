@@ -132,7 +132,43 @@ class Raylet(RpcServer):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
+        self._spawn_dashboard_agent()
         return self
+
+    def _spawn_dashboard_agent(self):
+        """Per-node observability agent as its OWN process (reference:
+        dashboard/agent.py) — host sampling and profiling queries must
+        not share the raylet's threads. Exits on its own when this
+        raylet's RPC server goes away."""
+        import json as _json
+        import subprocess
+
+        from ray_tpu.utils.config import get_config
+
+        self._agent_proc = None
+        if not get_config().dashboard_agent_enabled:
+            return
+        cfg = {"node_id": self.node_id,
+               "raylet_address": list(self.address),
+               "gcs_address": list(self.gcs_address),
+               "spill_dir": (self.objects.spill_dir
+                             if self.objects.spill_is_local else None)}
+        # same PYTHONPATH stripping the worker spawn does: a
+        # sitecustomize hook (TPU tunnel plugin) imports jax at EVERY
+        # interpreter start — ~2 s of CPU the agent burns mid-workload
+        # on small hosts, for a process that never touches a device
+        from ray_tpu.runtime.worker_pool import _worker_pythonpath
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
+        env["JAX_PLATFORMS"] = "cpu"
+        try:
+            self._agent_proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.dashboard_agent",
+                 _json.dumps(cfg)], env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        except Exception:  # noqa: BLE001 - observability only
+            self._agent_proc = None
 
     def stop(self):
         super().stop()
@@ -144,6 +180,9 @@ class Raylet(RpcServer):
         for t in self._threads:
             t.join(timeout=2.0)
         self.workers.stop()
+        agent = getattr(self, "_agent_proc", None)
+        if agent is not None and agent.poll() is None:
+            agent.terminate()
         self.store.close()
         self.objects.cleanup_disk()
 
@@ -718,6 +757,15 @@ class Raylet(RpcServer):
     # per-node observability (reference: the dashboard reporter agent —
     # psutil stats + py-spy stack dumps/profiles proxied per worker)
     # ------------------------------------------------------------------
+
+    def rpc_worker_targets(self, conn, send_lock, *,
+                           worker_id: str | None = None):
+        """Live workers' (id, push_addr) pairs — the dashboard agent's
+        one raylet dependency (it dials workers directly for stacks/
+        profiles; reference: the reporter agent gets the worker list
+        from its raylet)."""
+        return [[wid, list(addr)]
+                for wid, addr in self.workers.push_targets(worker_id)]
 
     def rpc_worker_stacks(self, conn, send_lock, *,
                           worker_id: str | None = None):

@@ -95,10 +95,35 @@ class WorkerPool:
             # workers never touch the TPU tunnel unless told to
             "JAX_PLATFORMS": env_get_default("JAX_PLATFORMS", "cpu"),
         })
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.runtime.worker_main"],
-            env=env, cwd=os.getcwd(),
-        )
+        cmd = [sys.executable, "-m", "ray_tpu.runtime.worker_main"]
+        container = (runtime_env or {}).get("container")
+        if container:
+            # CONTAINER worker (reference: runtime_env/container.py —
+            # the worker process itself runs in the image). Host
+            # networking + host IPC keep the raylet channel and the
+            # /dev/shm object store working unchanged.
+            from ray_tpu.runtime_env import (container_command,
+                                             find_container_runtime)
+
+            runtime = find_container_runtime()
+            if runtime is None:
+                # fail every queued task for this env fast instead of a
+                # spawn/crash loop (same path a worker-side env setup
+                # failure takes); the spawned stand-in exits immediately
+                # and the monitor reaps it like any dead worker
+                from ray_tpu.runtime_env import env_key as _ek
+
+                node.rpc_runtime_env_failed(
+                    None, None, key=_ek(runtime_env),
+                    error="runtime_env.container requested but no "
+                          "docker/podman on PATH")
+                cmd = [sys.executable, "-c", "raise SystemExit(1)"]
+            else:
+                cmd = container_command(
+                    container,
+                    ["python", "-m", "ray_tpu.runtime.worker_main"],
+                    env, runtime=runtime)
+        proc = subprocess.Popen(cmd, env=env, cwd=os.getcwd())
         handle = WorkerHandle(worker_id=worker_id, proc=proc,
                               env_key=_env_key(runtime_env))
         with self.lock:

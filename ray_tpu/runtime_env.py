@@ -16,9 +16,15 @@ per-node agent). Supported fields:
   workers activate it by prepending its site-packages to ``sys.path``.
 - ``config``: opaque dict passed through (reference parity; e.g.
   ``{"setup_timeout_seconds": ...}``).
-
-``conda``/``container`` are rejected loudly (no conda/docker in the
-image) rather than silently ignored.
+- ``conda``: an existing conda env NAME, or a spec dict
+  (``{"dependencies": [...]}`` — environment.yml content). Cached envs
+  are created with the host's conda; workers prepend the env's
+  site-packages (reference: ``_private/runtime_env/conda.py``). Hosts
+  without conda fail the env FAST (RuntimeEnvSetupError), not silently.
+- ``container``: ``{"image": ..., "run_options": [...]}`` — the worker
+  process runs INSIDE the container via docker/podman with host
+  networking and host IPC (so the shm object store and raylet ports
+  keep working — reference: ``_private/runtime_env/container.py``).
 
 Workers are cached per runtime-env key exactly like the reference's
 (language, runtime_env)-keyed worker pool (``worker_pool.cc``): tasks
@@ -33,9 +39,6 @@ import os
 import shutil
 from typing import Any
 
-_UNSUPPORTED = ("conda", "container")
-
-
 class RuntimeEnv(dict):
     """Dict-like (wire-serializable as plain JSON)."""
 
@@ -43,13 +46,9 @@ class RuntimeEnv(dict):
                  working_dir: str | None = None,
                  py_modules: list | None = None,
                  pip: list | dict | None = None,
+                 conda: str | dict | None = None,
+                 container: dict | None = None,
                  config: dict | None = None, **kwargs):
-        for k in _UNSUPPORTED:
-            if k in kwargs:
-                raise ValueError(
-                    f"runtime_env field {k!r} is not supported in this "
-                    "environment (use 'pip' for per-env packages, or "
-                    "pre-bake dependencies into the image)")
         if kwargs:
             raise ValueError(f"unknown runtime_env fields: {list(kwargs)}")
         body: dict[str, Any] = {}
@@ -85,6 +84,28 @@ class RuntimeEnv(dict):
                 return r
 
             body["pip"] = [_localize(r) for r in reqs]
+        if conda:
+            if isinstance(conda, str):
+                body["conda"] = conda
+            elif isinstance(conda, dict):
+                if not conda.get("dependencies"):
+                    raise ValueError(
+                        "conda spec dict needs a non-empty 'dependencies' "
+                        "list (environment.yml content)")
+                body["conda"] = dict(conda)
+            else:
+                raise TypeError(
+                    "conda must be an env name or a spec dict")
+        if container:
+            if not isinstance(container, dict) or "image" not in container:
+                raise TypeError(
+                    "container must be a dict with at least 'image'")
+            opts = container.get("run_options", [])
+            if not (isinstance(opts, list)
+                    and all(isinstance(o, str) for o in opts)):
+                raise TypeError("container.run_options must be [str]")
+            body["container"] = {"image": container["image"],
+                                 "run_options": list(opts)}
         if config:
             body["config"] = dict(config)
         super().__init__(body)
@@ -223,6 +244,120 @@ def ensure_pip_env(reqs: list[str]) -> str:
     return _venv_site_packages(dest)
 
 
+# ---------------------------------------------------------------------------
+# conda plugin (reference: _private/runtime_env/conda.py — cached env per
+# spec; the env's site-packages layers onto the worker's sys.path)
+# ---------------------------------------------------------------------------
+
+def _find_conda() -> str | None:
+    exe = os.environ.get("CONDA_EXE")
+    if exe and os.path.exists(exe):
+        return exe
+    return shutil.which("conda") or shutil.which("mamba") \
+        or shutil.which("micromamba")
+
+
+def conda_create_commands(spec: dict, dest: str, conda_exe: str) -> list:
+    """Command lines that materialize a conda env for ``spec`` at
+    ``dest`` (pure — unit-testable without conda installed)."""
+    deps = spec.get("dependencies", [])
+    return [[conda_exe, "create", "--yes", "--quiet", "--prefix", dest,
+             *[d for d in deps if isinstance(d, str)]]]
+
+
+def ensure_conda_env(conda_field, *, runner=None) -> str:
+    """Resolve a conda field to a site-packages path. A NAME resolves
+    against `conda env list`; a SPEC dict creates a cached env keyed by
+    content. Fails fast (RuntimeError) when no conda binary exists —
+    the raylet's bad-env registry turns that into RuntimeEnvSetupError
+    for every queued task instead of a spawn/crash loop."""
+    import fcntl
+    import glob as _glob
+    import subprocess
+
+    runner = runner or (lambda cmd: subprocess.run(
+        cmd, check=True, capture_output=True, text=True, timeout=1800))
+    conda_exe = _find_conda()
+    if conda_exe is None:
+        raise RuntimeError(
+            "runtime_env.conda requested but no conda/mamba binary is "
+            "on PATH (and CONDA_EXE is unset)")
+    if isinstance(conda_field, str):
+        root = os.path.dirname(os.path.dirname(conda_exe))
+        if conda_field == "base":
+            # base lives at the ROOT prefix, not under envs/
+            base = root
+        elif os.sep in conda_field:
+            # `conda create -p /path/env` style: the name IS the prefix
+            base = os.path.expanduser(conda_field)
+        else:
+            base = os.path.join(root, "envs", conda_field)
+        hits = _glob.glob(os.path.join(base, "lib", "python*",
+                                       "site-packages"))
+        if not hits:
+            raise RuntimeError(
+                f"conda env {conda_field!r} not found under {base}")
+        return hits[0]
+    digest = hashlib.sha256(
+        json.dumps(conda_field, sort_keys=True).encode()).hexdigest()[:16]
+    dest = os.path.join(_cache_root(), "conda", digest)
+    ready = os.path.join(dest, ".ray_tpu_ready")
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    if not os.path.exists(ready):
+        with open(dest + ".lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if not os.path.exists(ready):
+                try:
+                    for cmd in conda_create_commands(conda_field, dest,
+                                                     conda_exe):
+                        runner(cmd)
+                except subprocess.CalledProcessError as e:
+                    shutil.rmtree(dest, ignore_errors=True)
+                    raise RuntimeError(
+                        f"conda env create failed: "
+                        f"{e.stderr[-2000:] if e.stderr else e}") from None
+                open(ready, "w").close()
+    hits = _glob.glob(os.path.join(dest, "lib", "python*",
+                                   "site-packages"))
+    if not hits:
+        raise RuntimeError(f"no site-packages under conda env {dest}")
+    return hits[0]
+
+
+# ---------------------------------------------------------------------------
+# container plugin (reference: _private/runtime_env/container.py — the
+# worker process runs inside the image)
+# ---------------------------------------------------------------------------
+
+def find_container_runtime() -> str | None:
+    return shutil.which("docker") or shutil.which("podman")
+
+
+def container_command(container: dict, base_cmd: list,
+                      env: dict, *, runtime: str,
+                      mounts: list | None = None) -> list:
+    """Wrap a worker command line to run inside ``container['image']``
+    (pure — unit-testable without docker installed). Host networking
+    keeps the raylet/GCS ports reachable; host IPC keeps the /dev/shm
+    object store attachable; the package root mounts read-only so the
+    image needs python but not ray_tpu."""
+    import ray_tpu as _pkg
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        _pkg.__file__)))
+    cmd = [runtime, "run", "--rm", "--network=host", "--ipc=host",
+           f"-v={pkg_root}:{pkg_root}:ro"]
+    for m in mounts or []:
+        cmd.append(f"-v={m}:{m}")
+    for k, v in env.items():
+        if k.startswith(("RAY_TPU_", "JAX_", "PYTHON")):
+            cmd.append(f"-e={k}={v}")
+    cmd.append(f"-e=PYTHONPATH={pkg_root}")
+    cmd += container.get("run_options", [])
+    cmd.append(container["image"])
+    return cmd + base_cmd
+
+
 def apply_runtime_env(runtime_env: dict | None) -> None:
     """Apply an env in-place to THIS process (worker boot path —
     reference: runtime-env agent's GetOrCreateRuntimeEnv result applied
@@ -241,6 +376,13 @@ def apply_runtime_env(runtime_env: dict | None) -> None:
         if site not in sys.path:
             # FRONT of sys.path: the env's packages shadow same-named
             # system packages, venv-activation style
+            sys.path.insert(0, site)
+    conda_field = runtime_env.get("conda")
+    if conda_field:
+        import sys
+
+        site = ensure_conda_env(conda_field)
+        if site not in sys.path:
             sys.path.insert(0, site)
     wd = runtime_env.get("working_dir")
     if wd:

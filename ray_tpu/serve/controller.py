@@ -142,14 +142,24 @@ class _Replica:
         generator error is delivered AFTER its preceding chunks: chunks
         already accumulated return normally and the error re-raises on
         the next call. ASYNC wrapper: the blocking queue wait runs in
-        the executor — a slow stream poll must not freeze the replica's
-        event loop (and with it every overlapped request + metrics)."""
+        the executor in SHORT slices — a long poll parking an executor
+        thread for its full timeout would let a handful of idle streams
+        starve the shared pool that sync handlers also use."""
         import asyncio
         import functools as _ft
+        import time as _time
 
-        return await asyncio.get_running_loop().run_in_executor(
-            None, _ft.partial(self._next_chunks_sync, stream_id,
-                              max_chunks, timeout_s))
+        loop = asyncio.get_running_loop()
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            slice_s = min(0.25, max(deadline - _time.monotonic(), 0.0))
+            result = await loop.run_in_executor(
+                None, _ft.partial(self._next_chunks_sync, stream_id,
+                                  max_chunks, slice_s))
+            if result[0] != "pending" or result[1]:
+                return result
+            if _time.monotonic() >= deadline:
+                return result
 
     def _next_chunks_sync(self, stream_id: str, max_chunks: int,
                           timeout_s: float):

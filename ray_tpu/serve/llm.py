@@ -227,6 +227,42 @@ class LLMEngine:
         first = jnp.where(temps > 0.0, sampled, greedy)
         return KVCache(k=k, v=v, lengths=cache.lengths), first
 
+    def warmup(self, prompt_len: int):
+        """Deterministically compile every program a burst at this
+        prompt bucket can hit: the batched prefill at each power-of-two
+        group size up to max_batch, and both decode programs. Call
+        BEFORE start() (request-driven warmup races the admit loop, so
+        which (n, bucket) prefill variants compile is scheduling-
+        dependent — a missed one lands seconds of JIT inside a measured
+        or user-facing TTFT)."""
+        bucket = min(_bucket(prompt_len), self.max_len)
+        tokens = jnp.zeros((1, bucket), jnp.int32)
+        n = 1
+        while n <= self.max_batch:
+            toks = jnp.broadcast_to(tokens, (n, bucket))
+            self._cache, firsts = self._prefill_batch_fn(
+                self.params, self._cache, toks,
+                jnp.ones((n,), jnp.int32),
+                jnp.arange(n, dtype=jnp.int32),
+                jnp.zeros((n,), jnp.float32), self._next_key())
+            np.asarray(firsts)
+            n *= 2
+        active = jnp.zeros((self.max_batch,), bool)
+        for fn in {id(self._decode_fn): self._decode_fn,
+                   id(self._decode_fn_drain):
+                       self._decode_fn_drain}.values():
+            self._cache, toks = fn(
+                self.params, self._cache,
+                jnp.zeros((self.max_batch,), jnp.int32),
+                jnp.zeros((self.max_batch,), jnp.int32), active,
+                jnp.zeros((self.max_batch,), jnp.float32),
+                self._next_key())
+            np.asarray(toks)
+        # warmup wrote garbage prefills into cache rows; lengths stayed
+        # 0 and no slot is active, so real admissions overwrite cleanly
+        self._lengths[:] = 0
+        self._last_tok[:] = 0
+
     # -- engine loop -------------------------------------------------------
 
     def start(self):

@@ -135,15 +135,25 @@ def dump_worker_stacks(node_id: str | None = None,
     out_lock = threading.Lock()
 
     def query(node):
-        client = None
-        try:
-            client = RpcClient(tuple(node["address"]), timeout=15)
-            stacks = client.call("worker_stacks", worker_id=worker_id)
-        except Exception as e:  # noqa: BLE001
-            stacks = {"error": repr(e)}
-        finally:
-            if client is not None:
-                client.close()
+        # the per-node AGENT serves observability when present; a DEAD
+        # agent (stale agent_addr) falls back to the raylet path, which
+        # still serves the same RPC
+        candidates = [tuple(node["address"])]
+        if node.get("agent_addr"):
+            candidates.insert(0, tuple(node["agent_addr"]))
+        stacks = None
+        for addr in candidates:
+            client = None
+            try:
+                client = RpcClient(addr, timeout=15)
+                stacks = client.call("worker_stacks",
+                                     worker_id=worker_id)
+                break
+            except Exception as e:  # noqa: BLE001 - next candidate
+                stacks = {"error": repr(e)}
+            finally:
+                if client is not None:
+                    client.close()
         with out_lock:
             out[node["node_id"]] = stacks
 
@@ -172,19 +182,27 @@ def profile_worker(worker_id: str, *, node_id: str | None = None,
     for node in rt._gcs.call("get_nodes", alive_only=True):
         if node_id is not None and node["node_id"] != node_id:
             continue
-        client = None
-        try:
-            client = RpcClient(tuple(node["address"]),
-                               timeout=duration_s + 30)
-            result = client.call("profile_worker", worker_id=worker_id,
-                                 duration_s=duration_s, hz=hz)
-        except Exception as e:  # noqa: BLE001 - node may not own it;
-            # remember the failure so it is not misreported as not-found
-            transport_errors[node["node_id"]] = repr(e)
+        candidates = [tuple(node["address"])]
+        if node.get("agent_addr"):
+            # prefer the agent; a dead one falls back to the raylet
+            candidates.insert(0, tuple(node["agent_addr"]))
+        result = None
+        for addr in candidates:
+            client = None
+            try:
+                client = RpcClient(addr, timeout=duration_s + 30)
+                result = client.call("profile_worker",
+                                     worker_id=worker_id,
+                                     duration_s=duration_s, hz=hz)
+                break
+            except Exception as e:  # noqa: BLE001 - next candidate
+                transport_errors[node["node_id"]] = repr(e)
+            finally:
+                if client is not None:
+                    client.close()
+        if result is None:
             continue
-        finally:
-            if client is not None:
-                client.close()
+        transport_errors.pop(node["node_id"], None)
         if result.get("not_found"):
             continue   # the worker lives on another node; keep looking
         # genuine outcome from the owning node — success OR its real

@@ -53,6 +53,9 @@ class Config:
     object_store_eviction_fraction: float = 0.8
     # Enable automatic spilling to disk under memory pressure.
     object_spilling_enabled: bool = True
+    # Per-node dashboard agent process (reference: dashboard/agent.py);
+    # observability queries bypass the raylet data plane through it.
+    dashboard_agent_enabled: bool = True
     # Spill loop thresholds: start spilling above `high`, stop below `low`
     # (fractions of store capacity; reference:
     # RAY_object_spilling_threshold + LocalObjectManager).

@@ -348,6 +348,15 @@ void* store_create(const char* name, uint64_t capacity, uint64_t table_cap) {
     shm_unlink(name);
     return nullptr;
   }
+  // Write-touch every page NOW: tmpfs allocates pages on the first
+  // WRITE fault, so without this a cold large put runs fault-bound
+  // (~1.9 GB/s measured for a fresh 64 MiB object vs ~6.7 GB/s on
+  // materialized pages). One memset per store boot (~0.2 s/GiB) buys
+  // warm-page bandwidth for every subsequent create/put; attaching
+  // processes only take cheap minor faults on the existing pages.
+  // (MAP_POPULATE is not enough: it read-faults tmpfs holes without
+  // allocating backing pages for writes.)
+  memset(base, 0, total);
   Header* h = (Header*)base;
   memset(h, 0, sizeof(Header));
   h->segment_size = total;
@@ -393,8 +402,17 @@ void* store_attach(const char* name) {
     close(fd);
     return nullptr;
   }
+  // MAP_POPULATE on attach: the creator materialized every page (see
+  // create's memset); prefaulting this process's PTEs up front turns
+  // per-page minor faults on first access — the residual large-put
+  // cost for attached writers — into one bulk populate at attach time.
+  int attach_flags = MAP_SHARED;
+#ifdef MAP_POPULATE
+  attach_flags |= MAP_POPULATE;
+#endif
   uint8_t* base = (uint8_t*)mmap(nullptr, (size_t)st.st_size,
-                                 PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+                                 PROT_READ | PROT_WRITE, attach_flags,
+                                 fd, 0);
   if (base == MAP_FAILED) {
     close(fd);
     return nullptr;

@@ -21,6 +21,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # 95% kill threshold would otherwise see random worker kills. The OOM
 # tests opt back in explicitly.
 os.environ.setdefault("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0")
+# Per-node dashboard agents default OFF in tests (a process per node in
+# every throwaway cluster); test_dashboard_agent opts back in.
+os.environ.setdefault("RAY_TPU_DASHBOARD_AGENT_ENABLED", "0")
 # Append (not guard): XLA's flag parsing is last-occurrence-wins, so this
 # forces 8 virtual devices even if the env already set a different count.
 os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,189 @@
+"""Autoscaler v2-style instance manager + provider unit tests.
+
+Reference analogs: ``autoscaler/v2/tests`` (instance storage versioning,
+reconciler lifecycle) and ``FakeMultiNodeProvider``-style provider tests
+— including the GKE provider's COMMAND CONSTRUCTION via an injected
+runner (the cloud CLI layer itself needs no credentials to be tested).
+"""
+
+import pytest
+
+from ray_tpu.autoscaler import GKETPUNodeProvider
+from ray_tpu.instance_manager import (
+    ALLOCATED,
+    QUEUED,
+    RAY_RUNNING,
+    REQUESTED,
+    TERMINATED,
+    InstanceManager,
+    InstanceStorage,
+    VersionConflict,
+)
+
+
+class FakeProvider:
+    """In-memory cloud: create is async-visible (like GKE — no id at
+    request time until ``provision()`` is called)."""
+
+    def __init__(self, sync: bool = True):
+        self.sync = sync
+        self.nodes: list[str] = []
+        self.pending = 0
+        self._n = 0
+        self.terminated: list[str] = []
+
+    def create_node(self, resources):
+        if self.sync:
+            self._n += 1
+            nid = f"node-{self._n}"
+            self.nodes.append(nid)
+            return nid
+        self.pending += 1
+        return ""
+
+    def provision(self):
+        while self.pending:
+            self.pending -= 1
+            self._n += 1
+            self.nodes.append(f"node-{self._n}")
+
+    def terminate_node(self, node_id):
+        self.terminated.append(node_id)
+        if node_id in self.nodes:
+            self.nodes.remove(node_id)
+
+    def non_terminated_nodes(self):
+        return list(self.nodes)
+
+
+def test_instance_storage_versioning():
+    st = InstanceStorage()
+    inst = st.create({"CPU": 2})
+    assert inst.status == QUEUED and inst.version == 0
+    st.update_status(inst.instance_id, REQUESTED, 0)
+    with pytest.raises(VersionConflict):
+        st.update_status(inst.instance_id, ALLOCATED, 0)  # stale version
+    st.update_status(inst.instance_id, ALLOCATED, 1, node_id="n1")
+    assert st.get(inst.instance_id).node_id == "n1"
+    assert [s for s, _ in st.get(inst.instance_id).status_history] == [
+        QUEUED, REQUESTED, ALLOCATED]
+
+
+def test_reconciler_sync_provider_lifecycle():
+    prov = FakeProvider(sync=True)
+    im = InstanceManager(prov)
+    inst = im.launch({"CPU": 4})
+    assert im.provisioning() and im.live_count() == 1
+    im.reconcile()                       # QUEUED -> REQUESTED -> (listed)
+    im.reconcile()                       # REQUESTED -> ALLOCATED
+    got = im.storage.get(inst.instance_id)
+    assert got.status == ALLOCATED and got.node_id == "node-1"
+    im.reconcile(gcs_alive={"node-1"})   # raylet registered
+    assert im.storage.get(inst.instance_id).status == RAY_RUNNING
+    assert not im.provisioning()
+    im.terminate("node-1")
+    im.reconcile()
+    assert im.storage.get(inst.instance_id).status == TERMINATED
+    assert im.live_count() == 0
+
+
+def test_reconciler_async_provider_claims_new_node():
+    prov = FakeProvider(sync=False)
+    im = InstanceManager(prov)
+    inst = im.launch({"TPU": 4})
+    im.reconcile()                       # request sent; no node id yet
+    assert im.storage.get(inst.instance_id).status == REQUESTED
+    assert im.storage.get(inst.instance_id).node_id is None
+    prov.provision()                     # cloud finishes minutes later
+    im.reconcile()
+    got = im.storage.get(inst.instance_id)
+    assert got.status == ALLOCATED and got.node_id == "node-1"
+
+
+def test_reconciler_detects_lost_node_and_adopts_foreign():
+    prov = FakeProvider(sync=True)
+    im = InstanceManager(prov)
+    inst = im.launch({"CPU": 1})
+    im.reconcile()
+    im.reconcile(gcs_alive={"node-1"})
+    # the cloud kills the VM out from under us
+    prov.nodes.remove("node-1")
+    im.reconcile()
+    assert im.storage.get(inst.instance_id).status == TERMINATED
+    # a VM appears that nobody launched (pre-existing pool capacity):
+    # it gets adopted so live_count() reflects real capacity
+    prov.nodes.append("foreign-1")
+    im.reconcile()
+    adopted = [i for i in im.storage.list((ALLOCATED,))
+               if i.node_id == "foreign-1"]
+    assert adopted and im.live_count() == 1
+
+
+# ---------------------------------------------------------------------------
+# GKE provider: command construction against a stubbed runner
+# ---------------------------------------------------------------------------
+
+def _gke(calls, replies=None):
+    replies = replies or {}
+
+    def runner(argv):
+        calls.append(argv)
+        for key, out in replies.items():
+            if key in " ".join(argv):
+                return out
+        return ""
+
+    return GKETPUNodeProvider(cluster="c1", node_pool="tpu-pool",
+                              zone="us-central2-b", project="proj",
+                              runner=runner)
+
+
+def test_gke_list_and_create_commands():
+    calls = []
+    prov = _gke(calls, {"get nodes": "gke-a gke-b"})
+    assert prov.non_terminated_nodes() == ["gke-a", "gke-b"]
+    kubectl = calls[0]
+    assert kubectl[:3] == ["kubectl", "get", "nodes"]
+    assert "cloud.google.com/gke-nodepool=tpu-pool" in " ".join(kubectl)
+    prov.create_node({"TPU": 4})
+    resize = calls[-1]
+    assert resize[:4] == ["gcloud", "container", "clusters", "resize"]
+    assert "c1" in resize
+    assert "--node-pool=tpu-pool" in resize
+    assert "--num-nodes=3" in resize          # 2 existing + 1
+    assert "--zone=us-central2-b" in resize
+    assert "--project=proj" in resize
+
+
+def test_gke_terminate_commands():
+    calls = []
+    prov = _gke(calls, {
+        "node-pools describe":
+            "https://gce/projects/p/zones/z/instanceGroupManagers/mig-1",
+    })
+    prov.terminate_node("gke-a")
+    joined = [" ".join(c) for c in calls]
+    assert any(c.startswith("kubectl drain gke-a") for c in joined)
+    assert any("node-pools describe tpu-pool" in c for c in joined)
+    delete = [c for c in calls
+              if "delete-instances" in c]
+    assert delete, joined
+    assert "mig-1" in delete[0]
+    assert "--instances=gke-a" in delete[0]
+
+
+def test_gke_terminate_survives_failed_drain():
+    calls = []
+
+    def runner(argv):
+        calls.append(argv)
+        if argv[0] == "kubectl" and argv[1] == "drain":
+            raise RuntimeError("node unreachable")
+        if "describe" in argv:
+            return "https://gce/zones/z/instanceGroupManagers/mig-9"
+        return ""
+
+    prov = GKETPUNodeProvider(cluster="c", node_pool="p",
+                              zone="z", runner=runner)
+    prov.terminate_node("dead-node")   # must not raise
+    assert any("delete-instances" in c for c in calls)

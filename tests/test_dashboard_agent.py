@@ -1,0 +1,95 @@
+"""Per-node dashboard agent (reference: dashboard/agent.py +
+reporter_agent.py): an observability process per node, registered in the
+GCS node table, serving host stats and worker stacks/profiles off the
+raylet data plane."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.runtime.rpc import RpcClient
+from ray_tpu.utils.config import reset_config
+
+
+@pytest.fixture
+def agent_cluster(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_DASHBOARD_AGENT_ENABLED", "1")
+    reset_config()
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+    reset_config()
+
+
+def _wait_agent(c, timeout=15):
+    gcs = RpcClient(c.gcs_address)
+    deadline = time.monotonic() + timeout
+    try:
+        while time.monotonic() < deadline:
+            nodes = gcs.call("get_nodes", alive_only=True)
+            if nodes and nodes[0].get("agent_addr"):
+                return tuple(nodes[0]["agent_addr"])
+            time.sleep(0.1)
+    finally:
+        gcs.close()
+    raise TimeoutError("agent never registered")
+
+
+def test_agent_registers_and_serves(agent_cluster):
+    addr = _wait_agent(agent_cluster)
+    agent = RpcClient(addr, timeout=20)
+    try:
+        info = agent.call("agent_info")
+        assert info["node_id"] == next(iter(agent_cluster.nodes))
+        # the agent is its OWN process, not the raylet's
+        raylet = next(iter(agent_cluster.nodes.values())).raylet
+        import os
+
+        assert info["pid"] != os.getpid()
+        stats = agent.call("host_stats")
+        assert isinstance(stats, dict)
+
+        # worker stacks through the agent (spin up a worker first)
+        @ray_tpu.remote
+        def live():
+            return 1
+
+        assert ray_tpu.get(live.remote()) == 1
+        stacks = agent.call("worker_stacks")
+        assert isinstance(stacks, dict) and stacks, stacks
+        assert raylet is not None
+    finally:
+        agent.close()
+
+
+def test_state_api_prefers_agent(agent_cluster):
+    _wait_agent(agent_cluster)
+
+    @ray_tpu.remote
+    def live():
+        return 1
+
+    ray_tpu.get(live.remote())
+    from ray_tpu.util import state
+
+    stacks = state.dump_worker_stacks()
+    assert stacks and isinstance(stacks, dict)
+
+
+def test_agent_dies_with_raylet(agent_cluster):
+    _wait_agent(agent_cluster)
+    handle = next(iter(agent_cluster.nodes.values()))
+    proc = handle.raylet._agent_proc
+    assert proc is not None and proc.poll() is None
+    handle.raylet.stop()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and proc.poll() is None:
+        time.sleep(0.1)
+    assert proc.poll() is not None, "agent outlived its raylet"
+    agent_cluster.nodes.clear()   # raylet already stopped

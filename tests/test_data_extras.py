@@ -357,3 +357,76 @@ def test_actor_pool_autoscales(ray_tpu_start):
         assert len(op._pool) == 0, "idle actors not retired after drain"
     finally:
         MapOperator.__init__ = orig_init
+
+
+# ---------------------------------------------------------------------------
+# round-3 additions: DatasetStats + TFRecord + WebDataset
+# ---------------------------------------------------------------------------
+
+def test_dataset_stats(ray_tpu_start):
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(32, num_blocks=4).map_batches(
+        lambda b: {"id": b["id"] * 2})
+    ds.take_all()
+    out = ds.stats()
+    assert "Operator 0 Input" in out
+    assert "rows" in out and "task wall" in out
+    assert out["MapBatches"]["tasks"] == 4
+    assert out["MapBatches"]["rows_out"] == 32
+    # an unexecuted dataset executes once to produce stats
+    fresh = rdata.range(4, num_blocks=2).map_batches(lambda b: b)
+    assert fresh.stats()["MapBatches"]["tasks"] == 2
+
+
+def test_tfrecord_roundtrip(ray_tpu_start, tmp_path):
+    import ray_tpu.data as rdata
+
+    rows = [
+        {"label": 3, "name": "cat", "scores": [0.5, 1.5]},
+        {"label": 7, "name": "dog", "scores": [2.0]},
+        {"label": 1, "name": b"raw-bytes", "scores": [0.0, -1.0, 4.0]},
+    ]
+    path = str(tmp_path / "data.tfrecord")
+    rdata.write_tfrecords_file(rows, path)
+    back = rdata.read_tfrecords(path).take_all()
+    assert len(back) == 3
+    assert back[0]["label"] == 3
+    assert back[0]["name"] == b"cat"         # bytes feature (TF semantics)
+    assert back[0]["scores"] == [0.5, 1.5]
+    assert back[1]["scores"] == 2.0          # single element unwraps
+    assert back[2]["name"] == b"raw-bytes"
+
+
+def test_tfrecord_crc_detects_corruption(tmp_path):
+    from ray_tpu.data import tfrecord as tfr
+
+    framed = bytearray(tfr.frame_record(tfr.build_example({"x": 1})))
+    framed[14] ^= 0xFF    # flip a payload byte
+    import pytest
+
+    with pytest.raises(ValueError, match="CRC"):
+        list(tfr.iter_records(bytes(framed)))
+
+
+def test_webdataset_reader(ray_tpu_start, tmp_path):
+    import io
+    import tarfile
+
+    import ray_tpu.data as rdata
+
+    tar_path = tmp_path / "shard-000.tar"
+    with tarfile.open(tar_path, "w") as tar:
+        for key, cls, txt in (("s1", 0, "hello"), ("s2", 4, "world")):
+            for ext, payload in (("cls", str(cls).encode()),
+                                 ("txt", txt.encode()),
+                                 ("bin", b"\x00\x01")):
+                data = io.BytesIO(payload)
+                info = tarfile.TarInfo(f"{key}.{ext}")
+                info.size = len(payload)
+                tar.addfile(info, data)
+    rows = rdata.read_webdataset(str(tar_path)).take_all()
+    assert len(rows) == 2
+    by_key = {r["__key__"]: r for r in rows}
+    assert by_key["s1"]["cls"] == 0 and by_key["s1"]["txt"] == "hello"
+    assert by_key["s2"]["cls"] == 4 and by_key["s2"]["bin"] == b"\x00\x01"
