@@ -837,10 +837,14 @@ def read_webdataset(paths, *, num_blocks: int = 8) -> Dataset:
                 for member in tar:
                     if not member.isfile():
                         continue
-                    # key = FULL path before the first extension dot
-                    # (webdataset convention): same basenames in
-                    # different tar directories are DIFFERENT samples
-                    key, _, ext = member.name.partition(".")
+                    # key = directory + basename-stem (webdataset
+                    # convention): the extension split happens on the
+                    # BASENAME only — a dot in a directory name must not
+                    # corrupt the key — while same basenames in
+                    # different directories stay different samples
+                    dirpart, _, base = member.name.rpartition("/")
+                    stem, _, ext = base.partition(".")
+                    key = f"{dirpart}/{stem}" if dirpart else stem
                     if key != current_key:
                         if row:
                             rows.append(row)

@@ -100,6 +100,10 @@ class InstanceManager:
     """Decision intake + reconciliation over an InstanceStorage."""
 
     KEEP_TERMINATED = 128   # recent dead records kept for observability
+    # a REQUESTED instance whose VM never appears (cloud quota, failed
+    # resize) times out to TERMINATED so it stops counting toward the
+    # cap and blocking further scale-up forever
+    REQUEST_TIMEOUT_S = 600.0
 
     def __init__(self, provider):
         self.provider = provider
@@ -146,10 +150,16 @@ class InstanceManager:
         unclaimed = provider_nodes - {
             i.node_id for i in self.storage.list(LIVE_STATES)
             if i.node_id}
+        now = time.monotonic()
         for inst in self.storage.list((REQUESTED,)):
             if inst.node_id and inst.node_id in provider_nodes:
                 self.storage.update_status(inst.instance_id, ALLOCATED,
                                            inst.version)
+            elif (inst.requested_at is not None
+                  and now - inst.requested_at > self.REQUEST_TIMEOUT_S):
+                self.storage.update_status(
+                    inst.instance_id, TERMINATED, inst.version,
+                    terminated_at=now)
             elif not inst.node_id and unclaimed:
                 # async providers (GKE) return no id at request time: the
                 # next new provider node claims the oldest such request

@@ -259,10 +259,27 @@ def _find_conda() -> str | None:
 
 def conda_create_commands(spec: dict, dest: str, conda_exe: str) -> list:
     """Command lines that materialize a conda env for ``spec`` at
-    ``dest`` (pure — unit-testable without conda installed)."""
+    ``dest`` (pure — unit-testable without conda installed). The
+    environment.yml ``{"pip": [...]}`` dependency subsection becomes a
+    second pip-install step inside the env; any other non-string entry
+    is an error (silent drops would cache an incomplete env forever)."""
     deps = spec.get("dependencies", [])
-    return [[conda_exe, "create", "--yes", "--quiet", "--prefix", dest,
-             *[d for d in deps if isinstance(d, str)]]]
+    conda_pkgs = [d for d in deps if isinstance(d, str)]
+    pip_pkgs: list = []
+    for d in deps:
+        if isinstance(d, str):
+            continue
+        if isinstance(d, dict) and list(d.keys()) == ["pip"]:
+            pip_pkgs.extend(d["pip"])
+        else:
+            raise ValueError(
+                f"unsupported conda dependency entry: {d!r}")
+    cmds = [[conda_exe, "create", "--yes", "--quiet", "--prefix", dest,
+             *conda_pkgs]]
+    if pip_pkgs:
+        cmds.append([conda_exe, "run", "--prefix", dest, "python", "-m",
+                     "pip", "install", "--no-input", *pip_pkgs])
+    return cmds
 
 
 def ensure_conda_env(conda_field, *, runner=None) -> str:

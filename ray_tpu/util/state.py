@@ -118,6 +118,30 @@ def cluster_summary() -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _call_node(node: dict, method: str, *, timeout: float, **kwargs):
+    """One observability RPC against a node, preferring its dashboard
+    AGENT and falling back to the raylet (same method names on both;
+    a dead agent's stale agent_addr must not disable the query).
+    Returns (result, last_error_repr)."""
+    from ray_tpu.runtime.rpc import RpcClient
+
+    candidates = [tuple(node["address"])]
+    if node.get("agent_addr"):
+        candidates.insert(0, tuple(node["agent_addr"]))
+    err = None
+    for addr in candidates:
+        client = None
+        try:
+            client = RpcClient(addr, timeout=timeout)
+            return client.call(method, **kwargs), None
+        except Exception as e:  # noqa: BLE001 - try the next candidate
+            err = repr(e)
+        finally:
+            if client is not None:
+                client.close()
+    return None, err
+
+
 def dump_worker_stacks(node_id: str | None = None,
                        worker_id: str | None = None) -> dict:
     """Per-thread stacks of cluster workers, keyed node -> worker ->
@@ -135,27 +159,11 @@ def dump_worker_stacks(node_id: str | None = None,
     out_lock = threading.Lock()
 
     def query(node):
-        # the per-node AGENT serves observability when present; a DEAD
-        # agent (stale agent_addr) falls back to the raylet path, which
-        # still serves the same RPC
-        candidates = [tuple(node["address"])]
-        if node.get("agent_addr"):
-            candidates.insert(0, tuple(node["agent_addr"]))
-        stacks = None
-        for addr in candidates:
-            client = None
-            try:
-                client = RpcClient(addr, timeout=15)
-                stacks = client.call("worker_stacks",
-                                     worker_id=worker_id)
-                break
-            except Exception as e:  # noqa: BLE001 - next candidate
-                stacks = {"error": repr(e)}
-            finally:
-                if client is not None:
-                    client.close()
+        stacks, err = _call_node(node, "worker_stacks", timeout=15,
+                                 worker_id=worker_id)
         with out_lock:
-            out[node["node_id"]] = stacks
+            out[node["node_id"]] = (stacks if stacks is not None
+                                    else {"error": err})
 
     # fan out per node (one unresponsive raylet must not serialize the
     # whole cluster dump behind its timeout)
@@ -182,27 +190,13 @@ def profile_worker(worker_id: str, *, node_id: str | None = None,
     for node in rt._gcs.call("get_nodes", alive_only=True):
         if node_id is not None and node["node_id"] != node_id:
             continue
-        candidates = [tuple(node["address"])]
-        if node.get("agent_addr"):
-            # prefer the agent; a dead one falls back to the raylet
-            candidates.insert(0, tuple(node["agent_addr"]))
-        result = None
-        for addr in candidates:
-            client = None
-            try:
-                client = RpcClient(addr, timeout=duration_s + 30)
-                result = client.call("profile_worker",
-                                     worker_id=worker_id,
-                                     duration_s=duration_s, hz=hz)
-                break
-            except Exception as e:  # noqa: BLE001 - next candidate
-                transport_errors[node["node_id"]] = repr(e)
-            finally:
-                if client is not None:
-                    client.close()
+        result, err = _call_node(node, "profile_worker",
+                                 timeout=duration_s + 30,
+                                 worker_id=worker_id,
+                                 duration_s=duration_s, hz=hz)
         if result is None:
+            transport_errors[node["node_id"]] = err
             continue
-        transport_errors.pop(node["node_id"], None)
         if result.get("not_found"):
             continue   # the worker lives on another node; keep looking
         # genuine outcome from the owning node — success OR its real

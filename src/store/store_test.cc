@@ -1,0 +1,143 @@
+// Native unit/stress driver for the shm object store, built under
+// AddressSanitizer and ThreadSanitizer by `make asan` / `make tsan`
+// (SURVEY §5 race-detection row; reference: the C++ unit suites run
+// under sanitizer configs in CI).
+//
+// Exercises: create/seal/get/release, first-write-wins, abort, delete
+// refcount guards, and a multi-threaded reader/writer/deleter storm
+// over one segment attached per-thread — the paths where a data race
+// or lifetime bug in the allocator/table would surface.
+
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+// the store's C ABI (keep in sync with ray_tpu/_private/shm_store.py)
+extern "C" {
+void* store_create(const char* name, uint64_t capacity, uint64_t table_cap);
+void* store_attach(const char* name);
+void store_close(void* sp);
+uint8_t* store_base(void* sp);
+int store_create_object(void* sp, const uint8_t* id, uint64_t data_size,
+                        uint64_t meta_size, uint64_t* offset_out);
+int store_seal(void* sp, const uint8_t* id);
+int store_get(void* sp, const uint8_t* id, int64_t timeout_ms,
+              uint64_t* offset_out, uint64_t* data_size_out,
+              uint64_t* meta_size_out);
+int store_release(void* sp, const uint8_t* id);
+int store_abort(void* sp, const uint8_t* id);
+int store_delete(void* sp, const uint8_t* id);
+int store_contains(void* sp, const uint8_t* id);
+}
+
+enum { TS_OK = 0, TS_ERR = -1, TS_EXISTS = -2, TS_NOT_FOUND = -3 };
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,   \
+                   __LINE__, #cond);                                  \
+      std::exit(1);                                                   \
+    }                                                                 \
+  } while (0)
+
+// store keys are 20 bytes (ray_tpu/_private/shm_store.py ID_LEN)
+static void fill_oid(uint8_t* oid, int v) {
+  std::memset(oid, 0, 20);
+  std::memcpy(oid, &v, sizeof(v));
+}
+
+static int put(void* s, const uint8_t* oid, const uint8_t* data,
+               uint64_t n) {
+  uint64_t off = 0;
+  int rc = store_create_object(s, oid, n, 0, &off);
+  if (rc != TS_OK) return rc;
+  std::memcpy(store_base(s) + off, data, n);
+  return store_seal(s, oid);
+}
+
+int main() {
+  char name[64];
+  std::snprintf(name, sizeof(name), "/raytpu_sani_%d", (int)getpid());
+  void* store = store_create(name, 64ull << 20, 4096);
+  CHECK(store != nullptr);
+
+  // basic put/get round trip
+  uint8_t oid[20];
+  fill_oid(oid, 1);
+  uint8_t payload[256];
+  for (int i = 0; i < 256; ++i) payload[i] = (uint8_t)i;
+  CHECK(put(store, oid, payload, sizeof(payload)) == TS_OK);
+  uint64_t off = 0, dsz = 0, msz = 0;
+  CHECK(store_get(store, oid, 0, &off, &dsz, &msz) == TS_OK);
+  CHECK(dsz == 256);
+  CHECK(std::memcmp(store_base(store) + off, payload, 256) == 0);
+  CHECK(store_release(store, oid) == TS_OK);
+
+  // first write wins
+  CHECK(put(store, oid, payload, 8) == TS_EXISTS);
+
+  // abort of an unsealed object frees the slot
+  uint8_t oid2[20];
+  fill_oid(oid2, 2);
+  uint64_t off2 = 0;
+  CHECK(store_create_object(store, oid2, 64, 0, &off2) == TS_OK);
+  CHECK(store_abort(store, oid2) == TS_OK);
+  CHECK(store_contains(store, oid2) == 0);
+
+  // a held reader blocks delete; release then delete succeeds
+  CHECK(store_get(store, oid, 0, &off, &dsz, &msz) == TS_OK);
+  CHECK(store_delete(store, oid) != TS_OK);
+  CHECK(store_release(store, oid) == TS_OK);
+  CHECK(store_delete(store, oid) == TS_OK);
+  CHECK(store_contains(store, oid) == 0);
+
+  // concurrent storm: writers create distinct objects, readers chase a
+  // neighbor's objects, deleters race over a shared range — each
+  // thread attaches its OWN handle, like real worker processes
+  constexpr int kThreads = 4;
+  constexpr int kObjects = 200;
+  std::atomic<int> errors{0};
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; ++t) {
+    threads.emplace_back([&, t] {
+      void* s = store_attach(name);
+      if (!s) {
+        errors.fetch_add(1);
+        return;
+      }
+      uint8_t o[20];
+      uint8_t buf[512];
+      std::memset(buf, t + 1, sizeof(buf));
+      for (int i = 0; i < kObjects; ++i) {
+        fill_oid(o, 1000 + t * kObjects + i);
+        if (put(s, o, buf, sizeof(buf)) != TS_OK) errors.fetch_add(1);
+        // read a NEIGHBOR thread's recent object, if it exists yet
+        fill_oid(o, 1000 + ((t + 1) % kThreads) * kObjects + (i / 2));
+        uint64_t ro = 0, rd = 0, rm = 0;
+        if (store_get(s, o, 0, &ro, &rd, &rm) == TS_OK) {
+          volatile uint8_t sink = store_base(s)[ro];
+          (void)sink;
+          store_release(s, o);
+        }
+        // race create/delete over a small shared id range
+        fill_oid(o, 5000 + (i % 32));
+        put(s, o, buf, 64);
+        store_delete(s, o);
+      }
+      store_close(s);
+    });
+  }
+  for (auto& th : threads) th.join();
+  CHECK(errors.load() == 0);
+
+  store_close(store);
+  std::printf("store_test ok\n");
+  return 0;
+}

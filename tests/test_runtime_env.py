@@ -457,9 +457,18 @@ def test_conda_create_commands_and_missing_binary(monkeypatch):
     cmds = re_mod.conda_create_commands(
         {"dependencies": ["numpy", "pandas=2.2", {"pip": ["x"]}]},
         "/cache/conda/abc", "/opt/conda/bin/conda")
-    assert cmds == [["/opt/conda/bin/conda", "create", "--yes", "--quiet",
-                     "--prefix", "/cache/conda/abc", "numpy",
-                     "pandas=2.2"]]
+    assert cmds == [
+        ["/opt/conda/bin/conda", "create", "--yes", "--quiet",
+         "--prefix", "/cache/conda/abc", "numpy", "pandas=2.2"],
+        # environment.yml pip subsection installs INSIDE the env
+        ["/opt/conda/bin/conda", "run", "--prefix", "/cache/conda/abc",
+         "python", "-m", "pip", "install", "--no-input", "x"],
+    ]
+    import pytest as _pt
+
+    with _pt.raises(ValueError, match="unsupported conda dependency"):
+        re_mod.conda_create_commands(
+            {"dependencies": [["not-a-dep"]]}, "/d", "/c")
     monkeypatch.delenv("CONDA_EXE", raising=False)
     monkeypatch.setattr(re_mod.shutil, "which", lambda *_: None)
     import pytest

@@ -1,0 +1,23 @@
+"""Sanitizer builds of the native store (SURVEY §5 race detection):
+`make asan` / `make tsan` compile the C++ store + a unit/stress driver
+under AddressSanitizer / ThreadSanitizer and run it. Slow-ish (two
+compiles), so it runs as one test per sanitizer."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src")
+
+
+@pytest.mark.parametrize("target", ["asan", "tsan"])
+def test_store_under_sanitizer(target):
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no native toolchain")
+    proc = subprocess.run(["make", "-C", SRC, target],
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "store_test ok" in proc.stdout
