@@ -306,12 +306,41 @@ def bench_core() -> dict:
     }
 
 
+def bench_core_subprocess() -> dict:
+    """Core microbenchmarks in a FRESH interpreter: after the train and
+    serve phases this process carries jax dispatch + TPU-tunnel threads
+    whose GIL slices depress a pure-Python RPC benchmark ~30% — the
+    standalone number is the honest one (ray_perf runs standalone too)."""
+    import signal
+    import subprocess
+
+    env = dict(os.environ)
+    env["BENCH_MODE"] = "core"
+    # own process group: a timeout kill must take the child's external
+    # raylet/GCS processes down with it, not orphan them on the host
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=900)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait()
+        raise RuntimeError("core bench subprocess timed out") from None
+    if proc.returncode != 0 or not stdout.strip():
+        raise RuntimeError(
+            f"core bench subprocess failed (rc={proc.returncode}): "
+            f"{(stderr or '')[-2000:]}")
+    return json.loads(stdout.strip().splitlines()[-1])
+
+
 def bench_all() -> dict:
     """Train headline + serve/core sub-benchmarks folded into detail.
     Sub-bench failures degrade to an error string: the train number must
     still land in the round artifact."""
     result = bench_train()
-    subs = [("serve", bench_serve), ("core", bench_core)]
+    subs = [("serve", bench_serve), ("core", bench_core_subprocess)]
     if os.environ.get("BENCH_PRESET", "base") != "small":
         # the ~1B entry is a real-chip measurement; a CPU smoke run
         # (BENCH_PRESET=small) must not train a 1B model on host
