@@ -42,6 +42,7 @@ from ray_tpu.rllib.offline import (
 )
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
+from ray_tpu.rllib.td3 import DDPG, DDPGConfig, TD3, TD3Config
 
 __all__ = ["APPO", "APPOConfig", "BC", "BCConfig", "BanditEnv", "CQL",
            "CQLConfig", "CartPole", "ContinuousBandit", "DQN", "DQNConfig",

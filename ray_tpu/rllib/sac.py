@@ -141,7 +141,12 @@ def _sac_update(params, target_q, opt_state, batch, key, *, tx, gamma, tau,
     return params, target_q, opt_state, metrics
 
 
-class _SACRolloutWorker:
+class _ContinuousRolloutWorker:
+    """Shared rollout actor for the continuous-control algorithms (SAC,
+    TD3/DDPG): env stepping, warmup random actions, episode bookkeeping,
+    action scaling. Subclasses supply ``_act`` (the numpy policy — the
+    rollout actors stay jax-free)."""
+
     def __init__(self, env_name, seed: int):
         self.env = make_env(env_name, seed=seed)
         self.rng = np.random.default_rng(seed)
@@ -151,16 +156,15 @@ class _SACRolloutWorker:
         self.high = float(getattr(self.env, "action_high", 1.0))
 
     def _act(self, actor_np, obs):
-        # numpy mirror of _sample_action (rollout actors stay jax-free)
+        raise NotImplementedError
+
+    def _mlp_np(self, actor_np, obs):
         x = obs[None]
         for i, layer in enumerate(actor_np):
             x = x @ layer["w"] + layer["b"]
             if i < len(actor_np) - 1:
                 x = np.tanh(x)
-        mu, log_std = np.split(x[0], 2)
-        std = np.exp(np.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
-        a = np.tanh(mu + std * self.rng.standard_normal(mu.shape))
-        return a
+        return x[0]
 
     def _scale(self, a):
         return self.low + (a + 1.0) * 0.5 * (self.high - self.low)
@@ -177,7 +181,7 @@ class _SACRolloutWorker:
             next_obs, reward, done, _ = self.env.step(self._scale(a))
             obs_l.append(self.obs)
             next_l.append(next_obs)
-            act_l.append(a.astype(np.float32))
+            act_l.append(np.asarray(a, np.float32))
             rew_l.append(reward)
             done_l.append(float(done))
             self.ep_ret += reward
@@ -193,6 +197,14 @@ class _SACRolloutWorker:
                 "rewards": np.asarray(rew_l, np.float32),
                 "dones": np.asarray(done_l, np.float32),
                 "episode_returns": episode_returns}
+
+
+class _SACRolloutWorker(_ContinuousRolloutWorker):
+    def _act(self, actor_np, obs):
+        # numpy mirror of _sample_action
+        mu, log_std = np.split(self._mlp_np(actor_np, obs), 2)
+        std = np.exp(np.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+        return np.tanh(mu + std * self.rng.standard_normal(mu.shape))
 
 
 @dataclass

@@ -1,0 +1,258 @@
+"""TD3 (+ DDPG) for continuous control.
+
+Reference analog: ``rllib/algorithms/td3`` / ``rllib/algorithms/ddpg``
+(legacy stack; moved to rllib_contrib). TD3 = deterministic-policy
+actor-critic with the three fixes over DDPG: twin critics (min-Q
+targets), target-policy smoothing noise, and delayed policy updates.
+DDPG is the degenerate config (single critic, no smoothing, delay 1) —
+exposed as :class:`DDPG` the same way APPO layers over IMPALA.
+
+Shares the MLP/critic builders, replay buffer, and rollout-actor shape
+with SAC (``rllib/sac.py``); the learner is one jitted update.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.dqn import ReplayBuffer
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.sac import (_ContinuousRolloutWorker, _init_mlp,
+                               _mlp, _q)
+
+
+def init_td3(key, obs_dim: int, action_dim: int, hidden: int = 64,
+             twin_q: bool = True):
+    import jax
+
+    ka, k1, k2 = jax.random.split(key, 3)
+    params = {
+        "actor": _init_mlp(ka, (obs_dim, hidden, hidden, action_dim)),
+        "q1": _init_mlp(k1, (obs_dim + action_dim, hidden, hidden, 1)),
+    }
+    if twin_q:
+        params["q2"] = _init_mlp(k2, (obs_dim + action_dim, hidden,
+                                      hidden, 1))
+    return params
+
+
+def _pi(actor_params, obs):
+    import jax.numpy as jnp
+
+    return jnp.tanh(_mlp(actor_params, obs))
+
+
+def _td3_update(params, targets, opt_state, batch, key, do_policy, *,
+                tx, gamma, tau, target_noise, noise_clip, twin_q):
+    """One TD3 step: critics every call; the actor (and polyak targets)
+    only when ``do_policy`` (delayed policy updates)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    obs, act = batch["obs"], batch["actions"]
+    rew, nxt, done = batch["rewards"], batch["next_obs"], batch["dones"]
+
+    # target action with clipped smoothing noise (TD3 fix #2)
+    na = _pi(targets["actor"], nxt)
+    noise = jnp.clip(
+        target_noise * jax.random.normal(key, na.shape),
+        -noise_clip, noise_clip)
+    na = jnp.clip(na + noise, -1.0, 1.0)
+    tq = _q(targets["q1"], nxt, na)
+    if twin_q:
+        tq = jnp.minimum(tq, _q(targets["q2"], nxt, na))  # fix #1
+    target = jax.lax.stop_gradient(
+        rew + gamma * (1.0 - done) * tq)
+
+    def critic_loss_fn(p):
+        loss = jnp.mean((_q(p["q1"], obs, act) - target) ** 2)
+        if twin_q:
+            loss = loss + jnp.mean((_q(p["q2"], obs, act) - target) ** 2)
+        return loss
+
+    def actor_loss_fn(p):
+        a = _pi(p["actor"], obs)
+        return -jnp.mean(_q(jax.lax.stop_gradient(p["q1"]), obs, a))
+
+    c_loss, c_grads = jax.value_and_grad(critic_loss_fn)(params)
+    a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(params)
+    grads = jax.tree.map(lambda c, a: c + a, c_grads, a_grads)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    # delayed policy updates (fix #3): gate the APPLIED actor update —
+    # zeroing only the gradient would still move the actor off-cycle
+    # through the shared Adam's nonzero first moment
+    updates = {**updates,
+               "actor": jax.tree.map(
+                   lambda u: jnp.where(do_policy, u, 0.0),
+                   updates["actor"])}
+    params = optax.apply_updates(params, updates)
+    # polyak targets move only on policy steps (matches the paper)
+    targets = jax.tree.map(
+        lambda t, o: jnp.where(do_policy, (1 - tau) * t + tau * o, t),
+        targets,
+        {k: params[k] for k in targets})
+    return params, targets, opt_state, {
+        "critic_loss": c_loss, "actor_loss": a_loss}
+
+
+class _TD3RolloutWorker(_ContinuousRolloutWorker):
+    """Deterministic policy + Gaussian exploration noise (reference:
+    DDPG/TD3 exploration); rollout loop shared with SAC."""
+
+    def __init__(self, env_name, seed: int, expl_noise: float):
+        super().__init__(env_name, seed)
+        self.expl_noise = expl_noise
+
+    def _act(self, actor_np, obs):
+        a = np.tanh(self._mlp_np(actor_np, obs))
+        a = a + self.expl_noise * self.rng.standard_normal(a.shape)
+        return np.clip(a, -1.0, 1.0)
+
+
+@dataclass
+class TD3Config:
+    env: str = "Pendulum-v1"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 128
+    lr: float = 1e-3
+    gamma: float = 0.99
+    tau: float = 0.005
+    buffer_capacity: int = 100_000
+    learning_starts: int = 500
+    train_batch_size: int = 128
+    num_updates_per_iter: int = 32
+    policy_delay: int = 2
+    target_noise: float = 0.2
+    noise_clip: float = 0.5
+    expl_noise: float = 0.1
+    twin_q: bool = True
+    hidden: int = 64
+    seed: int = 0
+
+    def environment(self, env) -> "TD3Config":
+        return replace(self, env=env)
+
+    def rollouts(self, **kw) -> "TD3Config":
+        return replace(self, **kw)
+
+    def training(self, **kw) -> "TD3Config":
+        return replace(self, **kw)
+
+    def build(self) -> "TD3":
+        return TD3(self)
+
+
+@dataclass
+class DDPGConfig(TD3Config):
+    """DDPG = TD3 minus its three fixes (reference: ddpg is the base
+    TD3 generalizes)."""
+
+    policy_delay: int = 1
+    target_noise: float = 0.0
+    noise_clip: float = 0.0
+    twin_q: bool = False
+
+    def build(self) -> "TD3":
+        return DDPG(self)
+
+
+class TD3:
+    def __init__(self, config: TD3Config):
+        import jax
+        import optax
+
+        self.config = config
+        env = make_env(config.env, seed=config.seed)
+        if not getattr(env, "continuous", False):
+            raise ValueError(f"TD3 requires a continuous-action env, "
+                             f"got {config.env!r}")
+        self.obs_dim = env.obs_dim
+        self.action_dim = env.action_dim
+        self.action_low = float(getattr(env, "action_low", -1.0))
+        self.action_high = float(getattr(env, "action_high", 1.0))
+        self.params = init_td3(jax.random.key(config.seed), self.obs_dim,
+                               self.action_dim, config.hidden,
+                               twin_q=config.twin_q)
+        self.targets = jax.tree.map(lambda x: x, self.params)
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.buffer = ReplayBuffer(config.buffer_capacity, self.obs_dim,
+                                   action_shape=(self.action_dim,),
+                                   action_dtype=np.float32)
+        self.iteration = 0
+        self.update_count = 0
+        self.rng = np.random.default_rng(config.seed)
+        self.key = jax.random.key(config.seed + 1)
+        worker_cls = ray_tpu.remote(_TD3RolloutWorker)
+        self.workers = [
+            worker_cls.remote(config.env, config.seed + 1000 * (i + 1),
+                              config.expl_noise)
+            for i in range(config.num_rollout_workers)
+        ]
+        self._update = jax.jit(partial(
+            _td3_update, tx=self.tx, gamma=config.gamma, tau=config.tau,
+            target_noise=config.target_noise,
+            noise_clip=config.noise_clip, twin_q=config.twin_q))
+
+    def train(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        actor_np = jax.tree.map(np.asarray, self.params["actor"])
+        warmup = self.buffer.size < cfg.learning_starts
+        batches = ray_tpu.get([
+            w.sample.remote(actor_np, cfg.rollout_fragment_length, warmup)
+            for w in self.workers
+        ])
+        episode_returns = []
+        for b in batches:
+            episode_returns.extend(b.pop("episode_returns"))
+            self.buffer.add_batch(b)
+
+        metrics = {}
+        if self.buffer.size >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iter):
+                mb = self.buffer.sample(cfg.train_batch_size, self.rng)
+                self.key, sub = jax.random.split(self.key)
+                self.update_count += 1
+                do_policy = jnp.asarray(
+                    self.update_count % cfg.policy_delay == 0)
+                (self.params, self.targets, self.opt_state,
+                 metrics) = self._update(
+                    self.params, self.targets, self.opt_state, mb, sub,
+                    do_policy)
+            metrics = {k: float(v) for k, v in metrics.items()}
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (float(np.mean(episode_returns))
+                                    if episode_returns else float("nan")),
+            "episodes_this_iter": len(episode_returns),
+            "buffer_size": self.buffer.size,
+            **metrics,
+        }
+
+    def compute_single_action(self, obs) -> np.ndarray:
+        import jax.numpy as jnp
+
+        a = np.asarray(_pi(self.params["actor"],
+                           jnp.asarray(obs, jnp.float32)[None]))[0]
+        return self.action_low + (a + 1.0) * 0.5 * (
+            self.action_high - self.action_low)
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class DDPG(TD3):
+    """DDPG via its TD3 generalization (see DDPGConfig)."""
