@@ -5,7 +5,9 @@ Reference analog: ``dashboard/head.py:81`` + REST modules under
 React frontend; here a dependency-free threaded http.server exposes the
 same information surface:
 
-- ``GET /``                       tiny HTML overview (live summary)
+- ``GET /``                       single-page web UI (tabs over the REST
+                                  API below; ``_private/dashboard_app.html``
+                                  — the reference's React app analog)
 - ``GET /api/cluster_status``     cluster summary (nodes/actors/resources)
 - ``GET /api/nodes|actors|tasks|jobs|placement_groups|objects``
 - ``GET /api/timeline``           chrome://tracing JSON of task events
@@ -28,29 +30,24 @@ import ray_tpu
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import state as _state
 
-_INDEX_HTML = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title>
-<style>
- body {{ font-family: monospace; margin: 2em; }}
- pre {{ background: #f4f4f4; padding: 1em; }}
- a {{ margin-right: 1em; }}
-</style></head>
-<body>
-<h2>ray_tpu dashboard</h2>
-<div>
-<a href="/api/cluster_status">cluster_status</a>
-<a href="/api/nodes">nodes</a>
-<a href="/api/actors">actors</a>
-<a href="/api/tasks">tasks</a>
-<a href="/api/jobs">jobs</a>
-<a href="/api/placement_groups">placement_groups</a>
-<a href="/api/timeline">timeline</a>
-<a href="/metrics">metrics</a>
-</div>
-<h3>summary</h3>
-<pre>{summary}</pre>
-</body></html>
+_FALLBACK_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title></head>
+<body><h2>ray_tpu dashboard</h2>
+<p>web UI asset missing; REST API remains at /api/*</p>
+<pre>{summary}</pre></body></html>
 """
+
+
+def _index_html() -> bytes:
+    import importlib.resources
+
+    try:
+        return (importlib.resources.files("ray_tpu._private")
+                .joinpath("dashboard_app.html").read_bytes())
+    except (FileNotFoundError, ModuleNotFoundError, OSError):
+        summary = json.dumps(_state.cluster_summary(), indent=2,
+                             default=str)
+        return _FALLBACK_HTML.format(summary=summary).encode()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -118,10 +115,7 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         try:
             if path == "/":
-                summary = json.dumps(_state.cluster_summary(), indent=2,
-                                     default=str)
-                self._send(_INDEX_HTML.format(summary=summary).encode(),
-                           "text/html")
+                self._send(_index_html(), "text/html")
             elif path == "/api/cluster_status":
                 self._send_json(_state.cluster_summary())
             elif path == "/api/nodes":

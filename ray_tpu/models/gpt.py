@@ -1,0 +1,170 @@
+"""GPT-2-family transformer, TPU-first.
+
+Second decoder-only family next to Llama (reference parity: the
+reference trains GPT-class models through Train integrations, e.g. the
+GPT-J DeepSpeed example under ``train/examples/deepspeed/`` — here the
+family is in-framework). Architecture: learned absolute position
+embeddings, pre-LN LayerNorm blocks with biases, standard multi-head
+attention (no GQA), GELU MLP, tied LM head.
+
+Same TPU conventions as ``models/llama.py``: stacked per-layer arrays
+scanned with ``lax.scan`` (one compiled block body at any depth), a
+parallel logical-axis pytree so every sharding preset applies unchanged,
+bf16 params with fp32 norm statistics and logits.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.llama import cross_entropy_loss, fanin_init, num_params
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.norms import layer_norm
+
+__all__ = ["GPTConfig", "gpt2_small", "gpt2_xl", "gpt_tiny",
+           "param_logical_axes", "init_params", "forward",
+           "cross_entropy_loss", "num_params"]
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    ln_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: str = "none"           # "none" | "full"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def param_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def gpt2_small() -> GPTConfig:
+    return GPTConfig()
+
+
+def gpt2_xl() -> GPTConfig:
+    return GPTConfig(d_model=1600, n_layers=48, n_heads=25, d_ff=6400,
+                     remat="full")
+
+
+def gpt_tiny(vocab_size: int = 512) -> GPTConfig:
+    """Test-size config: seconds on the 8-device CPU mesh."""
+    return GPTConfig(vocab_size=vocab_size, max_seq_len=128, d_model=128,
+                     n_layers=2, n_heads=4, d_ff=256)
+
+
+def param_logical_axes(cfg: GPTConfig) -> dict:
+    """Logical-axis pytree mirroring ``init_params`` (consumed by
+    ``ray_tpu.parallel.sharding`` presets, same names as Llama's)."""
+    block = {
+        "ln1_w": ("layers", "embed"),
+        "ln1_b": ("layers", "embed"),
+        "wqkv": ("layers", "embed", "heads"),
+        "bqkv": ("layers", "heads"),
+        "wo": ("layers", "heads", "embed"),
+        "bo": ("layers", "embed"),
+        "ln2_w": ("layers", "embed"),
+        "ln2_b": ("layers", "embed"),
+        "w_up": ("layers", "embed", "mlp"),
+        "b_up": ("layers", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+        "b_down": ("layers", "embed"),
+    }
+    return {
+        "embedding": ("vocab", "embed"),
+        "pos_embedding": (None, "embed"),
+        "blocks": block,
+        "final_ln_w": ("embed",),
+        "final_ln_b": ("embed",),
+    }
+
+
+def init_params(cfg: GPTConfig, key) -> dict:
+    dt = cfg.param_dtype
+    d, l = cfg.d_model, cfg.n_layers
+    k_emb, k_pos, k_blocks = jax.random.split(key, 3)
+
+    def dense(k, shape, fan_in):
+        return fanin_init(k, shape, fan_in).astype(dt)
+
+    ks = jax.random.split(k_blocks, 4)
+    blocks = {
+        "ln1_w": jnp.ones((l, d), dtype=dt),
+        "ln1_b": jnp.zeros((l, d), dtype=dt),
+        "wqkv": dense(ks[0], (l, d, 3 * d), d),
+        "bqkv": jnp.zeros((l, 3 * d), dtype=dt),
+        "wo": dense(ks[1], (l, d, d), d),
+        "bo": jnp.zeros((l, d), dtype=dt),
+        "ln2_w": jnp.ones((l, d), dtype=dt),
+        "ln2_b": jnp.zeros((l, d), dtype=dt),
+        "w_up": dense(ks[2], (l, d, cfg.d_ff), d),
+        "b_up": jnp.zeros((l, cfg.d_ff), dtype=dt),
+        "w_down": dense(ks[3], (l, cfg.d_ff, d), cfg.d_ff),
+        "b_down": jnp.zeros((l, d), dtype=dt),
+    }
+    return {
+        "embedding": dense(k_emb, (cfg.vocab_size, d), d),
+        "pos_embedding": (fanin_init(k_pos, (cfg.max_seq_len, d), d)
+                          .astype(dt) * 0.1),
+        "blocks": blocks,
+        "final_ln_w": jnp.ones((d,), dtype=dt),
+        "final_ln_b": jnp.zeros((d,), dtype=dt),
+    }
+
+
+def _block(cfg: GPTConfig, x, p, segment_ids, attn_impl):
+    b, s, d = x.shape
+    h = layer_norm(x, p["ln1_w"], p["ln1_b"], eps=cfg.ln_eps)
+    qkv = h @ p["wqkv"] + p["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    attn_out = attention(q, k, v, causal=True, segment_ids=segment_ids,
+                         impl=attn_impl)
+    attn_out = attn_out.reshape(b, s, d)
+    x = x + attn_out @ p["wo"] + p["bo"]
+    h = layer_norm(x, p["ln2_w"], p["ln2_b"], eps=cfg.ln_eps)
+    up = jax.nn.gelu(h @ p["w_up"] + p["b_up"])
+    return x + up @ p["w_down"] + p["b_down"]
+
+
+def forward(cfg: GPTConfig, params: dict, tokens, *, positions=None,
+            segment_ids=None, attn_impl: str = "auto"):
+    """Token ids [b, s] -> logits [b, s, vocab] (fp32, tied head)."""
+    b, s = tokens.shape
+    if s > cfg.max_seq_len:
+        # learned absolute positions clamp OOB gathers silently; reject
+        raise ValueError(
+            f"sequence length {s} exceeds max_seq_len={cfg.max_seq_len}")
+    if positions is None:
+        positions = jnp.arange(s, dtype=jnp.int32)[None, :]
+    x = params["embedding"][tokens] + params["pos_embedding"][positions]
+
+    body = partial(_block, cfg, segment_ids=segment_ids,
+                   attn_impl=attn_impl)
+    if cfg.remat == "full":
+        body = jax.checkpoint(body)
+
+    def scan_fn(x, layer_params):
+        return body(x, layer_params), None
+
+    x, _ = lax.scan(scan_fn, x, params["blocks"])
+    x = layer_norm(x, params["final_ln_w"], params["final_ln_b"],
+                   eps=cfg.ln_eps)
+    return jnp.einsum("bsd,vd->bsv", x, params["embedding"],
+                      preferred_element_type=jnp.float32)

@@ -6,6 +6,12 @@ _rlu("rllib")
 
 
 from ray_tpu.rllib.appo import APPO, APPOConfig
+from ray_tpu.rllib.bandit import (
+    BanditLinTS,
+    BanditLinTSConfig,
+    BanditLinUCB,
+    BanditLinUCBConfig,
+)
 from ray_tpu.rllib.connectors import (
     ClipActions,
     Connector,
@@ -17,6 +23,7 @@ from ray_tpu.rllib.connectors import (
     UnsquashActions,
 )
 from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.env import (
     BanditEnv,
     CartPole,
@@ -40,12 +47,19 @@ from ray_tpu.rllib.offline import (
     OfflineDataset,
     collect_dataset,
 )
+from ray_tpu.rllib.marwil import MARWIL, MARWILConfig
+from ray_tpu.rllib.pg import A2C, A2CConfig, PG, PGConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.td3 import DDPG, DDPGConfig, TD3, TD3Config
 
-__all__ = ["APPO", "APPOConfig", "BC", "BCConfig", "BanditEnv", "CQL",
-           "CQLConfig", "CartPole", "ContinuousBandit", "DQN", "DQNConfig",
-           "DatasetWriter", "GymEnvAdapter", "IMPALA", "IMPALAConfig",
-           "OfflineDataset", "PPO", "PPOConfig", "Pendulum",
-           "SAC", "SACConfig", "collect_dataset", "make_env"]
+__all__ = ["A2C", "A2CConfig", "APPO", "APPOConfig", "ARS", "ARSConfig",
+           "BC", "BCConfig", "BanditEnv", "BanditLinTS",
+           "BanditLinTSConfig", "BanditLinUCB", "BanditLinUCBConfig",
+           "CQL", "CQLConfig", "CartPole", "ContinuousBandit", "DQN",
+           "DQNConfig", "DatasetWriter", "ES", "ESConfig",
+           "GymEnvAdapter", "IMPALA", "IMPALAConfig", "MARWIL",
+           "MARWILConfig", "OfflineDataset", "PG", "PGConfig", "PPO",
+           "PPOConfig", "Pendulum", "SAC", "SACConfig", "DDPG",
+           "DDPGConfig", "TD3", "TD3Config", "collect_dataset",
+           "make_env"]

@@ -1,0 +1,176 @@
+"""MARWIL — Monotonic Advantage Re-Weighted Imitation Learning.
+
+Reference analog: ``rllib/algorithms/marwil/marwil.py`` — hybrid
+imitation/RL from an offline dataset: fit a value function on
+Monte-Carlo returns, then weight the behavior-cloning log-likelihood by
+``exp(beta * advantage)`` so better-than-average transitions are imitated
+harder. ``beta = 0`` degenerates to plain BC (the same relationship the
+reference documents between its MARWIL and BC classes — here BC lives in
+``ray_tpu.rllib.offline`` and MARWIL reuses its dataset format).
+
+The update is one jitted program over the PPO-style MLP module.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+
+import numpy as np
+
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.estimators import episodes_from_dataset
+from ray_tpu.rllib.offline import OfflineDataset
+from ray_tpu.rllib.ppo import _np_forward, forward_module, init_module
+
+
+@dataclass
+class MARWILConfig:
+    env: str = "CartPole-v1"
+    input_path: str = ""
+    lr: float = 1e-3
+    beta: float = 1.0               # advantage-weighting temperature
+    vf_coeff: float = 1.0
+    gamma: float = 0.99
+    batch_size: int = 256
+    hidden: int = 64
+    # moving average of squared advantage used to normalize the
+    # exponent (the reference's ``moving_average_sqd_adv_norm``)
+    adv_norm_decay: float = 0.99
+    seed: int = 0
+
+    def environment(self, env):
+        return replace(self, env=env)
+
+    def offline_data(self, input_path: str):
+        return replace(self, input_path=input_path)
+
+    def training(self, **kw):
+        return replace(self, **kw)
+
+    def build(self):
+        return MARWIL(self)
+
+
+class MARWIL:
+    def __init__(self, config: MARWILConfig):
+        import jax
+        import optax
+
+        self.config = config
+        env = make_env(config.env, seed=config.seed)
+        self.env = env
+        self.params = init_module(jax.random.key(config.seed),
+                                  env.obs_dim, env.n_actions,
+                                  config.hidden)
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.iteration = 0
+        self.rng = np.random.default_rng(config.seed)
+        self._sqd_adv_norm = 1.0
+
+        ds = OfflineDataset(config.input_path)
+        # Monte-Carlo returns per episode (the regression target for the
+        # value head and the advantage source for the policy weight)
+        obs, actions, returns = [], [], []
+        for ep in episodes_from_dataset(ds):
+            g = 0.0
+            rets = np.zeros(len(ep["rewards"]))
+            for t in range(len(ep["rewards"]) - 1, -1, -1):
+                g = ep["rewards"][t] + config.gamma * g
+                rets[t] = g
+            obs.append(ep["obs"])
+            actions.append(ep["actions"])
+            returns.append(rets)
+        self.data = {
+            "obs": np.concatenate(obs).astype(np.float32),
+            "actions": np.concatenate(actions).astype(np.int32),
+            "returns": np.concatenate(returns).astype(np.float32),
+        }
+        self._update = jax.jit(partial(
+            _marwil_update, tx=self.tx, beta=config.beta,
+            vf_coeff=config.vf_coeff))
+
+    def train(self) -> dict:
+        n = len(self.data["obs"])
+        sel = self.rng.permutation(n)[:self.config.batch_size]
+        batch = {k: v[sel] for k, v in self.data.items()}
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, batch,
+            sqd_adv_norm=self._sqd_adv_norm)
+        d = self.config.adv_norm_decay
+        self._sqd_adv_norm = (d * self._sqd_adv_norm +
+                              (1 - d) * float(stats["sqd_adv"]))
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "policy_loss": float(stats["policy_loss"]),
+            "vf_loss": float(stats["vf_loss"]),
+            "mean_adv_weight": float(stats["mean_weight"]),
+            "num_samples_trained": len(batch["obs"]),
+        }
+
+    def compute_action(self, obs) -> int:
+        import jax
+
+        params_np = jax.tree.map(np.asarray, self.params)
+        logits, _ = _np_forward(params_np, np.asarray(obs)[None])
+        return int(np.argmax(logits[0]))
+
+    def evaluate(self, num_episodes: int = 10) -> dict:
+        rets = []
+        for _ in range(num_episodes):
+            obs, total, done = self.env.reset(), 0.0, False
+            steps = 0
+            while not done and steps < 500:
+                obs, r, done, _ = self.env.step(self.compute_action(obs))
+                total += r
+                steps += 1
+            rets.append(total)
+        return {"episode_return_mean": float(np.mean(rets))}
+
+    def save(self, path: str):
+        import pickle
+
+        import jax
+
+        with open(path, "wb") as f:
+            pickle.dump(jax.tree.map(np.asarray, self.params), f)
+
+    def restore(self, path: str):
+        import pickle
+
+        with open(path, "rb") as f:
+            self.params = pickle.load(f)
+
+    def stop(self):
+        pass
+
+
+def _marwil_update(params, opt_state, batch, *, sqd_adv_norm, tx, beta,
+                   vf_coeff):
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(p):
+        logits, values = forward_module(p, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1).squeeze(-1)
+        adv = batch["returns"] - values
+        # normalize the exponent by the running RMS of advantages so the
+        # weights stay bounded as the value fit improves
+        weight = jnp.exp(beta * adv /
+                         jnp.sqrt(sqd_adv_norm + 1e-8))
+        weight = jnp.minimum(weight, 20.0)  # explosion guard
+        policy_loss = -jnp.mean(jax.lax.stop_gradient(weight) * logp)
+        vf_loss = jnp.mean(adv ** 2)
+        total = policy_loss + vf_coeff * vf_loss
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "mean_weight": jnp.mean(weight),
+                       "sqd_adv": jnp.mean(adv ** 2)}
+
+    (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return params, opt_state, stats

@@ -1,0 +1,195 @@
+"""Vanilla Policy Gradient (REINFORCE) and A2C.
+
+Reference analogs: ``rllib/algorithms/pg/pg.py`` (the minimal
+on-policy baseline: plain REINFORCE on Monte-Carlo returns, no critic)
+and ``rllib/algorithms/a2c/a2c.py`` (synchronous advantage actor-critic:
+the PPO sampling architecture with a single unclipped update per batch).
+
+Both share PPO's rollout-worker actors and functional MLP module
+(``ray_tpu.rllib.ppo``) the same way the reference's A2C inherits from
+its PPO/PG lineage — the only difference is the loss. The updates are
+single jitted programs; the MXU sees the same fused MLP matmuls.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.ppo import (
+    _np_forward,
+    _RolloutWorker,
+    forward_module,
+    init_module,
+)
+
+
+@dataclass
+class A2CConfig:
+    env: str = "CartPole-v1"
+    num_rollout_workers: int = 2
+    rollout_fragment_length: int = 256
+    lr: float = 1e-3
+    gamma: float = 0.99
+    lam: float = 1.0                # MC advantages by default
+    entropy_coeff: float = 0.01
+    vf_coeff: float = 0.5
+    hidden: int = 64
+    seed: int = 0
+
+    def environment(self, env):
+        return replace(self, env=env)
+
+    def rollouts(self, *, num_rollout_workers=None,
+                 rollout_fragment_length=None):
+        cfg = self
+        if num_rollout_workers is not None:
+            cfg = replace(cfg, num_rollout_workers=num_rollout_workers)
+        if rollout_fragment_length is not None:
+            cfg = replace(cfg,
+                          rollout_fragment_length=rollout_fragment_length)
+        return cfg
+
+    def training(self, **kw):
+        return replace(self, **kw)
+
+    def build(self):
+        return A2C(self)
+
+
+@dataclass
+class PGConfig(A2CConfig):
+    vf_coeff: float = 0.0           # no critic in the loss
+
+    def build(self):
+        return PG(self)
+
+
+class A2C:
+    """Synchronous advantage actor-critic driver."""
+
+    _use_critic = True
+
+    def __init__(self, config):
+        import jax
+        import optax
+
+        self.config = config
+        env = make_env(config.env, seed=config.seed)
+        self.obs_dim = env.obs_dim
+        self.n_actions = env.n_actions
+        self.params = init_module(jax.random.key(config.seed),
+                                  self.obs_dim, self.n_actions,
+                                  config.hidden)
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self.iteration = 0
+        worker_cls = ray_tpu.remote(_RolloutWorker)
+        self.workers = [
+            worker_cls.remote(config.env, config.seed + 1000 * (i + 1))
+            for i in range(config.num_rollout_workers)
+        ]
+        self._update = jax.jit(partial(
+            _a2c_update, tx=self.tx,
+            entropy_coeff=config.entropy_coeff,
+            vf_coeff=config.vf_coeff,
+            use_critic=self._use_critic))
+
+    def train(self) -> dict:
+        import jax
+
+        cfg = self.config
+        params_np = jax.tree.map(np.asarray, self.params)
+        batches = ray_tpu.get([
+            w.sample.remote(params_np, cfg.rollout_fragment_length,
+                            cfg.gamma, cfg.lam)
+            for w in self.workers
+        ])
+        batch = {k: np.concatenate([b[k] for b in batches])
+                 for k in ("obs", "actions", "advantages", "returns")}
+        episode_returns = [r for b in batches for r in b["episode_returns"]]
+        adv = batch["advantages"]
+        batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, batch)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (float(np.mean(episode_returns))
+                                    if episode_returns else 0.0),
+            "num_episodes": len(episode_returns),
+            "policy_loss": float(stats["policy_loss"]),
+            "vf_loss": float(stats["vf_loss"]),
+            "entropy": float(stats["entropy"]),
+            "num_env_steps_sampled": len(batch["obs"]),
+        }
+
+    def compute_action(self, obs) -> int:
+        import jax
+
+        params_np = jax.tree.map(np.asarray, self.params)
+        logits, _ = _np_forward(params_np, np.asarray(obs)[None])
+        return int(np.argmax(logits[0]))
+
+    def save(self, path: str):
+        import pickle
+
+        import jax
+
+        with open(path, "wb") as f:
+            pickle.dump(jax.tree.map(np.asarray, self.params), f)
+
+    def restore(self, path: str):
+        import pickle
+
+        with open(path, "rb") as f:
+            self.params = pickle.load(f)
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class PG(A2C):
+    """REINFORCE: the A2C machinery with the critic removed from the
+    loss (the value head still exists in the module but gets no
+    gradient signal when ``vf_coeff == 0`` and advantages fall back to
+    returns-to-go via ``lam=1`` GAE)."""
+
+    _use_critic = False
+
+
+def _a2c_update(params, opt_state, batch, *, tx, entropy_coeff, vf_coeff,
+                use_critic):
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(p):
+        logits, values = forward_module(p, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1).squeeze(-1)
+        adv = batch["advantages"] if use_critic else batch["returns"]
+        if not use_critic:
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        policy_loss = -jnp.mean(logp * adv)
+        vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = policy_loss - entropy_coeff * entropy
+        if use_critic:
+            total = total + vf_coeff * vf_loss
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return params, opt_state, stats

@@ -78,10 +78,16 @@ class JaxTrainer:
         state, metrics = trainer.train_step(state, batch)  # batch: [B, S+1] tokens
     """
 
-    def __init__(self, model_cfg: llama.LlamaConfig, cfg: TrainConfig,
+    def __init__(self, model_cfg, cfg: TrainConfig,
                  *, mesh: Mesh | None = None):
         self.model_cfg = model_cfg
         self.cfg = cfg
+        # Model-family dispatch: any module exposing init_params /
+        # param_logical_axes / forward over a frozen config dataclass
+        # plugs in (llama is the flagship; gpt is the second decoder
+        # family). Llama-only features (fused loss, ring attention,
+        # 1F1B) are guarded below.
+        self.family = self._resolve_family(model_cfg)
         self.mesh = mesh if mesh is not None else create_mesh(cfg.mesh_axes)
         self.rules: ShardingRules = (
             cfg.strategy if isinstance(cfg.strategy, ShardingRules)
@@ -104,7 +110,15 @@ class JaxTrainer:
             ppax if isinstance(ppax, str) and ppax in self.mesh.axis_names
             and self.mesh.shape[ppax] > 1 else None
         )
+        if self.family is not llama and (cfg.fused_loss
+                                         or self.attn_impl == "ring"):
+            raise ValueError(
+                "fused_loss / ring attention are llama-only paths")
         if self.pp_axis:
+            if self.family is not llama:
+                raise ValueError(
+                    "pipeline parallelism is wired for the llama family "
+                    "only (make_llama_stage_fn)")
             n_pp = self.mesh.shape[self.pp_axis]
             if model_cfg.n_layers % n_pp:
                 raise ValueError(
@@ -117,6 +131,18 @@ class JaxTrainer:
                     "the 1F1B loss slot already computes the head "
                     "per-microbatch"
                 )
+
+    @staticmethod
+    def _resolve_family(model_cfg):
+        if isinstance(model_cfg, llama.LlamaConfig):
+            return llama
+        from ray_tpu.models import gpt
+
+        if isinstance(model_cfg, gpt.GPTConfig):
+            return gpt
+        raise TypeError(
+            f"unsupported model config {type(model_cfg).__name__}; "
+            "expected LlamaConfig or GPTConfig")
 
     # --- optimizer (AdamW + cosine schedule + clip, the Llama recipe) ---
 
@@ -137,13 +163,13 @@ class JaxTrainer:
     # --- state ---
 
     def _make_state_fn(self, key):
-        params = llama.init_params(self.model_cfg, key)
+        params = self.family.init_params(self.model_cfg, key)
         return TrainState.create(params, self.optimizer)
 
     def _state_axes(self) -> TrainState:
         """Abstract-eval a state skeleton to derive per-leaf logical axes
         (optimizer moments inherit their param's axes — ZeRO-style)."""
-        param_axes = llama.param_logical_axes(self.model_cfg)
+        param_axes = self.family.param_logical_axes(self.model_cfg)
         return state_logical_axes(self.abstract_state(), param_axes)
 
     def _axes_to_sharding(self, ax) -> NamedSharding:
@@ -181,6 +207,12 @@ class JaxTrainer:
         inputs = batch[:, :-1]
         targets = batch[:, 1:]
         mask = (targets != -1).astype(jnp.float32)
+        if self.family is not llama:
+            logits = self.family.forward(
+                self.model_cfg, params, inputs, segment_ids=segment_ids,
+                attn_impl=self.attn_impl)
+            return llama.cross_entropy_loss(
+                logits, jnp.maximum(targets, 0), mask=mask)
         if self.cfg.fused_loss:
             hidden = llama.forward_hidden(
                 self.model_cfg, params, inputs, segment_ids=segment_ids,

@@ -61,6 +61,22 @@ def test_dashboard_endpoints(rt):
         dash.stop()
 
 
+def test_dashboard_spa_served(rt):
+    """`/` serves the packaged single-page app (reference analog:
+    dashboard/client React UI), not just an API listing."""
+    from ray_tpu.dashboard import Dashboard
+
+    dash = Dashboard(port=0).start()
+    try:
+        status, body = _get(dash.url + "/")
+        assert status == 200
+        for marker in (b"ray_tpu dashboard", b'id="tabs"',
+                       b"placement_groups", b"sparkline", b"/api/"):
+            assert marker in body, marker
+    finally:
+        dash.stop()
+
+
 def test_dashboard_404(rt):
     from ray_tpu.dashboard import Dashboard
 

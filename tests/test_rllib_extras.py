@@ -1,0 +1,196 @@
+"""Tests for the round-3 RLlib breadth: PG/A2C, ES/ARS, MARWIL, bandits.
+
+Reference analogs: per-algorithm tests under
+``rllib/algorithms/{pg,a2c,es,ars,marwil,bandit}/tests/``.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (
+    A2CConfig,
+    ARSConfig,
+    BanditLinTSConfig,
+    BanditLinUCBConfig,
+    ESConfig,
+    MARWILConfig,
+    PGConfig,
+    collect_dataset,
+)
+
+
+@pytest.fixture
+def rt(ray_tpu_start):
+    return ray_tpu_start
+
+
+def _train_until(algo, target, max_iters):
+    last = -np.inf
+    for _ in range(max_iters):
+        last = algo.train()["episode_return_mean"]
+        if last >= target:
+            break
+    return last
+
+
+def test_a2c_learns_bandit(rt):
+    algo = (A2CConfig()
+            .environment("Bandit-v0")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=256)
+            .training(lr=0.02)
+            .build())
+    try:
+        assert _train_until(algo, 0.85, 30) >= 0.85
+    finally:
+        algo.stop()
+
+
+def test_pg_learns_bandit(rt):
+    algo = (PGConfig()
+            .environment("Bandit-v0")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=256)
+            .training(lr=0.02)
+            .build())
+    try:
+        assert _train_until(algo, 0.85, 40) >= 0.85
+    finally:
+        algo.stop()
+
+
+def test_a2c_save_restore(rt, tmp_path):
+    algo = A2CConfig().environment("Bandit-v0").build()
+    try:
+        algo.train()
+        path = str(tmp_path / "a2c.pkl")
+        algo.save(path)
+        fresh = A2CConfig().environment("Bandit-v0").build()
+        try:
+            fresh.restore(path)
+            obs = np.array([1.0, -1.0], dtype=np.float32)
+            assert fresh.compute_action(obs) == algo.compute_action(obs)
+        finally:
+            fresh.stop()
+    finally:
+        algo.stop()
+
+
+def test_es_learns_bandit(rt):
+    algo = (ESConfig()
+            .environment("Bandit-v0")
+            .rollouts(num_rollout_workers=2)
+            .training(episodes_per_batch=32, sigma=0.3, lr=0.1,
+                      hidden=0, episodes_per_direction=10)
+            .build())
+    try:
+        last = -np.inf
+        for _ in range(30):
+            last = algo.train()["episode_return_mean"]
+            if last >= 0.8:
+                break
+        assert last >= 0.8
+        # deterministic eval should match or beat perturbed returns
+        assert algo.evaluate(8)["episode_return_mean"] >= 0.8
+    finally:
+        algo.stop()
+
+
+def test_ars_learns_bandit(rt):
+    algo = (ARSConfig()
+            .environment("Bandit-v0")
+            .rollouts(num_rollout_workers=2)
+            .training(episodes_per_batch=32, sigma=0.3, lr=0.2,
+                      top_k=8, episodes_per_direction=10)
+            .build())
+    try:
+        last = -np.inf
+        for _ in range(30):
+            last = algo.train()["episode_return_mean"]
+            if last >= 0.8:
+                break
+        assert last >= 0.8
+    finally:
+        algo.stop()
+
+
+def test_es_save_restore(rt, tmp_path):
+    algo = ESConfig().environment("Bandit-v0").training(hidden=0).build()
+    try:
+        algo.train()
+        path = str(tmp_path / "es")
+        algo.save(path)
+        fresh = (ESConfig().environment("Bandit-v0")
+                 .training(hidden=0).build())
+        try:
+            fresh.restore(path)
+            np.testing.assert_allclose(fresh.theta, algo.theta)
+        finally:
+            fresh.stop()
+    finally:
+        algo.stop()
+
+
+def test_marwil_beats_random_behavior(tmp_path):
+    """MARWIL on a mixed-quality CartPole dataset must beat the random
+    behavior policy it was trained from (advantage weighting should
+    upweight the lucky long episodes)."""
+    path = collect_dataset("CartPole-v1", str(tmp_path / "ds"),
+                           num_steps=4000, seed=0)
+    algo = (MARWILConfig()
+            .environment("CartPole-v1")
+            .offline_data(path)
+            .training(lr=3e-3, beta=1.0, batch_size=512)
+            .build())
+    try:
+        for _ in range(150):
+            result = algo.train()
+        assert np.isfinite(result["policy_loss"])
+        # random CartPole averages ~22 steps; cloned+reweighted should
+        # hold the pole visibly longer
+        assert algo.evaluate(10)["episode_return_mean"] >= 35.0
+    finally:
+        algo.stop()
+
+
+def test_marwil_beta_zero_is_bc(tmp_path):
+    """beta=0 -> uniform weights (pure behavior cloning)."""
+    path = collect_dataset("Bandit-v0", str(tmp_path / "ds"),
+                           num_steps=512, seed=1)
+    algo = (MARWILConfig().environment("Bandit-v0")
+            .offline_data(path).training(beta=0.0).build())
+    try:
+        result = algo.train()
+        assert result["mean_adv_weight"] == pytest.approx(1.0)
+    finally:
+        algo.stop()
+
+
+def test_linucb_learns_bandit():
+    algo = (BanditLinUCBConfig().environment("Bandit-v0")
+            .training(steps_per_iteration=200).build())
+    r1 = algo.train()["episode_return_mean"]
+    r2 = algo.train()["episode_return_mean"]
+    # after 200 pulls the linear model has the structure nailed
+    assert r2 >= 0.9
+    assert r2 >= r1 - 0.05
+    assert sum(algo.train()["arm_pulls"]) == 600
+
+
+def test_lints_learns_bandit():
+    algo = (BanditLinTSConfig().environment("Bandit-v0")
+            .training(steps_per_iteration=200, alpha=0.5).build())
+    algo.train()
+    assert algo.train()["episode_return_mean"] >= 0.85
+
+
+def test_linucb_save_restore(tmp_path):
+    algo = (BanditLinUCBConfig().environment("Bandit-v0")
+            .training(steps_per_iteration=100).build())
+    algo.train()
+    path = str(tmp_path / "ucb")
+    algo.save(path)
+    fresh = BanditLinUCBConfig().environment("Bandit-v0").build()
+    fresh.restore(path)
+    for obs in ([1.0, 1.0], [-1.0, 1.0]):
+        x = np.asarray(obs)
+        assert fresh.compute_action(x) == algo.compute_action(x)
