@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# CI pipeline for ray_tpu (reference analog: the reference's ci/ +
+# .buildkite pipelines — lint, C++ build + sanitizer suites, Python
+# tests, multi-chip dryrun). Run locally with `bash ci/run_ci.sh`;
+# .github/workflows/ci.yml invokes the same stages.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+stage() { echo; echo "=== CI stage: $1 ==="; }
+
+stage "lint (syntax + bytecode compile of every source)"
+python -m compileall -q ray_tpu tests bench.py __graft_entry__.py
+
+stage "native build (shm store, collectives, scheduler, capi, crc)"
+make -C src -j"$(nproc)" all
+
+stage "native sanitizer suites (ASan + TSan on the shm store)"
+make -C src sanitizers
+
+stage "python unit + integration tests"
+python -m pytest tests/ -x -q
+
+stage "multi-chip dryrun (virtual 8-device mesh: fsdp_tp/sp/ep/pp/hybrid)"
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+stage "single-chip compile check of the flagship entry"
+JAX_PLATFORMS=cpu python - <<'EOF'
+import jax
+import __graft_entry__ as g
+fn, args = g.entry()
+jax.jit(fn).lower(*args).compile()
+print("entry() compiles")
+EOF
+
+echo
+echo "CI: all stages green"

@@ -22,7 +22,9 @@ from ray_tpu.data.dataset import (
     read_parquet,
     read_text,
     read_tfrecords,
+    read_avro,
     read_webdataset,
+    write_avro_file,
     write_tfrecords_file,
 )
 from ray_tpu.data.execution import ExecutionOptions, StreamingExecutor
@@ -51,6 +53,8 @@ __all__ = [
     "read_parquet",
     "read_text",
     "read_tfrecords",
+    "read_avro",
     "read_webdataset",
+    "write_avro_file",
     "write_tfrecords_file",
 ]

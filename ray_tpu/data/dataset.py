@@ -804,6 +804,37 @@ def write_tfrecords_file(rows, path: str):
             f.write(_tfr.frame_record(_tfr.build_example(row)))
 
 
+def read_avro(paths, *, num_blocks: int = 8) -> Dataset:
+    """Avro object container files → one dict row per record
+    (reference: ``datasource/avro_datasource.py``). Decoded WITHOUT an
+    avro library — see ``ray_tpu.data.avro`` for the binary codec
+    (null + deflate codecs). pyarrow.fs URIs work like every other
+    reader."""
+    from ray_tpu.data import avro as _avro
+
+    if isinstance(paths, str):
+        paths = [paths]
+
+    def source():
+        rows = []
+        for p in paths:
+            with _open_path(p, "rb") as f:
+                rows.extend(_avro.iter_avro(f.read()))
+        return from_items(rows, num_blocks=num_blocks)._source_fn()
+    return Dataset(source)
+
+
+def write_avro_file(rows, path: str, *, schema: dict | None = None,
+                    codec: str = "null"):
+    """Write dict rows to ONE avro container file (schema inferred from
+    the first row when omitted; single-file helper mirroring
+    ``write_tfrecords_file``)."""
+    from ray_tpu.data import avro as _avro
+
+    with _open_path(path, "wb") as f:
+        f.write(_avro.write_avro(rows, schema, codec=codec))
+
+
 def read_webdataset(paths, *, num_blocks: int = 8) -> Dataset:
     """WebDataset tar shards → one dict row per sample (reference:
     ``datasource/webdataset_datasource.py``): files grouped by basename

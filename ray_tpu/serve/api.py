@@ -88,10 +88,56 @@ def deployment(cls=None, *, name=None, num_replicas=1,
     return wrap(cls) if cls is not None else wrap
 
 
-def run(dep: Deployment, *, name: str | None = None) -> DeploymentHandle:
+class DeploymentRef:
+    """Picklable placeholder for a nested deployment in init args; the
+    replica resolves it into a live DeploymentHandle at construction
+    (reference: deployment-graph composition — passing one bound
+    deployment into another's ``.bind()``)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+def _deploy_nested(value, seen: dict):
+    """Depth-first deploy of Deployment objects found in init args;
+    returns the value with each replaced by a DeploymentRef. ``seen``
+    maps deployment name -> the Deployment node already deployed under
+    it; two distinct bind nodes sharing a name is an error (they would
+    silently alias to one deployment), so composition with the same
+    class twice requires ``.options(name=...)``."""
+    if isinstance(value, Deployment):
+        prior = seen.get(value.name)
+        if prior is None:
+            seen[value.name] = value
+            run(value, _seen=seen)
+        elif prior is not value and not (
+                prior._cls is value._cls
+                and prior._init_args == value._init_args
+                and prior._init_kwargs == value._init_kwargs):
+            raise ValueError(
+                f"two different deployments named {value.name!r} in one "
+                "graph; disambiguate with .options(name=...)")
+        return DeploymentRef(value.name)
+    if isinstance(value, (list, tuple)):
+        return type(value)(_deploy_nested(v, seen) for v in value)
+    if isinstance(value, dict):
+        return {k: _deploy_nested(v, seen) for k, v in value.items()}
+    return value
+
+
+def run(dep: Deployment, *, name: str | None = None,
+        _seen: set | None = None) -> DeploymentHandle:
     """Deploy (or redeploy) and return a handle (reference: serve.run:463).
-    """
+
+    Composition: any ``Deployment`` nested in the bound init args (incl.
+    inside lists/dicts) is deployed first and the replica receives a
+    live ``DeploymentHandle`` in its place — the deployment-graph
+    pattern (``outer.bind(inner.bind())``)."""
     controller = _get_or_start_controller()
+    seen = _seen if _seen is not None else {(name or dep.name): dep}
+    dep = Deployment(dep._cls, dep.name, dep.config,
+                     _deploy_nested(list(dep._init_args), seen),
+                     _deploy_nested(dict(dep._init_kwargs), seen))
     auto = dep.config.autoscaling
     cfg = {
         "num_replicas": dep.config.num_replicas,

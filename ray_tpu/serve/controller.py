@@ -27,6 +27,9 @@ class _Replica:
         import cloudpickle
 
         cls = cloudpickle.loads(cls_blob)
+        init_args = [self._resolve_refs(a) for a in init_args]
+        init_kwargs = {k: self._resolve_refs(v)
+                       for k, v in init_kwargs.items()}
         self._instance = cls(*init_args, **init_kwargs)
         if user_config and hasattr(self._instance, "reconfigure"):
             self._instance.reconfigure(user_config)
@@ -35,6 +38,21 @@ class _Replica:
         self._total = 0
         self._streams: dict = {}
         self._stream_errors: dict = {}
+
+    @staticmethod
+    def _resolve_refs(value):
+        """DeploymentRef placeholders (deployment-graph composition)
+        become live handles inside the replica."""
+        from ray_tpu.serve.api import DeploymentRef, get_deployment_handle
+
+        if isinstance(value, DeploymentRef):
+            return get_deployment_handle(value.name)
+        if isinstance(value, (list, tuple)):
+            return type(value)(_Replica._resolve_refs(v) for v in value)
+        if isinstance(value, dict):
+            return {k: _Replica._resolve_refs(v)
+                    for k, v in value.items()}
+        return value
 
     async def handle_request(self, method_name, args, kwargs):
         """ASYNC handler: replicas are asyncio actors (the coroutine here

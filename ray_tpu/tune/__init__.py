@@ -16,6 +16,7 @@ from ray_tpu.tune.schedulers import (
 from ray_tpu.tune.search import (
     BOHBSearcher,
     BasicVariantGenerator,
+    BayesOptSearcher,
     ConcurrencyLimiter,
     Searcher,
     TPESearcher,
@@ -30,6 +31,7 @@ from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
 __all__ = [
     "AsyncHyperBandScheduler",
     "BOHBSearcher",
+    "BayesOptSearcher",
     "BasicVariantGenerator",
     "ConcurrencyLimiter",
     "HyperBandScheduler",

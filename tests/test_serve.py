@@ -325,3 +325,93 @@ def test_streaming_chunks_before_error_delivered(rt):
             got.append(c)
     assert got == ["a", "b"]
     serve.delete("partial")
+
+
+def test_deployment_graph_composition(rt):
+    """Deployment-graph composition (reference: serve deployment graphs —
+    passing one bound deployment into another's .bind()): serve.run on
+    the outer node deploys the whole graph, and the replica receives
+    live handles for nested deployments."""
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Model:
+        def __init__(self, prefix):
+            self.prefix = prefix
+
+        def __call__(self, x):
+            return f"{self.prefix}:{x}"
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, pre, model):
+            self.pre = pre
+            self.model = model
+
+        def __call__(self, x):
+            y = ray_tpu.get(self.pre.remote(x))
+            return ray_tpu.get(self.model.remote(y))
+
+    handle = serve.run(Pipeline.bind(Preprocess.bind(),
+                                     Model.bind("out")))
+    assert handle.call(21) == "out:42"
+    # all three deployments are live and individually addressable
+    st = serve.status()
+    assert {"Pipeline", "Preprocess", "Model"} <= set(st["deployments"])
+    inner = serve.get_deployment_handle("Preprocess")
+    assert inner.call(5) == 10
+
+
+def test_deployment_graph_nested_in_containers(rt):
+    """Nested deployments inside lists/dicts of init args resolve too."""
+    @serve.deployment
+    class Leaf:
+        def __init__(self, k):
+            self.k = k
+
+        def __call__(self):
+            return self.k
+
+    @serve.deployment
+    class Fanout:
+        def __init__(self, legs):
+            self.legs = legs
+
+        def __call__(self):
+            return sorted(ray_tpu.get([h.remote() for h in
+                                       self.legs.values()]))
+
+    handle = serve.run(Fanout.bind(
+        {"a": Leaf.options(name="LeafA").bind(1),
+         "b": Leaf.options(name="LeafB").bind(2)}))
+    assert handle.call() == [1, 2]
+
+
+def test_deployment_graph_name_collision_rejected(rt):
+    """Two DIFFERENT bind nodes under one name must raise, not silently
+    alias to whichever deployed first."""
+    @serve.deployment
+    class Leaf:
+        def __init__(self, k):
+            self.k = k
+
+        def __call__(self):
+            return self.k
+
+    @serve.deployment
+    class Fanout:
+        def __init__(self, legs):
+            self.legs = legs
+
+        def __call__(self):
+            return [ray_tpu.get(h.remote()) for h in self.legs]
+
+    with pytest.raises(ValueError, match="disambiguate"):
+        serve.run(Fanout.bind([Leaf.bind(1), Leaf.bind(2)]))
+    # identical bind nodes under one name are fine (true sharing)
+    shared = Leaf.bind(7)
+    handle = serve.run(Fanout.bind([shared, shared]))
+    assert handle.call() == [7, 7]
