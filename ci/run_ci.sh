@@ -14,8 +14,10 @@ python -m compileall -q ray_tpu tests bench.py __graft_entry__.py
 stage "native build (shm store, collectives, scheduler, capi, crc)"
 make -C src -j"$(nproc)" all
 
-stage "native sanitizer suites (ASan + TSan on the shm store)"
-make -C src sanitizers
+if [ "${SKIP_SANITIZERS:-0}" != "1" ]; then
+  stage "native sanitizer suites (ASan + TSan on the shm store)"
+  make -C src sanitizers
+fi
 
 stage "python unit + integration tests"
 python -m pytest tests/ -x -q

@@ -177,6 +177,10 @@ def _encode(schema, value, out: io.BytesIO, names: dict):
             _write_long(out, schema["symbols"].index(value))
             return
         if t == "fixed":
+            if len(value) != schema["size"]:
+                raise ValueError(
+                    f"fixed {schema.get('name', '?')} wants "
+                    f"{schema['size']} bytes, got {len(value)}")
             out.write(value)
             return
         if t == "array":
@@ -204,7 +208,13 @@ def _encode(schema, value, out: io.BytesIO, names: dict):
     if schema == "boolean":
         out.write(b"\x01" if value else b"\x00")
     elif schema in ("int", "long"):
-        _write_long(out, int(value))
+        if not isinstance(value, int) or isinstance(value, bool):
+            # int(2.7) would silently truncate — schema/value drift
+            # (e.g. a float in a column inferred as long) must surface
+            raise TypeError(
+                f"avro {schema} field got {type(value).__name__} "
+                f"{value!r}")
+        _write_long(out, value)
     elif schema == "float":
         out.write(struct.pack("<f", float(value)))
     elif schema == "double":
@@ -288,11 +298,12 @@ def iter_avro(data: bytes):
 
 
 def infer_schema(row: dict, *, name: str = "row") -> dict:
-    """Record schema from a sample row (None → nullable union; int →
-    long, float → double)."""
+    """Record schema from a sample row (None → a wide nullable union so
+    later rows can hold any primitive; int → long, float → double)."""
     def typeof(v):
         if v is None:
-            return ["null", "string"]
+            return ["null", "boolean", "long", "double", "bytes",
+                    "string"]
         if isinstance(v, bool):
             return "boolean"
         if isinstance(v, int):

@@ -138,6 +138,34 @@ def cmd_memory(args):
             print(f"{n['node_id'][:12]}: unreachable")
 
 
+def cmd_serve_deploy(args):
+    """Apply a declarative serve config (reference: ``serve deploy``)."""
+    import ray_tpu
+    from ray_tpu.serve.schema import apply_config_file
+
+    if args.address:
+        ray_tpu.init(address=args.address)
+    else:
+        ray_tpu.init(num_cpus=args.num_cpus)
+    handles = apply_config_file(args.config)
+    for name in handles:
+        print(f"deployed {name}")
+    if args.block:
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+
+
+def cmd_serve_status(args):
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(address=args.address)
+    print(json.dumps(serve.status(), indent=2, default=str))
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="ray-tpu", description="ray_tpu cluster CLI")
@@ -190,6 +218,20 @@ def main(argv=None):
     p.add_argument("--address", required=True)
     p.add_argument("--output", "-o")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("serve-deploy",
+                       help="apply a declarative serve config (YAML)")
+    p.add_argument("config")
+    p.add_argument("--address", help="GCS host:port (omit for local)")
+    p.add_argument("--num-cpus", type=float,
+                   default=float(os.cpu_count() or 1))
+    p.add_argument("--block", action="store_true",
+                   help="keep the process (and local cluster) alive")
+    p.set_defaults(fn=cmd_serve_deploy)
+
+    p = sub.add_parser("serve-status", help="serve deployment status")
+    p.add_argument("--address", required=True)
+    p.set_defaults(fn=cmd_serve_status)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -19,11 +19,14 @@ from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.ingress import grpc_call, ingress, start_grpc_proxy
+from ray_tpu.serve.schema import apply_config, apply_config_file
 
 __all__ = [
     "AutoscalingConfig",
     "DeploymentConfig",
     "DeploymentHandle",
+    "apply_config",
+    "apply_config_file",
     "batch",
     "delete",
     "deployment",

@@ -118,8 +118,13 @@ def _deploy_nested(value, seen: dict):
                 f"two different deployments named {value.name!r} in one "
                 "graph; disambiguate with .options(name=...)")
         return DeploymentRef(value.name)
-    if isinstance(value, (list, tuple)):
-        return type(value)(_deploy_nested(v, seen) for v in value)
+    if isinstance(value, tuple):
+        walked = [_deploy_nested(v, seen) for v in value]
+        # namedtuples construct positionally, not from an iterable
+        return (type(value)(*walked) if hasattr(value, "_fields")
+                else tuple(walked))
+    if isinstance(value, list):
+        return [_deploy_nested(v, seen) for v in value]
     if isinstance(value, dict):
         return {k: _deploy_nested(v, seen) for k, v in value.items()}
     return value

@@ -47,8 +47,13 @@ class _Replica:
 
         if isinstance(value, DeploymentRef):
             return get_deployment_handle(value.name)
-        if isinstance(value, (list, tuple)):
-            return type(value)(_Replica._resolve_refs(v) for v in value)
+        if isinstance(value, tuple):
+            walked = [_Replica._resolve_refs(v) for v in value]
+            # namedtuples construct positionally, not from an iterable
+            return (type(value)(*walked) if hasattr(value, "_fields")
+                    else tuple(walked))
+        if isinstance(value, list):
+            return [_Replica._resolve_refs(v) for v in value]
         if isinstance(value, dict):
             return {k: _Replica._resolve_refs(v)
                     for k, v in value.items()}

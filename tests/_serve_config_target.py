@@ -1,0 +1,22 @@
+"""Import target for serve declarative-config tests (the module an
+``import_path`` in a config YAML points at)."""
+
+from ray_tpu import serve
+
+
+@serve.deployment
+class Greeter:
+    def __init__(self, greeting: str = "hello"):
+        self.greeting = greeting
+
+    def __call__(self, name: str) -> str:
+        return f"{self.greeting} {name}"
+
+
+greeter = Greeter  # plain Deployment (unbound)
+bound_greeter = Greeter.bind("hi")
+
+
+from collections import namedtuple
+
+Point = namedtuple("Point", "x y")

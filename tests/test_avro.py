@@ -117,7 +117,8 @@ def test_infer_schema_types():
     assert by_name["z"] == "bytes"
     assert by_name["l"] == {"type": "array", "items": "long"}
     assert by_name["m"] == {"type": "map", "values": "string"}
-    assert by_name["n"] == ["null", "string"]
+    assert by_name["n"] == ["null", "boolean", "long", "double",
+                            "bytes", "string"]
 
 
 def test_read_avro_dataset(tmp_path):
@@ -137,3 +138,24 @@ def test_deflate_is_raw_rfc1951():
     # find first block payload: after magic+meta+sync
     # (we only check the writer used raw deflate by re-reading)
     assert list(iter_avro(data)) == _rows()
+
+
+def test_long_schema_rejects_float_drift():
+    """A float sneaking into a column inferred as long must raise, not
+    silently truncate."""
+    with pytest.raises(TypeError, match="long"):
+        write_avro([{"x": 1}, {"x": 2.7}])
+
+
+def test_fixed_length_validated():
+    schema = {"type": "record", "name": "r", "fields": [
+        {"name": "sig", "type": {"type": "fixed", "name": "Sig",
+                                 "size": 4}}]}
+    with pytest.raises(ValueError, match="4 bytes"):
+        write_avro([{"sig": b"abc"}], schema)
+
+
+def test_null_first_row_column_holds_any_primitive():
+    rows = [{"a": None}, {"a": 5}, {"a": 2.5}, {"a": "s"},
+            {"a": True}, {"a": b"b"}]
+    assert list(iter_avro(write_avro(rows))) == rows

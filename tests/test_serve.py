@@ -415,3 +415,83 @@ def test_deployment_graph_name_collision_rejected(rt):
     shared = Leaf.bind(7)
     handle = serve.run(Fanout.bind([shared, shared]))
     assert handle.call() == [7, 7]
+
+
+def test_apply_config_dict(rt):
+    """Declarative config → live deployments (reference: serve/schema.py
+    + REST config)."""
+    handles = serve.apply_config({
+        "applications": [{
+            "name": "app",
+            "deployments": [{
+                "name": "G2",
+                "import_path": "tests._serve_config_target:greeter",
+                "init_args": ["yo"],
+                "num_replicas": 2,
+            }],
+        }],
+    })
+    assert set(handles) == {"G2"}
+    assert handles["G2"].call("x") == "yo x"
+    meta = serve.status()["deployments"]["G2"]
+    assert meta["target"] == 2 or meta.get("num_replicas") == 2, meta
+
+
+def test_apply_config_file_yaml(rt, tmp_path):
+    cfg = tmp_path / "serve.yaml"
+    cfg.write_text(
+        "deployments:\n"
+        "  - import_path: tests._serve_config_target:bound_greeter\n"
+        "    user_config: {}\n")
+    handles = serve.apply_config_file(str(cfg))
+    assert handles["Greeter"].call("there") == "hi there"
+
+
+def test_apply_config_validation_errors(rt):
+    with pytest.raises(ValueError, match="import_path is required"):
+        serve.apply_config({"deployments": [{"name": "X"}]})
+    with pytest.raises(ValueError, match="unknown field"):
+        serve.apply_config({"deployments": [
+            {"import_path": "tests._serve_config_target:greeter",
+             "replicas": 2}]})
+    with pytest.raises(ValueError, match="expected a @serve.deployment"):
+        serve.apply_config({"deployments": [
+            {"import_path": "tests._serve_config_target:serve"}]})
+
+
+def test_cli_serve_deploy_and_status(tmp_path, capsys):
+    """`ray-tpu serve-deploy config.yaml` end to end (local mode)."""
+    from ray_tpu.scripts.cli import main
+
+    cfg = tmp_path / "serve.yaml"
+    cfg.write_text(
+        "deployments:\n"
+        "  - import_path: tests._serve_config_target:greeter\n"
+        "    init_args: [hey]\n")
+    try:
+        main(["serve-deploy", str(cfg), "--num-cpus", "4"])
+        out = capsys.readouterr().out
+        assert "deployed Greeter" in out
+        assert serve.get_deployment_handle("Greeter").call("u") == "hey u"
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_namedtuple_init_args_survive_graph_walk(rt):
+    """Plain structured init args (incl. namedtuples) pass through the
+    deployment-graph walker untouched."""
+    from tests._serve_config_target import Point
+
+    @serve.deployment
+    class Holder:
+        def __init__(self, p, coords):
+            self.p = p
+            self.coords = coords
+
+        def __call__(self):
+            return (type(self.p).__name__, self.p.x + self.p.y,
+                    self.coords)
+
+    handle = serve.run(Holder.bind(Point(1, 2), (3, 4)))
+    assert handle.call() == ("Point", 3, (3, 4))
