@@ -27,6 +27,8 @@ import os
 import struct
 import zlib
 
+import numpy as _np
+
 MAGIC = b"Obj\x01"
 SYNC_SIZE = 16
 
@@ -208,6 +210,8 @@ def _encode(schema, value, out: io.BytesIO, names: dict):
     if schema == "boolean":
         out.write(b"\x01" if value else b"\x00")
     elif schema in ("int", "long"):
+        if isinstance(value, _np.integer):
+            value = int(value)  # numpy integer scalars are lossless
         if not isinstance(value, int) or isinstance(value, bool):
             # int(2.7) would silently truncate — schema/value drift
             # (e.g. a float in a column inferred as long) must surface
@@ -234,11 +238,12 @@ def _matches(schema, value, names) -> bool:
     if t == "null":
         return value is None
     if t == "boolean":
-        return isinstance(value, bool)
+        return isinstance(value, (bool, _np.bool_))
     if t in ("int", "long"):
-        return isinstance(value, int) and not isinstance(value, bool)
+        return (isinstance(value, (int, _np.integer))
+                and not isinstance(value, (bool, _np.bool_)))
     if t in ("float", "double"):
-        return isinstance(value, float)
+        return isinstance(value, (float, _np.floating))
     if t == "bytes" or t == "fixed":
         return isinstance(value, (bytes, bytearray))
     if t == "string":
@@ -304,11 +309,11 @@ def infer_schema(row: dict, *, name: str = "row") -> dict:
         if v is None:
             return ["null", "boolean", "long", "double", "bytes",
                     "string"]
-        if isinstance(v, bool):
+        if isinstance(v, (bool, _np.bool_)):
             return "boolean"
-        if isinstance(v, int):
+        if isinstance(v, (int, _np.integer)):
             return "long"
-        if isinstance(v, float):
+        if isinstance(v, (float, _np.floating)):
             return "double"
         if isinstance(v, (bytes, bytearray)):
             return "bytes"

@@ -1,0 +1,215 @@
+"""BERT-family bidirectional encoder, TPU-first.
+
+Encoder model family next to the decoder families (llama/gpt) and
+vision (vit) — covers masked-LM pretraining and sequence embedding
+(reference parity: the reference trains BERT-class models through its
+Train/Transformers integrations; here the family is in-framework).
+
+Architecture: learned absolute positions + token-type embeddings,
+post-LN transformer blocks (the original BERT residual order), GELU
+MLP, weight-tied MLM head over the final hidden states. Same TPU
+conventions as the other families: stacked per-layer arrays under one
+``lax.scan`` body, a logical-axis pytree for the sharding presets, bf16
+params with fp32 norms/logits.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.llama import fanin_init, num_params
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.norms import layer_norm
+
+__all__ = ["BertConfig", "bert_base", "bert_large", "bert_tiny",
+           "param_logical_axes", "init_params", "encode", "mlm_logits",
+           "mlm_loss", "num_params"]
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    ln_eps: float = 1e-12
+    dtype: str = "bfloat16"
+    remat: str = "none"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def param_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def bert_base() -> BertConfig:
+    return BertConfig()
+
+
+def bert_large() -> BertConfig:
+    return BertConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
+                      remat="full")
+
+
+def bert_tiny(vocab_size: int = 512) -> BertConfig:
+    return BertConfig(vocab_size=vocab_size, max_seq_len=128, d_model=128,
+                      n_layers=2, n_heads=4, d_ff=256)
+
+
+def param_logical_axes(cfg: BertConfig) -> dict:
+    block = {
+        "wqkv": ("layers", "embed", "heads"),
+        "bqkv": ("layers", "heads"),
+        "wo": ("layers", "heads", "embed"),
+        "bo": ("layers", "embed"),
+        "ln1_w": ("layers", "embed"),
+        "ln1_b": ("layers", "embed"),
+        "w_up": ("layers", "embed", "mlp"),
+        "b_up": ("layers", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+        "b_down": ("layers", "embed"),
+        "ln2_w": ("layers", "embed"),
+        "ln2_b": ("layers", "embed"),
+    }
+    return {
+        "embedding": ("vocab", "embed"),
+        "pos_embedding": (None, "embed"),
+        "type_embedding": (None, "embed"),
+        "emb_ln_w": ("embed",),
+        "emb_ln_b": ("embed",),
+        "blocks": block,
+        "mlm_dense_w": ("embed", "embed"),
+        "mlm_dense_b": ("embed",),
+        "mlm_ln_w": ("embed",),
+        "mlm_ln_b": ("embed",),
+        "mlm_bias": ("vocab",),
+    }
+
+
+def init_params(cfg: BertConfig, key) -> dict:
+    dt = cfg.param_dtype
+    d, l = cfg.d_model, cfg.n_layers
+    keys = jax.random.split(key, 8)
+
+    def dense(k, shape, fan_in):
+        return fanin_init(k, shape, fan_in).astype(dt)
+
+    blocks = {
+        "wqkv": dense(keys[0], (l, d, 3 * d), d),
+        "bqkv": jnp.zeros((l, 3 * d), dtype=dt),
+        "wo": dense(keys[1], (l, d, d), d),
+        "bo": jnp.zeros((l, d), dtype=dt),
+        "ln1_w": jnp.ones((l, d), dtype=dt),
+        "ln1_b": jnp.zeros((l, d), dtype=dt),
+        "w_up": dense(keys[2], (l, d, cfg.d_ff), d),
+        "b_up": jnp.zeros((l, cfg.d_ff), dtype=dt),
+        "w_down": dense(keys[3], (l, cfg.d_ff, d), cfg.d_ff),
+        "b_down": jnp.zeros((l, d), dtype=dt),
+        "ln2_w": jnp.ones((l, d), dtype=dt),
+        "ln2_b": jnp.zeros((l, d), dtype=dt),
+    }
+    return {
+        "embedding": dense(keys[4], (cfg.vocab_size, d), d),
+        "pos_embedding": dense(keys[5], (cfg.max_seq_len, d), d) * 0.1,
+        "type_embedding": dense(keys[6], (cfg.type_vocab_size, d), d) * 0.1,
+        "emb_ln_w": jnp.ones((d,), dtype=dt),
+        "emb_ln_b": jnp.zeros((d,), dtype=dt),
+        "blocks": blocks,
+        "mlm_dense_w": dense(keys[7], (d, d), d),
+        "mlm_dense_b": jnp.zeros((d,), dtype=dt),
+        "mlm_ln_w": jnp.ones((d,), dtype=dt),
+        "mlm_ln_b": jnp.zeros((d,), dtype=dt),
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), dtype=jnp.float32),
+    }
+
+
+def _block(cfg: BertConfig, x, p, attn_mask, attn_impl):
+    """Post-LN block: sublayer -> residual add -> LayerNorm."""
+    b, s, d = x.shape
+    qkv = x @ p["wqkv"] + p["bqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    # bidirectional attention; padding masked via segment_ids (pad
+    # tokens get segment 0, real tokens 1 — cross-segment is masked)
+    attn_out = attention(q, k, v, causal=False, segment_ids=attn_mask,
+                         impl=attn_impl)
+    attn_out = attn_out.reshape(b, s, d)
+    x = layer_norm(x + attn_out @ p["wo"] + p["bo"],
+                   p["ln1_w"], p["ln1_b"], eps=cfg.ln_eps)
+    up = jax.nn.gelu(x @ p["w_up"] + p["b_up"])
+    return layer_norm(x + up @ p["w_down"] + p["b_down"],
+                      p["ln2_w"], p["ln2_b"], eps=cfg.ln_eps)
+
+
+def encode(cfg: BertConfig, params: dict, tokens, *,
+           attention_mask=None, token_type_ids=None,
+           attn_impl: str = "auto"):
+    """Token ids [b, s] -> contextual hidden states [b, s, d].
+
+    ``attention_mask`` [b, s] in {0, 1} (1 = real token); padding can
+    neither attend nor be attended to.
+    """
+    b, s = tokens.shape
+    if s > cfg.max_seq_len:
+        raise ValueError(
+            f"sequence length {s} exceeds max_seq_len={cfg.max_seq_len}")
+    positions = jnp.arange(s, dtype=jnp.int32)[None, :]
+    x = params["embedding"][tokens] + params["pos_embedding"][positions]
+    if token_type_ids is not None:
+        x = x + params["type_embedding"][token_type_ids]
+    x = layer_norm(x, params["emb_ln_w"], params["emb_ln_b"],
+                   eps=cfg.ln_eps)
+
+    seg = (attention_mask.astype(jnp.int32)
+           if attention_mask is not None else None)
+    body = partial(_block, cfg, attn_mask=seg, attn_impl=attn_impl)
+    if cfg.remat == "full":
+        body = jax.checkpoint(body)
+
+    def scan_fn(x, layer_params):
+        return body(x, layer_params), None
+
+    x, _ = lax.scan(scan_fn, x, params["blocks"])
+    return x
+
+
+def mlm_logits(cfg: BertConfig, params: dict, hidden):
+    """MLM head: dense+GELU+LN then the tied embedding matrix."""
+    h = jax.nn.gelu(hidden @ params["mlm_dense_w"]
+                    + params["mlm_dense_b"])
+    h = layer_norm(h, params["mlm_ln_w"], params["mlm_ln_b"],
+                   eps=cfg.ln_eps)
+    return (jnp.einsum("bsd,vd->bsv", h, params["embedding"],
+                       preferred_element_type=jnp.float32)
+            + params["mlm_bias"])
+
+
+def mlm_loss(cfg: BertConfig, params: dict, tokens, targets, *,
+             attention_mask=None, loss_mask=None,
+             attn_impl: str = "auto"):
+    """Masked-LM cross entropy: ``targets`` are the ORIGINAL token ids;
+    ``loss_mask`` [b, s] selects the masked positions the loss covers
+    (the standard 15% MLM positions)."""
+    hidden = encode(cfg, params, tokens, attention_mask=attention_mask,
+                    attn_impl=attn_impl)
+    logits = mlm_logits(cfg, params, hidden)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None],
+                               axis=-1).squeeze(-1)
+    if loss_mask is None:
+        loss_mask = jnp.ones_like(nll)
+    loss_mask = loss_mask.astype(jnp.float32)
+    return (nll * loss_mask).sum() / jnp.maximum(loss_mask.sum(), 1.0)

@@ -94,8 +94,12 @@ def _build_one(spec: dict, where: str) -> "_api.Deployment":
         resources_per_replica=spec.get("resources_per_replica"),
     )
     if "init_args" in spec or "init_kwargs" in spec:
-        dep = dep.bind(*spec.get("init_args", ()),
-                       **spec.get("init_kwargs", {}))
+        # config args layer over whatever the import target bound:
+        # init_args replaces positionals only when present; init_kwargs
+        # merges over bound kwargs
+        args = spec.get("init_args", target._init_args)
+        kwargs = {**target._init_kwargs, **spec.get("init_kwargs", {})}
+        dep = dep.bind(*args, **kwargs)
     return dep
 
 
@@ -106,20 +110,38 @@ def apply_config(config: dict) -> dict:
     ``{"deployments": [...]}``."""
     if not isinstance(config, dict):
         raise ValueError("serve config must be a mapping")
+    unknown = set(config) - {"applications", "deployments"}
+    if unknown:
+        raise ValueError(
+            f"unknown top-level field(s) {sorted(unknown)}; expected "
+            "'applications' or 'deployments'")
     apps = config.get("applications")
     if apps is None:
+        if "deployments" not in config:
+            raise ValueError(
+                "config must contain 'applications' or 'deployments'")
         apps = [{"name": "default", "deployments":
                  config.get("deployments", [])}]
     handles: dict = {}
+    owner: dict = {}   # deployment name -> application that declared it
     for ai, app in enumerate(apps):
         if not isinstance(app, dict) or "deployments" not in app:
             raise ValueError(
                 f"applications[{ai}]: expected a mapping with a "
                 "'deployments' list")
+        app_name = app.get("name", f"applications[{ai}]")
         for di, spec in enumerate(app["deployments"]):
             where = (f"applications[{ai}].deployments[{di}]"
                      if "applications" in config else f"deployments[{di}]")
             dep = _build_one(spec, where)
+            if dep.name in owner:
+                # deployment names are cluster-global here: a second app
+                # reusing one would silently clobber the first
+                raise ValueError(
+                    f"{where}: deployment name {dep.name!r} already "
+                    f"declared by {owner[dep.name]!r}; rename one "
+                    "(names are global)")
+            owner[dep.name] = app_name
             handles[dep.name] = _api.run(dep)
     return handles
 

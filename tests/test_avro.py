@@ -159,3 +159,12 @@ def test_null_first_row_column_holds_any_primitive():
     rows = [{"a": None}, {"a": 5}, {"a": 2.5}, {"a": "s"},
             {"a": True}, {"a": b"b"}]
     assert list(iter_avro(write_avro(rows))) == rows
+
+
+def test_numpy_scalars_write_losslessly():
+    import numpy as np
+
+    rows = [{"i": np.int64(7), "f": np.float32(0.5),
+             "b": np.bool_(True)}]
+    got = list(iter_avro(write_avro(rows)))
+    assert got == [{"i": 7, "f": 0.5, "b": True}]

@@ -495,3 +495,31 @@ def test_namedtuple_init_args_survive_graph_walk(rt):
 
     handle = serve.run(Holder.bind(Point(1, 2), (3, 4)))
     assert handle.call() == ("Point", 3, (3, 4))
+
+
+def test_apply_config_top_level_typo_rejected(rt):
+    with pytest.raises(ValueError, match="unknown top-level"):
+        serve.apply_config({"deploymets": []})
+    with pytest.raises(ValueError, match="applications"):
+        serve.apply_config({})
+
+
+def test_apply_config_kwargs_only_keeps_bound_args(rt):
+    """init_kwargs in the config must not wipe the import target's
+    bound positional args."""
+    handles = serve.apply_config({"deployments": [{
+        "import_path": "tests._serve_config_target:bound_greeter",
+        "init_kwargs": {},
+    }]})
+    assert handles["Greeter"].call("k") == "hi k"
+
+
+def test_apply_config_cross_app_name_collision_rejected(rt):
+    cfg = {"applications": [
+        {"name": "a1", "deployments": [
+            {"import_path": "tests._serve_config_target:greeter"}]},
+        {"name": "a2", "deployments": [
+            {"import_path": "tests._serve_config_target:greeter"}]},
+    ]}
+    with pytest.raises(ValueError, match="already declared"):
+        serve.apply_config(cfg)
