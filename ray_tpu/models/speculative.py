@@ -1,0 +1,176 @@
+"""Greedy speculative decoding: draft proposes, target verifies.
+
+Net-new TPU-native capability (the reference serves models only through
+user code inside replicas — SURVEY.md P15). A small DRAFT model
+proposes ``k`` tokens autoregressively; the TARGET model scores all
+``k+1`` positions in ONE forward (prefill-shaped, MXU-friendly) and
+commits the longest matching prefix plus its own next token. With
+greedy acceptance the output is BIT-EXACT to the target's own greedy
+decode, for any draft — a bad draft only costs speed, never
+correctness. Wall-clock win ≈ (mean accepted + 1) target-forwards per
+round amortized over one verify pass.
+
+Everything is static-shaped and the whole loop is one
+``lax.while_loop`` program:
+
+- both KV caches advance by fixed-size chunk writes at per-row offsets
+  (stale entries past the accepted length are simply overwritten next
+  round — the slot convention of ``models.decoding.cached_forward``);
+- after verification the committed chunk is re-fed to the draft in one
+  (k+1)-token forward, which both repairs its cache to the committed
+  prefix and appends the entry a fully-accepted round needs;
+- per-row acceptance counts, EOS stops, and output writes are masks and
+  ``dynamic_update_slice`` — no recompiles across rounds.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.decoding import KVCache, cached_forward, init_cache
+
+
+def _prompt_lengths(prompts, pad_id):
+    p = prompts.shape[1]
+    positions = jnp.arange(p, dtype=jnp.int32)[None, :]
+    lens = jnp.max(jnp.where(prompts != pad_id, positions + 1, 0), axis=1)
+    return jnp.maximum(lens, 1)
+
+
+def speculative_generate(cfg_t, params_t, cfg_d, params_d, prompts, *,
+                         k_spec: int = 4, max_new_tokens: int = 128,
+                         eos_id: int | None = None, pad_id: int = 0,
+                         return_stats: bool = False):
+    """Greedy decode of the TARGET model, accelerated by a draft.
+
+    prompts [B, P] right-padded with ``pad_id``. Returns tokens
+    [B, max_new_tokens] (``pad_id`` after EOS), plus
+    ``{"rounds": int, "accepted": [B]}`` when ``return_stats``.
+    Guarantee: identical to ``decoding.generate`` with
+    ``SamplingParams(temperature=0, max_new_tokens=...)`` on the target.
+    """
+    b, p = prompts.shape
+    k = k_spec
+    prompt_lens = _prompt_lengths(prompts, pad_id)
+    max_total = p + max_new_tokens + k + 2
+
+    cache_t = init_cache(cfg_t, b, max_total)
+    cache_d = init_cache(cfg_d, b, max_total)
+
+    # Prefill both models; the target's last-position logits give the
+    # first pending token (exactly like decoding.generate).
+    zeros = jnp.zeros((b,), jnp.int32)
+    logits_t, cache_t = cached_forward(
+        cfg_t, params_t, prompts, cache_t, start=zeros,
+        logits_mode="index", logits_idx=prompt_lens - 1)
+    _, cache_d = cached_forward(
+        cfg_d, params_d, prompts, cache_d, start=zeros,
+        logits_mode="last")
+    pending = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+
+    # Both caches hold exactly the prompt; the invariant below is:
+    # cache length m = committed-token count - 1, and `pending` is the
+    # single committed-but-not-yet-fed token.
+    m0 = prompt_lens
+    out = jnp.full((b, max_new_tokens + k + 1), pad_id, dtype=jnp.int32)
+    done0 = ((pending == eos_id) if eos_id is not None
+             else jnp.zeros((b,), bool))
+    # the pending first token is emitted immediately
+    out = out.at[:, 0].set(pending)
+    o0 = jnp.ones((b,), jnp.int32)
+    state = (cache_t.k, cache_t.v, cache_d.k, cache_d.v, m0, pending,
+             out, o0, done0, jnp.zeros((), jnp.int32),
+             jnp.zeros((b,), jnp.int32))
+
+    def cond(state):
+        o, done = state[7], state[8]
+        return jnp.any(~done & (o < max_new_tokens))
+
+    def body(state):
+        (kt, vt, kd, vd, m, t0, out, o, done, rounds, acc) = state
+        cache_t = KVCache(k=kt, v=vt, lengths=m)
+        cache_d = KVCache(k=kd, v=vd, lengths=m)
+
+        # -- draft proposes k tokens, one at a time ------------------
+        def draft_step(carry, j):
+            tok, kd, vd = carry
+            logits, cd = cached_forward(
+                cfg_d, params_d, tok[:, None],
+                KVCache(k=kd, v=vd, lengths=m + j), logits_mode="last")
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, cd.k, cd.v), nxt
+
+        (_, kd, vd), draft_toks = lax.scan(
+            draft_step, (t0, cache_d.k, cache_d.v),
+            jnp.arange(k, dtype=jnp.int32))
+        d = draft_toks.T                     # [B, k] proposals d1..dk
+
+        # -- target verifies the whole chunk in one forward ----------
+        chunk = jnp.concatenate([t0[:, None], d], axis=1)   # [B, k+1]
+        logits_t, cache_t = cached_forward(
+            cfg_t, params_t, chunk, cache_t, start=m, logits_mode="all")
+        g = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)  # [B, k+1]
+
+        # accepted prefix length n = leading i with d[:, i] == g[:, i]
+        match = d == g[:, :k]
+        n = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        # committed this round: d1..dn then the target's own token
+        t0_new = jnp.take_along_axis(g, n[:, None], axis=1).squeeze(1)
+        # emitted chunk [B, k+1]: positions <n -> accepted d, ==n -> the
+        # target's own token at the first mismatch (or bonus)
+        idx = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+        cand = jnp.where(idx < n[:, None],
+                         jnp.pad(d, ((0, 0), (0, 1))), 0)
+        cand = jnp.where(idx == n[:, None], t0_new[:, None], cand)
+
+        advance = n + 1
+        if eos_id is not None:
+            is_eos = (cand == eos_id) & (idx <= n[:, None])
+            eos_pos = jnp.where(
+                jnp.any(is_eos, axis=1),
+                jnp.argmax(is_eos, axis=1), k + 1).astype(jnp.int32)
+            advance = jnp.minimum(advance, eos_pos + 1)
+            newly_done = jnp.any(is_eos, axis=1)
+        else:
+            newly_done = jnp.zeros((b,), bool)
+        cand = jnp.where(idx < advance[:, None], cand, pad_id)
+        advance = jnp.where(done, 0, advance)
+        cand = jnp.where(done[:, None], pad_id, cand)
+
+        # -- write the chunk into the output at per-row offsets ------
+        def write_row(row, chunk_row, off):
+            return lax.dynamic_update_slice(row, chunk_row, (off,))
+
+        out = jax.vmap(write_row)(out, cand, o)
+        o_new = jnp.minimum(o + advance, max_new_tokens + k + 1)
+        done = done | newly_done | (o_new >= max_new_tokens)
+
+        # -- repair/extend the draft cache with the committed chunk --
+        _, cache_d = cached_forward(
+            cfg_d, params_d, chunk,
+            KVCache(k=kd, v=vd, lengths=m), start=m, logits_mode="last")
+
+        m_new = jnp.where(advance > 0, m + advance, m)
+        t0 = jnp.where(advance > 0, t0_new, t0)
+        acc = acc + jnp.where(done, 0, n)
+        return (cache_t.k, cache_t.v, cache_d.k, cache_d.v, m_new, t0,
+                out, o_new, done, rounds + 1, acc)
+
+    state = lax.while_loop(cond, body, state)
+    out, rounds, acc = state[6], state[9], state[10]
+    tokens = out[:, :max_new_tokens]
+    if return_stats:
+        return tokens, {"rounds": rounds, "accepted": acc}
+    return tokens
+
+
+speculative_generate_jit = jax.jit(
+    speculative_generate,
+    static_argnums=(0, 2),
+    static_argnames=("k_spec", "max_new_tokens", "eos_id", "pad_id",
+                     "return_stats"),
+)

@@ -20,8 +20,12 @@ ServeApplicationSchema pydantic models behind the REST config and the
 
 ``import_path`` is ``module:attr`` or ``module.attr`` resolving to a
 ``Deployment`` (bound or not). ``apply_config`` deploys every entry and
-returns {deployment_name: DeploymentHandle}; config-listed init args
-override any bound ones. Validation errors name the offending field —
+returns {deployment_name: DeploymentHandle}. Init-arg layering:
+``init_args`` in the config REPLACES the target's bound positionals
+when present (otherwise they are kept), and ``init_kwargs`` MERGES over
+the target's bound kwargs key by key. The whole config is built and
+validated before anything deploys (atomic apply — a bad later entry
+leaves nothing running). Validation errors name the offending field —
 there is no pydantic in the image, so a small hand validator plays that
 role.
 """
@@ -122,7 +126,10 @@ def apply_config(config: dict) -> dict:
                 "config must contain 'applications' or 'deployments'")
         apps = [{"name": "default", "deployments":
                  config.get("deployments", [])}]
-    handles: dict = {}
+    # Phase 1: build + validate EVERYTHING (imports, fields, name
+    # collisions) before any deployment goes live, so a bad entry N
+    # cannot leave entries 0..N-1 running (atomic apply).
+    built: list = []
     owner: dict = {}   # deployment name -> application that declared it
     for ai, app in enumerate(apps):
         if not isinstance(app, dict) or "deployments" not in app:
@@ -142,8 +149,9 @@ def apply_config(config: dict) -> dict:
                     f"declared by {owner[dep.name]!r}; rename one "
                     "(names are global)")
             owner[dep.name] = app_name
-            handles[dep.name] = _api.run(dep)
-    return handles
+            built.append(dep)
+    # Phase 2: deploy
+    return {dep.name: _api.run(dep) for dep in built}
 
 
 def apply_config_file(path: str) -> dict:

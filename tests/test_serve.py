@@ -523,3 +523,14 @@ def test_apply_config_cross_app_name_collision_rejected(rt):
     ]}
     with pytest.raises(ValueError, match="already declared"):
         serve.apply_config(cfg)
+
+
+def test_apply_config_is_atomic(rt):
+    """A bad later entry must leave NOTHING deployed."""
+    cfg = {"deployments": [
+        {"import_path": "tests._serve_config_target:greeter"},
+        {"import_path": "tests._serve_config_target:nope"},
+    ]}
+    with pytest.raises(ValueError, match="no attribute"):
+        serve.apply_config(cfg)
+    assert "Greeter" not in serve.status()["deployments"]

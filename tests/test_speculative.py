@@ -1,0 +1,102 @@
+"""Speculative decoding tests.
+
+The load-bearing property: for ANY draft, greedy speculative output is
+bit-exact to the target's own greedy decode — drafts affect speed only.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import llama
+from ray_tpu.models.decoding import SamplingParams, generate
+from ray_tpu.models.speculative import speculative_generate
+
+
+@pytest.fixture(scope="module")
+def models():
+    cfg_t = llama.llama_tiny(vocab_size=128)
+    cfg_d = llama.LlamaConfig(
+        vocab_size=128, d_model=64, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=128, head_dim=32, remat="none")
+    params_t = llama.init_params(cfg_t, jax.random.key(0))
+    params_d = llama.init_params(cfg_d, jax.random.key(1))
+    return cfg_t, params_t, cfg_d, params_d
+
+
+def _prompts():
+    return jnp.array([[5, 9, 17, 33, 2, 0, 0, 0],
+                      [7, 7, 7, 7, 7, 7, 7, 7]], dtype=jnp.int32)
+
+
+def test_exact_vs_target_greedy_independent_draft(models):
+    """An unrelated random draft must not change the output."""
+    cfg_t, params_t, cfg_d, params_d = models
+    want = generate(cfg_t, params_t, _prompts(),
+                    sampling=SamplingParams(temperature=0.0,
+                                            max_new_tokens=24))
+    got = speculative_generate(cfg_t, params_t, cfg_d, params_d,
+                               _prompts(), k_spec=4, max_new_tokens=24)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_perfect_draft_accepts_everything(models):
+    """draft == target -> every proposal accepted: rounds collapse to
+    ~max_new/(k+1) and output stays exact."""
+    cfg_t, params_t, _, _ = models
+    want = generate(cfg_t, params_t, _prompts(),
+                    sampling=SamplingParams(temperature=0.0,
+                                            max_new_tokens=24))
+    got, stats = speculative_generate(
+        cfg_t, params_t, cfg_t, params_t, _prompts(),
+        k_spec=4, max_new_tokens=24, return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    rounds = int(stats["rounds"])
+    # 24 tokens at up to 5/round -> 5 rounds; allow one slack round
+    assert rounds <= 6, rounds
+    assert int(stats["accepted"].sum()) >= 2 * rounds
+
+
+def test_various_k(models):
+    cfg_t, params_t, cfg_d, params_d = models
+    want = generate(cfg_t, params_t, _prompts(),
+                    sampling=SamplingParams(temperature=0.0,
+                                            max_new_tokens=17))
+    for k in (1, 2, 7):
+        got = speculative_generate(cfg_t, params_t, cfg_d, params_d,
+                                   _prompts(), k_spec=k,
+                                   max_new_tokens=17)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                      err_msg=f"k={k}")
+
+
+def test_eos_stops_and_pads(models):
+    """Whatever token the target emits 3rd becomes EOS; output must pad
+    after it exactly like the plain decoder."""
+    cfg_t, params_t, cfg_d, params_d = models
+    plain = generate(cfg_t, params_t, _prompts(),
+                     sampling=SamplingParams(temperature=0.0,
+                                             max_new_tokens=20))
+    eos = int(np.asarray(plain)[0, 3])
+    want = generate(cfg_t, params_t, _prompts(),
+                    sampling=SamplingParams(temperature=0.0,
+                                            max_new_tokens=20),
+                    eos_id=eos)
+    got = speculative_generate(cfg_t, params_t, cfg_d, params_d,
+                               _prompts(), k_spec=4, max_new_tokens=20,
+                               eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_jit_wrapper_compiles_once(models):
+    from ray_tpu.models.speculative import speculative_generate_jit
+
+    cfg_t, params_t, cfg_d, params_d = models
+    out1 = speculative_generate_jit(cfg_t, params_t, cfg_d, params_d,
+                                    _prompts(), k_spec=2,
+                                    max_new_tokens=8)
+    out2 = speculative_generate_jit(cfg_t, params_t, cfg_d, params_d,
+                                    _prompts() + 1, k_spec=2,
+                                    max_new_tokens=8)
+    assert out1.shape == out2.shape == (2, 8)
