@@ -138,8 +138,9 @@ def speculative_generate(cfg_t, params_t, cfg_d, params_d, prompts, *,
         else:
             newly_done = jnp.zeros((b,), bool)
         cand = jnp.where(idx < advance[:, None], cand, pad_id)
-        advance = jnp.where(done, 0, advance)
-        cand = jnp.where(done[:, None], pad_id, cand)
+        done_at_entry = done   # rows already finished BEFORE this round
+        advance = jnp.where(done_at_entry, 0, advance)
+        cand = jnp.where(done_at_entry[:, None], pad_id, cand)
 
         # -- write the chunk into the output at per-row offsets ------
         def write_row(row, chunk_row, off):
@@ -156,7 +157,9 @@ def speculative_generate(cfg_t, params_t, cfg_d, params_d, prompts, *,
 
         m_new = jnp.where(advance > 0, m + advance, m)
         t0 = jnp.where(advance > 0, t0_new, t0)
-        acc = acc + jnp.where(done, 0, n)
+        # count acceptances for rows ACTIVE at round entry — masking
+        # with the updated `done` would drop each row's final round
+        acc = acc + jnp.where(done_at_entry, 0, n)
         return (cache_t.k, cache_t.v, cache_d.k, cache_d.v, m_new, t0,
                 out, o_new, done, rounds + 1, acc)
 

@@ -52,6 +52,9 @@ class ReplayBuffer:
         self.actions = np.zeros((capacity, *action_shape), action_dtype)
         self.rewards = np.zeros((capacity,), np.float32)
         self.dones = np.zeros((capacity,), np.float32)
+        # optional per-transition bootstrap factor (n-step folding);
+        # allocated on first batch that carries it
+        self.discounts: np.ndarray | None = None
         self.size = 0
         self.pos = 0
 
@@ -62,11 +65,15 @@ class ReplayBuffer:
         if n >= self.capacity:  # keep only the newest capacity items
             batch = {k: v[-self.capacity:] for k, v in batch.items()}
             n = self.capacity
+        fields = [("obs", self.obs), ("next_obs", self.next_obs),
+                  ("actions", self.actions), ("rewards", self.rewards),
+                  ("dones", self.dones)]
+        if "discounts" in batch:
+            if self.discounts is None:
+                self.discounts = np.zeros((self.capacity,), np.float32)
+            fields.append(("discounts", self.discounts))
         first = min(n, self.capacity - self.pos)
-        for name, dst in (("obs", self.obs), ("next_obs", self.next_obs),
-                          ("actions", self.actions),
-                          ("rewards", self.rewards),
-                          ("dones", self.dones)):
+        for name, dst in fields:
             src = batch[name]
             dst[self.pos:self.pos + first] = src[:first]
             if n > first:
@@ -76,9 +83,12 @@ class ReplayBuffer:
 
     def sample(self, batch_size: int, rng) -> dict:
         idx = rng.integers(0, self.size, size=batch_size)
-        return {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
-                "actions": self.actions[idx], "rewards": self.rewards[idx],
-                "dones": self.dones[idx]}
+        out = {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
+               "actions": self.actions[idx], "rewards": self.rewards[idx],
+               "dones": self.dones[idx]}
+        if self.discounts is not None:
+            out["discounts"] = self.discounts[idx]
+        return out
 
 
 class _DQNRolloutWorker:
@@ -130,6 +140,11 @@ class DQNConfig:
     num_updates_per_iter: int = 32
     target_update_freq: int = 4      # iterations between hard target syncs
     double_q: bool = True
+    n_step: int = 1                  # n-step return folding before insert
+    prioritized_replay: bool = False
+    pr_alpha: float = 0.6            # priority exponent
+    pr_beta0: float = 0.4            # IS-weight exponent, annealed -> 1
+    pr_beta_iters: int = 100
     epsilon_start: float = 1.0
     epsilon_end: float = 0.05
     epsilon_decay_iters: int = 30
@@ -163,7 +178,15 @@ class DQN:
         self.target_params = jax.tree.map(lambda x: x, self.params)
         self.tx = optax.adam(config.lr)
         self.opt_state = self.tx.init(self.params)
-        self.buffer = ReplayBuffer(config.buffer_capacity, self.obs_dim)
+        if config.prioritized_replay:
+            from ray_tpu.rllib.replay import PrioritizedReplayBuffer
+
+            self.buffer = PrioritizedReplayBuffer(
+                config.buffer_capacity, self.obs_dim,
+                alpha=config.pr_alpha)
+        else:
+            self.buffer = ReplayBuffer(config.buffer_capacity,
+                                       self.obs_dim)
         self.iteration = 0
         self.rng = np.random.default_rng(config.seed)
         worker_cls = ray_tpu.remote(_DQNRolloutWorker)
@@ -172,8 +195,7 @@ class DQN:
             for i in range(config.num_rollout_workers)
         ]
         self._update = jax.jit(partial(
-            _dqn_update, tx=self.tx, gamma=config.gamma,
-            double_q=config.double_q))
+            _dqn_update, tx=self.tx, double_q=config.double_q))
 
     def _epsilon(self) -> float:
         cfg = self.config
@@ -191,17 +213,34 @@ class DQN:
             w.sample.remote(params_np, cfg.rollout_fragment_length, eps)
             for w in self.workers
         ])
+        from ray_tpu.rllib.replay import nstep_batch
+
         episode_returns = []
         for b in batches:
             episode_returns.extend(b.pop("episode_returns"))
-            self.buffer.add_batch(b)
+            # per-worker batches are time-ordered, which n-step folding
+            # needs; discounts carry the bootstrap factor either way
+            self.buffer.add_batch(nstep_batch(b, cfg.n_step, cfg.gamma))
 
+        beta = min(1.0, cfg.pr_beta0 + (1.0 - cfg.pr_beta0)
+                   * self.iteration / max(1, cfg.pr_beta_iters))
         losses = []
         if self.buffer.size >= cfg.learning_starts:
             for _ in range(cfg.num_updates_per_iter):
-                mb = self.buffer.sample(cfg.train_batch_size, self.rng)
-                self.params, self.opt_state, loss = self._update(
+                if cfg.prioritized_replay:
+                    mb = self.buffer.sample(cfg.train_batch_size,
+                                            self.rng, beta=beta)
+                    idx = mb.pop("idx")
+                else:
+                    mb = self.buffer.sample(cfg.train_batch_size,
+                                            self.rng)
+                    mb["weights"] = np.ones(
+                        len(mb["obs"]), np.float32)
+                    idx = None
+                self.params, self.opt_state, loss, td = self._update(
                     self.params, self.opt_state, self.target_params, mb)
+                if idx is not None:
+                    self.buffer.update_priorities(idx, np.asarray(td))
                 losses.append(float(loss))
         self.iteration += 1
         if self.iteration % cfg.target_update_freq == 0:
@@ -247,8 +286,12 @@ class DQN:
                 pass
 
 
-def _dqn_update(params, opt_state, target_params, batch, *, tx, gamma,
+def _dqn_update(params, opt_state, target_params, batch, *, tx,
                 double_q):
+    """Weighted TD update. ``batch["discounts"]`` is the bootstrap
+    factor (gamma for 1-step, gamma^h with terminal zeroing for n-step);
+    ``batch["weights"]`` are IS weights (ones for uniform replay).
+    Returns per-sample |TD| for priority refresh."""
     import jax
     import jax.numpy as jnp
 
@@ -264,11 +307,12 @@ def _dqn_update(params, opt_state, target_params, batch, *, tx, gamma,
                 q_next_target, sel[:, None], axis=1).squeeze(-1)
         else:
             next_q = jnp.max(q_next_target, axis=-1)
-        target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+        target = batch["rewards"] + batch["discounts"] * \
             jax.lax.stop_gradient(next_q)
-        return jnp.mean((q_taken - target) ** 2)
+        td = q_taken - target
+        return jnp.mean(batch["weights"] * td ** 2), jnp.abs(td)
 
-    loss, grads = jax.value_and_grad(loss_fn)(params)
+    (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
     updates, opt_state = tx.update(grads, opt_state, params)
     params = jax.tree.map(lambda p, u: p + u, params, updates)
-    return params, opt_state, loss
+    return params, opt_state, loss, td

@@ -24,8 +24,12 @@ returns {deployment_name: DeploymentHandle}. Init-arg layering:
 ``init_args`` in the config REPLACES the target's bound positionals
 when present (otherwise they are kept), and ``init_kwargs`` MERGES over
 the target's bound kwargs key by key. The whole config is built and
-validated before anything deploys (atomic apply — a bad later entry
-leaves nothing running). Validation errors name the offending field —
+validated before anything deploys, so a config error (bad import path,
+unknown field, name collision) in any entry leaves nothing running; a
+RUNTIME failure while deploying entry N (replica init raising,
+resources never scheduling) can still leave entries before it live —
+the controller keeps them and the raised error names the failed entry.
+Validation errors name the offending field —
 there is no pydantic in the image, so a small hand validator plays that
 role.
 """
@@ -127,8 +131,9 @@ def apply_config(config: dict) -> dict:
         apps = [{"name": "default", "deployments":
                  config.get("deployments", [])}]
     # Phase 1: build + validate EVERYTHING (imports, fields, name
-    # collisions) before any deployment goes live, so a bad entry N
-    # cannot leave entries 0..N-1 running (atomic apply).
+    # collisions) before any deployment goes live, so a CONFIG error in
+    # entry N cannot leave entries 0..N-1 running. (Runtime deploy
+    # failures in phase 2 are not rolled back — see module docstring.)
     built: list = []
     owner: dict = {}   # deployment name -> application that declared it
     for ai, app in enumerate(apps):
