@@ -79,9 +79,16 @@ class JaxTrainer:
     """
 
     def __init__(self, model_cfg, cfg: TrainConfig,
-                 *, mesh: Mesh | None = None):
+                 *, mesh: Mesh | None = None,
+                 loss_fn: Callable | None = None):
+        """``loss_fn(model_cfg, params, batch) -> scalar`` overrides the
+        default next-token cross entropy — the hook that trains
+        non-causal objectives (e.g. BERT MLM with a dict batch) through
+        the same sharded-state machinery. Batch leaves must share the
+        [B, ...] leading axis for data sharding."""
         self.model_cfg = model_cfg
         self.cfg = cfg
+        self.loss_fn = loss_fn
         # Model-family dispatch: any module exposing init_params /
         # param_logical_axes / forward over a frozen config dataclass
         # plugs in (llama is the flagship; gpt is the second decoder
@@ -114,6 +121,15 @@ class JaxTrainer:
                                          or self.attn_impl == "ring"):
             raise ValueError(
                 "fused_loss / ring attention are llama-only paths")
+        if loss_fn is not None and (cfg.fused_loss or self.pp_axis):
+            raise ValueError(
+                "custom loss_fn cannot combine with fused_loss or "
+                "pipeline parallelism (both own the loss computation)")
+        # families without a causal-LM `forward` need the loss hook
+        if loss_fn is None and not hasattr(self.family, "forward"):
+            raise ValueError(
+                f"{self.family.__name__} has no causal-LM default; pass "
+                "loss_fn= (e.g. wrapping bert.mlm_loss)")
         if self.pp_axis:
             if self.family is not llama:
                 raise ValueError(
@@ -136,13 +152,15 @@ class JaxTrainer:
     def _resolve_family(model_cfg):
         if isinstance(model_cfg, llama.LlamaConfig):
             return llama
-        from ray_tpu.models import gpt
+        from ray_tpu.models import bert, gpt
 
         if isinstance(model_cfg, gpt.GPTConfig):
             return gpt
+        if isinstance(model_cfg, bert.BertConfig):
+            return bert
         raise TypeError(
             f"unsupported model config {type(model_cfg).__name__}; "
-            "expected LlamaConfig or GPTConfig")
+            "expected LlamaConfig, GPTConfig, or BertConfig")
 
     # --- optimizer (AdamW + cosine schedule + clip, the Llama recipe) ---
 
@@ -204,6 +222,8 @@ class JaxTrainer:
     # --- train step ---
 
     def _loss_fn(self, params, batch, segment_ids=None):
+        if self.loss_fn is not None:
+            return self.loss_fn(self.model_cfg, params, batch)
         inputs = batch[:, :-1]
         targets = batch[:, 1:]
         mask = (targets != -1).astype(jnp.float32)
@@ -302,24 +322,39 @@ class JaxTrainer:
         metrics = {"loss": loss, "grad_norm": gnorm, "step": new_state.step}
         return new_state, metrics
 
-    def compile_step(self, state: TrainState):
+    def _batch_shardings(self, batch):
+        """Per-leaf data sharding: dim 0 is the batch axis, the rest
+        replicated — so dict batches may mix ranks (e.g. [B, S] tokens
+        with [B] labels)."""
+        from ray_tpu.parallel.sharding import logical_sharding
+
+        def leaf(x):
+            nd = max(int(getattr(x, "ndim", 1)), 1)
+            if nd == 1:
+                return logical_sharding(("batch",), self.mesh, self.rules)
+            return batch_sharding(self.mesh, self.rules, ndim=nd,
+                                  shard_seq=False)
+
+        return jax.tree.map(leaf, batch)
+
+    def compile_step(self, state: TrainState, batch):
         if self._jit_step is None:
-            batch_s = batch_sharding(self.mesh, self.rules, shard_seq=False)
             donate = (0,) if self.cfg.donate_state else ()
             self._jit_step = jax.jit(
                 self._step,
-                in_shardings=(None, batch_s),  # state keeps its shardings
+                # state keeps its shardings
+                in_shardings=(None, self._batch_shardings(batch)),
                 donate_argnums=donate,
             )
         return self._jit_step
 
     def train_step(self, state: TrainState, batch):
         """One SPMD optimization step. ``batch``: int32 [B, S+1] tokens
-        (last column is the shifted target; -1 = padding)."""
-        step_fn = self.compile_step(state)
-        batch = jax.device_put(
-            batch, batch_sharding(self.mesh, self.rules, shard_seq=False)
-        )
+        (last column is the shifted target; -1 = padding), or — with a
+        custom ``loss_fn`` — any pytree whose leaves lead with the
+        batch dim."""
+        step_fn = self.compile_step(state, batch)
+        batch = jax.device_put(batch, self._batch_shardings(batch))
         return step_fn(state, batch)
 
     # --- simple fit loop (full harness arrives with the trial controller) ---
