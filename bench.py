@@ -62,7 +62,9 @@ def bench_train(preset: str | None = None) -> dict:
             n_kv_heads=4, head_dim=128, d_ff=6144,
             remat=remat or "full",
         )
-        batch, seq = 1, 16384
+        # one sequence per chip (the batch dim shards over fsdp when
+        # multi-chip, so it must be divisible by the device count)
+        batch, seq = max(n_dev, 1), 16384
     elif preset == "large":
         # ~1.0B params: the largest honest single-chip config — full
         # rematerialization trades recompute FLOPs for HBM so params +

@@ -1,0 +1,32 @@
+"""Quickstart: a streaming data pipeline with distributed transforms.
+
+    python -m ray_tpu.examples.data_pipeline
+
+Reference analog: the Dataset quickstarts in the reference's Data docs
+(read -> map_batches -> groupby -> iterate).
+"""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    ds = data.from_numpy({
+        "x": np.arange(10_000, dtype=np.float32),
+        "group": np.arange(10_000) % 7,
+    })
+    out = (ds
+           .map_batches(lambda b: {**b, "y": b["x"] * 2 + 1})
+           .filter(lambda row: row["group"] != 3)
+           .groupby("group").mean("y"))
+    for row in sorted(out.take_all(), key=lambda r: r["group"]):
+        print(row)
+    print(ds.stats())
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""Quickstart: train PPO with distributed rollout workers.
+
+    python -m ray_tpu.examples.rllib_quickstart
+
+Reference analog: the `Algorithm` quickstarts in the reference's RLlib
+docs (config builder -> .build() -> train loop).
+"""
+
+import ray_tpu
+from ray_tpu.rllib import PPOConfig
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=256)
+            .training(lr=3e-4, num_sgd_iter=4)
+            .build())
+    try:
+        for _ in range(10):
+            result = algo.train()
+            print(f"iter {result['training_iteration']:2d} "
+                  f"return={result['episode_return_mean']:.1f} "
+                  f"episodes={result['num_episodes']}")
+    finally:
+        algo.stop()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

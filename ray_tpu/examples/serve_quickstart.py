@@ -1,0 +1,59 @@
+"""Quickstart: serve a model graph over HTTP.
+
+    python -m ray_tpu.examples.serve_quickstart
+
+Reference analog: the serve.run / deployment-graph quickstarts in the
+reference's Serve docs.
+"""
+
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment
+class Preprocess:
+    def __call__(self, payload):
+        return [float(x) for x in payload["values"]]
+
+
+@serve.deployment(num_replicas=2)
+class Model:
+    def __init__(self, scale):
+        self.scale = scale
+
+    def __call__(self, values):
+        return {"sum": sum(values) * self.scale}
+
+
+@serve.deployment
+class Pipeline:
+    def __init__(self, pre, model):
+        self.pre, self.model = pre, model
+
+    def __call__(self, payload):
+        values = ray_tpu.get(self.pre.remote(payload))
+        return ray_tpu.get(self.model.remote(values))
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    handle = serve.run(Pipeline.bind(Preprocess.bind(), Model.bind(2.0)))
+    print("direct call:", handle.call({"values": [1, 2, 3]}))
+
+    server, (host, port) = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/Pipeline",
+        data=json.dumps({"values": [4, 5]}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        print("HTTP call:", json.load(resp))
+    server.shutdown()
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

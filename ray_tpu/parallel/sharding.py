@@ -186,4 +186,4 @@ def batch_sharding(mesh: Mesh, rules: ShardingRules, ndim: int = 2,
     indivisible by sp; ring attention's shard_map introduces the seq
     sharding inside the step instead)."""
     logical = ("batch", "seq" if shard_seq else None) + (None,) * (ndim - 2)
-    return logical_sharding(logical, mesh, rules)
+    return logical_sharding(logical[:max(ndim, 0)], mesh, rules)

@@ -326,12 +326,10 @@ class JaxTrainer:
         """Per-leaf data sharding: dim 0 is the batch axis, the rest
         replicated — so dict batches may mix ranks (e.g. [B, S] tokens
         with [B] labels)."""
-        from ray_tpu.parallel.sharding import logical_sharding
-
         def leaf(x):
-            nd = max(int(getattr(x, "ndim", 1)), 1)
-            if nd == 1:
-                return logical_sharding(("batch",), self.mesh, self.rules)
+            nd = int(getattr(x, "ndim", 0))
+            if nd == 0:   # python scalars / 0-d arrays: replicate
+                return NamedSharding(self.mesh, P())
             return batch_sharding(self.mesh, self.rules, ndim=nd,
                                   shard_seq=False)
 

@@ -181,3 +181,24 @@ def test_bert_without_loss_fn_rejected():
     with pytest.raises(ValueError, match="loss_fn"):
         JaxTrainer(bert.bert_tiny(), TrainConfig(strategy="dp"),
                    mesh=create_mesh({"dp": 8}))
+
+
+def test_custom_loss_batch_scalar_leaf_replicates():
+    """0-d/scalar leaves in a custom-loss batch are replicated, not
+    batch-sharded."""
+    from ray_tpu.parallel.mesh import create_mesh
+    from ray_tpu.train.trainer import JaxTrainer, TrainConfig
+
+    cfg = bert.bert_tiny(vocab_size=64)
+
+    def loss(model_cfg, params, batch):
+        h = bert.encode(model_cfg, params, batch["tokens"])
+        return jnp.mean(h ** 2) * batch["scale"]
+
+    trainer = JaxTrainer(cfg, TrainConfig(strategy="dp", warmup_steps=2),
+                         mesh=create_mesh({"dp": 8}), loss_fn=loss)
+    state = trainer.init_state(jax.random.key(0))
+    batch = {"tokens": jnp.ones((8, 8), jnp.int32),
+             "scale": jnp.float32(0.5)}
+    _, metrics = trainer.train_step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
