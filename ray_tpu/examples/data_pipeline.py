@@ -24,7 +24,7 @@ def main():
            .groupby("group").mean("y"))
     for row in sorted(out.take_all(), key=lambda r: r["group"]):
         print(row)
-    print(ds.stats())
+    print(out.stats())
     ray_tpu.shutdown()
 
 

@@ -19,9 +19,16 @@ from ray_tpu.train.trainer import JaxTrainer, TrainConfig
 
 
 def main():
+    # factor the device count: tp=2 on even hosts; the rest becomes
+    # fsdp when it's a power of two (so param dims stay divisible),
+    # otherwise plain dp (replicated params shard nothing) — the mesh
+    # resolves on any host: 1, 2, 5, or 8 devices alike
     n = len(jax.devices())
-    mesh = create_mesh({"dp": 1, "fsdp": max(n // 2, 1),
-                        "tp": 2 if n >= 2 else 1})
+    tp = 2 if n % 2 == 0 and n >= 2 else 1
+    rest = n // tp
+    pow2 = rest > 0 and (rest & (rest - 1)) == 0
+    mesh = create_mesh({"dp": 1 if pow2 else rest,
+                        "fsdp": rest if pow2 else 1, "tp": tp})
     trainer = JaxTrainer(
         llama.llama_tiny(),                    # swap for llama3_8b() on a pod
         TrainConfig(strategy="fsdp_tp", learning_rate=1e-3,
@@ -30,10 +37,13 @@ def main():
     )
     state = trainer.init_state(jax.random.key(0))
 
+    batch_size = rest * max(8 // rest, 1)   # a multiple of the data axes
+
     def batches():
         i = 0
         while True:
-            yield jax.random.randint(jax.random.key(i), (8, 129), 0, 512,
+            yield jax.random.randint(jax.random.key(i),
+                                     (batch_size, 129), 0, 512,
                                      dtype=jnp.int32)
             i += 1
 

@@ -1,0 +1,48 @@
+"""The quickstart examples must stay runnable (reference analog: doc
+examples exercised in CI). Each runs as a fresh subprocess — the same
+way a user would hit them."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(module: str, *, devices: int = 1, timeout: int = 420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    if devices > 1:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devices}")
+    proc = subprocess.run(
+        [sys.executable, "-m", module], env=env, cwd=_REPO,
+        capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, (
+        f"{module} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+    return proc.stdout
+
+
+def test_data_pipeline_example():
+    out = _run("ray_tpu.examples.data_pipeline")
+    assert "mean(y)" in out and "Dataset execution" in out
+
+
+def test_serve_quickstart_example():
+    out = _run("ray_tpu.examples.serve_quickstart")
+    assert "direct call: {'sum': 12.0}" in out
+    assert "'sum': 18.0" in out
+
+
+def test_rllib_quickstart_example():
+    out = _run("ray_tpu.examples.rllib_quickstart")
+    assert "iter 10" in out
+
+
+@pytest.mark.slow
+def test_train_llama_example():
+    out = _run("ray_tpu.examples.train_llama", devices=8)
+    assert "'loss':" in out
