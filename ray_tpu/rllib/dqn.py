@@ -24,17 +24,39 @@ from ray_tpu.rllib.env import make_env
 from ray_tpu.rllib.ppo import _np_forward, forward_module, init_module
 
 
-def init_qnet(key, obs_dim: int, n_actions: int, hidden: int = 64):
-    return init_module(key, obs_dim, n_actions, hidden)
+def init_qnet(key, obs_dim: int, n_actions: int, hidden: int = 64,
+              num_atoms: int = 1):
+    """num_atoms > 1 -> C51 head: the "pi" head emits n_actions *
+    num_atoms logits reshaped to per-action distributions."""
+    return init_module(key, obs_dim, n_actions * num_atoms, hidden)
 
 
-def q_forward(params, obs):
-    logits, _ = forward_module(params, obs)
+def q_forward(params, obs, *, dueling: bool = False):
+    logits, value = forward_module(params, obs)
+    if dueling:
+        # Q = V + A - mean_a A (Wang et al.) — reuses the module's value
+        # head as V, the action head as advantages; greedy argmax is
+        # unchanged, so rollout workers need no dueling flag
+        return value[:, None] + logits - logits.mean(-1, keepdims=True)
     return logits
 
 
-def _np_q(params, obs):
+def dist_forward(params, obs, n_actions: int, num_atoms: int):
+    """C51: per-action categorical distributions [B, A, atoms]."""
+    import jax
+
+    logits, _ = forward_module(params, obs)
+    return jax.nn.softmax(
+        logits.reshape(-1, n_actions, num_atoms), axis=-1)
+
+
+def _np_q(params, obs, num_atoms: int = 1, support=None):
     logits, _ = _np_forward(params, obs)
+    if num_atoms > 1:
+        z = logits.reshape(len(obs), -1, num_atoms)
+        z = np.exp(z - z.max(-1, keepdims=True))
+        probs = z / z.sum(-1, keepdims=True)
+        return probs @ support          # expected values [B, A]
     return logits
 
 
@@ -92,11 +114,14 @@ class ReplayBuffer:
 
 
 class _DQNRolloutWorker:
-    def __init__(self, env_name, seed: int):
+    def __init__(self, env_name, seed: int, num_atoms: int = 1,
+                 support=None):
         self.env = make_env(env_name, seed=seed)
         self.rng = np.random.default_rng(seed)
         self.obs = self.env.reset()
         self.ep_ret = 0.0
+        self.num_atoms = num_atoms
+        self.support = None if support is None else np.asarray(support)
 
     def sample(self, params_np: dict, num_steps: int, epsilon: float):
         obs_l, next_l, act_l, rew_l, done_l = [], [], [], [], []
@@ -105,7 +130,9 @@ class _DQNRolloutWorker:
             if self.rng.random() < epsilon:
                 action = int(self.rng.integers(self.env.n_actions))
             else:
-                action = int(np.argmax(_np_q(params_np, self.obs[None])[0]))
+                action = int(np.argmax(_np_q(
+                    params_np, self.obs[None], self.num_atoms,
+                    self.support)[0]))
             next_obs, reward, done, _ = self.env.step(action)
             obs_l.append(self.obs)
             next_l.append(next_obs)
@@ -140,6 +167,13 @@ class DQNConfig:
     num_updates_per_iter: int = 32
     target_update_freq: int = 4      # iterations between hard target syncs
     double_q: bool = True
+    dueling: bool = False            # Q = V + A - mean(A)
+    # C51 distributional Q (Bellemare et al.): num_atoms > 1 switches
+    # the head to per-action categorical distributions over
+    # [v_min, v_max] with a projected-Bellman cross-entropy loss
+    num_atoms: int = 1
+    v_min: float = -10.0
+    v_max: float = 10.0
     n_step: int = 1                  # n-step return folding before insert
     prioritized_replay: bool = False
     pr_alpha: float = 0.6            # priority exponent
@@ -170,11 +204,22 @@ class DQN:
         import optax
 
         self.config = config
+        if config.dueling and config.num_atoms > 1:
+            raise ValueError("dueling + distributional (C51) is not "
+                             "supported together; pick one")
+        if config.num_atoms > 1 and config.v_max <= config.v_min:
+            raise ValueError(
+                f"C51 needs v_max > v_min, got [{config.v_min}, "
+                f"{config.v_max}] (a degenerate support trains nothing)")
         env = make_env(config.env, seed=config.seed)
         self.obs_dim = env.obs_dim
         self.n_actions = env.n_actions
+        self.support = (np.linspace(config.v_min, config.v_max,
+                                    config.num_atoms, dtype=np.float32)
+                        if config.num_atoms > 1 else None)
         self.params = init_qnet(jax.random.key(config.seed), self.obs_dim,
-                                self.n_actions, config.hidden)
+                                self.n_actions, config.hidden,
+                                config.num_atoms)
         self.target_params = jax.tree.map(lambda x: x, self.params)
         self.tx = optax.adam(config.lr)
         self.opt_state = self.tx.init(self.params)
@@ -191,11 +236,19 @@ class DQN:
         self.rng = np.random.default_rng(config.seed)
         worker_cls = ray_tpu.remote(_DQNRolloutWorker)
         self.workers = [
-            worker_cls.remote(config.env, config.seed + 1000 * (i + 1))
+            worker_cls.remote(config.env, config.seed + 1000 * (i + 1),
+                              config.num_atoms, self.support)
             for i in range(config.num_rollout_workers)
         ]
-        self._update = jax.jit(partial(
-            _dqn_update, tx=self.tx, double_q=config.double_q))
+        if config.num_atoms > 1:
+            self._update = jax.jit(partial(
+                _c51_update, tx=self.tx, double_q=config.double_q,
+                n_actions=self.n_actions, num_atoms=config.num_atoms,
+                v_min=config.v_min, v_max=config.v_max))
+        else:
+            self._update = jax.jit(partial(
+                _dqn_update, tx=self.tx, double_q=config.double_q,
+                dueling=config.dueling))
 
     def _epsilon(self) -> float:
         cfg = self.config
@@ -259,7 +312,9 @@ class DQN:
         import jax
 
         params_np = jax.tree.map(np.asarray, self.params)
-        return int(np.argmax(_np_q(params_np, np.asarray(obs)[None])[0]))
+        return int(np.argmax(_np_q(params_np, np.asarray(obs)[None],
+                                   self.config.num_atoms,
+                                   self.support)[0]))
 
     def save(self, path: str):
         import pickle
@@ -287,7 +342,7 @@ class DQN:
 
 
 def _dqn_update(params, opt_state, target_params, batch, *, tx,
-                double_q):
+                double_q, dueling=False):
     """Weighted TD update. ``batch["discounts"]`` is the bootstrap
     factor (gamma for 1-step, gamma^h with terminal zeroing for n-step);
     ``batch["weights"]`` are IS weights (ones for uniform replay).
@@ -296,13 +351,15 @@ def _dqn_update(params, opt_state, target_params, batch, *, tx,
     import jax.numpy as jnp
 
     def loss_fn(p):
-        q = q_forward(p, batch["obs"])
+        q = q_forward(p, batch["obs"], dueling=dueling)
         q_taken = jnp.take_along_axis(
             q, batch["actions"][:, None], axis=1).squeeze(-1)
-        q_next_target = q_forward(target_params, batch["next_obs"])
+        q_next_target = q_forward(target_params, batch["next_obs"],
+                                  dueling=dueling)
         if double_q:
             # online net selects, target net evaluates
-            sel = jnp.argmax(q_forward(p, batch["next_obs"]), axis=-1)
+            sel = jnp.argmax(
+                q_forward(p, batch["next_obs"], dueling=dueling), axis=-1)
             next_q = jnp.take_along_axis(
                 q_next_target, sel[:, None], axis=1).squeeze(-1)
         else:
@@ -316,3 +373,62 @@ def _dqn_update(params, opt_state, target_params, batch, *, tx,
     updates, opt_state = tx.update(grads, opt_state, params)
     params = jax.tree.map(lambda p, u: p + u, params, updates)
     return params, opt_state, loss, td
+
+
+def _c51_update(params, opt_state, target_params, batch, *, tx, double_q,
+                n_actions, num_atoms, v_min, v_max):
+    """C51 projected-Bellman update (Bellemare et al. 2017): the target
+    distribution Tz = clip(r + discount * z) is projected onto the fixed
+    support and the loss is categorical cross entropy against the online
+    distribution of the taken action. ``discounts`` already carries
+    terminal zeroing and n-step gamma^h, so termination collapses Tz to
+    a point mass at the (clipped) reward for free. Returns per-sample
+    cross entropy as the priority signal."""
+    import jax
+    import jax.numpy as jnp
+
+    support = jnp.linspace(v_min, v_max, num_atoms)
+    delta = (v_max - v_min) / (num_atoms - 1)
+
+    def loss_fn(p):
+        # next-state distribution of the greedy action
+        next_target = dist_forward(target_params, batch["next_obs"],
+                                   n_actions, num_atoms)      # [B, A, Z]
+        ev_target = next_target @ support                     # [B, A]
+        if double_q:
+            next_online = dist_forward(p, batch["next_obs"],
+                                       n_actions, num_atoms)
+            sel = jnp.argmax(next_online @ support, axis=-1)
+        else:
+            sel = jnp.argmax(ev_target, axis=-1)
+        p_next = jnp.take_along_axis(
+            next_target, sel[:, None, None], axis=1).squeeze(1)  # [B, Z]
+
+        # project Tz onto the support
+        tz = jnp.clip(batch["rewards"][:, None]
+                      + batch["discounts"][:, None] * support[None, :],
+                      v_min, v_max)                           # [B, Z]
+        b = (tz - v_min) / delta
+        lo = jnp.floor(b).astype(jnp.int32)
+        hi = jnp.ceil(b).astype(jnp.int32)
+        # when b is integral lo == hi: give it full mass via the lo term
+        w_hi = b - lo
+        w_lo = 1.0 - w_hi
+        atoms = jnp.arange(num_atoms)
+        # m[k] = sum_j p_next[j] * (w_lo[j]·[lo_j==k] + w_hi[j]·[hi_j==k])
+        m = (jnp.where(lo[:, :, None] == atoms[None, None, :],
+                       (p_next * w_lo)[:, :, None], 0.0).sum(1)
+             + jnp.where(hi[:, :, None] == atoms[None, None, :],
+                         (p_next * w_hi)[:, :, None], 0.0).sum(1))
+        m = jax.lax.stop_gradient(m)
+
+        online = dist_forward(p, batch["obs"], n_actions, num_atoms)
+        p_taken = jnp.take_along_axis(
+            online, batch["actions"][:, None, None], axis=1).squeeze(1)
+        xent = -(m * jnp.log(p_taken + 1e-8)).sum(-1)         # [B]
+        return jnp.mean(batch["weights"] * xent), xent
+
+    (loss, xent), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return params, opt_state, loss, xent

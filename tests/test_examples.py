@@ -13,7 +13,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _run(module: str, *, devices: int = 1, timeout: int = 420):
     env = dict(os.environ)
-    env["PYTHONPATH"] = _REPO
+    prior = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = (_REPO + os.pathsep + prior) if prior else _REPO
     env["JAX_PLATFORMS"] = "cpu"
     if devices > 1:
         env["XLA_FLAGS"] = (
@@ -44,5 +45,7 @@ def test_rllib_quickstart_example():
 
 @pytest.mark.slow
 def test_train_llama_example():
+    """Runs by default (CI exercises it); skip locally with
+    ``pytest -m 'not slow'``."""
     out = _run("ray_tpu.examples.train_llama", devices=8)
     assert "'loss':" in out

@@ -131,3 +131,90 @@ def test_dqn_prioritized_nstep_learns_bandit(ray_tpu_start):
         assert last >= 0.9
     finally:
         algo.stop()
+
+
+def test_dueling_dqn_learns_bandit(ray_tpu_start):
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (DQNConfig().environment("Bandit-v0")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=128)
+            .training(lr=5e-3, learning_starts=128, dueling=True,
+                      epsilon_decay_iters=10)
+            .build())
+    try:
+        last = 0.0
+        for _ in range(30):
+            last = algo.train()["episode_return_mean"]
+            if last >= 0.9:
+                break
+        assert last >= 0.9
+    finally:
+        algo.stop()
+
+
+def test_c51_learns_bandit(ray_tpu_start):
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (DQNConfig().environment("Bandit-v0")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=128)
+            .training(lr=5e-3, learning_starts=128, num_atoms=21,
+                      v_min=-1.0, v_max=2.0, epsilon_decay_iters=10)
+            .build())
+    try:
+        last = 0.0
+        for _ in range(30):
+            last = algo.train()["episode_return_mean"]
+            if last >= 0.9:
+                break
+        assert last >= 0.9
+    finally:
+        algo.stop()
+
+
+def test_c51_projection_point_mass():
+    """With discounts=0 (terminal), the projected target must be a point
+    mass at the clipped reward; cross entropy then trains the online
+    dist toward it."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.dqn import _c51_update, dist_forward, init_qnet
+    import optax
+
+    n_actions, atoms = 2, 11
+    params = init_qnet(jax.random.key(0), 3, n_actions, 32, atoms)
+    target = jax.tree.map(lambda x: x, params)
+    tx = optax.adam(5e-3)
+    opt = tx.init(params)
+    batch = {
+        "obs": jnp.ones((16, 3), jnp.float32),
+        "next_obs": jnp.ones((16, 3), jnp.float32),
+        "actions": jnp.zeros((16,), jnp.int32),
+        "rewards": jnp.full((16,), 0.5, jnp.float32),
+        "dones": jnp.ones((16,), jnp.float32),
+        "discounts": jnp.zeros((16,), jnp.float32),
+        "weights": jnp.ones((16,), jnp.float32),
+    }
+    step = jax.jit(lambda p, o: _c51_update(
+        p, o, target, batch, tx=tx, double_q=True, n_actions=n_actions,
+        num_atoms=atoms, v_min=-1.0, v_max=1.0))
+    for _ in range(300):
+        params, opt, loss, _ = step(params, opt)
+    dist = dist_forward(params, batch["obs"][:1], n_actions, atoms)
+    ev = float((dist[0, 0] * jnp.linspace(-1, 1, atoms)).sum())
+    # expected value of the learned distribution -> the 0.5 reward
+    assert abs(ev - 0.5) < 0.1, ev
+
+
+def test_dueling_plus_c51_rejected():
+    from ray_tpu.rllib import DQNConfig
+
+    with pytest.raises(ValueError, match="dueling"):
+        DQNConfig().training(dueling=True, num_atoms=51).build()
+
+
+def test_c51_degenerate_support_rejected():
+    from ray_tpu.rllib import DQNConfig
+
+    with pytest.raises(ValueError, match="v_max > v_min"):
+        DQNConfig().training(num_atoms=21, v_min=1.0, v_max=1.0).build()
