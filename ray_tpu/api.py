@@ -44,6 +44,7 @@ def init(
     system_config: dict | None = None,
     ignore_reinit_error: bool = True,
     namespace: str | None = None,
+    log_to_driver: bool = True,
 ):
     """Start the runtime (reference: ``ray.init``, ``worker.py:1139``).
 
@@ -73,7 +74,8 @@ def init(
                     f"address must be 'host:port' or a (host, port) tuple, "
                     f"got {address!r}")
             address = (host or "127.0.0.1", int(port))
-        rt = ClusterRuntime(address, namespace=namespace)
+        rt = ClusterRuntime(address, namespace=namespace,
+                            log_to_driver=log_to_driver)
         _core.install_runtime(rt)
         return rt
     from ray_tpu._private.usage_stats import record_extra_usage_tag

@@ -41,7 +41,8 @@ class ClusterRuntime:
     """Connects ``ray_tpu.api`` to a running cluster (GCS + raylets)."""
 
     def __init__(self, gcs_address, raylet_address=None,
-                 namespace: str | None = None):
+                 namespace: str | None = None,
+                 log_to_driver: bool = False):
         self.gcs_address = tuple(gcs_address)
         # reconnecting: survives a GCS restart (file-backed recovery)
         self._gcs = ReconnectingRpcClient(self.gcs_address)
@@ -115,6 +116,31 @@ class ClusterRuntime:
             legacy_submit=self._legacy_submit,
             on_task_failed=self._fail_task_returns,
         )
+        # Worker-log echo (reference: log_monitor -> GCS pubsub ->
+        # driver stdout). Only top-level drivers subscribe — nested
+        # in-worker runtimes echoing would loop their own output back
+        # through the capture files forever.
+        self._log_sub = None
+        if log_to_driver:
+            from ray_tpu.runtime.rpc import PushSubscriber
+
+            self._log_sub = PushSubscriber(
+                self.gcs_address,
+                {"method": "subscribe", "channels": ["log"]},
+                self._print_worker_logs,
+                reconnect=True)   # survive a GCS restart like _gcs does
+
+    @staticmethod
+    def _print_worker_logs(msg: dict):
+        import sys
+
+        for entry in msg.get("entries", ()):
+            stream = (sys.stderr if entry.get("stream") == "err"
+                      else sys.stdout)
+            prefix = (f"(pid={entry.get('pid')}, "
+                      f"node={msg.get('node_id', '')[:8]})")
+            for line in entry.get("lines", ()):
+                print(f"{prefix} {line}", file=stream)
 
     # ------------------------------------------------------------------
     # objects
@@ -936,6 +962,8 @@ class ClusterRuntime:
 
     def shutdown(self):
         self._closed = True
+        if self._log_sub is not None:
+            self._log_sub.close()
         self._leases.stop()
         # grace for pusher threads already past their _closed checks to
         # finish touching the store before it unmaps

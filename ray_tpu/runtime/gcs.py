@@ -388,6 +388,14 @@ class GcsServer(RpcServer):
         send_msg(conn, {"subscribed": channels}, send_lock)
         return RpcServer.HELD
 
+    def rpc_publish_logs(self, conn, send_lock, *, node_id: str,
+                         entries: list):
+        """Raylet log monitors forward worker stdout/stderr lines here;
+        fan-out to CH_LOG subscribers (drivers echoing worker output —
+        reference: log_monitor.py -> GCS pubsub -> driver stdout)."""
+        self.publish(CH_LOG, {"node_id": node_id, "entries": entries})
+        return {}
+
     def publish(self, channel: str, message: dict):
         message = {"channel": channel, **message}
         with self._lock:

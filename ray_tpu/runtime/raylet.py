@@ -52,6 +52,13 @@ class Raylet(RpcServer):
         self.store = ShmObjectStore(self.store_name, capacity=store_capacity,
                                     create=True)
         self.labels = labels or {}
+        # per-worker stdout/stderr capture + forwarding to the driver
+        # (reference: the log_monitor process tailing the session log
+        # dir); workers write to files here, _log_monitor_loop tails
+        import tempfile
+
+        self.log_dir = tempfile.mkdtemp(
+            prefix=f"raytpu-logs-{node_id[:8]}-")
 
         # reconnecting: survives a GCS restart (file-backed recovery)
         self._gcs = ReconnectingRpcClient(self.gcs_address)
@@ -122,7 +129,8 @@ class Raylet(RpcServer):
                 labels=self.labels)
         loops = [self.scheduler.dispatch_loop, self._heartbeat_loop,
                  self.workers.monitor_loop, self.scheduler.infeasible_loop,
-                 self.objects.location_flush_loop]
+                 self.objects.location_flush_loop,
+                 self._log_monitor_loop]
         if self.objects.spill_enabled:
             loops.append(self.objects.spill_loop)
         if self._mem_threshold > 0:
@@ -170,6 +178,83 @@ class Raylet(RpcServer):
         except Exception:  # noqa: BLE001 - observability only
             self._agent_proc = None
 
+    def _log_monitor_loop(self, poll_s: float = 0.25,
+                          dead_linger_s: float = 5.0):
+        """Tail every capture file in the log dir and forward new
+        COMPLETE lines to the GCS log channel (reference:
+        log_monitor.py). Scanning the DIRECTORY (not live worker
+        handles) means a crashed worker's final output — its traceback —
+        still ships even though the pool reaps the handle within
+        ~0.1s; fully-drained files of dead workers are deleted after a
+        short linger so dicts and disk stay bounded under worker churn."""
+        offsets: dict[str, int] = {}
+        partial: dict[str, bytes] = {}
+        pid_of: dict[str, int] = {}         # filename stem -> pid
+        dead_since: dict[str, float] = {}
+        while not self._stopping:
+            with self.workers.lock:
+                live = {h.worker_id[:12]: (h.proc.pid if h.proc else 0)
+                        for h in self.workers.workers.values()}
+            pid_of.update(live)
+            entries = []
+            try:
+                names = sorted(os.listdir(self.log_dir))
+            except OSError:
+                names = []
+            for name in names:
+                path = os.path.join(self.log_dir, name)
+                stem, _, stream = name.rpartition(".")
+                stem = stem[len("worker-"):] if stem.startswith(
+                    "worker-") else stem
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                off = offsets.get(path, 0)
+                if size > off:
+                    take = min(size - off, 1 << 20)
+                    try:
+                        with open(path, "rb") as f:
+                            f.seek(off)
+                            data = partial.pop(path, b"") + f.read(take)
+                    except OSError:
+                        continue
+                    offsets[path] = off + take
+                    lines = data.split(b"\n")
+                    if lines and lines[-1]:
+                        partial[path] = lines[-1]   # incomplete tail
+                    lines = lines[:-1]
+                    # chunked, not truncated: every line ships even on
+                    # a burst bigger than one publish frame
+                    for i in range(0, len(lines), 500):
+                        entries.append({
+                            "pid": pid_of.get(stem, 0),
+                            "worker_id": stem,
+                            "stream": stream,
+                            "lines": [ln.decode("utf-8", "replace")
+                                      for ln in lines[i:i + 500]],
+                        })
+                elif stem not in live:
+                    # drained file of a dead worker: linger, then drop
+                    first = dead_since.setdefault(path, time.monotonic())
+                    if time.monotonic() - first > dead_linger_s:
+                        for d in (offsets, partial, dead_since):
+                            d.pop(path, None)
+                        pid_of.pop(stem, None)
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+            if entries:
+                try:
+                    with self._gcs_lock:
+                        self._gcs.call("publish_logs",
+                                       node_id=self.node_id,
+                                       entries=entries)
+                except Exception:  # noqa: BLE001 - GCS mid-restart
+                    pass
+            self._interruptible_sleep(poll_s)
+
     def stop(self):
         super().stop()
         self.objects.stop()
@@ -183,6 +268,9 @@ class Raylet(RpcServer):
         agent = getattr(self, "_agent_proc", None)
         if agent is not None and agent.poll() is None:
             agent.terminate()
+        import shutil
+
+        shutil.rmtree(self.log_dir, ignore_errors=True)
         self.store.close()
         self.objects.cleanup_disk()
 

@@ -415,24 +415,45 @@ class PendingCall:
 
 
 class PushSubscriber:
-    """Client side of a server-push channel (pubsub subscribe)."""
+    """Client side of a server-push channel (pubsub subscribe).
+
+    ``reconnect=True`` redials and re-subscribes after a dropped
+    connection (e.g. a GCS restart) — messages published while
+    disconnected are lost, matching pubsub semantics."""
 
     def __init__(self, address: tuple[str, int], subscribe_msg: dict,
-                 callback: Callable[[Any], None]):
-        self._sock = socket.create_connection(tuple(address), timeout=30)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                 callback: Callable[[Any], None], *,
+                 reconnect: bool = False,
+                 reconnect_delay_s: float = 1.0):
+        self._address = tuple(address)
+        self._subscribe_msg = subscribe_msg
         self._callback = callback
+        self._reconnect = reconnect
+        self._reconnect_delay_s = reconnect_delay_s
         self._closed = False
-        send_msg(self._sock, subscribe_msg)
+        self._sock = self._dial()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def _dial(self):
+        sock = socket.create_connection(self._address, timeout=30)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        send_msg(sock, self._subscribe_msg)
+        return sock
 
     def _loop(self):
         while not self._closed:
             try:
                 msg = recv_msg(self._sock)
             except (ConnectionLost, OSError, EOFError):
-                return
+                if not self._reconnect or self._closed:
+                    return
+                time.sleep(self._reconnect_delay_s)
+                try:
+                    self._sock = self._dial()
+                except OSError:
+                    continue   # server still down; retry next round
+                continue
             try:
                 self._callback(msg)
             except Exception:  # noqa: BLE001 - subscriber errors are isolated

@@ -43,6 +43,10 @@ class WorkerHandle:
     # set by the memory monitor right before a pressure kill so the death
     # handler stores OutOfMemoryError instead of WorkerCrashedError
     oom_killed: bool = False
+    # captured stdout/stderr file paths (tailed by the raylet log
+    # monitor and forwarded to drivers)
+    log_out: str | None = None
+    log_err: str | None = None
     dispatched_at: float = 0.0   # monotonic time the current task started
     # runtime-env identity this worker booted with; tasks only run on a
     # worker with a matching key (reference: (language, runtime_env)-
@@ -94,6 +98,9 @@ class WorkerPool:
             "RAY_TPU_NODE_ID": node.node_id,
             # workers never touch the TPU tunnel unless told to
             "JAX_PLATFORMS": env_get_default("JAX_PLATFORMS", "cpu"),
+            # stdout is a capture file now; without this, prints sit in
+            # the worker's block buffer instead of reaching the driver
+            "PYTHONUNBUFFERED": "1",
         })
         cmd = [sys.executable, "-m", "ray_tpu.runtime.worker_main"]
         container = (runtime_env or {}).get("container")
@@ -123,9 +130,35 @@ class WorkerPool:
                     container,
                     ["python", "-m", "ray_tpu.runtime.worker_main"],
                     env, runtime=runtime)
-        proc = subprocess.Popen(cmd, env=env, cwd=os.getcwd())
+        # Capture worker stdout/stderr into the raylet's log dir; the
+        # raylet's log monitor tails these and forwards lines to the
+        # driver (reference: worker logs -> session dir -> log_monitor)
+        log_dir = getattr(node, "log_dir", None)
+        log_out = log_err = None
+        stdout = stderr = None
+        if log_dir:
+            base = os.path.join(log_dir, f"worker-{worker_id[:12]}")
+            try:
+                stdout = open(base + ".out", "ab", buffering=0)
+                stderr = open(base + ".err", "ab", buffering=0)
+                log_out, log_err = base + ".out", base + ".err"
+            except OSError:
+                # disk-full/permission: run uncaptured, don't leak the
+                # half-opened fd
+                if stdout is not None:
+                    stdout.close()
+                stdout = stderr = None
+        try:
+            proc = subprocess.Popen(cmd, env=env, cwd=os.getcwd(),
+                                    stdout=stdout, stderr=stderr)
+        finally:
+            # Popen dup'd the fds; our handles can close immediately
+            if stdout is not None:
+                stdout.close()
+                stderr.close()
         handle = WorkerHandle(worker_id=worker_id, proc=proc,
                               env_key=_env_key(runtime_env))
+        handle.log_out, handle.log_err = log_out, log_err
         with self.lock:
             self.workers[worker_id] = handle
         return handle
