@@ -430,6 +430,13 @@ def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
 # @remote decorator
 # ---------------------------------------------------------------------------
 
+# ``lifetime``: accepted for reference-API compatibility
+# (``lifetime="detached"``); actors here are GCS-registered and survive
+# their creating driver ALREADY — the detached behavior is the default,
+# so the option is a documented no-op rather than a mode switch. (The
+# reference kills owner-bound actors on driver exit; this runtime
+# reclaims their workers only when the actor is killed or its process
+# dies.)
 _ACTOR_OPTION_KEYS = {
     "name", "namespace", "max_concurrency", "max_restarts", "num_cpus",
     "num_tpus", "memory", "resources", "lifetime", "runtime_env",
