@@ -324,3 +324,14 @@ def broadcast(array, src_rank: int = 0, group_name: str = "default"):
 
 def barrier(group_name: str = "default"):
     return get_group(group_name).barrier()
+
+
+def send(array, dst_rank: int, group_name: str = "default", tag: int = 0):
+    """Point-to-point send (reference: collective.py:531)."""
+    return get_group(group_name).send(array, dst_rank, tag)
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0,
+         timeout: float = 60.0):
+    """Point-to-point receive; returns the array."""
+    return get_group(group_name).recv(src_rank, tag, timeout)

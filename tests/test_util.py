@@ -117,6 +117,24 @@ def test_collective_reducescatter_sendrecv(rt):
     assert outs[1][1] == [42.0]
 
 
+def test_collective_module_level_send_recv(rt):
+    """Module-level col.send/col.recv wrappers (reference:
+    collective.py:531 exposes them at module scope)."""
+    @ray_tpu.remote
+    def rank_fn(rank, world):
+        col.init_collective_group(world, rank, "g4")
+        if rank == 0:
+            col.send(np.array([7.0, 8.0]), dst_rank=1, group_name="g4")
+            col.barrier("g4")
+            return "sent"
+        got = col.recv(src_rank=0, group_name="g4")
+        col.barrier("g4")
+        return got.tolist()
+
+    outs = ray_tpu.get([rank_fn.remote(r, 2) for r in range(2)])
+    assert outs[0] == "sent" and outs[1] == [7.0, 8.0]
+
+
 def test_dag_bind_execute(rt):
     @ray_tpu.remote
     def add(a, b):
