@@ -1,0 +1,162 @@
+// Native unit driver for the hybrid scheduling policy (reference
+// analog: raylet/scheduling/policy/hybrid_scheduling_policy_test.cc —
+// gtest there; a dependency-free assert driver here, like
+// store/store_test.cc). Build + run: `make -C src sched_test`; also run
+// under ASan via `make -C src sched_asan` (part of `make sanitizers`;
+// the policy is single-threaded so there is nothing for TSan to see).
+
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <set>
+#include <vector>
+
+extern "C" {
+int sched_pick_node(const double* totals, const double* avails,
+                    const unsigned char* alive,
+                    const unsigned char* excluded, int n_nodes,
+                    const double* demand, int n_kinds,
+                    double spread_threshold, int top_k, unsigned int seed);
+void sched_score_nodes(const double* totals, const double* avails,
+                       const unsigned char* alive, int n_nodes,
+                       const double* demand, int n_kinds,
+                       double* scores_out);
+}
+
+namespace {
+
+struct Fixture {
+  // 2 resource kinds (CPU, TPU) x 4 nodes
+  std::vector<double> totals;
+  std::vector<double> avails;
+  std::vector<unsigned char> alive;
+  std::vector<unsigned char> excluded;
+  int n = 4, k = 2;
+
+  Fixture() {
+    totals = {8, 0, /*n1*/ 8, 4, /*n2*/ 16, 0, /*n3*/ 8, 0};
+    avails = {8, 0, /*n1*/ 8, 4, /*n2*/ 4, 0, /*n3*/ 2, 0};
+    alive = {1, 1, 1, 1};
+    excluded = {0, 0, 0, 0};
+  }
+
+  int pick(const std::vector<double>& demand, double spread = 0.5,
+           int top_k = 1, unsigned seed = 0) {
+    return sched_pick_node(totals.data(), avails.data(), alive.data(),
+                           excluded.data(), n, demand.data(), k, spread,
+                           top_k, seed);
+  }
+};
+
+void test_prefers_emptiest_above_threshold() {
+  Fixture f;
+  // CPU demand 2: utilizations (with demand folded in) are
+  // n0 2/8=0.25 n1 2/8=0.25 n2 (12+2)/16=0.875 n3 (6+2)/8=1.0;
+  // spread 0.5 ties n0/n1 at the threshold; top_k=1 -> lowest index
+  assert(f.pick({2, 0}) == 0);
+}
+
+void test_infeasible_returns_minus1() {
+  Fixture f;
+  assert(f.pick({32, 0}) == -1);       // no node has 32 total CPU
+  assert(f.pick({0, 8}) == -1);        // no node has 8 TPU total
+}
+
+void test_feasible_but_busy_fallback() {
+  Fixture f;
+  // demand 6 CPU: only n2 (16 total) has... n0/n1/n3 total 8 >= 6 are
+  // feasible; available: n0 (8) yes. Exclude n0/n1, drain n2/n3 avail.
+  f.excluded[0] = f.excluded[1] = 1;
+  f.avails = {8, 0, 8, 4, 4, 0, 2, 0};
+  // n2 feasible (16 total) but only 4 avail < 6; n3 feasible(8) 2 avail
+  assert(f.pick({6, 0}) == 2);         // first feasible-but-busy
+}
+
+void test_excluded_and_dead_skipped() {
+  Fixture f;
+  f.excluded[0] = 1;
+  f.alive[1] = 0;
+  // n0 excluded, n1 dead -> among n2 (0.875) and n3 (1.0) pick n2
+  assert(f.pick({2, 0}) == 2);
+}
+
+void test_tpu_demand_routes_to_tpu_node() {
+  Fixture f;
+  assert(f.pick({1, 2}) == 1);         // only n1 has TPUs
+}
+
+void test_zero_demand_kind_still_penalizes_saturation() {
+  Fixture f;
+  // n1 TPUs fully used: a CPU-only task should prefer an idle CPU node
+  f.avails[1 * 2 + 1] = 0;             // n1 TPU avail 0/4 -> util 1.0
+  f.avails[0] = 8;                     // n0 idle
+  int got = f.pick({2, 0}, /*spread=*/0.0);
+  assert(got == 0);
+}
+
+void test_top_k_spreads_across_ties() {
+  Fixture f;
+  std::set<int> seen;
+  for (unsigned seed = 0; seed < 64; seed++) {
+    seen.insert(f.pick({2, 0}, 0.5, /*top_k=*/2, seed));
+  }
+  // n0 and n1 tie at the spread threshold; both must be reachable
+  assert(seen.count(0) == 1 && seen.count(1) == 1);
+  assert(seen.size() == 2);
+}
+
+void test_determinism_per_seed() {
+  Fixture f;
+  for (unsigned seed = 0; seed < 8; seed++) {
+    int a = f.pick({2, 0}, 0.5, 3, seed);
+    int b = f.pick({2, 0}, 0.5, 3, seed);
+    assert(a == b);
+  }
+}
+
+void test_huge_byte_quantities_no_overflow() {
+  // memory-scale resources: 64 GB totals in BYTES must not overflow
+  // the fixed-point micros representation
+  std::vector<double> totals = {64e9, 64e9};
+  std::vector<double> avails = {32e9, 8e9};
+  std::vector<unsigned char> alive = {1, 1}, excluded = {0, 0};
+  std::vector<double> demand = {16e9};
+  int got = sched_pick_node(totals.data(), avails.data(), alive.data(),
+                            excluded.data(), 2, demand.data(), 1, 0.0, 1,
+                            0);
+  assert(got == 0);                    // 0.75 util beats... n0 (32+16)/64
+  // n0: (32+16)/64 = 0.75; n1: (56+16)/64 -> >1 clamped to 1.0
+}
+
+void test_score_nodes_matches_pick_ordering() {
+  Fixture f;
+  std::vector<double> demand = {2, 0};
+  std::vector<double> scores(f.n);
+  sched_score_nodes(f.totals.data(), f.avails.data(), f.alive.data(),
+                    f.n, demand.data(), f.k, scores.data());
+  assert(scores[0] == 0.25 && scores[1] == 0.25);
+  assert(scores[2] > scores[1]);
+  assert(scores[3] > scores[2]);
+  // infeasible demand scores -1
+  std::vector<double> big = {32, 0};
+  sched_score_nodes(f.totals.data(), f.avails.data(), f.alive.data(),
+                    f.n, big.data(), f.k, scores.data());
+  for (int i = 0; i < f.n; i++) assert(scores[i] == -1.0);
+}
+
+}  // namespace
+
+int main() {
+  test_prefers_emptiest_above_threshold();
+  test_infeasible_returns_minus1();
+  test_feasible_but_busy_fallback();
+  test_excluded_and_dead_skipped();
+  test_tpu_demand_routes_to_tpu_node();
+  test_zero_demand_kind_still_penalizes_saturation();
+  test_top_k_spreads_across_ties();
+  test_determinism_per_seed();
+  test_huge_byte_quantities_no_overflow();
+  test_score_nodes_matches_pick_ordering();
+  std::printf("scheduling_test: all tests passed\n");
+  return 0;
+}

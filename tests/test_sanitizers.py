@@ -21,3 +21,14 @@ def test_store_under_sanitizer(target):
                           capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "store_test ok" in proc.stdout
+
+
+def test_scheduler_native_unit_driver():
+    """The scheduling-policy C++ unit driver (reference analog:
+    hybrid_scheduling_policy_test.cc) builds and passes."""
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no native toolchain")
+    proc = subprocess.run(["make", "-C", SRC, "sched_test"],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all tests passed" in proc.stdout
