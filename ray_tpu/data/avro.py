@@ -134,7 +134,10 @@ def _decode(schema, buf: io.BytesIO, names: dict):
     if schema == "null":
         return None
     if schema == "boolean":
-        return buf.read(1) == b"\x01"
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated avro boolean")
+        return b == b"\x01"
     if schema in ("int", "long"):
         return _read_long(buf)
     if schema == "float":

@@ -67,16 +67,19 @@ class ReplayBuffer:
     float) action spaces with one implementation."""
 
     def __init__(self, capacity: int, obs_dim: int,
-                 action_shape: tuple = (), action_dtype=np.int32):
+                 action_shape: tuple = (), action_dtype=np.int32,
+                 gamma: float = 0.99):
         self.capacity = capacity
+        self.gamma = gamma
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
         self.actions = np.zeros((capacity, *action_shape), action_dtype)
         self.rewards = np.zeros((capacity,), np.float32)
         self.dones = np.zeros((capacity,), np.float32)
-        # optional per-transition bootstrap factor (n-step folding);
-        # allocated on first batch that carries it
-        self.discounts: np.ndarray | None = None
+        # per-transition bootstrap factor; always allocated so ring
+        # slots can't silently hold stale values when some batches
+        # carry "discounts" and others don't
+        self.discounts = np.zeros((capacity,), np.float32)
         self.size = 0
         self.pos = 0
 
@@ -84,16 +87,19 @@ class ReplayBuffer:
         """Vectorized ring insert: at most two slice assignments per
         field (wraparound)."""
         n = len(batch["obs"])
+        if n == 0:
+            return
         if n >= self.capacity:  # keep only the newest capacity items
             batch = {k: v[-self.capacity:] for k, v in batch.items()}
             n = self.capacity
+        if "discounts" not in batch:
+            # derive the 1-step bootstrap factor so every slot is valid
+            batch = dict(batch)
+            batch["discounts"] = (self.gamma
+                                  * (1.0 - batch["dones"])).astype(np.float32)
         fields = [("obs", self.obs), ("next_obs", self.next_obs),
                   ("actions", self.actions), ("rewards", self.rewards),
-                  ("dones", self.dones)]
-        if "discounts" in batch:
-            if self.discounts is None:
-                self.discounts = np.zeros((self.capacity,), np.float32)
-            fields.append(("discounts", self.discounts))
+                  ("dones", self.dones), ("discounts", self.discounts)]
         first = min(n, self.capacity - self.pos)
         for name, dst in fields:
             src = batch[name]
@@ -105,12 +111,10 @@ class ReplayBuffer:
 
     def sample(self, batch_size: int, rng) -> dict:
         idx = rng.integers(0, self.size, size=batch_size)
-        out = {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
-               "actions": self.actions[idx], "rewards": self.rewards[idx],
-               "dones": self.dones[idx]}
-        if self.discounts is not None:
-            out["discounts"] = self.discounts[idx]
-        return out
+        return {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
+                "actions": self.actions[idx], "rewards": self.rewards[idx],
+                "dones": self.dones[idx],
+                "discounts": self.discounts[idx]}
 
 
 class _DQNRolloutWorker:
@@ -228,10 +232,10 @@ class DQN:
 
             self.buffer = PrioritizedReplayBuffer(
                 config.buffer_capacity, self.obs_dim,
-                alpha=config.pr_alpha)
+                alpha=config.pr_alpha, gamma=config.gamma)
         else:
             self.buffer = ReplayBuffer(config.buffer_capacity,
-                                       self.obs_dim)
+                                       self.obs_dim, gamma=config.gamma)
         self.iteration = 0
         self.rng = np.random.default_rng(config.seed)
         worker_cls = ray_tpu.remote(_DQNRolloutWorker)

@@ -29,7 +29,10 @@ class SumTree:
 
     def set(self, idx: np.ndarray, priority: np.ndarray):
         """Set leaf priorities and repair the path to the root."""
-        pos = np.asarray(idx, np.int64) + self.capacity
+        idx = np.asarray(idx, np.int64)
+        if idx.size == 0:
+            return
+        pos = idx + self.capacity
         self.tree[pos] = priority
         pos //= 2
         while pos[0] >= 1:
@@ -66,9 +69,10 @@ class PrioritizedReplayBuffer(ReplayBuffer):
 
     def __init__(self, capacity: int, obs_dim: int, *,
                  alpha: float = 0.6, action_shape: tuple = (),
-                 action_dtype=np.int32, eps: float = 1e-6):
+                 action_dtype=np.int32, eps: float = 1e-6,
+                 gamma: float = 0.99):
         super().__init__(capacity, obs_dim, action_shape=action_shape,
-                         action_dtype=action_dtype)
+                         action_dtype=action_dtype, gamma=gamma)
         self.alpha = alpha
         self.eps = eps
         self._tree = SumTree(capacity)
@@ -93,13 +97,11 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         probs = prios / max(total, 1e-12)
         weights = (self.size * probs + 1e-12) ** -beta
         weights = (weights / weights.max()).astype(np.float32)
-        out = {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
-               "actions": self.actions[idx],
-               "rewards": self.rewards[idx], "dones": self.dones[idx],
-               "weights": weights, "idx": idx}
-        if self.discounts is not None:
-            out["discounts"] = self.discounts[idx]
-        return out
+        return {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
+                "actions": self.actions[idx],
+                "rewards": self.rewards[idx], "dones": self.dones[idx],
+                "weights": weights, "idx": idx,
+                "discounts": self.discounts[idx]}
 
     def update_priorities(self, idx: np.ndarray, td_errors: np.ndarray):
         priority = (np.abs(td_errors) + self.eps) ** self.alpha

@@ -183,7 +183,8 @@ class TD3:
         self.opt_state = self.tx.init(self.params)
         self.buffer = ReplayBuffer(config.buffer_capacity, self.obs_dim,
                                    action_shape=(self.action_dim,),
-                                   action_dtype=np.float32)
+                                   action_dtype=np.float32,
+                                   gamma=config.gamma)
         self.iteration = 0
         self.update_count = 0
         self.rng = np.random.default_rng(config.seed)

@@ -238,6 +238,16 @@ class Raylet(RpcServer):
                     # drained file of a dead worker: linger, then drop
                     first = dead_since.setdefault(path, time.monotonic())
                     if time.monotonic() - first > dead_linger_s:
+                        tail = partial.get(path)
+                        if tail:
+                            # a crashed worker's final line may lack a
+                            # trailing newline — ship it before cleanup
+                            entries.append({
+                                "pid": pid_of.get(stem, 0),
+                                "worker_id": stem,
+                                "stream": stream,
+                                "lines": [tail.decode("utf-8", "replace")],
+                            })
                         for d in (offsets, partial, dead_since):
                             d.pop(path, None)
                         pid_of.pop(stem, None)

@@ -101,7 +101,7 @@ class JaxTrainer:
             else PRESETS[cfg.strategy]
         )
         self.optimizer = self._make_optimizer()
-        self._jit_step = None
+        self._jit_step = {}
         # Sequence parallelism: use ring attention when the rules shard seq
         # over a mesh axis that actually exists on this mesh.
         sp = self.rules.seq
@@ -336,15 +336,23 @@ class JaxTrainer:
         return jax.tree.map(leaf, batch)
 
     def compile_step(self, state: TrainState, batch):
-        if self._jit_step is None:
+        # keyed on the batch pytree structure + leaf ranks: a later
+        # batch with a different structure gets its own jit rather than
+        # hitting stale in_shardings
+        key = (jax.tree.structure(batch),
+               tuple(int(getattr(x, "ndim", 0))
+                     for x in jax.tree.leaves(batch)))
+        step = self._jit_step.get(key)
+        if step is None:
             donate = (0,) if self.cfg.donate_state else ()
-            self._jit_step = jax.jit(
+            step = jax.jit(
                 self._step,
                 # state keeps its shardings
                 in_shardings=(None, self._batch_shardings(batch)),
                 donate_argnums=donate,
             )
-        return self._jit_step
+            self._jit_step[key] = step
+        return step
 
     def train_step(self, state: TrainState, batch):
         """One SPMD optimization step. ``batch``: int32 [B, S+1] tokens
