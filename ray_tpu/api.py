@@ -402,8 +402,14 @@ class ActorClass:
             max_restarts=opts.get("max_restarts", 0),
             runtime_env=_normalize_runtime_env(opts.get("runtime_env")),
         )
-        actor_id = rt.create_actor(spec, name=opts.get("name"),
-                                   namespace=opts.get("namespace"))
+        lifetime = opts.get("lifetime")
+        if lifetime not in (None, "detached", "non_detached"):
+            raise ValueError(
+                f"lifetime must be None, 'detached' or 'non_detached', "
+                f"got {lifetime!r}")
+        actor_id = rt.create_actor(
+            spec, name=opts.get("name"), namespace=opts.get("namespace"),
+            lifetime=None if lifetime == "non_detached" else lifetime)
         return ActorHandle(actor_id, self._cls.__name__)
 
 
@@ -430,13 +436,11 @@ def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
 # @remote decorator
 # ---------------------------------------------------------------------------
 
-# ``lifetime``: accepted for reference-API compatibility
-# (``lifetime="detached"``); actors here are GCS-registered and survive
-# their creating driver ALREADY — the detached behavior is the default,
-# so the option is a documented no-op rather than a mode switch. (The
-# reference kills owner-bound actors on driver exit; this runtime
-# reclaims their workers only when the actor is killed or its process
-# dies.)
+# ``lifetime``: owner-scoped actor lifetime (reference: actor.py:524 +
+# gcs_actor_manager.cc:632). Default: the actor dies when its owning
+# client (the creating driver/worker runtime) disconnects or misses
+# heartbeats; ``lifetime="detached"`` opts the actor out — it survives
+# until killed explicitly or its process dies.
 _ACTOR_OPTION_KEYS = {
     "name", "namespace", "max_concurrency", "max_restarts", "num_cpus",
     "num_tpus", "memory", "resources", "lifetime", "runtime_env",

@@ -122,11 +122,13 @@ class ClientRuntime:
     # -- actors ---------------------------------------------------------
 
     def create_actor(self, spec: TaskSpec, name: str | None = None,
-                     namespace: str | None = None):
+                     namespace: str | None = None,
+                     lifetime: str | None = None):
         out = self._rpc.call(
             "client_create_actor",
             name=name,
             namespace=namespace,
+            lifetime=lifetime,
             class_name=spec.function_name,
             cls_blob=cloudpickle.dumps(spec.function, protocol=5),
             args_blob=self._wire_args(spec),

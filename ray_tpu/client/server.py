@@ -47,6 +47,23 @@ class ClientServer(RpcServer):
             self._rt = ray_tpu.init(
                 num_cpus=num_cpus if num_cpus is not None else 4,
                 num_tpus=0)
+        # Ownership lives HERE (reference: the client server owns client
+        # objects — util/client/server/): remote clients hold no process-
+        # local ObjectRefs, so the server retains one per client-visible
+        # object or distributed refcounting would free them the moment
+        # the transient RPC-scope ref dropped. Scoped per CONNECTION and
+        # dropped on disconnect (a client session's objects die with it,
+        # matching the reference's per-client proxier lifetime); explicit
+        # client_free releases earlier.
+        self._held: dict[int, dict[str, ObjectRef]] = {}
+
+    def _retain(self, conn, refs):
+        table = self._held.setdefault(id(conn), {})
+        for r in refs:
+            table.setdefault(r.hex(), r)
+
+    def on_disconnect(self, conn):
+        self._held.pop(id(conn), None)
 
     # -- session ---------------------------------------------------------
 
@@ -61,6 +78,7 @@ class ClientServer(RpcServer):
 
     def rpc_client_put(self, conn, send_lock, *, blob: bytes) -> str:
         ref = self._rt.put(cloudpickle.loads(blob))
+        self._retain(conn, [ref])
         return ref.id.hex()
 
     def rpc_client_get(self, conn, send_lock, *, oids, get_timeout=None):
@@ -82,6 +100,9 @@ class ClientServer(RpcServer):
                 "not_ready": [r.id.hex() for r in not_ready]}
 
     def rpc_client_free(self, conn, send_lock, *, oids):
+        for table in self._held.values():
+            for o in oids:
+                table.pop(o, None)
         self._rt.free([ObjectRef(ObjectID.from_hex(o)) for o in oids])
         return {"ok": True}
 
@@ -113,6 +134,7 @@ class ClientServer(RpcServer):
         )
         refs = self._rt.submit_task(spec)
         self._rt.note_return_owner(spec)
+        self._retain(conn, refs)
         return [r.id.hex() for r in refs]
 
     def rpc_client_submit_actor_task(self, conn, send_lock, *, actor_id,
@@ -133,6 +155,7 @@ class ClientServer(RpcServer):
         )
         refs = self._rt.submit_task(spec)
         self._rt.note_return_owner(spec)
+        self._retain(conn, refs)
         return [r.id.hex() for r in refs]
 
     # -- actors ----------------------------------------------------------
@@ -140,7 +163,7 @@ class ClientServer(RpcServer):
     def rpc_client_create_actor(self, conn, send_lock, *, name, class_name,
                                 cls_blob, args_blob, resources,
                                 max_concurrency, max_restarts, runtime_env,
-                                namespace=None):
+                                namespace=None, lifetime=None):
         args, kwargs = _unwire_args(args_blob)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
@@ -158,7 +181,8 @@ class ClientServer(RpcServer):
         )
         try:
             actor_id = self._rt.create_actor(spec, name=name,
-                                             namespace=namespace)
+                                             namespace=namespace,
+                                             lifetime=lifetime)
         except ValueError as e:
             return {"error": str(e), "actor_id": None}
         return {"error": None, "actor_id": actor_id.hex()}

@@ -202,6 +202,40 @@ class Runtime:
         # ray.timeline() chrome-trace export)
         self._task_events: deque = deque(maxlen=10000)
 
+        # --- reference counting (local mode: immediate in-process
+        # release — the cluster protocol's GCS half collapses to a
+        # store.free call; see runtime/refcount.py) ---
+        from ray_tpu.runtime.refcount import global_counter as _refs
+        self._refs = _refs
+        self._ref_enabled = self.config.ref_counting_enabled
+        # released-before-created oids (fire-and-forget returns): freed
+        # the moment the producing task stores them
+        self._released_oids: set[ObjectID] = set()
+        if self._ref_enabled:
+            self._refs.set_local_release(self._on_ref_zero)
+            threading.Thread(target=self._ref_poll_loop, daemon=True,
+                             name="ref-poller").start()
+
+    def _ref_poll_loop(self):
+        while not self._shutdown:
+            time.sleep(0.05)
+            self._refs.poll_local()
+
+    def _on_ref_zero(self, oid_hex: str):
+        """No live ObjectRef instance anywhere in this process: free the
+        stored value (or arrange free-on-arrival for a result whose task
+        is still running — fire-and-forget returns). Marked BEFORE the
+        store check: an object arriving in between is caught by either
+        this free or _on_object_available's released check (free is
+        idempotent; both sides discard the mark)."""
+        oid = ObjectID.from_hex(oid_hex)
+        self._released_oids.add(oid)
+        while len(self._released_oids) > 1_000_000:
+            self._released_oids.pop()
+        if self.store.contains(oid):
+            self._released_oids.discard(oid)
+            self.store.free([oid])
+
     def record_task_event(self, spec: TaskSpec, start: float, end: float,
                           ok: bool):
         self._task_events.append({
@@ -455,6 +489,13 @@ class Runtime:
                     fut.set_exception(value)
                 else:
                     fut.set_result(value)
+        if oid in self._released_oids:
+            # every reference was dropped before the producing task
+            # finished: free on arrival (futures above resolved first);
+            # discard-then-free mirrors _on_ref_zero so the concurrent
+            # paths converge on exactly one (idempotent) free
+            self._released_oids.discard(oid)
+            self.store.free([oid])
 
     def _mark_ready(self, spec: TaskSpec):
         if spec.task_type == TaskType.ACTOR_TASK:
@@ -637,7 +678,10 @@ class Runtime:
         return current_task_namespace() or self.namespace
 
     def create_actor(self, spec: TaskSpec, name: str | None = None,
-                     namespace: str | None = None) -> ActorID:
+                     namespace: str | None = None,
+                     lifetime: str | None = None) -> ActorID:
+        # ``lifetime`` is owner-scoped in cluster mode; in local mode the
+        # owner IS this process, so every actor dies with it either way.
         actor_id = ActorID.from_random()
         spec.actor_id = actor_id
         ns = self._effective_namespace(namespace)
@@ -890,6 +934,9 @@ class Runtime:
 
     def shutdown(self):
         self._shutdown = True
+        if self._ref_enabled:
+            self._refs.set_local_release(None)
+            self._refs.reset()
         with self._ready_cv:
             self._ready_cv.notify_all()
         with self._res_cv:

@@ -47,6 +47,14 @@ class ClusterRuntime:
         # reconnecting: survives a GCS restart (file-backed recovery)
         self._gcs = ReconnectingRpcClient(self.gcs_address)
         self.caller_id = WorkerID.from_random().hex()
+        # ref-counting client identity: inside a pool worker the PROCESS
+        # id (the Worker's flusher owns the channel there — one client
+        # per process so holder attribution is consistent); drivers use
+        # their own caller id (reference: reference_count.h — per-worker
+        # ownership)
+        import os as _os
+        self.client_id = _os.environ.get("RAY_TPU_WORKER_ID",
+                                         self.caller_id)
         # Namespace for named actors (reference: worker.py:1157,1258):
         # explicit init(namespace=...), else the job's own id — two jobs
         # on one cluster never collide on actor names by default. Worker-
@@ -129,6 +137,28 @@ class ClusterRuntime:
                 {"method": "subscribe", "channels": ["log"]},
                 self._print_worker_logs,
                 reconnect=True)   # survive a GCS restart like _gcs does
+        # --- distributed refcounting (reference: reference_count.h:61;
+        # see runtime/refcount.py): this runtime flushes the process's
+        # ref deltas to the GCS and doubles as the client heartbeat that
+        # scopes actor lifetimes. Inside a pool worker the Worker loop
+        # already owns the process flush channel — skip ours. ---
+        from ray_tpu.runtime import refcount as _refcount
+        from ray_tpu.utils.config import get_config as _get_config
+        _cfg = _get_config()
+        self._refs = _refcount.global_counter
+        self._ref_enabled = _cfg.ref_counting_enabled
+        self._ref_interval = _cfg.ref_flush_interval_s
+        self._ref_send_lock = threading.Lock()
+        self._owns_flusher = (self._ref_enabled
+                              and _refcount.claim_flusher(self.client_id))
+        if self._owns_flusher:
+            try:
+                self._gcs.call("register_client", client_id=self.client_id,
+                               kind="driver")
+            except Exception:  # noqa: BLE001 - reconnecting client retries
+                pass
+            threading.Thread(target=self._ref_flush_loop, daemon=True,
+                             name="ref-flusher").start()
 
     @staticmethod
     def _print_worker_logs(msg: dict):
@@ -141,6 +171,50 @@ class ClusterRuntime:
                       f"node={msg.get('node_id', '')[:8]})")
             for line in entry.get("lines", ()):
                 print(f"{prefix} {line}", file=stream)
+
+    # ------------------------------------------------------------------
+    # refcount flushing
+    # ------------------------------------------------------------------
+
+    def _ref_flush_loop(self):
+        last_beat = 0.0
+        while not self._closed:
+            time.sleep(self._ref_interval)
+            now = time.monotonic()
+            # an empty update every ~2s keeps the client-liveness
+            # heartbeat alive (actor lifetimes hang off it)
+            beat = now - last_beat >= 2.0
+            if self._ref_flush_now(force_heartbeat=beat) or beat:
+                last_beat = now
+
+    def _ref_flush_now(self, force_heartbeat: bool = False) -> bool:
+        """Send pending ref deltas (serialized by a lock so the loop and
+        synchronous borrower flushes never interleave a payload)."""
+        if not self._ref_enabled or self._closed:
+            return False
+        with self._ref_send_lock:
+            payload = self._refs.take_flush()
+            if payload is None and not force_heartbeat:
+                return False
+            if payload and payload["remove"]:
+                # dropped refs lose reconstructability too (the object
+                # is gone; resurrecting it would leak)
+                with self._lineage_lock:
+                    for oid_hex in payload["remove"]:
+                        self._lineage.pop(oid_hex, None)
+            try:
+                reply = self._gcs.call("ref_update",
+                                       client_id=self.client_id,
+                                       kind="driver", **(payload or {}))
+                if reply.get("resync"):
+                    # the GCS reaped us during a heartbeat gap and
+                    # dropped our holds: re-register everything held
+                    self._refs.force_resync()
+                return True
+            except Exception:  # noqa: BLE001 - GCS unreachable: requeue
+                if payload:
+                    self._refs.restore_flush(payload)
+                return False
 
     # ------------------------------------------------------------------
     # objects
@@ -182,8 +256,14 @@ class ClusterRuntime:
             if pending:
                 self._recover_lost(pending)
         out = []
+        epoch0 = self._refs.created_epoch() if self._ref_enabled else 0
         for oid_hex in oids:
             out.append(self._read_local(oid_hex, deadline))
+        if self._ref_enabled and self._refs.created_epoch() != epoch0:
+            # the values carried nested ObjectRefs (this process just
+            # became a borrower): register the holds synchronously so
+            # the owner dropping the outer cannot free the inners first
+            self._ref_flush_now()
         return out
 
     # ------------------------------------------------------------------
@@ -413,19 +493,32 @@ class ClusterRuntime:
 
     _EMPTY_ARGS_BLOB = cloudpickle.dumps(([], {}), protocol=5)
 
-    def _wire_args(self, spec: TaskSpec):
+    def _wire_args(self, spec: TaskSpec, pin_sink: set | None = None):
         """Replace top-level ObjectRefs with markers (reference semantics:
         only top-level args are resolved before execution). Plain-data
         args take the C pickler (~5x the Python-level cloudpickle
         Pickler on small payloads — the per-call cost that matters at
         10k+ submits/s); closures/lambdas in args fall back to
-        cloudpickle."""
+        cloudpickle.
+
+        ``pin_sink``: collects the oid of every ref the task depends on
+        (top-level markers AND refs nested inside arg containers, found
+        via serialization capture) so the submitter can pin them for the
+        task's lifetime (reference: submitted-task references,
+        reference_count.h:61)."""
         if not spec.args and not spec.kwargs:
             return self._EMPTY_ARGS_BLOB
-        args = [("__objref__", a.id.hex()) if isinstance(a, ObjectRef) else a
+        args = [("__objref__", a.hex()) if isinstance(a, ObjectRef) else a
                 for a in spec.args]
-        kwargs = {k: ("__objref__", v.id.hex()) if isinstance(v, ObjectRef)
+        kwargs = {k: ("__objref__", v.hex()) if isinstance(v, ObjectRef)
                   else v for k, v in spec.kwargs.items()}
+        if pin_sink is not None:
+            pin_sink.update(a[1] for a in args
+                            if type(a) is tuple and len(a) == 2
+                            and a[0] == "__objref__")
+            pin_sink.update(v[1] for v in kwargs.values()
+                            if type(v) is tuple and len(v) == 2
+                            and v[0] == "__objref__")
         # The C pickler fast path is gated to builtin SCALARS only:
         # stdlib pickle serializes __main__-defined classes by REFERENCE
         # (workers can't resolve them — their __main__ is worker_main),
@@ -436,22 +529,35 @@ class ClusterRuntime:
                    type(v) in _SCALAR_TYPES for v in kwargs.values()):
             import pickle
             return pickle.dumps((args, kwargs), protocol=5)
-        return cloudpickle.dumps((args, kwargs), protocol=5)
+        # nested refs inside containers surface through the capture hook
+        with self._refs.capture() as cap:
+            blob = cloudpickle.dumps((args, kwargs), protocol=5)
+        if pin_sink is not None:
+            pin_sink.update(cap.oids)
+        return blob
 
-    def _function_blob(self, fn) -> bytes:
+    def _function_blob(self, fn):
         """Pickle-once function export (reference: the GCS function table
         — ``_private/function_manager.py:228`` exports each function once;
         executors fetch by id). Re-pickling the closure on EVERY submit
-        dominates the hot path for small tasks."""
+        dominates the hot path for small tasks.
+
+        Returns ``(blob, closure_oids)`` — ObjectRefs captured in the
+        function's CLOSURE are task dependencies too: every submit pins
+        them alongside the args (the cache keeps the captured set, so
+        repeat submits pin without re-pickling)."""
         key = id(fn)
         hit = self._fn_blobs.get(key)
         if hit is not None and hit[0] is fn:
-            return hit[1]
-        blob = cloudpickle.dumps(fn, protocol=5)
+            return hit[1], hit[2]
+        with self._refs.capture() as cap:
+            blob = cloudpickle.dumps(fn, protocol=5)
+        closure_oids = frozenset(cap.oids)
         if len(self._fn_blobs) > 512:
             self._fn_blobs.clear()
-        self._fn_blobs[key] = (fn, blob)   # fn ref pins id(fn) stable
-        return blob
+        # fn ref pins id(fn) stable
+        self._fn_blobs[key] = (fn, blob, closure_oids)
+        return blob, closure_oids
 
     def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
         streaming = spec.num_returns in ("streaming", "dynamic")
@@ -471,11 +577,14 @@ class ClusterRuntime:
         if spec.task_type == TaskType.ACTOR_TASK:
             self._submit_actor_task(spec)
         else:
+            pin_oids: set = set()
+            fn_blob, closure_oids = self._function_blob(spec.function)
+            pin_oids.update(closure_oids)
             task = {
                 "task_id": spec.task_id.hex(),
                 "name": spec.function_name,
-                "function_blob": self._function_blob(spec.function),
-                "args_blob": self._wire_args(spec),
+                "function_blob": fn_blob,
+                "args_blob": self._wire_args(spec, pin_oids),
                 "return_oids": [o.hex() for o in spec.return_ids],
                 "resources": dict(spec.resources.resources),
                 "strategy": _wire_strategy(spec),
@@ -486,6 +595,13 @@ class ClusterRuntime:
             }
             if streaming:
                 task["streaming"] = True
+            if pin_oids and self._ref_enabled:
+                # pin the args for the task's lifetime; the executing
+                # worker releases after it finishes ("pinned" tells it
+                # a pin exists to release)
+                task["pinned"] = True
+                self._refs.add_task_pins(spec.task_id.hex(),
+                                         sorted(pin_oids))
             if spec.max_retries > 0:
                 deps = [a.id.hex() for a in spec.args
                         if isinstance(a, ObjectRef)]
@@ -533,6 +649,9 @@ class ClusterRuntime:
                else exc.WorkerCrashedError(
                    f"worker lease broke while executing "
                    f"{task.get('name', '?')}: {error}"))
+        if task.get("pinned"):
+            # the task will never run to release its arg pins itself
+            self._refs.release_task_pin(task.get("task_id", ""))
         for oid_hex in task.get("return_oids", ()):
             if locs.get(oid_hex):
                 continue  # the task actually finished before the break
@@ -567,21 +686,32 @@ class ClusterRuntime:
         return current_task_namespace() or self.namespace
 
     def create_actor(self, spec: TaskSpec, name: str | None = None,
-                     namespace: str | None = None) -> ActorID:
+                     namespace: str | None = None,
+                     lifetime: str | None = None) -> ActorID:
         actor_id = ActorID.from_random()
         spec.actor_id = actor_id
         ns = self._effective_namespace(namespace)
+        pin_oids: set = set()
+        with self._refs.capture() as _cls_cap:
+            cls_blob = cloudpickle.dumps(spec.function, protocol=5)
+        pin_oids.update(_cls_cap.oids)
         creation = {
             "task_id": spec.task_id.hex(),
             "name": spec.function_name,
-            "function_blob": cloudpickle.dumps(spec.function, protocol=5),
-            "args_blob": self._wire_args(spec),
+            "function_blob": cls_blob,
+            "args_blob": self._wire_args(spec, pin_oids),
             "return_oids": [ObjectID.from_random().hex()],
             "resources": dict(spec.resources.resources),
             "max_concurrency": spec.max_concurrency,
             "runtime_env": spec.runtime_env,
             "namespace": ns,
         }
+        if pin_oids and self._ref_enabled:
+            creation["pinned"] = True
+            self._refs.add_task_pins(spec.task_id.hex(), sorted(pin_oids))
+            # the pin must exist at the GCS before the raylet-hosted
+            # creation task can finish and release it
+            self._ref_flush_now()
         strategy = _wire_strategy(spec)
         self._gcs.call(
             "register_actor", actor_id=actor_id.hex(), name=name,
@@ -589,7 +719,9 @@ class ClusterRuntime:
             resources=dict(spec.resources.resources),
             max_restarts=spec.max_restarts,
             pg_id=strategy.get("pg_id"),
-            namespace=ns)
+            namespace=ns,
+            owner_id=self.client_id if self._ref_enabled else None,
+            lifetime=lifetime)
         return actor_id
 
     def _actor_location(self, actor_id_hex: str, timeout: float = 30.0):
@@ -631,16 +763,20 @@ class ClusterRuntime:
         seq buffer tolerates wire reordering). Blocks only when the
         actor's unacked window is full."""
         actor_hex = spec.actor_id.hex()
+        pin_oids: set = set()
         task = {
             "task_id": spec.task_id.hex(),
             "name": spec.function_name,
             "actor_id": actor_hex,
             "method_name": spec.actor_method_name,
-            "args_blob": self._wire_args(spec),
+            "args_blob": self._wire_args(spec, pin_oids),
             "return_oids": [o.hex() for o in spec.return_ids],
             "caller_id": self.caller_id,
             "trace_ctx": spec.trace_ctx,
         }
+        if pin_oids and self._ref_enabled:
+            task["pinned"] = True
+            self._refs.add_task_pins(spec.task_id.hex(), sorted(pin_oids))
         if spec.num_returns in ("streaming", "dynamic"):
             # generator METHOD: worker-side _store_returns streams the
             # yields exactly like a generator task
@@ -826,6 +962,8 @@ class ClusterRuntime:
                 ConnectionLost, LookupError, TimeoutError) as e:
             err = e if isinstance(e, exc.RayTpuError) else \
                 exc.ActorDiedError(actor_hex, repr(e))
+        if task.get("pinned"):
+            self._refs.release_task_pin(task.get("task_id", ""))
         for oid_hex in task.get("return_oids", ()):
             oid = bytes.fromhex(oid_hex)
             if not self.store.contains(oid):
@@ -961,6 +1099,18 @@ class ClusterRuntime:
         return self._gcs.call("cluster_resources")["available"]
 
     def shutdown(self):
+        if self._owns_flusher:
+            # clean exit = immediate owner-death semantics: the GCS
+            # drops this client's holds and reaps its non-detached
+            # actors (reference: driver exit, gcs_actor_manager.cc:632)
+            try:
+                self._gcs.call("unregister_client",
+                               client_id=self.client_id)
+            except Exception:  # noqa: BLE001 - timeout reaping covers it
+                pass
+            from ray_tpu.runtime import refcount as _refcount
+            _refcount.release_flusher(self.client_id)
+            self._refs.reset()
         self._closed = True
         if self._log_sub is not None:
             self._log_sub.close()

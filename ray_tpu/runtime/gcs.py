@@ -56,6 +56,12 @@ class ActorInfo:
     # logical namespace scoping the name (reference: worker.py:1157 —
     # named actors are unique PER NAMESPACE, not cluster-global)
     namespace: str = "default"
+    # owner-scoped lifetime (reference: gcs_actor_manager.cc:632 — a
+    # non-detached actor dies with its owner; lifetime="detached" opts
+    # out, actor.py:524). owner_id is the creating client; None (e.g.
+    # external-language clients) means detached.
+    owner_id: str | None = None
+    detached: bool = True
     node_id: str | None = None
     creation_spec: bytes | None = None   # pickled wire spec (for restart)
     resources: dict = field(default_factory=dict)
@@ -209,6 +215,24 @@ class GcsServer(RpcServer):
         # pubsub: channel -> list of (conn, send_lock)
         self._subs: dict[str, list] = {}
         self._hb_timeout = heartbeat_timeout_s
+        # --- distributed refcounting (reference: reference_count.h:61;
+        # centralized here to match the centralized object directory).
+        # count(oid) = holders + task pins + contains edges; a decrement
+        # to zero releases every registered copy cluster-wide. ---
+        from ray_tpu.utils.config import get_config as _get_config
+        _cfg = _get_config()
+        self._client_timeout = _cfg.client_timeout_s
+        self._ref_grace = _cfg.ref_release_grace_s
+        self._clients: dict[str, dict] = {}        # id -> kind/last_seen/alive
+        self._ref_holders: dict[str, set] = {}     # oid -> holder client ids
+        self._ref_pins: dict[str, tuple] = {}      # task_id -> (client, oids)
+        self._ref_pin_count: dict[str, int] = {}   # oid -> pin contributions
+        self._pin_released: dict[str, None] = {}   # early-release tombstones
+        self._ref_contains: dict[str, list] = {}   # outer oid -> inner oids
+        self._ref_contained: dict[str, int] = {}   # inner oid -> edge count
+        self._ref_released: dict[str, None] = {}   # freed oids (tombstones)
+        self._pending_release: dict[str, set] = {} # node -> oids to free
+        self._deferred_contains: list = []         # (due, [inner oids])
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True)
         self._task_events: list[dict] = []           # bounded task event sink
@@ -252,6 +276,23 @@ class GcsServer(RpcServer):
                                for o, ls in self._object_dir.items()},
                 "object_meta": dict(self._object_meta),
                 "lost_objects": list(self._lost_objects),
+                # refcount state rides the snapshot (not the WAL — the
+                # mutation rate is too high); a crash loses at most one
+                # snapshot period of deltas
+                "ref": {
+                    "clients": {cid: c["kind"]
+                                for cid, c in self._clients.items()
+                                if c["alive"]},
+                    "holders": {o: sorted(hs)
+                                for o, hs in self._ref_holders.items()},
+                    "pins": {t: (c, list(os_))
+                             for t, (c, os_) in self._ref_pins.items()},
+                    "contains": {o: list(i)
+                                 for o, i in self._ref_contains.items()},
+                    "released": list(self._ref_released),
+                    "pending_release": {n: sorted(s) for n, s in
+                                        self._pending_release.items()},
+                },
             }
 
     def _apply_record(self, kind: str, key, payload):
@@ -297,6 +338,34 @@ class GcsServer(RpcServer):
                                 for o, ls in state["object_dir"].items()}
             self._object_meta = dict(state["object_meta"])
             self._lost_objects = dict.fromkeys(state["lost_objects"])
+            ref = state.get("ref")
+            if ref:
+                # client last_seen is process-local monotonic time:
+                # reset to "now" so live clients get a full timeout
+                # window to resume heartbeating after the restart
+                now = time.monotonic()
+                self._clients = {cid: {"kind": k, "last_seen": now,
+                                       "alive": True}
+                                 for cid, k in ref["clients"].items()}
+                self._ref_holders = {o: set(hs)
+                                     for o, hs in ref["holders"].items()}
+                self._ref_pins = {t: (c, list(os_))
+                                  for t, (c, os_) in ref["pins"].items()}
+                self._ref_pin_count = {}
+                for _, (_, os_) in self._ref_pins.items():
+                    for o in os_:
+                        self._ref_pin_count[o] = \
+                            self._ref_pin_count.get(o, 0) + 1
+                self._ref_contains = {o: list(i)
+                                      for o, i in ref["contains"].items()}
+                self._ref_contained = {}
+                for inners in self._ref_contains.values():
+                    for o in inners:
+                        self._ref_contained[o] = \
+                            self._ref_contained.get(o, 0) + 1
+                self._ref_released = dict.fromkeys(ref["released"])
+                self._pending_release = {n: set(s) for n, s in
+                                         ref["pending_release"].items()}
         for kind, key, payload in records:
             try:
                 self._apply_record(kind, key, payload)
@@ -444,7 +513,7 @@ class GcsServer(RpcServer):
         return {"ok": True}
 
     def rpc_heartbeat(self, conn, send_lock, *, node_id, available,
-                      load=None, host_stats=None):
+                      load=None, host_stats=None, freed_acks=None):
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
@@ -453,6 +522,19 @@ class GcsServer(RpcServer):
             node.available = dict(available)
             if host_stats:
                 node.host_stats = dict(host_stats)
+            # refcount release delivery is piggybacked on the heartbeat:
+            # at-least-once (re-sent until the node acks on its next
+            # beat; release is idempotent on the raylet side)
+            if freed_acks:
+                pend = self._pending_release.get(node_id)
+                if pend is not None:
+                    pend.difference_update(freed_acks)
+                    if not pend:
+                        del self._pending_release[node_id]
+            pend = self._pending_release.get(node_id)
+            release = sorted(pend)[:5000] if pend else None
+        if release:
+            return {"ok": True, "release_oids": release}
         return {"ok": True}
 
     def rpc_get_nodes(self, conn, send_lock, *, alive_only: bool = True):
@@ -480,12 +562,18 @@ class GcsServer(RpcServer):
                         if n.alive and now - n.last_heartbeat > self._hb_timeout]
             for node_id in dead:
                 self._mark_node_dead(node_id, reason="heartbeat timeout")
+            try:
+                self._process_deferred_contains()
+                self._reap_stale_clients()
+            except Exception:  # noqa: BLE001 - next tick retries
+                pass
 
     def _mark_node_dead(self, node_id: str, reason: str):
         with self._lock:
             # a dead node's parked demand must not drive the autoscaler
             # forever
             self._pending_demand.pop(node_id, None)
+            self._pending_release.pop(node_id, None)
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
                 return
@@ -511,8 +599,13 @@ class GcsServer(RpcServer):
 
     def rpc_register_actor(self, conn, send_lock, *, actor_id, name,
                            creation_spec, resources, max_restarts,
-                           pg_id=None, namespace=None):
+                           pg_id=None, namespace=None, owner_id=None,
+                           lifetime=None):
         namespace = namespace or "default"
+        # owner-scoped lifetime (reference: actor.py:524 + gcs_actor_
+        # manager.cc:632): default actors die with their owner client;
+        # lifetime="detached" (or an ownerless registration) opts out
+        detached = (lifetime == "detached") or owner_id is None
         with self._lock:
             if name is not None:
                 key = _ns_key(namespace, name)
@@ -526,6 +619,7 @@ class GcsServer(RpcServer):
                 state="PENDING",
                 creation_spec=creation_spec, resources=dict(resources),
                 max_restarts=max_restarts, pg_id=pg_id,
+                owner_id=owner_id, detached=detached,
             )
             self._log_actor(self._actors[actor_id])
             if name is not None:
@@ -656,11 +750,13 @@ class GcsServer(RpcServer):
     def rpc_kill_actor(self, conn, send_lock, *, actor_id, no_restart=True):
         from ray_tpu.runtime.rpc import RpcClient
         with self._lock:
-            actor = self._actors.get(actor_id)
-            if actor is None:
+            if actor_id not in self._actors:
                 return {"ok": False}
-            if no_restart:
-                actor.max_restarts = actor.num_restarts  # exhaust budget
+        if no_restart:
+            self._kill_actor(actor_id, "killed via ray_tpu.kill()")
+            return {"ok": True}
+        with self._lock:
+            actor = self._actors.get(actor_id)
             node = self._nodes.get(actor.node_id) if actor.node_id else None
         if node is not None:
             try:
@@ -801,6 +897,11 @@ class GcsServer(RpcServer):
     def rpc_add_object_location(self, conn, send_lock, *, oid, node_id,
                                 size=0):
         with self._lock:
+            if oid in self._ref_released:
+                # free-on-arrival: every reference was dropped before the
+                # object materialized (fire-and-forget task returns)
+                self._pending_release.setdefault(node_id, set()).add(oid)
+                return {"ok": True}
             self._object_dir.setdefault(oid, set()).add(node_id)
             self._lost_objects.pop(oid, None)  # re-created (reconstruction)
             if size:
@@ -815,13 +916,19 @@ class GcsServer(RpcServer):
         locations and flush them together — one directory RPC per flush
         instead of per task; the hot-path win behind the reference's
         ownership-based directory being OFF the task critical path)."""
+        live = []
         with self._lock:
             for oid, size in entries:
+                if oid in self._ref_released:
+                    self._pending_release.setdefault(node_id,
+                                                     set()).add(oid)
+                    continue
                 self._object_dir.setdefault(oid, set()).add(node_id)
                 self._lost_objects.pop(oid, None)
                 if size:
                     self._object_meta[oid] = size
-        for oid, _ in entries:
+                live.append(oid)
+        for oid in live:
             self.publish(CH_OBJECT, {"event": "added", "oid": oid,
                                      "node_id": node_id})
         return {"ok": True}
@@ -856,6 +963,222 @@ class GcsServer(RpcServer):
                     del self._object_dir[oid]
                     self._tombstone(oid)
         return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # distributed refcounting (reference: reference_count.h:61-115 — the
+    # owner/borrower protocol, centralized: every client reports holder
+    # transitions, task pins, and contains-edges; zero count => release)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _trim(table: dict, cap: int):
+        while len(table) > cap:
+            table.pop(next(iter(table)))
+
+    def _ref_count(self, oid: str) -> int:
+        return (len(self._ref_holders.get(oid, ()))
+                + self._ref_pin_count.get(oid, 0)
+                + self._ref_contained.get(oid, 0))
+
+    def _touch_client(self, client_id: str, kind: str | None = None) -> bool:
+        """Refresh client liveness. Returns True when the client was
+        previously reaped and is being resurrected — its holds were
+        dropped, so the caller must tell it to re-sync its held set."""
+        c = self._clients.get(client_id)
+        if c is None:
+            self._clients[client_id] = {"kind": kind or "unknown",
+                                        "last_seen": time.monotonic(),
+                                        "alive": True}
+            return False
+        c["last_seen"] = time.monotonic()
+        if kind and c["kind"] == "unknown":
+            c["kind"] = kind
+        if not c["alive"]:
+            # back from the dead (GC pause / partition outlived the
+            # timeout): resurrect so its future holds are reclaimable,
+            # and fence — it must re-register everything it still holds
+            c["alive"] = True
+            return True
+        return False
+
+    @staticmethod
+    def _dec_counts(table: dict, oids, dec: set):
+        """Decrement ``table[oid]`` for each oid, popping zeros into
+        ``dec`` (the release-candidate set). Shared by the pin-release,
+        owner-death, and contains-release paths."""
+        for oid in oids:
+            n = table.get(oid, 0) - 1
+            if n <= 0:
+                table.pop(oid, None)
+                dec.add(oid)
+            else:
+                table[oid] = n
+
+    def rpc_register_client(self, conn, send_lock, *, client_id,
+                            kind="driver"):
+        with self._lock:
+            self._touch_client(client_id, kind)
+        return {"ok": True}
+
+    def rpc_unregister_client(self, conn, send_lock, *, client_id):
+        """Clean client shutdown: drop its ref contributions now and
+        reap its non-detached actors (reference: job/driver exit kills
+        owned actors, gcs_actor_manager.cc:632)."""
+        self._reap_client(client_id, "client disconnected")
+        return {"ok": True}
+
+    def rpc_ref_update(self, conn, send_lock, *, client_id, add=(),
+                       remove=(), transient=(), pins=(), pin_releases=(),
+                       contains=(), kind=None):
+        """Batched per-client refcount deltas; doubles as the client
+        liveness heartbeat. Adds/pins/contains are applied before
+        removes so one batch carrying both orders correctly."""
+        dec: set[str] = set()
+        with self._lock:
+            resync = self._touch_client(client_id, kind)
+            for oid in add:
+                self._ref_holders.setdefault(oid, set()).add(client_id)
+            for task_id, oids in pins:
+                if task_id in self._pin_released:
+                    # the executor finished (and released) before the
+                    # owner's pin landed: consume the tombstone
+                    del self._pin_released[task_id]
+                    continue
+                if task_id in self._ref_pins:
+                    continue
+                self._ref_pins[task_id] = (client_id, list(oids))
+                for oid in oids:
+                    self._ref_pin_count[oid] = \
+                        self._ref_pin_count.get(oid, 0) + 1
+            for outer, inners in contains:
+                if outer in self._ref_contains \
+                        or outer in self._ref_released:
+                    continue
+                self._ref_contains[outer] = list(inners)
+                for oid in inners:
+                    self._ref_contained[oid] = \
+                        self._ref_contained.get(oid, 0) + 1
+            for task_id in pin_releases:
+                entry = self._ref_pins.pop(task_id, None)
+                if entry is None:
+                    self._pin_released[task_id] = None
+                    self._trim(self._pin_released, 200_000)
+                    continue
+                self._dec_counts(self._ref_pin_count, entry[1], dec)
+            for oid in remove:
+                holders = self._ref_holders.get(oid)
+                if holders is not None:
+                    holders.discard(client_id)
+                    if not holders:
+                        self._ref_holders.pop(oid, None)
+                    dec.add(oid)
+            # transient = held-and-dropped within one client flush window
+            # (the hold never registered): a pure decrement event
+            dec.update(transient)
+            self._release_zeroed(dec)
+        if resync:
+            return {"ok": True, "resync": True}
+        return {"ok": True}
+
+    def _release_zeroed(self, oids):
+        """Release objects whose count dropped to zero (lock held).
+        Releases are triggered only by DECREMENTS — an object tracked
+        but never held (e.g. a contains-edge reported before the owner's
+        first flush) just waits."""
+        for oid in oids:
+            if oid not in self._ref_released and self._ref_count(oid) == 0:
+                self._release_object(oid)
+
+    def _release_object(self, oid: str):
+        """Free one object's copies cluster-wide (lock held): pull it
+        from the directory, queue a release on every node that holds a
+        copy, and (after a grace) release anything it contained."""
+        self._ref_released[oid] = None
+        self._trim(self._ref_released, 500_000)
+        locs = self._object_dir.pop(oid, None)
+        self._object_meta.pop(oid, None)
+        if locs:
+            for node_id in locs:
+                self._pending_release.setdefault(node_id, set()).add(oid)
+        inners = self._ref_contains.pop(oid, None)
+        if inners:
+            # grace: a borrower that just deserialized the outer may have
+            # increfs for the inners still in flight
+            self._deferred_contains.append(
+                (time.monotonic() + self._ref_grace, inners))
+        self._ref_holders.pop(oid, None)
+        self._ref_pin_count.pop(oid, None)
+        self._ref_contained.pop(oid, None)
+
+    def _process_deferred_contains(self):
+        now = time.monotonic()
+        with self._lock:
+            due, keep = [], []
+            for item in self._deferred_contains:
+                (due if item[0] <= now else keep).append(item)
+            self._deferred_contains = keep
+            dec: set[str] = set()
+            for _, inners in due:
+                self._dec_counts(self._ref_contained, inners, dec)
+            self._release_zeroed(dec)
+
+    def _reap_stale_clients(self):
+        now = time.monotonic()
+        with self._lock:
+            stale = [cid for cid, c in self._clients.items()
+                     if c["alive"]
+                     and now - c["last_seen"] > self._client_timeout]
+        for cid in stale:
+            self._reap_client(cid, "client heartbeat timeout")
+
+    def _reap_client(self, client_id: str, reason: str):
+        """A driver/worker runtime died: drop every ref contribution it
+        held and kill its non-detached actors (reference: owner-death
+        handling in ReferenceCounter + GcsActorManager)."""
+        with self._lock:
+            c = self._clients.get(client_id)
+            if c is not None and not c["alive"]:
+                return
+            if c is not None:
+                c["alive"] = False
+            dec: set[str] = set()
+            for oid, holders in list(self._ref_holders.items()):
+                if client_id in holders:
+                    holders.discard(client_id)
+                    if not holders:
+                        self._ref_holders.pop(oid, None)
+                    dec.add(oid)
+            for task_id, (owner, oids) in list(self._ref_pins.items()):
+                if owner != client_id:
+                    continue
+                del self._ref_pins[task_id]
+                self._dec_counts(self._ref_pin_count, oids, dec)
+            self._release_zeroed(dec)
+            doomed = [a.actor_id for a in self._actors.values()
+                      if a.owner_id == client_id and not a.detached
+                      and a.state != "DEAD"]
+        for actor_id in doomed:
+            self._kill_actor(actor_id, f"owner {client_id[:8]} died: "
+                                       f"{reason}")
+
+    def _kill_actor(self, actor_id: str, reason: str):
+        """Terminate an actor with no restart (shared by kill() and
+        owner-death reaping)."""
+        from ray_tpu.runtime.rpc import RpcClient
+        with self._lock:
+            actor = self._actors.get(actor_id)
+            if actor is None:
+                return
+            actor.max_restarts = actor.num_restarts  # exhaust budget
+            node = self._nodes.get(actor.node_id) if actor.node_id else None
+        if node is not None:
+            try:
+                client = RpcClient(node.address)
+                client.call("kill_actor_worker", actor_id=actor_id)
+                client.close()
+            except Exception:  # noqa: BLE001 - node may be gone already
+                pass
+        self._on_actor_failure_id(actor_id, reason)
 
     # ------------------------------------------------------------------
     # KV (reference: GcsKvManager / internal_kv)

@@ -18,10 +18,23 @@ from __future__ import annotations
 import pickle
 import struct
 
+from ray_tpu.runtime.refcount import global_counter as _refs
 from ray_tpu.runtime.serialization import SerializedObject, deserialize, serialize
 
 _U64 = struct.Struct("<Q")
 FLAG_ERROR = 1
+
+
+def _serialize_capturing(object_id: bytes, value):
+    """Serialize, recording contains-edges for any ObjectRef pickled
+    inside the value (reference: contained-in tracking,
+    reference_count.h:67 — the outer object holds a reference on each
+    inner object until the outer is released)."""
+    with _refs.capture() as cap:
+        obj = serialize(value)
+    if cap.oids:
+        _refs.add_contains(object_id.hex(), cap.oids)
+    return obj
 
 
 def encoded_size(obj: SerializedObject) -> int:
@@ -75,7 +88,7 @@ def put_value(store, object_id: bytes, value, *, is_error: bool = False) -> int:
     matching the local-mode store's semantics."""
     from ray_tpu._private.shm_store import ObjectExistsError
 
-    obj = serialize(value)
+    obj = _serialize_capturing(object_id, value)
     size = encoded_size(obj)
     try:
         buf = store.create(object_id, size)
@@ -107,7 +120,7 @@ def put_value_durable(store, object_id: bytes, value, *,
 
     from ray_tpu._private.shm_store import ObjectExistsError, StoreFullError
 
-    obj = serialize(value)
+    obj = _serialize_capturing(object_id, value)
     size = encoded_size(obj)
     deadline = _time.monotonic() + timeout_s
     delay = 0.02

@@ -306,9 +306,14 @@ class LocalObjectManager:
     # explicit free (reference: ray.internal.free)
     # ------------------------------------------------------------------
 
-    def free_objects(self, oids: list) -> int:
+    def free_objects(self, oids: list, deregister: bool = True) -> int:
         """Release local copies: unpin, drop from shm and the spill dir,
-        deregister locations. Returns the number of copies freed."""
+        deregister locations. Returns the number of copies freed.
+
+        ``deregister=False``: the free was INITIATED by the GCS
+        (refcount hit zero — the directory entry is already gone), so
+        skip the remove_object_location round trips and the lost-object
+        tombstoning they would cause."""
         from ray_tpu._private.shm_store import TS_ERR, TS_OK
 
         node = self._node
@@ -353,7 +358,7 @@ class LocalObjectManager:
             with self._local_objects_lock:
                 was_local = oid_hex in self._local_objects
                 self._local_objects.discard(oid_hex)
-            if was_local or had_spill:
+            if deregister and (was_local or had_spill):
                 try:
                     with node._gcs_lock:
                         node._gcs.call("remove_object_location",

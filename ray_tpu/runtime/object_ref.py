@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import TYPE_CHECKING
 
+from ray_tpu.runtime import refcount as _refcount
+from ray_tpu.runtime.refcount import global_counter as _refs
 from ray_tpu.utils.ids import ObjectID
 
 if TYPE_CHECKING:
@@ -18,18 +20,36 @@ if TYPE_CHECKING:
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owner_hint", "__weakref__")
+    __slots__ = ("_id", "_owner_hint", "_hex", "_tracked", "__weakref__")
 
-    def __init__(self, object_id: ObjectID, owner_hint: str | None = None):
+    def __init__(self, object_id: ObjectID, owner_hint: str | None = None,
+                 _track: bool = True):
         self._id = object_id
         self._owner_hint = owner_hint
+        # distributed refcounting (reference: reference_count.h:61): every
+        # live instance contributes to this process's local count; the
+        # hex is cached so __del__ never touches the (possibly torn-down)
+        # ObjectID during interpreter shutdown. ``_track=False`` opts
+        # derived refs out (streaming item/end refs are minted and
+        # dropped transiently during polling — counting them would free
+        # live stream objects).
+        self._hex = object_id.hex()
+        # inactive process (no flusher / no local sink): never track, or
+        # the counter's tables grow with nothing draining them
+        self._tracked = _track and _refcount.is_active()
+        if self._tracked:
+            _refs.on_created(self._hex)
+
+    def __del__(self):
+        if self._tracked:
+            _refs.on_destroyed(self._hex)
 
     @property
     def id(self) -> ObjectID:
         return self._id
 
     def hex(self) -> str:
-        return self._id.hex()
+        return self._hex
 
     def __hash__(self):
         return hash(self._id)
@@ -41,6 +61,16 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
+        if not self._tracked:
+            # untracked (stream-derived) refs stay untracked across
+            # process boundaries — their lifecycle is LRU/eviction, not
+            # refcounting
+            return (_untracked_ref, (self._id, self._owner_hint))
+        # serialization capture: a ref pickled inside a put value or a
+        # task arg escapes this process — the active capture scope (see
+        # refcount.RefCounter.capture) records it for contains-edge /
+        # task-pin reporting
+        _refs.note_serialized(self._hex)
         return (ObjectRef, (self._id, self._owner_hint))
 
     # Convenience: ref.get() / await-ability via the runtime.
@@ -61,3 +91,7 @@ class ObjectRef:
         from ray_tpu.runtime.core import get_runtime
 
         return asyncio.wrap_future(get_runtime().as_future(self)).__await__()
+
+
+def _untracked_ref(object_id: ObjectID, owner_hint: str | None = None):
+    return ObjectRef(object_id, owner_hint, _track=False)

@@ -930,6 +930,7 @@ class Raylet(RpcServer):
 
     def _heartbeat_loop(self):
         ticks = 0
+        freed_acks: set[str] = set()
         while not self._stopping:
             self._interruptible_sleep(self._hb_interval)
             if self._stopping:
@@ -948,10 +949,14 @@ class Raylet(RpcServer):
                     stats = host_stats(
                         self.objects.spill_dir
                         if self.objects.spill_is_local else None)
+                acks = sorted(freed_acks) if freed_acks else None
                 with self._gcs_lock:
                     reply = self._gcs.call("heartbeat", node_id=self.node_id,
                                            available=self._avail_snapshot(),
-                                           host_stats=stats or None)
+                                           host_stats=stats or None,
+                                           freed_acks=acks)
+                if acks:
+                    freed_acks.difference_update(acks)
                 if reply.get("reregister"):
                     with self._gcs_lock:
                         self._gcs.call(
@@ -959,6 +964,15 @@ class Raylet(RpcServer):
                             address=self.address, store_name=self.store_name,
                             resources=self.total_resources,
                             labels=self.labels)
+                # refcount releases ride the heartbeat reply (at-least-
+                # once: acked on the NEXT beat; freeing is idempotent)
+                release = reply.get("release_oids")
+                if release:
+                    try:
+                        self.objects.free_objects(release,
+                                                  deregister=False)
+                    finally:
+                        freed_acks.update(release)
             except Exception:  # noqa: BLE001 - gcs down; keep trying
                 pass
 

@@ -1,0 +1,312 @@
+"""Distributed object reference counting — the process-local half.
+
+Reference analog: ``src/ray/core_worker/reference_count.h:61-115`` — the
+reference tracks owners and borrowers per ObjectRef and releases objects
+when every reference goes out of scope. The TPU-native redesign keeps the
+same *capability* with a centralized protocol that matches this runtime's
+centralized object directory (``runtime/gcs.py``):
+
+- Every process (driver or worker) counts live ``ObjectRef`` instances per
+  object id. Transitions (0→held, held→0) are flushed in batches to the
+  GCS, which sums per-client holds, in-flight task pins, and
+  contained-in edges; at zero, the GCS releases the primary copy on every
+  node that registered a location.
+- Submitting a task pins its argument objects under the task id (the
+  owner's flush carries the pin); the executing worker releases the pin
+  after the task finishes (``pin_releases``), covering normal, actor, and
+  legacy submission paths uniformly.
+- Serializing a value that *contains* ObjectRefs (a put, a task return)
+  records contains-edges: the outer object holds a reference on each
+  inner one until the outer itself is released (reference: borrower /
+  contained-in tracking, ``reference_count.h:67``).
+
+The counter is a process-global singleton: ``ObjectRef.__init__`` /
+``__del__`` feed it directly, so it works in the driver, in pool workers
+executing tasks, and in nested in-worker runtimes alike. ``__del__``
+never takes the lock (a GC pass can fire inside a locked section): death
+notices go through a lock-free deque drained on the next flush.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+class RefCounter:
+    """Process-local reference table + pending flush state."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}       # oid hex -> live instances
+        self._dead: deque = deque()             # oid hex death notices
+        self._dirty: set[str] = set()           # count changed since flush
+        self._flushed_held: set[str] = set()    # what the sink believes
+        self._pins: list[tuple[str, list[str]]] = []   # (task_id, oids)
+        self._pin_releases: list[str] = []              # task ids
+        self._contains: list[tuple[str, list[str]]] = []
+        # serialization capture: thread-local list appended to by
+        # ObjectRef.__reduce__ while a capture scope is active
+        self._tl = threading.local()
+        # deserialize-tracking epoch: bumped on every on_created so
+        # callers can detect "refs were constructed during this block"
+        self._created_epoch = 0
+        # local-mode immediate release callback (no flusher): called with
+        # the oid hex when its count drops to zero
+        self._local_release_cb = None
+
+    # ------------------------------------------------------------------
+    # instance tracking (ObjectRef hooks)
+    # ------------------------------------------------------------------
+
+    def on_created(self, oid_hex: str):
+        with self._lock:
+            c = self._counts.get(oid_hex, 0)
+            self._counts[oid_hex] = c + 1
+            self._created_epoch += 1
+            if c == 0:
+                self._dirty.add(oid_hex)
+
+    def on_destroyed(self, oid_hex: str):
+        # lock-free: __del__ may run mid-GC inside a locked section
+        self._dead.append(oid_hex)
+
+    def _drain_dead_locked(self):
+        zeroed = []
+        while True:
+            try:
+                oid_hex = self._dead.popleft()
+            except IndexError:
+                break
+            c = self._counts.get(oid_hex, 0) - 1
+            if c <= 0:
+                self._counts.pop(oid_hex, None)
+                self._dirty.add(oid_hex)
+                zeroed.append(oid_hex)
+            else:
+                self._counts[oid_hex] = c
+        return zeroed
+
+    # ------------------------------------------------------------------
+    # serialization capture (contains-edges / nested task args)
+    # ------------------------------------------------------------------
+
+    class _Capture:
+        def __init__(self, counter: "RefCounter"):
+            self._counter = counter
+            self.oids: set[str] = set()
+            self._prev = None
+
+        def add(self, oid_hex: str):
+            self.oids.add(oid_hex)
+
+        def __enter__(self):
+            tl = self._counter._tl
+            self._prev = getattr(tl, "capture", None)
+            tl.capture = self
+            return self
+
+        def __exit__(self, *exc):
+            self._counter._tl.capture = self._prev
+            return False
+
+    def capture(self) -> "RefCounter._Capture":
+        """Scope that collects the oid of every ObjectRef serialized
+        (``__reduce__``-ed) on this thread — puts record contains-edges,
+        task submission records nested arg pins from it."""
+        return RefCounter._Capture(self)
+
+    def note_serialized(self, oid_hex: str):
+        cap = getattr(self._tl, "capture", None)
+        if cap is not None:
+            cap.add(oid_hex)
+
+    def created_epoch(self) -> int:
+        """Monotone counter of ObjectRef constructions in this process;
+        callers compare before/after a deserialize to decide whether a
+        synchronous flush is needed (borrower registration)."""
+        with self._lock:
+            return self._created_epoch
+
+    # ------------------------------------------------------------------
+    # task pins + contains edges
+    # ------------------------------------------------------------------
+
+    def add_task_pins(self, task_id: str, oids: list[str]):
+        if not oids:
+            return
+        with self._lock:
+            self._pins.append((task_id, list(oids)))
+
+    def release_task_pin(self, task_id: str):
+        with self._lock:
+            self._pin_releases.append(task_id)
+
+    def add_contains(self, outer_hex: str, inner_hexes) -> None:
+        inner = [h for h in inner_hexes if h != outer_hex]
+        if not inner:
+            return
+        with self._lock:
+            self._contains.append((outer_hex, inner))
+
+    # ------------------------------------------------------------------
+    # flushing
+    # ------------------------------------------------------------------
+
+    def take_flush(self) -> dict | None:
+        """Snapshot-and-clear the pending state as a ``ref_update``
+        payload; None when there is nothing to send. Adds are computed
+        before removes so an add+remove of the same oid inside one
+        window coalesces away."""
+        with self._lock:
+            self._drain_dead_locked()
+            add, remove, transient = [], [], []
+            for oid_hex in self._dirty:
+                held = self._counts.get(oid_hex, 0) > 0
+                was = oid_hex in self._flushed_held
+                if held and not was:
+                    add.append(oid_hex)
+                    self._flushed_held.add(oid_hex)
+                elif not held and was:
+                    remove.append(oid_hex)
+                    self._flushed_held.discard(oid_hex)
+                elif not held and not was:
+                    # held-and-dropped entirely WITHIN this flush window
+                    # (put-get-del loops): the GCS never saw the hold, but
+                    # it still needs the decrement event or the object is
+                    # never considered for release
+                    transient.append(oid_hex)
+            self._dirty.clear()
+            pins, self._pins = self._pins, []
+            rel, self._pin_releases = self._pin_releases, []
+            contains, self._contains = self._contains, []
+        if not (add or remove or transient or pins or rel or contains):
+            return None
+        return {"add": add, "remove": remove, "transient": transient,
+                "pins": pins, "pin_releases": rel, "contains": contains}
+
+    def force_resync(self):
+        """The GCS reaped this client (heartbeat gap) and dropped every
+        hold it believed we had: re-register the full held set on the
+        next flush."""
+        with self._lock:
+            self._flushed_held.clear()
+            for oid_hex, c in self._counts.items():
+                if c > 0:
+                    self._dirty.add(oid_hex)
+
+    def restore_flush(self, payload: dict):
+        """Re-queue a flush whose send failed so the deltas are not
+        lost (a lost add risks premature release; a lost remove leaks)."""
+        with self._lock:
+            for oid_hex in payload.get("add", ()):
+                # still held? resend on the next flush
+                self._flushed_held.discard(oid_hex)
+                self._dirty.add(oid_hex)
+            for oid_hex in payload.get("remove", ()):
+                self._flushed_held.add(oid_hex)
+                self._dirty.add(oid_hex)
+            for oid_hex in payload.get("transient", ()):
+                # not held, not believed held: re-dirty so the next flush
+                # re-emits the transient decrement
+                self._dirty.add(oid_hex)
+            self._pins[:0] = payload.get("pins", ())
+            self._pin_releases[:0] = payload.get("pin_releases", ())
+            self._contains[:0] = payload.get("contains", ())
+
+    # ------------------------------------------------------------------
+    # local mode (in-process runtime: release immediately, no RPC)
+    # ------------------------------------------------------------------
+
+    def set_local_release(self, cb):
+        """Install an immediate-release callback (local-mode runtime).
+        While set, zero-count transitions call ``cb(oid_hex)`` from the
+        poll loop instead of accumulating flush state."""
+        with self._lock:
+            self._local_release_cb = cb
+        if cb is not None:
+            _activate()
+        else:
+            _deactivate()
+
+    def poll_local(self):
+        """Drain death notices and fire the local release callback for
+        oids that dropped to zero (called from the local runtime's
+        dispatcher / store hooks)."""
+        with self._lock:
+            cb = self._local_release_cb
+            if cb is None:
+                return
+            self._drain_dead_locked()
+            zeroed = [h for h in self._dirty
+                      if self._counts.get(h, 0) == 0]
+            # positive transitions carry no local-mode action: clear all
+            # so the dirty set stays bounded
+            self._dirty.clear()
+        for oid_hex in zeroed:
+            try:
+                cb(oid_hex)
+            except Exception:  # noqa: BLE001 - release is best-effort
+                pass
+
+    def reset(self):
+        """Forget all state (runtime shutdown / test isolation)."""
+        with self._lock:
+            self._counts.clear()
+            self._dead.clear()
+            self._dirty.clear()
+            self._flushed_held.clear()
+            self._pins.clear()
+            self._pin_releases.clear()
+            self._contains.clear()
+            self._local_release_cb = None
+
+
+# The process-global counter fed by ObjectRef lifecycle hooks.
+global_counter = RefCounter()
+
+# Tracking is armed only once a drain exists (a flusher claim or a
+# local-mode release callback): processes that never drain (remote
+# ray-client processes, ref_counting_enabled=False) must not accumulate
+# per-ref state unboundedly. ObjectRefs constructed before activation
+# are permanently untracked — safe: they simply never contribute.
+_active = False
+
+
+def is_active() -> bool:
+    return _active
+
+
+def _activate():
+    global _active
+    _active = True
+
+
+def _deactivate():
+    global _active
+    _active = False
+
+# One flush channel per process: a pool worker's Worker loop claims it
+# first; a nested in-worker ClusterRuntime then piggybacks on it instead
+# of double-reporting under a second client id (holder attribution must
+# be consistent within a process).
+_flusher_lock = threading.Lock()
+_flusher_owner: str | None = None
+
+
+def claim_flusher(owner: str) -> bool:
+    global _flusher_owner
+    with _flusher_lock:
+        if _flusher_owner is not None and _flusher_owner != owner:
+            return False
+        _flusher_owner = owner
+        _activate()
+        return True
+
+
+def release_flusher(owner: str):
+    global _flusher_owner
+    with _flusher_lock:
+        if _flusher_owner == owner:
+            _flusher_owner = None
+            _deactivate()

@@ -47,11 +47,14 @@ def stream_oid(task_id_bytes: bytes, index: int) -> ObjectID:
 
 
 def stream_end_ref(task_id_bytes: bytes) -> ObjectRef:
-    return ObjectRef(stream_oid(task_id_bytes, _END_INDEX))
+    # _track=False: these refs are minted transiently on every poll —
+    # refcounting them would release live stream objects between polls
+    # (stream object lifecycle stays LRU/eviction-managed)
+    return ObjectRef(stream_oid(task_id_bytes, _END_INDEX), _track=False)
 
 
 def stream_item_ref(task_id_bytes: bytes, index: int) -> ObjectRef:
-    return ObjectRef(stream_oid(task_id_bytes, index))
+    return ObjectRef(stream_oid(task_id_bytes, index), _track=False)
 
 
 class ObjectRefGenerator:

@@ -261,6 +261,26 @@ class Worker:
         self._report_cv = threading.Condition()
         threading.Thread(target=self._report_flush_loop, daemon=True,
                          name="report-flusher").start()
+        # --- distributed refcounting (runtime/refcount.py): this worker
+        # owns the process's ref flush channel — nested in-worker
+        # runtimes piggyback on it (claim_flusher). It reports refs the
+        # worker retains (actor state), releases task arg pins after
+        # execution, and heartbeats client liveness. ---
+        from ray_tpu.runtime import refcount as _refcount
+        from ray_tpu.utils.config import get_config as _get_config
+        _cfg = _get_config()
+        self._refs = _refcount.global_counter
+        self._ref_enabled = _cfg.ref_counting_enabled
+        self._ref_send_lock = threading.Lock()
+        if self._ref_enabled:
+            _refcount.claim_flusher(self.worker_id)
+            try:
+                self._gcs.call("register_client",
+                               client_id=self.worker_id, kind="worker")
+            except Exception:  # noqa: BLE001 - reconnecting client
+                pass
+            threading.Thread(target=self._ref_flush_loop, daemon=True,
+                             name="ref-flusher").start()
         self._install_sigint_router()
         # Owner-facing push port, then registration — ALL execution state
         # above must exist first: the instant registration lands, the
@@ -380,6 +400,7 @@ class Worker:
     # ------------------------------------------------------------------
 
     def _resolve_args(self, task: dict):
+        epoch0 = (self._refs.created_epoch() if self._ref_enabled else 0)
         args, kwargs = cloudpickle.loads(task["args_blob"])
         dep_oids = [a[1] for a in _iter_markers(args, kwargs)]
         if dep_oids:
@@ -397,7 +418,46 @@ class Worker:
         args = [values[a[1]] if _is_marker(a) else a for a in args]
         kwargs = {k: values[v[1]] if _is_marker(v) else v
                   for k, v in kwargs.items()}
+        if self._ref_enabled and self._refs.created_epoch() != epoch0:
+            # args carried nested ObjectRefs: register this process's
+            # holds BEFORE execution so they are live at the GCS while
+            # the submitter's task pin is still in place
+            self._ref_flush_now()
         return args, kwargs
+
+    def _ref_flush_loop(self):
+        import time as _time
+
+        last_beat = 0.0
+        while True:
+            _time.sleep(0.2)
+            now = _time.monotonic()
+            beat = now - last_beat >= 2.0   # client-liveness heartbeat
+            if self._ref_flush_now(force_heartbeat=beat) or beat:
+                last_beat = now
+
+    def _ref_flush_now(self, force_heartbeat: bool = False) -> bool:
+        with self._ref_send_lock:
+            payload = self._refs.take_flush()
+            if payload is None and not force_heartbeat:
+                return False
+            try:
+                reply = self._gcs.call("ref_update",
+                                       client_id=self.worker_id,
+                                       kind="worker", **(payload or {}))
+                if reply.get("resync"):
+                    self._refs.force_resync()
+                return True
+            except Exception:  # noqa: BLE001 - GCS unreachable: requeue
+                if payload:
+                    self._refs.restore_flush(payload)
+                return False
+
+    def _release_task_pin(self, task: dict):
+        """Execution finished: release the submitter's arg pins for this
+        task (only when the owner actually registered some)."""
+        if self._ref_enabled and task.get("pinned"):
+            self._refs.release_task_pin(task.get("task_id", ""))
 
     def _store_returns(self, task: dict, result):
         if task.get("streaming"):
@@ -551,6 +611,7 @@ class Worker:
             self._execute_inner(task)
         finally:
             reset_task_namespace(ns_token)
+            self._release_task_pin(task)
 
     def _execute_inner(self, task: dict):
         import time as _time
@@ -642,8 +703,11 @@ class Worker:
                         "reason": f"{type(e).__name__}: {e}"})
             self._store_error(task, exc.ActorDiedError(
                 actor_id, f"__init__ failed: {e!r}"))
+            self._release_task_pin(task)
+            self._ref_flush_now()   # the pin release must outrun os._exit
             self._send({"type": "task_done", "task_id": task.get("task_id")})
             os._exit(1)
+        self._release_task_pin(task)
         self._store_returns(task, None)
         self._send({"type": "actor_ready", "actor_id": actor_id})
         self._send({"type": "task_done", "task_id": task.get("task_id")})
@@ -709,6 +773,7 @@ class Worker:
                 task, exc.TaskError(task.get("name", "?"), e,
                                     tb=traceback.format_exc()))
             self._report_task_event(task, started, False)
+            self._release_task_pin(task)
             if not task.get("_direct"):
                 self._send({"type": "task_done",
                             "task_id": task.get("task_id")})
@@ -725,9 +790,13 @@ class Worker:
 
         async with self._actor_sem:
             started = _time.monotonic()
-            done = (lambda: None) if task.get("_direct") else (
+            _done = (lambda: None) if task.get("_direct") else (
                 lambda: self._send({"type": "task_done",
                                     "task_id": task.get("task_id")}))
+
+            def done():
+                self._release_task_pin(task)
+                _done()
             try:
                 from ray_tpu.util.tracing import execution_span
 
@@ -761,9 +830,13 @@ class Worker:
         # no task_done: the raylet tracked nothing for them, and at 10k+
         # calls/s the per-call frame to the raylet channel is pure GIL
         # and syscall overhead on both ends
-        done = (lambda: None) if task.get("_direct") else (
+        _done = (lambda: None) if task.get("_direct") else (
             lambda: self._send({"type": "task_done",
                                 "task_id": task.get("task_id")}))
+
+        def done():
+            self._release_task_pin(task)
+            _done()
         if task.get("noop"):
             # seq gap-filler (owner sealed errors for a submit that never
             # arrived): advances the ordered queue, executes nothing

@@ -32,8 +32,11 @@ def _get_or_start_controller():
         pass
     controller_cls = ray_tpu.remote(ServeController)
     try:
+        # detached: the serve app outlives the driver that started it
+        # (reference: serve's controller runs detached)
         return controller_cls.options(name=CONTROLLER_NAME,
-                                      max_concurrency=16).remote()
+                                      max_concurrency=16,
+                                      lifetime="detached").remote()
     except ValueError:  # raced another starter
         return ray_tpu.get_actor(CONTROLLER_NAME)
 

@@ -84,6 +84,22 @@ class Config:
     # re-dispatch backs off exponentially while pressure persists.
     task_oom_retries: int = 3
 
+    # --- distributed reference counting (reference:
+    # core_worker/reference_count.h:61 — here: per-process local counts
+    # reported to a centralized GCS refcount table keyed by client id;
+    # zero-count primaries are released cluster-wide) ---
+    ref_counting_enabled: bool = True
+    # How often each process flushes its ref-count deltas / heartbeats.
+    ref_flush_interval_s: float = 0.1
+    # A client (driver or worker runtime) missing heartbeats this long is
+    # dead: its ref contributions are dropped and its non-detached actors
+    # killed (reference: GcsActorManager owner-death handling,
+    # gcs_actor_manager.cc:632).
+    client_timeout_s: float = 10.0
+    # Grace before contains-edge releases propagate to inner objects
+    # (covers the borrower-incref-in-flight window).
+    ref_release_grace_s: float = 0.5
+
     # --- workers ---
     num_workers: int = 0  # 0 = num_cpus
     worker_register_timeout_s: float = 30.0

@@ -34,9 +34,12 @@ class Holder:
 def test_two_drivers_do_not_collide(cluster):
     """Two 'jobs' (drivers) create same-named actors without collision
     and each resolves its own (VERDICT done-criterion)."""
-    # driver A
+    # driver A ("detached": it must outlive driver A's shutdown to prove
+    # driver B resolves its own namespace — non-detached actors die with
+    # their owner now, matching the reference)
     ray_tpu.init(address=cluster.gcs_address, namespace="job-a")
-    a = Holder.options(name="shared-name").remote("from-a")
+    a = Holder.options(name="shared-name",
+                       lifetime="detached").remote("from-a")
     assert ray_tpu.get(a.get_tag.remote()) == "from-a"
     id_a = a.actor_id.hex()
     ray_tpu.shutdown()

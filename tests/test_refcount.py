@@ -1,0 +1,222 @@
+"""Distributed reference counting + owner-scoped actor lifetime.
+
+Reference analogs: ``src/ray/core_worker/reference_count.h:61-115``
+(distributed refcounting / automatic reclamation) and
+``src/ray/gcs/gcs_server/gcs_actor_manager.cc:632`` (non-detached actors
+die with their owner). VERDICT round-3 done-criteria: a put/get/drop
+loop holds shm usage flat, and a driver exit reaps its actors.
+"""
+
+import gc
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=4)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _alloc(rt) -> int:
+    return rt.store.stats()["bytes_allocated"]
+
+
+def test_put_drop_soak_holds_shm_flat(cluster):
+    """The round-3 leak: every primary was pinned forever; a put/drop
+    loop grew shm until spill. Now dropped refs release the primary."""
+    rt = ray_tpu.init(address=cluster.gcs_address)
+    payload = b"x" * (1 << 20)
+    base = None
+    for i in range(60):
+        ref = ray_tpu.put(payload)
+        assert ray_tpu.get([ref])[0] == payload
+        del ref
+        if i == 20:
+            gc.collect()
+            time.sleep(1.5)
+            base = _alloc(rt)
+    gc.collect()
+    time.sleep(2.0)
+    final = _alloc(rt)
+    # flat: everything released (a couple of MiB of slack for in-flight
+    # releases; without refcounting this is ~40 MiB of growth)
+    assert final <= max(base, 4 << 20), (base, final)
+
+
+def test_task_returns_released_on_drop(cluster):
+    rt = ray_tpu.init(address=cluster.gcs_address)
+
+    @ray_tpu.remote
+    def make():
+        return b"r" * (1 << 20)
+
+    refs = [make.remote() for _ in range(8)]
+    assert all(len(v) == 1 << 20 for v in ray_tpu.get(refs, timeout=60))
+    oids = [r.id.binary() for r in refs]
+    del refs
+    gc.collect()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if not any(rt.store.contains(o) for o in oids):
+            break
+        time.sleep(0.2)
+    assert not any(rt.store.contains(o) for o in oids)
+
+
+def test_args_pinned_while_task_runs(cluster):
+    """Dropping the owner's last ref to an arg while a task still needs
+    it must not free the object (submitted-task pin)."""
+    ray_tpu.init(address=cluster.gcs_address)
+
+    @ray_tpu.remote
+    def use(x):
+        time.sleep(0.8)
+        return len(x)
+
+    big = ray_tpu.put(b"y" * (1 << 20))
+    r = use.remote(big)
+    time.sleep(0.2)   # give the flusher a window to ship the pin
+    del big
+    gc.collect()
+    assert ray_tpu.get([r], timeout=60)[0] == 1 << 20
+
+
+def test_contains_edge_keeps_inner_alive(cluster):
+    """A ref nested inside a stored value keeps its object alive until
+    the outer object is released (contained-in tracking)."""
+    rt = ray_tpu.init(address=cluster.gcs_address)
+    inner = ray_tpu.put(b"z" * 100_000)
+    inner_oid = inner.id.binary()
+    outer = ray_tpu.put({"inner": inner})
+    time.sleep(0.3)
+    del inner
+    gc.collect()
+    time.sleep(1.0)
+    got = ray_tpu.get([outer])[0]["inner"]
+    assert ray_tpu.get([got])[0] == b"z" * 100_000
+    del got, outer
+    gc.collect()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if not rt.store.contains(inner_oid):
+            break
+        time.sleep(0.2)
+    # after the outer (and the borrowed inner handle) drop, the chain
+    # releases the inner too
+    assert not rt.store.contains(inner_oid)
+
+
+def test_fire_and_forget_return_freed_on_arrival(cluster):
+    rt = ray_tpu.init(address=cluster.gcs_address)
+
+    @ray_tpu.remote
+    def make():
+        return b"f" * (1 << 20)
+
+    ref = make.remote()
+    oid = ref.id.binary()
+    del ref                      # dropped before the task finishes
+    gc.collect()
+    deadline = time.monotonic() + 15
+    seen = False
+    while time.monotonic() < deadline:
+        if rt.store.contains(oid):
+            seen = True
+        elif seen:
+            break                # arrived, then freed
+        time.sleep(0.05)
+    time.sleep(1.5)
+    assert not rt.store.contains(oid)
+
+
+def test_local_mode_put_drop_frees():
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=2, num_tpus=0)
+    ref = ray_tpu.put(list(range(10000)))
+    oid = ref.id
+    del ref
+    gc.collect()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if not rt.store.contains(oid):
+            break
+        time.sleep(0.05)
+    assert not rt.store.contains(oid)
+    ray_tpu.shutdown()
+
+
+_CHILD = """
+import sys
+import ray_tpu
+
+host, port, mode = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+ray_tpu.init(address=(host, port), namespace="lifetimes")
+
+
+@ray_tpu.remote
+class A:
+    def ping(self):
+        return "pong"
+
+
+a = A.options(name="plain").remote()
+d = A.options(name="persist", lifetime="detached").remote()
+assert ray_tpu.get(a.ping.remote()) == "pong"
+assert ray_tpu.get(d.ping.remote()) == "pong"
+if mode == "clean":
+    ray_tpu.shutdown()
+else:
+    import os
+    os._exit(0)   # no unregister: heartbeat-timeout reaping must cover
+"""
+
+
+@pytest.mark.parametrize("mode", ["clean", "kill"])
+def test_driver_exit_reaps_non_detached_actors(cluster, mode, tmp_path):
+    """Owner-scoped lifetime: a driver's actors die with it — clean
+    disconnect reaps immediately, a SIGKILL'd driver via heartbeat
+    timeout. lifetime="detached" opts out and survives both."""
+    child = tmp_path / "child.py"
+    child.write_text(_CHILD)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    host, port = cluster.gcs_address
+    out = subprocess.run(
+        [sys.executable, str(child), host, str(port), mode],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+
+    ray_tpu.init(address=cluster.gcs_address, namespace="lifetimes")
+    # non-detached: reaped (fast on clean exit; within the client
+    # timeout after a hard kill)
+    deadline = time.monotonic() + (10 if mode == "clean" else 30)
+    reaped = False
+    while time.monotonic() < deadline:
+        try:
+            h = ray_tpu.get_actor("plain")
+        except ValueError:
+            reaped = True
+            break
+        try:
+            ray_tpu.get(h.ping.remote(), timeout=2)
+        except Exception:
+            reaped = True
+            break
+        time.sleep(0.5)
+    assert reaped, "non-detached actor survived its driver"
+    # detached: alive and serving
+    h = ray_tpu.get_actor("persist")
+    assert ray_tpu.get(h.ping.remote(), timeout=30) == "pong"
