@@ -1,0 +1,26 @@
+import time, sys
+import ray_tpu
+import ray_tpu.runtime.driver as drv
+# patch the micro-linger in the actor flusher loop
+linger = float(sys.argv[1])
+src_sleep = time.sleep
+from ray_tpu.cluster_utils import Cluster
+
+c = Cluster(external_gcs=True)
+c.add_node(num_cpus=4, external=True)
+rt = ray_tpu.init(address=c.gcs_address)
+
+@ray_tpu.remote
+class A:
+    def m(self): return None
+
+a = A.remote()
+ray_tpu.get(a.m.remote())
+n = 3000
+best = 0
+for _ in range(3):
+    t0 = time.perf_counter()
+    ray_tpu.get([a.m.remote() for _ in range(n)])
+    best = max(best, n/(time.perf_counter()-t0))
+print("linger-default best %.0f calls/s" % best)
+ray_tpu.shutdown(); c.shutdown()

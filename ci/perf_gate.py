@@ -1,0 +1,110 @@
+"""Cross-round perf gate: diff the newest BENCH_r{N}.json against the
+previous round's and fail on regressions beyond a fence.
+
+Reference analog: the reference runs ``release/microbenchmark`` nightly
+and tracks deltas externally; here the fence is in-repo so a perf
+regression (like round 3's actor-call/put drop) cannot land silently.
+
+Usage:
+    python ci/perf_gate.py                 # compare newest vs previous
+    python ci/perf_gate.py NEW.json OLD.json
+    PERF_GATE_FENCE=0.10 python ci/perf_gate.py
+
+Exit 0: no metric regressed more than the fence (default 10%).
+Exit 1: regression(s) found — printed with both values.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+# metric name -> candidate paths into the bench JSON (all
+# higher-is-better). Two layouts exist: the full-run doc (core metrics
+# under detail.core) and a BENCH_MODE=core-only doc (under detail).
+METRICS = {
+    "train_tokens_per_sec_per_chip": [("value",)],
+    "train_mfu": [("detail", "mfu")],
+    "train_large_tokens_per_sec": [("detail", "train_large", "value")],
+    "train_longctx_tokens_per_sec": [("detail", "train_longctx", "value")],
+    "serve_tokens_per_sec": [("detail", "serve", "value")],
+    "core_tasks_per_sec": [("detail", "core", "tasks_per_sec"),
+                           ("detail", "tasks_per_sec")],
+    "core_actor_calls_per_sec": [("detail", "core", "actor_calls_per_sec"),
+                                 ("detail", "actor_calls_per_sec")],
+    "core_puts_1kb_per_sec": [("detail", "core", "puts_1kb_per_sec"),
+                              ("detail", "puts_1kb_per_sec")],
+    "core_gets_1kb_per_sec": [("detail", "core", "gets_1kb_per_sec"),
+                              ("detail", "gets_1kb_per_sec")],
+}
+
+# train metric paths only exist in full-run docs; the train bench value
+# doubles as core_tasks in core-only docs — guard that collision
+_TRAIN_ONLY = {"train_tokens_per_sec_per_chip"}
+
+
+def _dig_one(doc: dict, path: tuple):
+    cur = doc
+    for key in path:
+        if not isinstance(cur, dict) or key not in cur:
+            return None
+        cur = cur[key]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def _dig(doc: dict, name: str):
+    if name in _TRAIN_ONLY and doc.get("metric") != \
+            "llama_train_tokens_per_sec_per_chip":
+        return None
+    for path in METRICS[name]:
+        v = _dig_one(doc, path)
+        if v is not None:
+            return v
+    return None
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    # driver-recorded rounds wrap the bench line under "parsed"
+    return doc.get("parsed", doc)
+
+
+def main(argv: list[str]) -> int:
+    fence = float(os.environ.get("PERF_GATE_FENCE", "0.10"))
+    if len(argv) >= 3:
+        new_path, old_path = argv[1], argv[2]
+    else:
+        rounds = sorted(
+            glob.glob("BENCH_r*.json"),
+            key=lambda p: int(re.search(r"r(\d+)", p).group(1)))
+        if len(rounds) < 2:
+            print("perf gate: fewer than two BENCH_r*.json rounds; skip")
+            return 0
+        old_path, new_path = rounds[-2], rounds[-1]
+    new, old = _load(new_path), _load(old_path)
+    print(f"perf gate: {new_path} vs {old_path} (fence {fence:.0%})")
+    failures = []
+    for name in METRICS:
+        a, b = _dig(new, name), _dig(old, name)
+        if a is None or b is None or b <= 0:
+            continue
+        delta = a / b - 1.0
+        flag = "REGRESSION" if delta < -fence else "ok"
+        print(f"  {name:34s} {b:>12.1f} -> {a:>12.1f}  "
+              f"{delta:+7.1%}  {flag}")
+        if delta < -fence:
+            failures.append((name, b, a, delta))
+    if failures:
+        print(f"perf gate: {len(failures)} metric(s) regressed past "
+              f"the {fence:.0%} fence")
+        return 1
+    print("perf gate: pass")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -26,6 +26,17 @@ stage "multi-chip dryrun (virtual 8-device mesh: fsdp_tp/sp/ep/pp/hybrid)"
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+if [ "${SKIP_PERF_GATE:-0}" != "1" ]; then
+  stage "perf gate (current tree's core bench vs last round, ±10% fence)"
+  LAST_BENCH=$(ls BENCH_r*.json 2>/dev/null | sort -V | tail -1 || true)
+  if [ -n "$LAST_BENCH" ]; then
+    BENCH_MODE=core BENCH_CORE_OPS=2000 python bench.py > /tmp/bench_core_ci.json
+    python ci/perf_gate.py /tmp/bench_core_ci.json "$LAST_BENCH"
+  else
+    echo "no recorded BENCH_r*.json; skipping gate"
+  fi
+fi
+
 stage "single-chip compile check of the flagship entry"
 JAX_PLATFORMS=cpu python - <<'EOF'
 import jax

@@ -318,7 +318,12 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        # cache on the instance: `a.m.remote()` in a tight loop must not
+        # allocate a fresh ActorMethod per call (__getattr__ only fires
+        # on misses, so the cached attribute short-circuits next time)
+        method = ActorMethod(self, name)
+        object.__setattr__(self, name, method)
+        return method
 
     def _submit_method(self, method_name, args, kwargs, overrides=None):
         rt = _runtime()

@@ -35,19 +35,50 @@ class Cluster:
     (address=cluster.gcs_address)."""
 
     def __init__(self, *, heartbeat_timeout_s: float = 3.0,
-                 gcs_fault_tolerance: bool = False):
+                 gcs_fault_tolerance: bool = False,
+                 external_gcs: bool = False):
         self._hb_timeout = heartbeat_timeout_s
         self._gcs_persist_dir = None
         self._owns_persist_dir = False
+        self._gcs_proc = None
         if gcs_fault_tolerance:
             import tempfile
 
             self._gcs_persist_dir = tempfile.mkdtemp(prefix="raytpu_gcs_")
             self._owns_persist_dir = True
-        self.gcs = GcsServer(
-            heartbeat_timeout_s=heartbeat_timeout_s,
-            persistence_dir=self._gcs_persist_dir).start()
-        self.gcs_address = self.gcs.address
+        if external_gcs:
+            # the control plane as its OWN process (the reference's
+            # gcs_server is one too): its RPC handling must not share
+            # the driver's GIL — the hot resource in submit benchmarks.
+            # Chaos helpers (kill_gcs/restart_gcs) stay in-process-only.
+            if gcs_fault_tolerance:
+                raise ValueError(
+                    "external_gcs does not compose with the in-process "
+                    "chaos helpers; use gcs_fault_tolerance without it")
+            cfg = {"heartbeat_timeout_s": heartbeat_timeout_s}
+            self._gcs_proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.runtime.gcs",
+                 json.dumps(cfg)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            line = self._gcs_proc.stdout.readline()
+            if not line.strip():
+                err = ""
+                try:
+                    _, err = self._gcs_proc.communicate(timeout=5)
+                except subprocess.TimeoutExpired:
+                    self._gcs_proc.kill()
+                    self._gcs_proc.wait()
+                self._gcs_proc = None
+                raise RuntimeError(
+                    f"external GCS process failed to start: "
+                    f"{(err or '').strip()[-2000:]}")
+            self.gcs = None
+            self.gcs_address = tuple(json.loads(line)["address"])
+        else:
+            self.gcs = GcsServer(
+                heartbeat_timeout_s=heartbeat_timeout_s,
+                persistence_dir=self._gcs_persist_dir).start()
+            self.gcs_address = self.gcs.address
         self.nodes: dict[str, NodeHandle] = {}
         self._head_id: str | None = None
         self._lock = threading.Lock()
@@ -161,7 +192,14 @@ class Cluster:
     def shutdown(self):
         for handle in list(self.nodes.values()):
             self.remove_node(handle, graceful=True)
-        self.gcs.stop()
+        if self._gcs_proc is not None:
+            self._gcs_proc.terminate()
+            try:
+                self._gcs_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._gcs_proc.kill()
+        if self.gcs is not None:
+            self.gcs.stop()
         if self._owns_persist_dir and self._gcs_persist_dir:
             import shutil
 

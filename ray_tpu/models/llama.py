@@ -25,6 +25,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from ray_tpu.ops.attention import attention
 from ray_tpu.ops.norms import rms_norm
@@ -43,7 +44,16 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     rms_eps: float = 1e-5
     dtype: str = "bfloat16"
-    # remat policy for the scan body: "none" | "full" | "dots"
+    # remat policy for the scan body:
+    #   "none"      - save all activations (most HBM, no recompute)
+    #   "full"      - save only layer inputs (least HBM, full recompute)
+    #   "dots"      - save matmul outputs (recompute elementwise only)
+    #   "attn"      - save only the attention OUTPUT: the backward never
+    #                 re-runs the flash-attention forward — the known
+    #                 lever for long-context MFU where attention
+    #                 dominates (policy: save_only_these_names)
+    #   "dots_attn" - dots + the attention output (skips both matmul and
+    #                 flash-fwd recompute; elementwise-only recompute)
     remat: str = "full"
     tie_embeddings: bool = False
 
@@ -173,6 +183,11 @@ def attention_sublayer(cfg, x, p, sin, cos, segment_ids, attn_impl,
     else:
         attn_out = attention(q, k, v, causal=True, segment_ids=segment_ids,
                              impl=attn_impl)
+    # checkpoint naming for the "attn"/"dots_attn" remat policies lives
+    # INSIDE the attention impls (flash names its kernel residuals in
+    # _flash_vjp_fwd; the reference impl names its output in
+    # ops/attention.py) — naming the post-reshape copy here too would
+    # double-store ~b*s*d per layer under those policies.
     attn_out = attn_out.reshape(b, s, cfg.n_heads * cfg.head_dim)
     return x + attn_out @ p["wo"]
 
@@ -228,6 +243,24 @@ def forward_hidden(cfg, params, tokens, *, positions=None,
         body = jax.checkpoint(
             body,
             policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        )
+    elif cfg.remat == "attn":
+        # "attn_lse" must be saved WITH the output: both are flash-bwd
+        # residuals — with them saved, remat DCE drops the flash-forward
+        # call from the backward entirely
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "attn_lse"),
+        )
+    elif cfg.remat == "dots_attn":
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                jax.checkpoint_policies.save_only_these_names(
+                    "attn_out", "attn_lse"),
+            ),
         )
 
     def scan_fn(x, layer_params):

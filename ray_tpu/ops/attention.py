@@ -89,10 +89,16 @@ def attention(q, k, v, *, causal=True, segment_ids=None,
         from ray_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)
-    return reference_attention(
-        q, k, v, causal=causal, segment_ids=segment_ids,
-        logits_soft_cap=logits_soft_cap,
-    )
+    from jax.ad_checkpoint import checkpoint_name
+
+    # save point for the "attn"/"dots_attn" remat policies (the flash
+    # impl names its kernel residuals instead — _flash_vjp_fwd)
+    return checkpoint_name(
+        reference_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            logits_soft_cap=logits_soft_cap,
+        ),
+        "attn_out")
 
 
 def _flash_supported(q, segment_ids, logits_soft_cap, causal) -> bool:

@@ -348,6 +348,15 @@ def _flash_vjp_fwd(q, k, v, scale, causal, block_q_k, interpret):
     out, lse = _flash_fwd(q, k, v, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
                           interpret=interpret, with_lse=True)
+    # named checkpoint targets: under jax.checkpoint with the
+    # "attn"/"dots_attn" policies (models/llama.py) these residuals are
+    # SAVED, so the backward never re-runs this kernel — the O(seq^2)
+    # forward otherwise recomputes inside every remat backward, the
+    # round-3 long-context MFU gap
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return out, (q, k, v, out, lse)
 
 
@@ -382,16 +391,23 @@ def _fit_block(seq: int, want: int) -> int:
 
 def flash_attention(
     q, k, v, *, causal: bool = True, scale: float | None = None,
-    block_q: int = 512, block_k: int = 1024, interpret: bool = False,
+    block_q: int | None = None, block_k: int | None = None,
+    interpret: bool = False,
 ):
-    # defaults from a v5e sweep at s=2048 d=128: (512,1024) runs ~27%
-    # faster than (256,256) — fewer grid steps amortize the scratch
-    # init/finalize and keep the MXU busier per block
+    # default blocks from v5e FULL-gradient in-graph sweeps (d=128,
+    # fwd + dq + dk/dv kernels): (512,1024) wins at s=2048/b=8
+    # (16.3ms vs 19.6 for bq=1024); at s=16k/b=1 the larger q block
+    # wins ((1024,1024): 39.8ms vs 43.3) — more rows per grid step
+    # amortize scratch when many kv blocks stream per q block
     """Flash attention. q/k/v: [batch, seq, heads, head_dim] (same layout as
     ``reference_attention``); returns [batch, seq, heads, head_dim].
     """
     b, sq, h, d = q.shape
     skv = k.shape[1]
+    if block_q is None:
+        block_q = 1024 if sq >= 8192 else 512
+    if block_k is None:
+        block_k = 1024
     scale = scale if scale is not None else d ** -0.5
     block_q = _fit_block(sq, block_q)
     block_k = _fit_block(skv, block_k)

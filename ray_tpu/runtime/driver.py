@@ -149,7 +149,18 @@ class ClusterRuntime:
         self._ref_enabled = _cfg.ref_counting_enabled
         self._ref_interval = _cfg.ref_flush_interval_s
         self._ref_send_lock = threading.Lock()
-        self._owns_flusher = (self._ref_enabled
+        self._actor_window = _cfg.actor_submit_window
+        # batched put-pin reports (see put/_put_report_loop)
+        self._put_report_buf: list[tuple[str, int]] = []
+        self._put_report_cv = threading.Condition()
+        threading.Thread(target=self._put_report_loop, daemon=True,
+                         name="put-report-flusher").start()
+        # a nested in-worker runtime must NOT claim: the Worker loop owns
+        # the process flush channel (claim_flusher(worker_id) would
+        # spuriously succeed for us since client_id == worker_id, and our
+        # shutdown() would then unregister the still-running worker)
+        in_worker = "RAY_TPU_WORKER_ID" in _os.environ
+        self._owns_flusher = (self._ref_enabled and not in_worker
                               and _refcount.claim_flusher(self.client_id))
         if self._owns_flusher:
             try:
@@ -189,52 +200,84 @@ class ClusterRuntime:
 
     def _ref_flush_now(self, force_heartbeat: bool = False) -> bool:
         """Send pending ref deltas (serialized by a lock so the loop and
-        synchronous borrower flushes never interleave a payload)."""
+        synchronous borrower flushes never interleave a payload). The
+        protocol round itself is refcount.flush_once, shared with the
+        worker loop; this wrapper adds the driver-only lineage cleanup."""
         if not self._ref_enabled or self._closed:
             return False
-        with self._ref_send_lock:
-            payload = self._refs.take_flush()
-            if payload is None and not force_heartbeat:
-                return False
-            if payload and payload["remove"]:
+        from ray_tpu.runtime.refcount import flush_once
+
+        def call(method, **kwargs):
+            if kwargs.get("remove"):
                 # dropped refs lose reconstructability too (the object
                 # is gone; resurrecting it would leak)
                 with self._lineage_lock:
-                    for oid_hex in payload["remove"]:
+                    for oid_hex in kwargs["remove"]:
                         self._lineage.pop(oid_hex, None)
-            try:
-                reply = self._gcs.call("ref_update",
-                                       client_id=self.client_id,
-                                       kind="driver", **(payload or {}))
-                if reply.get("resync"):
-                    # the GCS reaped us during a heartbeat gap and
-                    # dropped our holds: re-register everything held
-                    self._refs.force_resync()
-                return True
-            except Exception:  # noqa: BLE001 - GCS unreachable: requeue
-                if payload:
-                    self._refs.restore_flush(payload)
-                return False
+            return self._gcs.call(method, **kwargs)
+
+        with self._ref_send_lock:
+            return flush_once(self._refs, call, self.client_id, "driver",
+                              force_heartbeat)
 
     # ------------------------------------------------------------------
     # objects
     # ------------------------------------------------------------------
 
     def put(self, value) -> ObjectRef:
+        """Seal into shm with a held read ref and return immediately; the
+        pin registration is BATCHED (one raylet RPC per flush, not per
+        put — same protocol as the worker's task-return reports). The
+        seal-hold keeps the object eviction-safe until the pin lands;
+        the report flusher releases it after."""
         oid = ObjectID.from_random()
-        # hold=True: the sealed object keeps a read ref until the raylet
-        # has pinned the primary copy — never a window where LRU eviction
-        # can destroy the sole copy
         size = object_codec.put_value_durable(
             self.store, oid.binary(), value, hold=True,
             request_space=lambda n: self._raylet.call("request_space",
                                                       nbytes=n))
-        try:
-            self._raylet.call("report_object", oid=oid.hex(), size=size)
-        finally:
-            if size > 0:
-                self.store.release(oid.binary())
+        if size > 0:
+            with self._put_report_cv:
+                self._put_report_buf.append((oid.hex(), size))
+                self._put_report_cv.notify()
         return ObjectRef(oid)
+
+    def _put_report_loop(self):
+        """Drain put reports into batched report_objects RPCs, releasing
+        each object's seal-hold once its pin is confirmed."""
+        while not self._closed:
+            with self._put_report_cv:
+                while not self._put_report_buf and not self._closed:
+                    self._put_report_cv.wait(timeout=0.5)
+                if self._closed:
+                    batch = []
+                else:
+                    time_to_linger = bool(self._put_report_buf)
+            if self._closed:
+                return
+            if time_to_linger:
+                time.sleep(0.0005)   # coalesce a burst of puts
+            with self._put_report_cv:
+                batch, self._put_report_buf = self._put_report_buf, []
+            if not batch:
+                continue
+            try:
+                self._raylet.call("report_objects", entries=batch)
+            except Exception:  # noqa: BLE001 - raylet unreachable
+                # the seal-holds are what keep these objects alive until
+                # their pins land: requeue and retry rather than
+                # releasing unpinned sole copies into LRU eviction
+                if not self._closed:
+                    with self._put_report_cv:
+                        self._put_report_buf[:0] = batch
+                    time.sleep(0.05)
+                continue
+            if self._closed:
+                continue   # store may be unmapped: never touch
+            for oid_hex, _ in batch:
+                try:
+                    self.store.release(bytes.fromhex(oid_hex))
+                except Exception:  # noqa: BLE001
+                    pass
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None):
         oids = [r.id.hex() for r in refs]
@@ -755,7 +798,12 @@ class ClusterRuntime:
         raise exc.ActorUnavailableError(
             f"actor {actor_id_hex[:8]} not ALIVE within {timeout}s")
 
-    ACTOR_WINDOW = 256   # max unacked tasks per actor (outbox + in flight)
+    @property
+    def ACTOR_WINDOW(self):
+        """Max unacked tasks per actor (outbox + in flight); flag
+        ``actor_submit_window`` — deep enough to absorb enqueue-ack
+        latency without stalling the submitter."""
+        return self._actor_window
 
     def _submit_actor_task(self, spec: TaskSpec):
         """Enqueue one actor call for the flusher (seq assigned HERE so

@@ -1128,6 +1128,13 @@ class GcsServer(RpcServer):
             stale = [cid for cid, c in self._clients.items()
                      if c["alive"]
                      and now - c["last_seen"] > self._client_timeout]
+            # prune long-dead entries: every driver session otherwise
+            # leaves a permanent _clients row (the 60s linger keeps the
+            # resurrection fence effective across brief outages)
+            for cid in [cid for cid, c in self._clients.items()
+                        if not c["alive"]
+                        and now - c["last_seen"] > 60.0]:
+                del self._clients[cid]
         for cid in stale:
             self._reap_client(cid, "client heartbeat timeout")
 
@@ -1269,6 +1276,31 @@ class GcsServer(RpcServer):
         return {"total": total, "available": avail}
 
 
+def main():
+    """Run the GCS as a standalone process (reference:
+    ``gcs_server_main.cc`` — the control plane is its own process).
+    cluster_utils spawns this for ``Cluster(external_gcs=True)``."""
+    import json
+    import signal
+    import sys
+
+    cfg = json.loads(sys.argv[1]) if len(sys.argv) > 1 else {}
+    server = GcsServer(
+        host=cfg.get("host", "127.0.0.1"),
+        port=cfg.get("port", 0),
+        heartbeat_timeout_s=cfg.get("heartbeat_timeout_s", 5.0),
+        persistence_dir=cfg.get("persistence_dir"),
+    ).start()
+    stop_ev = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop_ev.set())
+    signal.signal(signal.SIGINT, lambda *_: stop_ev.set())
+    print(json.dumps({"address": server.address}), flush=True)
+    try:
+        stop_ev.wait()
+    finally:
+        server.stop()
+
+
 def _ns_key(namespace: str, name: str) -> str:
     """Registry key scoping a named actor to its namespace (the unit
     separator cannot appear in user-visible names by convention)."""
@@ -1362,3 +1394,7 @@ def _place_bundles(bundles: list, strategy: str, nodes: list):
             return None
         assignment.append(placed)
     return assignment
+
+
+if __name__ == "__main__":
+    main()

@@ -157,7 +157,10 @@ class LeaseManager:
             return None
 
     PIPELINE_DEPTH = 2   # in-flight push GROUPS per lease (hides owner RTT)
-    GROUP_SIZE = 32      # max tasks packed into one push RPC
+    # max tasks packed into one push RPC: 64 measured ~20% faster than
+    # 32 at 4 leases (fewer reply wakeups contending for the owner GIL);
+    # deeper pipelining (4) measured WORSE — more pusher-thread churn
+    GROUP_SIZE = 64
 
     def _pop_group(self, key: tuple, limit: int) -> list:
         with self._lock:

@@ -25,16 +25,20 @@ _U64 = struct.Struct("<Q")
 FLAG_ERROR = 1
 
 
-def _serialize_capturing(object_id: bytes, value):
-    """Serialize, recording contains-edges for any ObjectRef pickled
-    inside the value (reference: contained-in tracking,
-    reference_count.h:67 — the outer object holds a reference on each
-    inner object until the outer is released)."""
+def _serialize_capturing(value):
+    """Serialize, capturing any ObjectRef pickled inside the value
+    (reference: contained-in tracking, reference_count.h:67). Returns
+    ``(obj, captured_oid_hexes)`` — the caller records the
+    contains-edges only AFTER the store write succeeds; reporting a
+    failed put's edges would inflate the inner refcounts forever."""
     with _refs.capture() as cap:
         obj = serialize(value)
-    if cap.oids:
-        _refs.add_contains(object_id.hex(), cap.oids)
-    return obj
+    return obj, cap.oids
+
+
+def _note_contains(object_id: bytes, caught):
+    if caught:
+        _refs.add_contains(object_id.hex(), caught)
 
 
 def encoded_size(obj: SerializedObject) -> int:
@@ -88,7 +92,7 @@ def put_value(store, object_id: bytes, value, *, is_error: bool = False) -> int:
     matching the local-mode store's semantics."""
     from ray_tpu._private.shm_store import ObjectExistsError
 
-    obj = _serialize_capturing(object_id, value)
+    obj, caught = _serialize_capturing(value)
     size = encoded_size(obj)
     try:
         buf = store.create(object_id, size)
@@ -99,6 +103,7 @@ def put_value(store, object_id: bytes, value, *, is_error: bool = False) -> int:
     finally:
         del buf
     store.seal(object_id)
+    _note_contains(object_id, caught)
     return size
 
 
@@ -120,7 +125,7 @@ def put_value_durable(store, object_id: bytes, value, *,
 
     from ray_tpu._private.shm_store import ObjectExistsError, StoreFullError
 
-    obj = _serialize_capturing(object_id, value)
+    obj, caught = _serialize_capturing(value)
     size = encoded_size(obj)
     deadline = _time.monotonic() + timeout_s
     delay = 0.02
@@ -145,6 +150,7 @@ def put_value_durable(store, object_id: bytes, value, *,
         finally:
             del buf
         store.seal(object_id, hold=hold)
+        _note_contains(object_id, caught)
         return size
 
 

@@ -262,6 +262,27 @@ class RefCounter:
             self._local_release_cb = None
 
 
+def flush_once(counter: "RefCounter", call, client_id: str, kind: str,
+               force_heartbeat: bool = False) -> bool:
+    """One flush round of the client protocol, shared by the driver and
+    worker loops: take pending deltas, send ``ref_update``, requeue on
+    failure, and re-sync the held set when the GCS says this client was
+    reaped and resurrected. ``call(method, **kwargs)`` is the GCS RPC."""
+    payload = counter.take_flush()
+    if payload is None and not force_heartbeat:
+        return False
+    try:
+        reply = call("ref_update", client_id=client_id, kind=kind,
+                     **(payload or {}))
+        if reply.get("resync"):
+            counter.force_resync()
+        return True
+    except Exception:  # noqa: BLE001 - GCS unreachable: requeue deltas
+        if payload:
+            counter.restore_flush(payload)
+        return False
+
+
 # The process-global counter fed by ObjectRef lifecycle hooks.
 global_counter = RefCounter()
 

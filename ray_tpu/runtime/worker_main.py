@@ -437,21 +437,11 @@ class Worker:
                 last_beat = now
 
     def _ref_flush_now(self, force_heartbeat: bool = False) -> bool:
+        from ray_tpu.runtime.refcount import flush_once
+
         with self._ref_send_lock:
-            payload = self._refs.take_flush()
-            if payload is None and not force_heartbeat:
-                return False
-            try:
-                reply = self._gcs.call("ref_update",
-                                       client_id=self.worker_id,
-                                       kind="worker", **(payload or {}))
-                if reply.get("resync"):
-                    self._refs.force_resync()
-                return True
-            except Exception:  # noqa: BLE001 - GCS unreachable: requeue
-                if payload:
-                    self._refs.restore_flush(payload)
-                return False
+            return flush_once(self._refs, self._gcs.call, self.worker_id,
+                              "worker", force_heartbeat)
 
     def _release_task_pin(self, task: dict):
         """Execution finished: release the submitter's arg pins for this

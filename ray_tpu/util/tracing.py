@@ -60,14 +60,32 @@ def enable_tracing(trace_dir: str) -> None:
     tracing startup hook)."""
     os.makedirs(trace_dir, exist_ok=True)
     os.environ[_ENV_DIR] = trace_dir
+    global _enabled_cache
+    _enabled_cache = (True, time.monotonic())
 
 
 def disable_tracing() -> None:
     os.environ.pop(_ENV_DIR, None)
+    global _enabled_cache
+    _enabled_cache = (False, time.monotonic())
+
+
+# (value, checked_at): is_enabled sits on the per-call submit hot path —
+# an os.environ read per submit measurably taxes 10k+ calls/s, so the
+# env probe is cached with a short TTL. enable/disable invalidate
+# immediately; a worker learning of tracing purely via inherited env
+# sees it within the TTL (observability-only lag).
+_enabled_cache: tuple[bool, float] = (False, -1.0)
 
 
 def is_enabled() -> bool:
-    return bool(os.environ.get(_ENV_DIR))
+    global _enabled_cache
+    value, checked = _enabled_cache
+    now = time.monotonic()
+    if now - checked > 0.2:
+        value = bool(os.environ.get(_ENV_DIR))
+        _enabled_cache = (value, now)
+    return value
 
 
 def current_context() -> SpanContext | None:

@@ -100,6 +100,13 @@ class Config:
     # (covers the borrower-incref-in-flight window).
     ref_release_grace_s: float = 0.5
 
+    # --- submission pipeline ---
+    # Max unacked actor tasks per actor (outbox + frames in flight).
+    # Deep enough that the submitter never stalls waiting for enqueue
+    # acks at 10k+ calls/s (reference analog: max_pending_calls /
+    # the async gRPC stream depth in DirectActorTaskSubmitter).
+    actor_submit_window: int = 4096
+
     # --- workers ---
     num_workers: int = 0  # 0 = num_cpus
     worker_register_timeout_s: float = 30.0
