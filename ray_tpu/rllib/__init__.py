@@ -33,6 +33,7 @@ from ray_tpu.rllib.env import (
 )
 from ray_tpu.rllib.gym_env import GymEnvAdapter
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.learner_group import LearnerGroup
 from ray_tpu.rllib.estimators import (
     ImportanceSampling,
     WeightedImportanceSampling,
@@ -58,7 +59,8 @@ __all__ = ["A2C", "A2CConfig", "APPO", "APPOConfig", "ARS", "ARSConfig",
            "BanditLinTSConfig", "BanditLinUCB", "BanditLinUCBConfig",
            "CQL", "CQLConfig", "CartPole", "ContinuousBandit", "DQN",
            "DQNConfig", "DatasetWriter", "ES", "ESConfig",
-           "GymEnvAdapter", "IMPALA", "IMPALAConfig", "MARWIL",
+           "GymEnvAdapter", "IMPALA", "IMPALAConfig", "LearnerGroup",
+           "MARWIL",
            "MARWILConfig", "OfflineDataset", "PG", "PGConfig", "PPO",
            "PPOConfig", "Pendulum", "SAC", "SACConfig", "DDPG",
            "DDPGConfig", "TD3", "TD3Config", "collect_dataset",

@@ -22,43 +22,56 @@ from ray_tpu.rllib.ppo import (_np_forward, _softmax, forward_module,
 
 
 class _TrajectoryWorker:
-    """Collects fixed-length trajectories (time-major) with behavior
-    logits recorded for V-trace."""
+    """Collects fixed-length trajectories with behavior logits recorded
+    for V-trace. VECTORIZED over ``num_envs`` environments: each step
+    runs one batched policy forward for all envs (reference: vectorized
+    EnvRunner — the round-3 one-env-per-forward weakness)."""
 
-    def __init__(self, env_name, seed: int):
-        self.env = make_env(env_name, seed=seed)
+    def __init__(self, env_name, seed: int, num_envs: int = 1):
+        self.envs = [make_env(env_name, seed=seed + i)
+                     for i in range(num_envs)]
         self.rng = np.random.default_rng(seed)
-        self.obs = self.env.reset()
-        self.ep_ret = 0.0
+        self.obs = np.stack([e.reset() for e in self.envs])   # [E, obs]
+        self.ep_ret = np.zeros(num_envs)
+        self.num_envs = num_envs
 
     def sample(self, params_np: dict, unroll_length: int):
-        T = unroll_length
+        from ray_tpu.rllib.ppo import _sample_actions, _softmax_rows
+
+        T, ne = unroll_length, self.num_envs
         obs_l, act_l, logits_l, rew_l, done_l = [], [], [], [], []
         episode_returns = []
         for _ in range(T):
-            logits, _ = _np_forward(params_np, self.obs[None])
-            probs = _softmax(logits[0])
-            action = int(self.rng.choice(len(probs), p=probs))
-            next_obs, reward, done, _ = self.env.step(action)
-            obs_l.append(self.obs)
-            act_l.append(action)
-            logits_l.append(logits[0])
-            rew_l.append(reward)
-            done_l.append(float(done))
-            self.ep_ret += reward
-            if done:
-                episode_returns.append(self.ep_ret)
-                self.ep_ret = 0.0
-                self.obs = self.env.reset()
-            else:
-                self.obs = next_obs
+            logits, _ = _np_forward(params_np, self.obs)      # [E, A]
+            probs = _softmax_rows(logits)
+            actions = _sample_actions(self.rng, probs)
+            obs_l.append(self.obs.copy())
+            act_l.append(actions)
+            logits_l.append(logits)
+            step_rew = np.zeros(ne, np.float32)
+            step_done = np.zeros(ne, np.float32)
+            for i, env in enumerate(self.envs):
+                o, r, d, _ = env.step(int(actions[i]))
+                step_rew[i] = r
+                step_done[i] = float(d)
+                self.ep_ret[i] += r
+                if d:
+                    episode_returns.append(float(self.ep_ret[i]))
+                    self.ep_ret[i] = 0.0
+                    o = env.reset()
+                self.obs[i] = o
+            rew_l.append(step_rew)
+            done_l.append(step_done)
+        # [T, E, ...] -> [E, T, ...] (the learner stacks over the batch
+        # axis; each env is one trajectory)
         return {
-            "obs": np.asarray(obs_l, np.float32),           # [T, obs]
-            "actions": np.asarray(act_l, np.int32),          # [T]
-            "behavior_logits": np.asarray(logits_l, np.float32),
-            "rewards": np.asarray(rew_l, np.float32),
-            "dones": np.asarray(done_l, np.float32),
-            "bootstrap_obs": np.asarray(self.obs, np.float32),
+            "obs": np.stack(obs_l).swapaxes(0, 1).astype(np.float32),
+            "actions": np.stack(act_l).swapaxes(0, 1).astype(np.int32),
+            "behavior_logits": np.stack(logits_l).swapaxes(0, 1).astype(
+                np.float32),
+            "rewards": np.stack(rew_l).swapaxes(0, 1),
+            "dones": np.stack(done_l).swapaxes(0, 1),
+            "bootstrap_obs": self.obs.copy().astype(np.float32),
             "episode_returns": episode_returns,
         }
 
@@ -67,6 +80,8 @@ class _TrajectoryWorker:
 class IMPALAConfig:
     env: str = "CartPole-v1"
     num_rollout_workers: int = 2
+    # envs stepped in lockstep per worker (one batched forward per step)
+    num_envs_per_worker: int = 1
     unroll_length: int = 64
     lr: float = 5e-4
     gamma: float = 0.99
@@ -79,6 +94,9 @@ class IMPALAConfig:
     clip_param: float | None = None
     hidden: int = 64
     seed: int = 0
+    # multi-learner plane (reference: LearnerGroup learner_group.py:61)
+    num_learners: int = 0
+    learner_mode: str = "mesh"
 
     def environment(self, env) -> "IMPALAConfig":
         return replace(self, env=env)
@@ -107,30 +125,57 @@ class IMPALA:
         env = make_env(config.env, seed=config.seed)
         self.obs_dim = env.obs_dim
         self.n_actions = env.n_actions
-        self.params = init_module(jax.random.key(config.seed),
-                                  self.obs_dim, self.n_actions,
-                                  config.hidden)
         self.tx = optax.adam(config.lr)
-        self.opt_state = self.tx.init(self.params)
         self.iteration = 0
         worker_cls = ray_tpu.remote(_TrajectoryWorker)
         self.workers = [
-            worker_cls.remote(config.env, config.seed + 1000 * (i + 1))
+            worker_cls.remote(config.env, config.seed + 1000 * (i + 1),
+                              config.num_envs_per_worker)
             for i in range(config.num_rollout_workers)
         ]
-        self._update = jax.jit(partial(
-            _impala_update, tx=self.tx, gamma=config.gamma,
-            rho_clip=config.rho_clip, c_clip=config.c_clip,
-            entropy_coeff=config.entropy_coeff,
-            vf_coeff=config.vf_coeff,
-            clip_param=config.clip_param))
+        grad_fn = partial(
+            _impala_grads, gamma=config.gamma, rho_clip=config.rho_clip,
+            c_clip=config.c_clip, entropy_coeff=config.entropy_coeff,
+            vf_coeff=config.vf_coeff, clip_param=config.clip_param)
+        if config.num_learners > 0:
+            from ray_tpu.rllib.learner_group import LearnerGroup
+
+            # bind plain ints — a lambda over `self` would cloudpickle
+            # the whole algorithm into every learner actor's ctor blob
+            obs_dim, n_actions, hidden = (self.obs_dim, self.n_actions,
+                                          config.hidden)
+            self.learners = LearnerGroup(
+                init_fn=lambda key: init_module(
+                    key, obs_dim, n_actions, hidden),
+                grad_fn=grad_fn, tx=self.tx,
+                num_learners=config.num_learners,
+                mode=config.learner_mode, seed=config.seed)
+            self.params = None
+            self.opt_state = None
+        else:
+            self.learners = None
+            self.params = init_module(jax.random.key(config.seed),
+                                      self.obs_dim, self.n_actions,
+                                      config.hidden)
+            self.opt_state = self.tx.init(self.params)
+            self._update = jax.jit(partial(
+                _impala_update, tx=self.tx, gamma=config.gamma,
+                rho_clip=config.rho_clip, c_clip=config.c_clip,
+                entropy_coeff=config.entropy_coeff,
+                vf_coeff=config.vf_coeff,
+                clip_param=config.clip_param))
         self._inflight = None  # refs sampled with lagged params
 
-    def train(self) -> dict:
+    def _params_np(self):
         import jax
 
+        if self.learners is not None:
+            return self.learners.get_params()
+        return jax.tree.map(np.asarray, self.params)
+
+    def train(self) -> dict:
         cfg = self.config
-        params_np = jax.tree.map(np.asarray, self.params)
+        params_np = self._params_np()
         if self._inflight is None:  # first iteration: no lag yet
             self._inflight = [
                 w.sample.remote(params_np, cfg.unroll_length)
@@ -145,14 +190,18 @@ class IMPALA:
 
         episode_returns = [r for b in batches
                            for r in b["episode_returns"]]
-        # stack to [B, T, ...]
+        # concatenate env trajectories to [B, T, ...] (each worker
+        # contributes num_envs_per_worker trajectories)
         batch = {
-            k: np.stack([b[k] for b in batches])
+            k: np.concatenate([b[k] for b in batches])
             for k in ("obs", "actions", "behavior_logits", "rewards",
                       "dones", "bootstrap_obs")
         }
-        self.params, self.opt_state, stats = self._update(
-            self.params, self.opt_state, batch)
+        if self.learners is not None:
+            stats = self.learners.update(batch)
+        else:
+            self.params, self.opt_state, stats = self._update(
+                self.params, self.opt_state, batch)
         self.iteration += 1
         return {
             "training_iteration": self.iteration,
@@ -166,27 +215,28 @@ class IMPALA:
         }
 
     def compute_action(self, obs) -> int:
-        import jax
-
-        params_np = jax.tree.map(np.asarray, self.params)
-        logits, _ = _np_forward(params_np, np.asarray(obs)[None])
+        logits, _ = _np_forward(self._params_np(), np.asarray(obs)[None])
         return int(np.argmax(logits[0]))
 
     def save(self, path: str):
         import pickle
 
-        import jax
-
         with open(path, "wb") as f:
-            pickle.dump(jax.tree.map(np.asarray, self.params), f)
+            pickle.dump(self._params_np(), f)
 
     def restore(self, path: str):
         import pickle
 
         with open(path, "rb") as f:
-            self.params = pickle.load(f)
+            params = pickle.load(f)
+        if self.learners is not None:
+            self.learners.set_params(params)
+        else:
+            self.params = params
 
     def stop(self):
+        if self.learners is not None:
+            self.learners.stop()
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
@@ -225,8 +275,10 @@ def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
     return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv), rho
 
 
-def _impala_update(params, opt_state, batch, *, tx, gamma, rho_clip,
-                   c_clip, entropy_coeff, vf_coeff, clip_param=None):
+def _impala_grads(params, batch, *, gamma, rho_clip, c_clip,
+                  entropy_coeff, vf_coeff, clip_param=None):
+    """Pure gradient fn (Learner.compute_gradients analog); under a
+    dp-sharded batch axis the mean-loss grads are globally averaged."""
     import jax
     import jax.numpy as jnp
 
@@ -274,6 +326,17 @@ def _impala_update(params, opt_state, batch, *, tx, gamma, rho_clip,
                        "entropy": entropy, "mean_rho": jnp.mean(rho)}
 
     (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    return grads, stats
+
+
+def _impala_update(params, opt_state, batch, *, tx, gamma, rho_clip,
+                   c_clip, entropy_coeff, vf_coeff, clip_param=None):
+    import jax
+
+    grads, stats = _impala_grads(
+        params, batch, gamma=gamma, rho_clip=rho_clip, c_clip=c_clip,
+        entropy_coeff=entropy_coeff, vf_coeff=vf_coeff,
+        clip_param=clip_param)
     updates, opt_state = tx.update(grads, opt_state, params)
     params = jax.tree.map(lambda p, u: p + u, params, updates)
     return params, opt_state, stats
