@@ -187,12 +187,18 @@ def bench_train(preset: str | None = None) -> dict:
 
 
 def bench_serve() -> dict:
-    """Continuous-batching decode throughput + TTFT on the LLM engine."""
+    """Continuous-batching decode throughput + TTFT on the paged-KV LLM
+    engine: a burst phase (comparable with earlier rounds) and a
+    SUSTAINED closed-loop phase (concurrency 16, a new request the
+    moment one finishes)."""
+    import threading
+
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from ray_tpu.models import llama
-    from ray_tpu.serve.llm import LLMEngine
+    from ray_tpu.serve.paged_llm import PagedLLMEngine
 
     preset = os.environ.get("BENCH_PRESET", "base")
     n_requests = int(os.environ.get("BENCH_REQUESTS", "16"))
@@ -201,25 +207,36 @@ def bench_serve() -> dict:
     if preset == "small":
         model_cfg = llama.llama_tiny()
         max_batch, max_len, prompt_len, new_tokens = 4, 256, 32, 32
+        concurrency, sustained_total = 4, 8
     else:
         model_cfg = llama.LlamaConfig(
             vocab_size=32768, d_model=1536, n_layers=12, n_heads=12,
             n_kv_heads=4, head_dim=128, d_ff=6144, remat="none",
         )
-        # slots sized to the offered concurrency (continuous-batching
-        # SOP: a request should never wait for a KV slot when HBM can
-        # hold its cache) — decode is weight-bandwidth-bound at this
-        # size, so doubling slots nearly doubles aggregate tokens/s and
-        # removes the slot-wait component of TTFT
-        max_batch, max_len, prompt_len, new_tokens = 16, 2048, 128, 128
+        # 4 spare slots over the offered concurrency: admission never
+        # waits for a retirement (the free-slot drain path runs)
+        max_batch, max_len, prompt_len, new_tokens = 20, 2048, 128, 128
+        concurrency, sustained_total = 16, 64
+
+    # the fixed per-dispatch sync cost through the device transport —
+    # the TTFT floor no engine scheduling can beat (recorded so the
+    # numbers are interpretable on tunneled chips)
+    _f = jax.jit(lambda x: x + 1)
+    _x = jnp.zeros((4,))
+    np.asarray(_f(_x))
+    _t = time.perf_counter()
+    for _ in range(5):
+        np.asarray(_f(_x))
+    sync_rtt_ms = (time.perf_counter() - _t) / 5 * 1e3
 
     params = llama.init_params(model_cfg, jax.random.key(0))
     n_params = llama.num_params(params)
-    eng = LLMEngine(params=params, cfg=model_cfg, max_batch=max_batch,
-                    max_len=max_len)
+    eng = PagedLLMEngine(params=params, cfg=model_cfg,
+                         max_batch=max_batch, max_len=max_len,
+                         decode_chunk=32 if preset != "small" else 8)
     # deterministic warmup BEFORE the loop starts: every prefill group
-    # size + both decode programs compile now, so no JIT lands inside
-    # the measured window no matter how the burst gets admitted
+    # size + decode programs at every pages bucket compile now, so no
+    # JIT lands inside a measured window
     eng.warmup(prompt_len)
     eng.start()
     rng = np.random.default_rng(0)
@@ -227,6 +244,7 @@ def bench_serve() -> dict:
                    max_new_tokens=4)
     list(w.tokens())
 
+    # -- burst phase (round-comparable) --
     t0 = time.perf_counter()
     reqs = [
         eng.submit(rng.integers(1, model_cfg.vocab_size, prompt_len),
@@ -235,29 +253,90 @@ def bench_serve() -> dict:
     ]
     done = [list(r.tokens()) for r in reqs]
     elapsed = time.perf_counter() - t0
-    eng.stop()
 
     generated = sum(len(d) for d in done)
     tokens_per_sec = generated / elapsed
     ttfts = [r.ttft for r in reqs if r.ttft is not None]
 
+    # -- sustained phase: closed loop at fixed concurrency --
+    done_counts: list = []
+    sus_ttfts: list = []
+    lock = threading.Lock()
+    remaining = [sustained_total - concurrency]
+    # monotonic: Request.submit_t uses time.monotonic — mixing clocks
+    # breaks the steady-state filter on platforms where their epochs
+    # differ
+    t0 = time.monotonic()
+
+    def consume(req):
+        toks = list(req.tokens())
+        with lock:
+            done_counts.append(len(toks))
+            if req.ttft is not None:
+                sus_ttfts.append((req.submit_t - t0, req.ttft))
+            go = remaining[0] > 0
+            if go:
+                remaining[0] -= 1
+        if go:
+            nxt = eng.submit(
+                rng.integers(1, model_cfg.vocab_size, prompt_len),
+                max_new_tokens=new_tokens)
+            threading.Thread(target=consume, args=(nxt,),
+                             daemon=True).start()
+
+    for _ in range(concurrency):
+        r = eng.submit(rng.integers(1, model_cfg.vocab_size, prompt_len),
+                       max_new_tokens=new_tokens)
+        threading.Thread(target=consume, args=(r,), daemon=True).start()
+    while True:
+        with lock:
+            if len(done_counts) >= sustained_total:
+                break
+        time.sleep(0.05)
+    sus_elapsed = time.monotonic() - t0
+    sus_tps = sum(done_counts) / sus_elapsed
+    steady = [t for (ts, t) in sus_ttfts if ts > 0.5] or \
+        [t for _, t in sus_ttfts]
+    pages = eng.stats()
+    eng.stop()
+
     # end-to-end engine throughput: the window covers prefill + queueing +
     # decode for the whole request set (what a serving client experiences)
     result = {
         "metric": "llama_serve_engine_tokens_per_sec",
-        "value": round(tokens_per_sec, 1),
+        # headline = SUSTAINED throughput (the serving-steady-state
+        # number; the burst figure is round-comparable detail)
+        "value": round(sus_tps, 1),
         "unit": "tokens/s",
         "vs_baseline": None,  # reference publishes no serving numbers
         "detail": {
             "platform": platform,
             "params": n_params,
+            "kv_layout": "paged",
             "requests": n_requests,
             "prompt_len": prompt_len,
             "new_tokens": new_tokens,
             "max_batch": max_batch,
+            "burst_tokens_per_sec": round(tokens_per_sec, 1),
             "mean_ttft_s": round(float(np.mean(ttfts)), 4) if ttfts else None,
             "p50_ttft_s": round(float(np.median(ttfts)), 4) if ttfts else None,
             "requests_per_sec": round(n_requests / elapsed, 2),
+            "sustained": {
+                "concurrency": concurrency,
+                "requests": sustained_total,
+                "tokens_per_sec": round(sus_tps, 1),
+                "p50_ttft_s": round(float(np.median(steady)), 4),
+                "p95_ttft_s": round(float(np.percentile(steady, 95)), 4),
+            },
+            # fixed per-dispatch sync latency of the device transport —
+            # the floor under every TTFT above (tunneled chips pay ~2 of
+            # these per prefill; a local PCIe chip pays ~1ms)
+            "dispatch_sync_rtt_ms": round(sync_rtt_ms, 1),
+            "kv_pages": {
+                "total": pages.get("kv_pages_total"),
+                "bytes": pages.get("kv_pages_bytes"),
+                "dense_equiv_bytes": pages.get("kv_dense_equiv_bytes"),
+            },
         },
     }
     return result

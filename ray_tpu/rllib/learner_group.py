@@ -127,10 +127,6 @@ class _LearnerActorImpl:
             self.group = CollectiveGroup(group_name, world_size, rank)
         else:
             self.group = None
-        # flat spec for grad all-reduce (built lazily on first update)
-        self._treedef = None
-        self._shapes = None
-
     def _allreduce_mean(self, grads):
         import jax
 

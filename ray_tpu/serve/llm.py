@@ -106,7 +106,6 @@ class LLMEngine:
         # requests happens between chunks (adds <= chunk * step_time to
         # queueing latency).
         self.decode_chunk = max(1, decode_chunk)
-        self._cache = decoding.init_cache(cfg, max_batch, max_len)
         # host-side slot state (mirrors cache.lengths but trusted copy)
         self._lengths = np.zeros((max_batch,), np.int32)
         self._last_tok = np.zeros((max_batch,), np.int32)
@@ -124,16 +123,28 @@ class LLMEngine:
         self.total_generated = 0
         self.total_finished = 0
         self.ttfts: "deque[float]" = deque(maxlen=1024)
+        # device-resident loop inputs (see _device_inputs)
+        self._dev_inputs: dict | None = None
+        self._dev_dirty = True
+        # set when an admission failed on resources (not slots) this
+        # round — gates the free-slot drain clause
+        self._admission_blocked = False
+        # drain-mode decode: a SHORT chunk used when a slot is about to
+        # retire while requests wait, so admission happens within ~8
+        # steps instead of a full chunk (TTFT <- admission latency)
+        self._drain_chunk = max(1, min(8, self.decode_chunk))
+        self._setup_device_state()
 
+    def _setup_device_state(self):
+        """Build the KV cache + compiled programs (dense layout; the
+        paged engine overrides this — serve/paged_llm.py)."""
+        cfg = self.cfg
+        self._cache = decoding.init_cache(cfg, self.max_batch,
+                                          self.max_len)
         self._decode_fn = jax.jit(
             partial(self._decode_impl, cfg, chunk=self.decode_chunk),
             donate_argnums=(1,)
         )
-        # drain-mode decode: a SHORT chunk used while requests are
-        # waiting, so prefills are admitted after ~4 steps instead of a
-        # full chunk — prefill priority without abandoning chunked
-        # decode's dispatch amortization (TTFT <- admission latency)
-        self._drain_chunk = max(1, min(4, self.decode_chunk))
         self._decode_fn_drain = (
             self._decode_fn if self._drain_chunk == self.decode_chunk
             else jax.jit(
@@ -158,10 +169,11 @@ class LLMEngine:
     def _decode_impl(cfg, params, cache: KVCache, tokens, lengths, active,
                      temps, key, *, chunk):
         """``chunk`` decode steps over every slot in one compiled program
-        (scan); returns the [chunk, max_batch] token matrix. Inactive
-        slots are computed but masked (position 0 write is harmless: a
-        later prefill overwrites). Slots finishing mid-chunk keep
-        decoding; the host drops their surplus tokens."""
+        (scan); returns the [chunk, max_batch] token matrix plus the
+        advanced lengths (kept ON DEVICE so chained chunks never need a
+        host upload). Inactive slots are computed but masked (position 0
+        write is harmless: a later prefill overwrites). Slots finishing
+        mid-chunk keep decoding; the host drops their surplus tokens."""
         def step(carry, _):
             cache, toks, lens, key = carry
             key, sub = jax.random.split(key)
@@ -178,9 +190,9 @@ class LLMEngine:
             lens = jnp.where(active, lens + 1, lens)
             return (cache, nxt, lens, key), nxt
 
-        (cache, _, _, _), toks = jax.lax.scan(
+        (cache, _, lens, _), toks = jax.lax.scan(
             step, (cache, tokens, lengths, key), None, length=chunk)
-        return cache, toks
+        return cache, toks, lens
 
     @staticmethod
     def _prefill_impl(cfg, params, cache: KVCache, tokens, plen, slot, *,
@@ -251,7 +263,7 @@ class LLMEngine:
         for fn in {id(self._decode_fn): self._decode_fn,
                    id(self._decode_fn_drain):
                        self._decode_fn_drain}.values():
-            self._cache, toks = fn(
+            self._cache, toks, _ = fn(
                 self.params, self._cache,
                 jnp.zeros((self.max_batch,), jnp.int32),
                 jnp.zeros((self.max_batch,), jnp.int32), active,
@@ -297,6 +309,36 @@ class LLMEngine:
     def _free_slots(self) -> list[int]:
         return [i for i, r in enumerate(self._active) if r is None]
 
+    def _on_slot_retired(self, slot: int):
+        """Hook: a request finished and its slot was released (paged
+        engine reclaims KV pages here)."""
+        self._dev_dirty = True
+
+    def _on_idle(self):
+        """Hook: the loop has no active slots and nothing in flight
+        (paged engine finishes deferred page frees here — with the
+        pipeline drained they cannot race an in-flight chunk)."""
+
+    def _reserve_slot_resources(self, req: "Request", slot: int) -> bool:
+        """Hook: claim per-slot resources for an admission (paged engine
+        reserves KV pages). False = backpressure — the caller requeues
+        the request and stops admitting this round."""
+        return True
+
+    def _dispatch_prefill(self, part: list, bucket: int):
+        """Hook: dispatch one prefill sub-batch (``part`` is a list of
+        (req, slot, plen, padded)); returns the device first-tokens."""
+        tokens = jnp.asarray(np.stack([it[3] for it in part]))
+        plens = jnp.asarray(np.array([it[2] for it in part], np.int32))
+        slots = jnp.asarray(np.array([it[1] for it in part], np.int32))
+        temps = jnp.asarray(np.array(
+            [it[0].temperature for it in part], np.float32))
+        self._cache, firsts = self._prefill_batch_fn(
+            self.params, self._cache, tokens, plens, slots, temps,
+            self._next_key(),
+        )
+        return firsts
+
     def _admit(self):
         """Prefill waiting requests into free slots. All prefills of the
         round are DISPATCHED first and their first tokens extracted in
@@ -304,6 +346,7 @@ class LLMEngine:
         dominant prefill cost, so a burst of admissions pays ~one RTT,
         not one per request."""
         admits = []   # (req, slot, plen, padded)
+        self._admission_blocked = False
         for slot in self._free_slots():
             try:
                 req = self._waiting.get_nowait()
@@ -316,6 +359,10 @@ class LLMEngine:
                     f"{self.max_len}")
                 req.out.put(None)
                 continue
+            if not self._reserve_slot_resources(req, slot):
+                self._waiting.put(req)   # backpressure: retry later
+                self._admission_blocked = True
+                break
             bucket = min(_bucket(plen), self.max_len)
             padded = np.zeros((bucket,), np.int32)
             padded[:plen] = req.prompt
@@ -340,19 +387,8 @@ class LLMEngine:
                     m *= 2
                 part = items[i:i + m]
                 i += m
-                tokens = jnp.asarray(np.stack([it[3] for it in part]))
-                plens = jnp.asarray(
-                    np.array([it[2] for it in part], np.int32))
-                slots = jnp.asarray(
-                    np.array([it[1] for it in part], np.int32))
-                temps = jnp.asarray(
-                    np.array([it[0].temperature for it in part],
-                             np.float32))
-                self._cache, firsts = self._prefill_batch_fn(
-                    self.params, self._cache, tokens, plens, slots,
-                    temps, self._next_key(),
-                )
-                batches.append((part, firsts))
+                batches.append((part, self._dispatch_prefill(part,
+                                                             bucket)))
         all_firsts = np.asarray(jnp.concatenate(
             [f for _, f in batches])) if batches else []
         flat = [it for part, _ in batches for it in part]
@@ -363,6 +399,7 @@ class LLMEngine:
             self._active[slot] = req
             self._lengths[slot] = plen
             self._emit(req, int(first))
+        self._dev_dirty = True   # active set / lengths changed
 
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
@@ -372,14 +409,18 @@ class LLMEngine:
         req.generated += 1
         self.total_generated += 1
         self._last_tok[req.slot] = tok
+        # the cache-capacity cutoff counts prompt + emitted tokens — the
+        # _lengths mirror is chunk-granular (pre-advanced at dispatch)
+        # and would trip this up to two chunks early
         done = (req.eos_id is not None and tok == req.eos_id) or \
             req.generated >= req.max_new_tokens or \
-            self._lengths[req.slot] + 1 >= self.max_len
+            len(req.prompt) + req.generated >= self.max_len
         req.out.put(tok)
         if done:
             req.out.put(None)
             self._active[req.slot] = None
             self.total_finished += 1
+            self._on_slot_retired(req.slot)
         else:
             # the emitted token occupies position lengths[slot] next step
             pass
@@ -405,40 +446,126 @@ class LLMEngine:
                     except queue.Empty:
                         break
 
-    def _run_loop(self):
-        while not self._stop.is_set():
-            self._admit()
-            active_idx = [i for i, r in enumerate(self._active)
-                          if r is not None]
-            if not active_idx:
-                time.sleep(0.001)
-                continue
+    def _use_drain_chunk(self) -> bool:
+        """Short decode chunks ONLY when a waiting request could
+        actually be admitted soon — i.e. a slot is about to retire (an
+        active request near its token budget). Draining whenever the
+        queue was non-empty ran 4-step chunks for entire saturated runs
+        (4x the sync overhead) while no slot could possibly free.
+
+        Two admission opportunities count: a FREE SLOT already exists
+        (run the engine with max_batch above the offered concurrency and
+        this is the common case — admission then never waits for a
+        retirement), or a retirement is imminent. The horizon is 3
+        chunks because the double-buffered loop's ``generated`` counts
+        lag the device by up to two in-flight chunks."""
+        if self._waiting.empty():
+            return False
+        if any(r is None for r in self._active) \
+                and not self._admission_blocked:
+            # a free slot AND admission actually possible (a page-starved
+            # paged engine must not drain forever against a free slot it
+            # cannot fill)
+            return True
+        horizon = 3 * self.decode_chunk
+        return any(
+            r is not None
+            and (r.max_new_tokens - r.generated) <= horizon
+            for r in self._active)
+
+    def _device_inputs(self, active_idx):
+        """Device-resident loop inputs (active mask, temps, lengths).
+        Uploaded only when admission/retirement changed them — through a
+        remote-device tunnel each per-dispatch host upload costs an RTT
+        that would otherwise serialize with the decode chunks."""
+        if self._dev_inputs is None or self._dev_dirty:
             active = np.zeros((self.max_batch,), bool)
             active[active_idx] = True
             temps = np.array(
                 [r.temperature if r is not None else 0.0
                  for r in self._active], np.float32)
-            # prefill priority: while requests are WAITING, decode in
-            # short chunks so admission (slot turnover or mid-burst
-            # arrivals) happens within ~drain_chunk steps instead of a
-            # full chunk — the queueing component of TTFT shrinks ~4x
-            # at a small throughput cost that vanishes once the queue
-            # is empty
-            decode = (self._decode_fn if self._waiting.empty()
-                      else self._decode_fn_drain)
-            self._cache, toks = decode(
-                self.params, self._cache, jnp.asarray(self._last_tok),
-                jnp.asarray(self._lengths), jnp.asarray(active),
-                jnp.asarray(temps), self._next_key(),
-            )
-            toks = np.asarray(toks)           # [chunk, max_batch]
-            for i in active_idx:
-                for t in range(toks.shape[0]):
-                    req = self._active[i]
-                    if req is None:
-                        break   # finished mid-chunk; drop surplus tokens
-                    self._lengths[i] += 1  # consumed token is now cached
-                    self._emit(req, int(toks[t, i]))
+            self._dev_inputs = {
+                "active": jnp.asarray(active),
+                "temps": jnp.asarray(temps),
+                # .copy(): the host mirror is mutated right after each
+                # dispatch; an asynchronous transfer reading the live
+                # buffer would upload a torn lengths vector
+                "lens": jnp.asarray(self._lengths.copy()),
+            }
+            self._dev_dirty = False
+        return self._dev_inputs
+
+    def _dispatch_decode(self, last_tok, active_idx):
+        """Dispatch one decode chunk (no host sync). ``last_tok`` may be
+        a DEVICE array from the previous chunk's output — the data
+        dependency then stays on-device, so consecutive chunks chain
+        without a host round trip between them."""
+        drain = self._use_drain_chunk()
+        decode = self._decode_fn_drain if drain else self._decode_fn
+        chunk = self._drain_chunk if drain else self.decode_chunk
+        dev = self._device_inputs(active_idx)
+        self._cache, toks, lens = decode(
+            self.params, self._cache, last_tok,
+            dev["lens"], dev["active"], dev["temps"], self._next_key(),
+        )
+        dev["lens"] = lens   # stays on device for the chained chunk
+        # start the token matrix's device->host copy NOW: it overlaps
+        # the next chunk's compute instead of adding a serial RTT to
+        # every chunk sync
+        try:
+            toks.copy_to_host_async()
+        except Exception:  # noqa: BLE001 - backend without async copy
+            pass
+        # host mirror advances deterministically (+chunk per active
+        # slot) — retired slots are reconciled at admission
+        self._lengths[active_idx] += chunk
+        return toks, active_idx, chunk
+
+    def _emit_chunk(self, toks_np, active_idx):
+        for i in active_idx:
+            for t in range(toks_np.shape[0]):
+                req = self._active[i]
+                if req is None:
+                    break   # finished mid-chunk; drop surplus tokens
+                self._emit(req, int(toks_np[t, i]))
+
+    def _run_loop(self):
+        """Double-buffered decode: while chunk N's tokens copy back to
+        the host and get emitted, chunk N+1 already runs on device (its
+        input token vector is chunk N's LAST row, left on device) — the
+        per-chunk host sync + tunnel RTT overlaps compute instead of
+        serializing with it."""
+        pending = None   # (device_toks, active_idx, chunk)
+        while not self._stop.is_set():
+            self._admit()
+            active_idx = [i for i, r in enumerate(self._active)
+                          if r is not None]
+            if not active_idx:
+                if pending is not None:
+                    toks, idxs, _ = pending
+                    pending = None
+                    self._emit_chunk(np.asarray(toks), idxs)
+                    continue
+                self._on_idle()
+                time.sleep(0.001)
+                continue
+            if pending is None:
+                pending = self._dispatch_decode(
+                    jnp.asarray(self._last_tok), active_idx)
+                continue
+            toks_prev, idx_prev, _ = pending
+            # chain the next chunk on-device off the previous chunk's
+            # final token row, but only while the active set is stable
+            # (admission/retirement changes inputs host-side)
+            if idx_prev == active_idx:
+                nxt = self._dispatch_decode(toks_prev[-1], active_idx)
+            else:
+                nxt = None
+            self._emit_chunk(np.asarray(toks_prev), idx_prev)
+            if nxt is None:
+                pending = None   # active set changed: re-dispatch fresh
+            else:
+                pending = nxt
 
     # -- metrics -----------------------------------------------------------
 
@@ -466,10 +593,20 @@ class LLMDeployment:
     """
 
     def __init__(self, model_builder, *, max_batch: int = 8,
-                 max_len: int = 2048):
+                 max_len: int = 2048, kv_layout: str = "paged",
+                 **engine_kwargs):
         cfg, params = model_builder()
-        self._engine = LLMEngine(cfg, params, max_batch=max_batch,
-                                 max_len=max_len)
+        if kv_layout == "paged":
+            from ray_tpu.serve.paged_llm import PagedLLMEngine
+
+            self._engine = PagedLLMEngine(
+                cfg, params, max_batch=max_batch, max_len=max_len,
+                **engine_kwargs)
+        elif kv_layout == "dense":
+            self._engine = LLMEngine(cfg, params, max_batch=max_batch,
+                                     max_len=max_len, **engine_kwargs)
+        else:
+            raise ValueError(f"unknown kv_layout {kv_layout!r}")
         self._engine.start()
 
     def __call__(self, prompt, max_new_tokens: int = 128,
