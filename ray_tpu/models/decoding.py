@@ -190,6 +190,18 @@ def cached_forward(cfg, params, tokens, cache: KVCache, *,
 # Sampling
 # ---------------------------------------------------------------------------
 
+def select_tokens(logits, temps, key):
+    """The serving engines' per-slot token choice: greedy at temp 0,
+    temperature-scaled categorical otherwise. ONE implementation — the
+    dense and paged engines' decode/prefill programs all call this, and
+    their exact-token-equality contract depends on it staying shared."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(
+        jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
 @dataclass(frozen=True)
 class SamplingParams:
     temperature: float = 1.0

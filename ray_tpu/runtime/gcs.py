@@ -41,6 +41,10 @@ class NodeInfo:
     labels: dict = field(default_factory=dict)
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
+    # versioned resource view (reference: ray_syncer.h:86) — the last
+    # applied RESOURCE_VIEW version; -1 = never synced (ask the raylet
+    # for a full push on its next heartbeat)
+    resource_version: int = -1
     # latest reporter sample from the node (cpu/mem/spill-disk)
     host_stats: dict = field(default_factory=dict)
     # per-node dashboard agent RPC address (reference: dashboard/agent.py
@@ -512,14 +516,43 @@ class GcsServer(RpcServer):
             node.agent_addr = tuple(address)
         return {"ok": True}
 
-    def rpc_heartbeat(self, conn, send_lock, *, node_id, available,
-                      load=None, host_stats=None, freed_acks=None):
+    def rpc_resource_update(self, conn, send_lock, *, node_id, version,
+                            available):
+        """Versioned RESOURCE_VIEW push (reference: ray_syncer.cc:325
+        BroadcastRaySyncMessage): applied only when newer than the
+        stored version, so a slow push can never roll back a fresher
+        view. This — not the heartbeat — is how the scheduling view
+        tracks node state, at RPC latency."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                return {"ok": False, "reregister": True}
+            if version > node.resource_version:
+                node.resource_version = version
+                node.available = dict(available)
+        return {"ok": True}
+
+    def rpc_heartbeat(self, conn, send_lock, *, node_id, available=None,
+                      load=None, host_stats=None, freed_acks=None,
+                      resource_version=None):
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
                 return {"ok": False, "reregister": True}
             node.last_heartbeat = time.monotonic()
-            node.available = dict(available)
+            # liveness beat carries only the VERSION (payload O(1));
+            # `available` still accepted for legacy/snapshot callers.
+            # A version mismatch means the event-driven push stream and
+            # this view diverged (lost push, GCS restart): ask for one
+            # full resync push.
+            need_resources = False
+            if available is not None:
+                node.available = dict(available)
+                if resource_version is not None:
+                    node.resource_version = resource_version
+            elif resource_version is not None and \
+                    node.resource_version != resource_version:
+                need_resources = True
             if host_stats:
                 node.host_stats = dict(host_stats)
             # refcount release delivery is piggybacked on the heartbeat:
@@ -533,9 +566,12 @@ class GcsServer(RpcServer):
                         del self._pending_release[node_id]
             pend = self._pending_release.get(node_id)
             release = sorted(pend)[:5000] if pend else None
+        reply = {"ok": True}
         if release:
-            return {"ok": True, "release_oids": release}
-        return {"ok": True}
+            reply["release_oids"] = release
+        if need_resources:
+            reply["need_resources"] = True
+        return reply
 
     def rpc_get_nodes(self, conn, send_lock, *, alive_only: bool = True):
         with self._lock:

@@ -84,12 +84,15 @@ class LeaseManager:
                  legacy_submit: Callable[[dict], None],
                  on_task_failed: Callable[[dict, BaseException], None],
                  max_leases_per_shape: int = 64,
-                 lease_block_s: float = 5.0):
+                 lease_block_s: float | None = None):
+        from ray_tpu.utils.config import get_config
+
         self._raylet = raylet_client
         self._legacy_submit = legacy_submit
         self._on_task_failed = on_task_failed
         self._max_per_shape = max_leases_per_shape
-        self._lease_block_s = lease_block_s
+        self._lease_block_s = (lease_block_s if lease_block_s is not None
+                               else get_config().lease_block_s)
         self._lock = threading.Lock()
         self._queues: dict[tuple, deque] = {}
         self._pushers: dict[tuple, int] = {}

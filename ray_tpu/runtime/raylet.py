@@ -43,7 +43,8 @@ from ray_tpu.runtime.worker_pool import WorkerHandle, WorkerPool  # noqa: F401
 class Raylet(RpcServer):
     def __init__(self, *, node_id: str, gcs_address, resources: dict,
                  store_capacity: int = 1 << 30, host: str = "127.0.0.1",
-                 labels: dict | None = None, heartbeat_interval_s: float = 0.5,
+                 labels: dict | None = None,
+                 heartbeat_interval_s: float | None = None,
                  infeasible_timeout_s: float = 10.0):
         super().__init__(host, 0)
         self.node_id = node_id
@@ -73,10 +74,21 @@ class Raylet(RpcServer):
         self.scheduler = TaskScheduler(
             self, resources=resources,
             infeasible_timeout_s=infeasible_timeout_s)
-        self._hb_interval = heartbeat_interval_s
         self._threads: list[threading.Thread] = []
         from ray_tpu.utils.config import get_config
         _cfg = get_config()
+        self._hb_interval = (heartbeat_interval_s
+                             if heartbeat_interval_s is not None
+                             else _cfg.raylet_heartbeat_interval_s)
+        # versioned resource sync (reference: ray_syncer.h:86): local
+        # resource mutations push to the GCS at RPC latency; heartbeats
+        # carry only the version
+        from ray_tpu.runtime.resource_sync import ResourceSyncer
+        self.resource_syncer = ResourceSyncer(
+            self, self._avail_snapshot,
+            push_delay_s=_cfg.resource_sync_push_delay_s)
+        self.scheduler.on_resources_changed = \
+            self.resource_syncer.mark_changed
         self._mem_threshold = _cfg.memory_usage_threshold
         self._mem_refresh_s = max(_cfg.memory_monitor_refresh_ms, 50) / 1e3
         self.objects = LocalObjectManager(
@@ -127,6 +139,7 @@ class Raylet(RpcServer):
                 "register_node", node_id=self.node_id, address=self.address,
                 store_name=self.store_name, resources=self.total_resources,
                 labels=self.labels)
+        self.resource_syncer.start()
         loops = [self.scheduler.dispatch_loop, self._heartbeat_loop,
                  self.workers.monitor_loop, self.scheduler.infeasible_loop,
                  self.objects.location_flush_loop,
@@ -951,10 +964,14 @@ class Raylet(RpcServer):
                         if self.objects.spill_is_local else None)
                 acks = sorted(freed_acks) if freed_acks else None
                 with self._gcs_lock:
-                    reply = self._gcs.call("heartbeat", node_id=self.node_id,
-                                           available=self._avail_snapshot(),
-                                           host_stats=stats or None,
-                                           freed_acks=acks)
+                    # liveness only: the versioned syncer carries the
+                    # resource view at RPC latency; the beat's payload is
+                    # O(1) (the version) unless the GCS asks for a resync
+                    reply = self._gcs.call(
+                        "heartbeat", node_id=self.node_id,
+                        resource_version=self.resource_syncer.version,
+                        host_stats=stats or None,
+                        freed_acks=acks)
                 if acks:
                     freed_acks.difference_update(acks)
                 if reply.get("reregister"):
@@ -964,6 +981,10 @@ class Raylet(RpcServer):
                             address=self.address, store_name=self.store_name,
                             resources=self.total_resources,
                             labels=self.labels)
+                    self.resource_syncer.force_push()
+                elif reply.get("need_resources"):
+                    # version mismatch (lost push / GCS restart): resync
+                    self.resource_syncer.force_push()
                 # refcount releases ride the heartbeat reply (at-least-
                 # once: acked on the NEXT beat; freeing is idempotent)
                 release = reply.get("release_oids")

@@ -50,6 +50,10 @@ class TaskScheduler:
         # OOM-backoff timers (cancelled by stop())
         self._deferred_timers: set[threading.Timer] = set()
         self._timers_lock = threading.Lock()
+        # set by the raylet: notified on every acquire/release so the
+        # versioned resource syncer pushes the new view at RPC latency
+        # (reference: ray_syncer RESOURCE_VIEW — runtime/resource_sync.py)
+        self.on_resources_changed = lambda: None
 
     def stop(self):
         """Cancel deferred timers and fail parked lease waiters (owners
@@ -145,7 +149,9 @@ class TaskScheduler:
                 return False
             for k, v in demand.items():
                 self.available[k] = self.available.get(k, 0.0) - v
-            return True
+        if demand:
+            self.on_resources_changed()
+        return True
 
     def release(self, demand: dict):
         if not demand:
@@ -153,6 +159,7 @@ class TaskScheduler:
         with self._res_lock:
             for k, v in demand.items():
                 self.available[k] = self.available.get(k, 0.0) + v
+        self.on_resources_changed()
         # freed capacity may unblock a parked lease request or queued task
         self.kick()
 

@@ -308,11 +308,14 @@ class Worker:
         and died is covered by on_disconnect."""
         import time as _time
 
+        from ray_tpu.utils.config import get_config as _get_config
+
+        timeout = _get_config().lease_never_dialed_timeout_s
         self._lease_watch_gen += 1
         gen = self._lease_watch_gen
 
         def watch():
-            _time.sleep(10.0)
+            _time.sleep(timeout)
             with self._push_conn_lock:
                 active = len(self.lease_conns)
             # the gen check keeps a STALE watch (armed for a previous

@@ -182,11 +182,7 @@ class LLMEngine:
                 cfg, params, toks[:, None], cache, start=start,
                 logits_mode="last",
             )
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-            sampled = jax.random.categorical(
-                sub, scaled, axis=-1).astype(jnp.int32)
-            nxt = jnp.where(temps > 0.0, sampled, greedy)
+            nxt = decoding.select_tokens(logits, temps, sub)
             lens = jnp.where(active, lens + 1, lens)
             return (cache, nxt, lens, key), nxt
 
@@ -232,11 +228,7 @@ class LLMEngine:
         )
         k = cache.k.at[:, slots].set(rows.k.astype(cache.k.dtype))
         v = cache.v.at[:, slots].set(rows.v.astype(cache.v.dtype))
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jax.random.categorical(key, scaled,
-                                         axis=-1).astype(jnp.int32)
-        first = jnp.where(temps > 0.0, sampled, greedy)
+        first = decoding.select_tokens(logits, temps, key)
         return KVCache(k=k, v=v, lengths=cache.lengths), first
 
     def warmup(self, prompt_len: int):
@@ -360,6 +352,12 @@ class LLMEngine:
                 req.out.put(None)
                 continue
             if not self._reserve_slot_resources(req, slot):
+                if req.error is not None:
+                    # permanently infeasible (e.g. a reservation larger
+                    # than the whole page pool): reject — requeueing
+                    # would hang it and head-of-line-block the queue
+                    req.out.put(None)
+                    continue
                 self._waiting.put(req)   # backpressure: retry later
                 self._admission_blocked = True
                 break
@@ -495,19 +493,28 @@ class LLMEngine:
             self._dev_dirty = False
         return self._dev_inputs
 
+    def _decode_call(self, chunk: int, last_tok, dev):
+        """Hook: run the compiled decode program for one chunk and
+        return (token_matrix, advanced_lens) — the ONLY piece the paged
+        engine overrides; the pipeline tail below stays shared."""
+        decode = (self._decode_fn_drain if chunk == self._drain_chunk
+                  and self._decode_fn_drain is not self._decode_fn
+                  else self._decode_fn)
+        self._cache, toks, lens = decode(
+            self.params, self._cache, last_tok,
+            dev["lens"], dev["active"], dev["temps"], self._next_key(),
+        )
+        return toks, lens
+
     def _dispatch_decode(self, last_tok, active_idx):
         """Dispatch one decode chunk (no host sync). ``last_tok`` may be
         a DEVICE array from the previous chunk's output — the data
         dependency then stays on-device, so consecutive chunks chain
         without a host round trip between them."""
         drain = self._use_drain_chunk()
-        decode = self._decode_fn_drain if drain else self._decode_fn
         chunk = self._drain_chunk if drain else self.decode_chunk
         dev = self._device_inputs(active_idx)
-        self._cache, toks, lens = decode(
-            self.params, self._cache, last_tok,
-            dev["lens"], dev["active"], dev["temps"], self._next_key(),
-        )
+        toks, lens = self._decode_call(chunk, last_tok, dev)
         dev["lens"] = lens   # stays on device for the chained chunk
         # start the token matrix's device->host copy NOW: it overlaps
         # the next chunk's compute instead of adding a serial RTT to

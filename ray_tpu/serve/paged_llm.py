@@ -33,7 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.models import llama
-from ray_tpu.models.decoding import _cached_attention
+from ray_tpu.models.decoding import (_cached_attention,
+                                     select_tokens)
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.paged_attention import PageAllocator
 from ray_tpu.ops.rope import apply_rope, rope_sin_cos
@@ -156,11 +157,7 @@ class PagedLLMEngine(LLMEngine):
             head = llama.lm_head_weights(cfg, params)
             logits = jnp.einsum("bd,dv->bv", x, head,
                                 preferred_element_type=jnp.float32)
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-            sampled = jax.random.categorical(
-                sub, scaled, axis=-1).astype(jnp.int32)
-            nxt = jnp.where(temps > 0.0, sampled, greedy)
+            nxt = select_tokens(logits, temps, sub)
             lens = jnp.where(active, lens + 1, lens)
             return (k_pages, v_pages, nxt, lens, key), nxt
 
@@ -218,11 +215,7 @@ class PagedLLMEngine(LLMEngine):
         head = llama.lm_head_weights(cfg, params)
         logits = jnp.einsum("bd,dv->bv", x, head,
                             preferred_element_type=jnp.float32)
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jax.random.categorical(key, scaled,
-                                         axis=-1).astype(jnp.int32)
-        first = jnp.where(temps > 0.0, sampled, greedy)
+        first = select_tokens(logits, temps, key)
         return k_pages, v_pages, first
 
     # -- engine integration ------------------------------------------------
@@ -239,12 +232,9 @@ class PagedLLMEngine(LLMEngine):
             pb *= 2
         return min(pb, self.max_pages_per_seq)
 
-    def _dispatch_decode(self, last_tok, active_idx):
-        drain = self._use_drain_chunk()
-        chunk = self._drain_chunk if drain else self.decode_chunk
+    def _decode_call(self, chunk: int, last_tok, dev):
         pb = self._pages_bucket()
         fn = self._decode_paged(chunk, pb)
-        dev = self._device_inputs(active_idx)
         key = ("table", pb)
         if key not in dev:
             # sliced page table uploads only on admission/retirement
@@ -259,13 +249,7 @@ class PagedLLMEngine(LLMEngine):
             last_tok, dev["lens"], dev["active"], dev["temps"],
             self._next_key(),
         )
-        dev["lens"] = lens
-        try:
-            toks.copy_to_host_async()   # overlap D2H with next chunk
-        except Exception:  # noqa: BLE001 - backend without async copy
-            pass
-        self._lengths[active_idx] += chunk
-        return toks, active_idx, chunk
+        return toks, lens
 
     def _reserve_slot_resources(self, req, slot: int) -> bool:
         """Reserve-on-admit: pages for prompt + token budget + one page
@@ -275,6 +259,15 @@ class PagedLLMEngine(LLMEngine):
         budget = min(plen + req.max_new_tokens, self.max_len)
         pages = min(-(-budget // self.page_size) + 1,
                     self.max_pages_per_seq)
+        if pages > self.num_pages:
+            # can NEVER fit, even with the pool empty: reject now (the
+            # base _admit turns req.error into a terminated stream)
+            req.error = MemoryError(
+                f"request needs {pages} KV pages "
+                f"(prompt {plen} + budget {req.max_new_tokens}) but the "
+                f"pool holds only {self.num_pages}; raise num_pages or "
+                f"lower max_new_tokens")
+            return False
         if len(self._alloc.free) < pages:
             return False
         page_ids = self._alloc.alloc(slot, pages)
@@ -345,8 +338,16 @@ class PagedLLMEngine(LLMEngine):
             np.asarray(firsts)
             n *= 2
         active = jnp.zeros((self.max_batch,), bool)
+        # every pages-bucket a run can touch: powers of two PLUS the
+        # non-power-of-two cap (_pages_bucket clamps to it — e.g.
+        # max_pages_per_seq=6 serves buckets {1,2,4,6})
+        buckets = []
         pb = 1
-        while pb <= self.max_pages_per_seq:
+        while pb < self.max_pages_per_seq:
+            buckets.append(pb)
+            pb *= 2
+        buckets.append(self.max_pages_per_seq)
+        for pb in buckets:
             for chunk in {self.decode_chunk, self._drain_chunk}:
                 fn = self._decode_paged(chunk, pb)
                 self._k_pages, self._v_pages, toks, _ = fn(
@@ -357,7 +358,6 @@ class PagedLLMEngine(LLMEngine):
                     jnp.zeros((self.max_batch,), jnp.float32),
                     self._next_key())
                 np.asarray(toks)
-            pb *= 2
         self._lengths[:] = 0
         self._last_tok[:] = 0
 

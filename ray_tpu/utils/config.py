@@ -100,6 +100,16 @@ class Config:
     # (covers the borrower-incref-in-flight window).
     ref_release_grace_s: float = 0.5
 
+    # --- resource sync (reference: ray_syncer.h:86 + the raylet
+    # heartbeat period, ray_config_def.h raylet_report_resources_period) ---
+    # Liveness heartbeat period; the VERSIONED resource syncer (event-
+    # driven, below) carries the scheduling view, so this only bounds
+    # failure detection.
+    raylet_heartbeat_interval_s: float = 0.5
+    # Debounce for event-driven resource pushes: a dispatch burst
+    # becomes one push; scheduling-view staleness ~ RPC latency + this.
+    resource_sync_push_delay_s: float = 0.01
+
     # --- submission pipeline ---
     # Max unacked actor tasks per actor (outbox + frames in flight).
     # Deep enough that the submitter never stalls waiting for enqueue
@@ -111,6 +121,12 @@ class Config:
     num_workers: int = 0  # 0 = num_cpus
     worker_register_timeout_s: float = 30.0
     worker_lease_timeout_s: float = 30.0
+    # A granted lease whose owner never dials the worker's push port is
+    # handed back after this long (runtime/worker_main.py watchdog).
+    lease_never_dialed_timeout_s: float = 10.0
+    # Server-side parking window for a lease request before the owner is
+    # told to retry (runtime/lease.py; reference: worker lease backoff).
+    lease_block_s: float = 5.0
 
     # --- fault tolerance ---
     task_max_retries: int = 3

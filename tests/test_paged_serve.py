@@ -116,6 +116,23 @@ def test_pool_exhaustion_applies_backpressure(tiny):
     assert all(len(o) == 24 for o in outs)
 
 
+def test_reservation_larger_than_pool_rejected(tiny):
+    """A request whose page reservation exceeds the whole pool must be
+    REJECTED (requeueing it forever would hang it and head-of-line
+    block the queue behind it)."""
+    cfg, params = tiny
+    eng = PagedLLMEngine(cfg=cfg, params=params, max_batch=2,
+                         max_len=256, page_size=32, num_pages=2)
+    eng.start()
+    big = eng.submit(np.ones(100, np.int32), max_new_tokens=64)
+    small = eng.submit(np.ones(8, np.int32), max_new_tokens=8)
+    with pytest.raises(MemoryError):
+        list(big.tokens())
+    # the queue behind the infeasible request still drains
+    assert len(list(small.tokens())) == 8
+    eng.stop()
+
+
 def test_prompt_too_long_rejected(tiny):
     cfg, params = tiny
     eng = PagedLLMEngine(cfg=cfg, params=params, max_batch=2,

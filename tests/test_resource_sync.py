@@ -1,0 +1,145 @@
+"""Versioned resource syncer (runtime/resource_sync.py).
+
+Reference analog: ``src/ray/common/ray_syncer/ray_syncer.h:86`` —
+versioned RESOURCE_VIEW sync at RPC latency. Round-3 behavior (whole-
+snapshot heartbeats) left the scheduling view up to a heartbeat period
+stale; these tests pin the new contract by running raylets with a
+pathologically LONG heartbeat so only the event-driven push can explain
+a fresh view.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.runtime.rpc import RpcClient
+
+
+@pytest.fixture
+def slow_heartbeat_cluster():
+    from ray_tpu.utils.config import reset_config
+
+    ray_tpu.shutdown()
+    # external raylets inherit the env: 30s heartbeats mean any view
+    # freshness below comes from the versioned syncer, not the beat.
+    # reset_config() on BOTH sides: the flag registry caches env reads,
+    # and a 30s heartbeat leaking into later tests' in-process raylets
+    # breaks their failure-detection timing
+    os.environ["RAY_TPU_RAYLET_HEARTBEAT_INTERVAL_S"] = "30"
+    reset_config()
+    c = Cluster(heartbeat_timeout_s=120.0)
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=1, resources={"widget": 1}, external=True)
+    c.wait_for_nodes(2)
+    yield c
+    os.environ.pop("RAY_TPU_RAYLET_HEARTBEAT_INTERVAL_S", None)
+    reset_config()
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _widget_available(gcs_address) -> float:
+    client = RpcClient(tuple(gcs_address))
+    try:
+        return client.call("cluster_resources")["available"].get(
+            "widget", 0.0)
+    finally:
+        client.close()
+
+
+def test_view_tracks_mutations_at_rpc_latency(slow_heartbeat_cluster):
+    """Acquire and release of a remote node's resource must appear in
+    the GCS view within ~the push debounce, not the heartbeat period."""
+    c = slow_heartbeat_cluster
+    ray_tpu.init(address=c.gcs_address)
+
+    @ray_tpu.remote(resources={"widget": 1}, num_cpus=0)
+    def hold(t):
+        time.sleep(t)
+        return "done"
+
+    assert _widget_available(c.gcs_address) == 1.0
+    ref = hold.remote(1.0)
+    # acquisition visible fast
+    deadline = time.monotonic() + 2.0
+    acquired_at = None
+    while time.monotonic() < deadline:
+        if _widget_available(c.gcs_address) == 0.0:
+            acquired_at = time.monotonic()
+            break
+        time.sleep(0.02)
+    assert acquired_at is not None, \
+        "widget acquisition never reached the GCS view"
+    # release visible fast after the task ends (well under the 30s beat)
+    assert ray_tpu.get([ref], timeout=60)[0] == "done"
+    deadline = time.monotonic() + 2.0
+    released = False
+    while time.monotonic() < deadline:
+        if _widget_available(c.gcs_address) == 1.0:
+            released = True
+            break
+        time.sleep(0.02)
+    assert released, "widget release never reached the GCS view " \
+                     "(event-driven push missing; heartbeat is 30s)"
+
+
+def test_task_schedules_promptly_after_remote_release(
+        slow_heartbeat_cluster):
+    """VERDICT done-criterion: a placement decision made right after a
+    remote resource frees must succeed promptly — the old snapshot
+    heartbeat would leave the view stale for the full period."""
+    c = slow_heartbeat_cluster
+    ray_tpu.init(address=c.gcs_address)
+
+    @ray_tpu.remote(resources={"widget": 1}, num_cpus=0)
+    def use_widget():
+        return os.getpid()
+
+    @ray_tpu.remote(resources={"widget": 1}, num_cpus=0)
+    def hold(t):
+        time.sleep(t)
+        return "held"
+
+    ref = hold.remote(0.8)
+    time.sleep(0.2)   # the widget is now visibly busy
+    assert ray_tpu.get([ref], timeout=60)[0] == "held"
+    # submit AFTER release: placement consults the GCS view; with a 30s
+    # heartbeat only the syncer can have marked the widget free
+    t0 = time.monotonic()
+    out = ray_tpu.get([use_widget.remote()], timeout=60)[0]
+    elapsed = time.monotonic() - t0
+    assert isinstance(out, int)
+    assert elapsed < 5.0, f"scheduling stalled {elapsed:.1f}s on a " \
+                          f"stale resource view"
+
+
+def test_heartbeat_payload_is_version_only():
+    """The liveness beat must not carry the resource dict (payload
+    O(1)); the versioned push channel owns the view."""
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    try:
+        node = next(iter(c.nodes.values())).raylet
+        assert node.resource_syncer is not None
+        v0 = node.resource_syncer.version
+        ray_tpu.init(address=c.gcs_address)
+
+        @ray_tpu.remote(num_cpus=1)
+        def f():
+            return 1
+
+        assert ray_tpu.get([f.remote()], timeout=30)[0] == 1
+        # dispatch + completion bumped the version (event stream alive)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if node.resource_syncer.version > v0:
+                break
+            time.sleep(0.02)
+        assert node.resource_syncer.version > v0
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
