@@ -8,6 +8,16 @@ cd "$(dirname "$0")/.."
 
 stage() { echo; echo "=== CI stage: $1 ==="; }
 
+# --nightly: ONLY the scaled scalability-envelope tier (minutes; the
+# reference runs its envelope nightly on real clusters —
+# release/benchmarks/README.md)
+if [ "${1:-}" = "--nightly" ]; then
+  stage "nightly scalability envelope (2k actors / 200k tasks / 5k args / 4 nodes)"
+  python -m pytest tests/test_envelope_nightly.py -m nightly -q -s
+  echo "nightly envelope: green"
+  exit 0
+fi
+
 stage "lint (syntax + bytecode compile of every source)"
 python -m compileall -q ray_tpu tests bench.py __graft_entry__.py
 
@@ -25,6 +35,11 @@ python -m pytest tests/ -x -q
 stage "multi-chip dryrun (virtual 8-device mesh: fsdp_tp/sp/ep/pp/hybrid)"
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+if [ "${SKIP_1B:-0}" != "1" ]; then
+  stage "flagship-size dryrun (1.0B params, fsdp over 8 virtual devices; minutes)"
+  python -c "import __graft_entry__ as g; g.dryrun_multichip_1b(8)"
+fi
 
 if [ "${SKIP_PERF_GATE:-0}" != "1" ]; then
   stage "perf gate (current tree's core bench vs last round, ±10% fence)"

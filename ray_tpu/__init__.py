@@ -22,6 +22,7 @@ from ray_tpu.api import (
 )
 from ray_tpu.runtime.object_ref import ObjectRef
 from ray_tpu.runtime.streaming import ObjectRefGenerator
+from ray_tpu.runtime_context import get_runtime_context
 from ray_tpu.runtime_env import RuntimeEnv
 from ray_tpu.utils import exceptions
 
@@ -38,6 +39,7 @@ __all__ = [
     "kill",
     "cancel",
     "get_actor",
+    "get_runtime_context",
     "cluster_resources",
     "available_resources",
     "timeline",

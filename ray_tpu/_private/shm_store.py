@@ -159,6 +159,13 @@ class ShmObjectStore:
             if capacity < (1 << 12):
                 raise ValueError(
                     f"store capacity must be >= 4 KiB, got {capacity}")
+            if table_cap == 0:
+                # scale the object table with capacity: the C default
+                # (64k entries) chokes small-object floods — a 256 MiB
+                # store full of task returns needs hundreds of
+                # thousands of entries (~96 B each; the table costs
+                # <10% of the arena at this ratio)
+                table_cap = min(max(1 << 16, capacity // 1024), 1 << 22)
             self._h = lib.store_create(name.encode(), capacity, table_cap)
         else:
             self._h = lib.store_attach(name.encode())

@@ -123,6 +123,7 @@ class ClusterRuntime:
             self._raylet,
             legacy_submit=self._legacy_submit,
             on_task_failed=self._fail_task_returns,
+            on_direct_results=self._accept_direct_results,
         )
         # Worker-log echo (reference: log_monitor -> GCS pubsub ->
         # driver stdout). Only top-level drivers subscribe — nested
@@ -152,6 +153,9 @@ class ClusterRuntime:
         self._actor_window = _cfg.actor_submit_window
         # batched put-pin reports (see put/_put_report_loop)
         self._put_report_buf: list[tuple[str, int]] = []
+        # direct results that failed placement on a full store, parked
+        # for retry by the flusher (never silently dropped)
+        self._direct_retry: list[tuple[str, bytes]] = []
         self._put_report_cv = threading.Condition()
         threading.Thread(target=self._put_report_loop, daemon=True,
                          name="put-report-flusher").start()
@@ -188,12 +192,17 @@ class ClusterRuntime:
     # ------------------------------------------------------------------
 
     def _ref_flush_loop(self):
-        last_beat = 0.0
+        last_beat = time.monotonic()
         while not self._closed:
-            time.sleep(self._ref_interval)
+            # event-driven: block until ref activity or the heartbeat is
+            # due (an empty update every ~2s keeps the client-liveness
+            # heartbeat alive — actor lifetimes hang off it)
+            remain = 2.0 - (time.monotonic() - last_beat)
+            if self._refs.wait_pending(max(remain, 0.05)):
+                time.sleep(self._ref_interval)   # coalesce into one RPC
+            if self._closed:
+                return
             now = time.monotonic()
-            # an empty update every ~2s keeps the client-liveness
-            # heartbeat alive (actor lifetimes hang off it)
             beat = now - last_beat >= 2.0
             if self._ref_flush_now(force_heartbeat=beat) or beat:
                 last_beat = now
@@ -241,20 +250,73 @@ class ClusterRuntime:
                 self._put_report_cv.notify()
         return ObjectRef(oid)
 
-    def _put_report_loop(self):
-        """Drain put reports into batched report_objects RPCs, releasing
-        each object's seal-hold once its pin is confirmed."""
-        while not self._closed:
-            with self._put_report_cv:
-                while not self._put_report_buf and not self._closed:
-                    self._put_report_cv.wait(timeout=0.5)
-                if self._closed:
-                    batch = []
-                else:
-                    time_to_linger = bool(self._put_report_buf)
+    def _accept_direct_results(self, results: dict):
+        """Small task returns that rode the push reply (reference: the
+        owner's in-process memory store for direct-call returns,
+        memory_store.h:43): land each in the LOCAL store and register
+        its pin through the batched put-report path. First write wins
+        against a racing duplicate execution's store copy."""
+        from ray_tpu._private.shm_store import (ObjectExistsError,
+                                                StoreFullError)
+
+        for oid_hex, payload in results.items():
             if self._closed:
                 return
-            if time_to_linger:
+            oid = bytes.fromhex(oid_hex)
+            placed = False
+            exists = False
+            for _ in range(20):
+                try:
+                    object_codec.put_raw(self.store, oid, payload,
+                                         hold=True)
+                    placed = True
+                    break
+                except ObjectExistsError:
+                    # a racing duplicate execution already landed this
+                    # result (first write won, its own report carries
+                    # the pin): neither report nor park — parking would
+                    # livelock the flusher on a permanent Exists
+                    exists = True
+                    break
+                except StoreFullError:
+                    try:
+                        self._raylet.call("request_space",
+                                          nbytes=len(payload))
+                    except Exception:  # noqa: BLE001
+                        pass
+                    time.sleep(0.02)
+            if placed:
+                with self._put_report_cv:
+                    self._put_report_buf.append((oid_hex, len(payload)))
+                    self._put_report_cv.notify()
+            elif not exists:
+                # NEVER silently drop the only copy of a result: park it
+                # for the put-report flusher to retry once space frees
+                # (blocking this lease pusher thread longer would stall
+                # its task pushes instead)
+                with self._put_report_cv:
+                    self._direct_retry.append((oid_hex, payload))
+                    self._put_report_cv.notify()
+
+    def _put_report_loop(self):
+        """Drain put reports into batched report_objects RPCs, releasing
+        each object's seal-hold once its pin is confirmed. Also retries
+        parked direct results that hit a full store."""
+        while not self._closed:
+            retry = None
+            with self._put_report_cv:
+                while (not self._put_report_buf and not self._direct_retry
+                       and not self._closed):
+                    self._put_report_cv.wait(timeout=0.5)
+                if self._direct_retry:
+                    retry, self._direct_retry = self._direct_retry, []
+            if self._closed:
+                return
+            if retry:
+                self._accept_direct_results(dict(retry))
+                if self._closed:
+                    return
+            if self._put_report_buf:
                 time.sleep(0.0005)   # coalesce a burst of puts
             with self._put_report_cv:
                 batch, self._put_report_buf = self._put_report_buf, []
@@ -284,7 +346,22 @@ class ClusterRuntime:
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = [o for o in oids
                    if not self.store.contains(bytes.fromhex(o))]
+        recover_tick = 0.0
         while pending:
+            # Local completions (direct small returns, same-host tasks)
+            # resolve with a cheap contains scan — only a WINDOW of the
+            # truly-unresolved set goes to the raylet per cycle.
+            # Shipping the full pending list (200k oids = multi-MB
+            # frames + full-set wave loops server-side) melted large
+            # gets; _read_local re-pulls per object anyway, so the
+            # window is a locality warmer, not a correctness gate.
+            # Re-filter BEFORE the deadline check: a final ensure_local
+            # that localized everything while eating the budget must
+            # exit success, not GetTimeoutError.
+            pending = [o for o in pending
+                       if not self.store.contains(bytes.fromhex(o))]
+            if not pending:
+                break
             step = 5.0
             if deadline is not None:
                 remain = deadline - time.monotonic()
@@ -292,12 +369,15 @@ class ClusterRuntime:
                     raise exc.GetTimeoutError(
                         f"get() timed out waiting for {len(pending)} objects")
                 step = min(step, remain)
+            window = pending[:4096]
             # RpcClient multiplexes by request id — no lock needed, and
             # holding one across the blocking poll would stall submits
-            pending = self._raylet.call("ensure_local", oids=pending,
-                                        timeout_s=min(step, 2.0))
-            if pending:
-                self._recover_lost(pending)
+            leftover = self._raylet.call("ensure_local", oids=window,
+                                         timeout_s=min(step, 2.0))
+            now = time.monotonic()
+            if leftover and now - recover_tick >= 2.0:
+                recover_tick = now
+                self._recover_lost(leftover)
         out = []
         epoch0 = self._refs.created_epoch() if self._ref_enabled else 0
         for oid_hex in oids:

@@ -45,6 +45,8 @@ class NodeInfo:
     # applied RESOURCE_VIEW version; -1 = never synced (ask the raylet
     # for a full push on its next heartbeat)
     resource_version: int = -1
+    # ready-queue depth from the versioned view (placement tiebreak)
+    load: int = 0
     # latest reporter sample from the node (cpu/mem/spill-disk)
     host_stats: dict = field(default_factory=dict)
     # per-node dashboard agent RPC address (reference: dashboard/agent.py
@@ -517,12 +519,13 @@ class GcsServer(RpcServer):
         return {"ok": True}
 
     def rpc_resource_update(self, conn, send_lock, *, node_id, version,
-                            available):
+                            available, load=0):
         """Versioned RESOURCE_VIEW push (reference: ray_syncer.cc:325
         BroadcastRaySyncMessage): applied only when newer than the
         stored version, so a slow push can never roll back a fresher
         view. This — not the heartbeat — is how the scheduling view
-        tracks node state, at RPC latency."""
+        tracks node state, at RPC latency. ``load`` = ready-queue depth
+        (placement prefers shallow queues when every node is busy)."""
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
@@ -530,6 +533,7 @@ class GcsServer(RpcServer):
             if version > node.resource_version:
                 node.resource_version = version
                 node.available = dict(available)
+                node.load = int(load)
         return {"ok": True}
 
     def rpc_heartbeat(self, conn, send_lock, *, node_id, available=None,
@@ -551,7 +555,11 @@ class GcsServer(RpcServer):
                 if resource_version is not None:
                     node.resource_version = resource_version
             elif resource_version is not None and \
-                    node.resource_version != resource_version:
+                    node.resource_version < resource_version:
+                # the raylet DELIVERED a version we never applied (GCS
+                # restart / lost state): ask for a full resync. The
+                # one-sided check matters: applied-ahead-of-acked is the
+                # normal in-flight-ack case, not a loss.
                 need_resources = True
             if host_stats:
                 node.host_stats = dict(host_stats)
@@ -620,7 +628,7 @@ class GcsServer(RpcServer):
                 locs.discard(node_id)
                 if not locs:
                     del self._object_dir[oid]
-                    self._tombstone(oid)
+                    self._tombstone(oid, f"node_dead:{node_id[:8]}")
             doomed_actors = [a for a in self._actors.values()
                             if a.node_id == node_id
                             and a.state in ("ALIVE", "PENDING", "RESTARTING")]
@@ -844,18 +852,23 @@ class GcsServer(RpcServer):
                 exclude or set(), demand,
                 spread_threshold=0.0, top_k=1)
         best, best_score = None, None
-        feasible_busy = None
+        feasible_busy, busy_load = None, None
         for n in self._nodes.values():
             if not n.alive or (exclude and n.node_id in exclude):
                 continue
             if not _fits(demand, n.resources):
                 continue
             if _fits(demand, n.available):
-                score = _critical_utilization(demand, n)
+                # queue depth folds into the score: a node whose
+                # `available` looks healthy because per-task
+                # acquire/release averages out may still hold a deep
+                # ready queue — placement must prefer shallow queues
+                score = (_critical_utilization(demand, n)
+                         + min(n.load, 1000) * 0.001)
                 if best_score is None or score < best_score:
                     best, best_score = n.node_id, score
-            elif feasible_busy is None:
-                feasible_busy = n.node_id
+            elif busy_load is None or n.load < busy_load:
+                feasible_busy, busy_load = n.node_id, n.load
         return best if best is not None else feasible_busy
 
     def rpc_pick_node(self, conn, send_lock, *, demand, exclude=None,
@@ -974,10 +987,11 @@ class GcsServer(RpcServer):
             return {oid: sorted(self._object_dir.get(oid, ()))
                     for oid in oids}
 
-    def _tombstone(self, oid: str):
+    def _tombstone(self, oid: str, reason: str = "?"):
         """Record a lost object, dropping the oldest past the cap (caller
-        holds the lock)."""
-        self._lost_objects[oid] = None
+        holds the lock). The reason is diagnostic: which path removed
+        the LAST copy matters when debugging scale runs."""
+        self._lost_objects[oid] = reason
         while len(self._lost_objects) > self._max_lost_objects:
             self._lost_objects.pop(next(iter(self._lost_objects)))
 
@@ -986,6 +1000,21 @@ class GcsServer(RpcServer):
         with its node (lineage-reconstruction trigger)."""
         with self._lock:
             return [o for o in oids if o in self._lost_objects]
+
+    def rpc_debug_counts(self, conn, send_lock):
+        """Diagnostic sizes of the hot tables (scale-run hunts)."""
+        with self._lock:
+            return {"object_dir": len(self._object_dir),
+                    "ref_holders": len(self._ref_holders),
+                    "ref_released": len(self._ref_released),
+                    "pending_release": sum(
+                        len(v) for v in self._pending_release.values()),
+                    "lost": len(self._lost_objects)}
+
+    def rpc_get_lost_reasons(self, conn, send_lock, *, oids):
+        """Diagnostic: tombstone reasons for lost oids."""
+        with self._lock:
+            return {o: self._lost_objects.get(o) for o in oids}
 
     def rpc_remove_object_location(self, conn, send_lock, *, oid, node_id):
         with self._lock:
@@ -997,7 +1026,7 @@ class GcsServer(RpcServer):
                     # node died, or explicit free): tombstone so owners can
                     # reconstruct from lineage
                     del self._object_dir[oid]
-                    self._tombstone(oid)
+                    self._tombstone(oid, f"removed_by:{node_id[:8]}")
         return {"ok": True}
 
     # ------------------------------------------------------------------

@@ -83,6 +83,7 @@ class LeaseManager:
     def __init__(self, raylet_client: RpcClient, *,
                  legacy_submit: Callable[[dict], None],
                  on_task_failed: Callable[[dict, BaseException], None],
+                 on_direct_results: Callable[[dict], None] | None = None,
                  max_leases_per_shape: int = 64,
                  lease_block_s: float | None = None):
         from ray_tpu.utils.config import get_config
@@ -90,6 +91,8 @@ class LeaseManager:
         self._raylet = raylet_client
         self._legacy_submit = legacy_submit
         self._on_task_failed = on_task_failed
+        # small task returns riding the push reply (owner-store path)
+        self._on_direct_results = on_direct_results
         self._max_per_shape = max_leases_per_shape
         self._lease_block_s = (lease_block_s if lease_block_s is not None
                                else get_config().lease_block_s)
@@ -270,7 +273,13 @@ class LeaseManager:
                     try:
                         if pending is None:
                             raise ConnectionLost("lease lost before send")
-                        pending.result(timeout=None)
+                        reply = pending.result(timeout=None)
+                        results = (reply or {}).get("results")
+                        if results and self._on_direct_results:
+                            # small returns came back IN the reply:
+                            # land them in the owner's store before the
+                            # tasks are considered complete
+                            self._on_direct_results(results)
                         # lineage marker: these objects EXISTED (the node
                         # may still die before the batched location flush
                         # — recovery then resubmits with no lease channel
@@ -388,17 +397,23 @@ class LeaseManager:
                     target = transient
                     continue
                 if resp.get("retry"):
-                    # parked past the server-side window; cap local spins
-                    # so a wedged node can't absorb the task forever
+                    # parked past the server-side window: KEEP WAITING —
+                    # a feasible-but-busy cluster eventually grants, and
+                    # falling back to the raylet-queue path here pushed
+                    # entire floods through the non-direct-return channel
+                    # (200k-task drains then crawled through cross-node
+                    # pulls of tiny results). The generous cap only
+                    # breaks true wedges; the task then takes the legacy
+                    # path's recovery machinery.
                     retries += 1
-                    if retries >= 3 and target is not home:
+                    if retries % 3 == 0 and target is not home:
                         # go home: the local raylet parks in ITS queue
                         if transient is not None:
                             transient.close()
                             transient = None
                         target = home
                         hops = 0
-                    if retries >= 6:
+                    if retries >= 240:
                         return None
                     continue
                 return None  # infeasible or unknown reply

@@ -109,7 +109,8 @@ def put_value(store, object_id: bytes, value, *, is_error: bool = False) -> int:
 
 def put_value_durable(store, object_id: bytes, value, *,
                       is_error: bool = False, request_space=None,
-                      timeout_s: float = 30.0, hold: bool = False) -> int:
+                      timeout_s: float = 30.0, hold: bool = False,
+                      preserialized=None, contained=None) -> int:
     """``put_value`` with memory-pressure backoff: when the store is full,
     ask the node manager to make room (synchronous spill of pinned-idle
     objects — ``request_space`` callable takes the needed byte count) and
@@ -125,7 +126,12 @@ def put_value_durable(store, object_id: bytes, value, *,
 
     from ray_tpu._private.shm_store import ObjectExistsError, StoreFullError
 
-    obj, caught = _serialize_capturing(value)
+    if preserialized is not None:
+        # a caller (the direct-return size probe) already serialized —
+        # never pickle a large value twice
+        obj, caught = preserialized, (contained or [])
+    else:
+        obj, caught = _serialize_capturing(value)
     size = encoded_size(obj)
     deadline = _time.monotonic() + timeout_s
     delay = 0.02
@@ -176,6 +182,26 @@ def raw_bytes(store, object_id: bytes, timeout_ms: int = -1) -> bytes:
     finally:
         del view
         store.release(object_id)
+
+
+def encode_bytes(value, *, is_error: bool = False, limit: int | None = None):
+    """Serialize a value into the store's binary layout WITHOUT touching
+    a store (the direct small-return path: the bytes ride the task
+    reply to the owner, who ``put_raw``s them into its local store —
+    reference analog: small returns go to the owner's in-process
+    memory store in the task reply, ``memory_store.h:43``).
+
+    Returns ``(payload | None, serialized_obj, contained_oids)`` —
+    payload is None when the encoded size exceeds ``limit`` (the size
+    check runs BEFORE any byte copy, and the serialized form is handed
+    back so the store path never re-pickles a large value)."""
+    obj, caught = _serialize_capturing(value)
+    size = encoded_size(obj)
+    if limit is not None and size > limit:
+        return None, obj, list(caught)
+    buf = bytearray(size)
+    encode_into(memoryview(buf), obj, is_error=is_error)
+    return bytes(buf), obj, list(caught)
 
 
 def put_raw(store, object_id: bytes, payload: bytes, *,

@@ -140,6 +140,13 @@ class LocalObjectManager:
         # restore; spilled secondaries stay evictable after restore
         self._spilled: dict[str, tuple[str, bool]] = {}
         self._spill_lock = threading.Lock()
+        # oids with a spill IN PROGRESS (guarded by _spill_lock): the
+        # spill loop and request_space callers race otherwise — the
+        # second spiller captures was_primary=False (the first already
+        # unpinned) and OVERWRITES the entry, so the restore came back
+        # unpinned and the object's only copy became LRU-evictable
+        # (lost ~1 in 200k task returns under memory pressure)
+        self._spilling: set[str] = set()
         self.spill_stats = {"num_spilled": 0, "bytes_spilled": 0,
                             "num_restored": 0, "bytes_restored": 0}
         # Primary-copy pins: every object CREATED on this node is pinned
@@ -150,6 +157,11 @@ class LocalObjectManager:
         # unpinned and evictable).
         self._pinned: set[str] = set()
         self._pin_lock = threading.Lock()
+        # debug: trace pin/deregister history for scale-run loss hunts
+        # (RAY_TPU_DEBUG_OBJECT_TRACE=/path enables; bounded cost)
+        self._trace_path = os.environ.get("RAY_TPU_DEBUG_OBJECT_TRACE")
+        self._ever_pinned: set[str] | None = (set() if self._trace_path
+                                              else None)
         # every object registered with the GCS as located here (primary or
         # pulled secondary); reconciled against the store so LRU-evicted
         # secondaries don't leave stale locations in the directory forever
@@ -183,6 +195,14 @@ class LocalObjectManager:
 
     def cleanup_disk(self):
         self._spill_fs.cleanup()
+
+    def _trace(self, msg: str):
+        if self._trace_path:
+            try:
+                with open(self._trace_path, "a") as f:
+                    f.write(f"{self._node.node_id[:8]} {msg}\n")
+            except OSError:
+                pass
 
     # ------------------------------------------------------------------
     # local tracking + pins + the GCS directory view
@@ -219,6 +239,11 @@ class LocalObjectManager:
             gone.append(oid_hex)
         if not gone:
             return
+        if self._ever_pinned is not None:
+            for oid_hex in gone:
+                if oid_hex in self._ever_pinned:
+                    self._trace(f"RECONCILE-DROP-PINNED {oid_hex} "
+                                f"pinned_now={self.is_pinned(oid_hex)}")
         with self._local_objects_lock:
             self._local_objects.difference_update(gone)
         with self._pin_lock:
@@ -239,12 +264,30 @@ class LocalObjectManager:
                 return
             if self.store.pin(bytes.fromhex(oid_hex)):
                 self._pinned.add(oid_hex)
+                if self._ever_pinned is not None:
+                    self._ever_pinned.add(oid_hex)
+            elif self._ever_pinned is not None:
+                self._trace(f"PIN-FAILED {oid_hex}")
 
     def unpin_object(self, oid_hex: str):
         with self._pin_lock:
             if oid_hex in self._pinned:
                 self._pinned.discard(oid_hex)
                 self.store.unpin(bytes.fromhex(oid_hex))
+
+    def _capture_and_unpin(self, oid_hex: str) -> bool:
+        """Atomically read-and-clear the pin (spill_one's primary-copy
+        capture). One locked section: a pin landing between a separate
+        capture and unpin would be silently erased — the spilled entry
+        would record was_primary=False, its restore would come back
+        UNPINNED, and LRU eviction could then destroy the object's only
+        copy (seen once per ~200k task returns under spill pressure)."""
+        with self._pin_lock:
+            was = oid_hex in self._pinned
+            if was:
+                self._pinned.discard(oid_hex)
+                self.store.unpin(bytes.fromhex(oid_hex))
+            return was
 
     def is_pinned(self, oid_hex: str) -> bool:
         with self._pin_lock:
@@ -264,11 +307,21 @@ class LocalObjectManager:
         ownership-based object directory is similarly not on the task
         completion critical path)."""
         self.pin_object(oid)
-        if not self.is_pinned(oid) and not self.store.contains(
-                bytes.fromhex(oid)):
-            # should be unreachable under the hold protocol; never
-            # advertise a location that cannot serve the object
-            return False
+        if not self.is_pinned(oid):
+            # the object may have been spilled BEFORE this pin landed
+            # (memory pressure racing the batched report): the spill
+            # entry then says was_primary=False — promote it, or its
+            # restore would come back unpinned and evictable as the
+            # object's only copy
+            with self._spill_lock:
+                entry = self._spilled.get(oid)
+                if entry is not None and not entry[1]:
+                    self._spilled[oid] = (entry[0], True)
+            if entry is None and not self.store.contains(
+                    bytes.fromhex(oid)):
+                # should be unreachable under the hold protocol; never
+                # advertise a location that cannot serve the object
+                return False
         self.track_local(oid)
         self.queue_location(oid, size)
         return True
@@ -320,6 +373,8 @@ class LocalObjectManager:
         freed = 0
         pending: list[tuple[str, bool, bool]] = []  # (oid, pinned, spilled)
         for oid_hex in oids:
+            if node._stopping:
+                return freed   # store is about to unmap: never touch it
             was_pinned = self.is_pinned(oid_hex)
             self.unpin_object(oid_hex)
             with self._spill_lock:
@@ -336,6 +391,10 @@ class LocalObjectManager:
         while pending:
             still = []
             for oid_hex, was_pinned, had_spill in pending:
+                if node._stopping:
+                    return freed   # mid-batch shutdown: bail before the
+                    # munmap (a large refcount release riding a heartbeat
+                    # was segfaulting here at teardown)
                 rc = self.store.try_delete(bytes.fromhex(oid_hex))
                 if rc == TS_ERR and time.monotonic() < deadline:
                     still.append((oid_hex, was_pinned, had_spill))
@@ -413,8 +472,21 @@ class LocalObjectManager:
                 st["bytes_allocated"] - int(self._spill_low * cap))
 
     def spill_one(self, oid: bytes) -> bool:
-        """Copy one sealed object out to a file, then drop it from shm."""
+        """Copy one sealed object out to a file, then drop it from shm.
+        Exclusive per oid: concurrent spillers (the spill loop racing a
+        request_space caller) corrupt the was_primary flag."""
         oid_hex = oid.hex()
+        with self._spill_lock:
+            if oid_hex in self._spilling or oid_hex in self._spilled:
+                return False
+            self._spilling.add(oid_hex)
+        try:
+            return self._spill_one_locked(oid, oid_hex)
+        finally:
+            with self._spill_lock:
+                self._spilling.discard(oid_hex)
+
+    def _spill_one_locked(self, oid: bytes, oid_hex: str) -> bool:
         try:
             payload = object_codec.raw_bytes(self.store, oid, timeout_ms=0)
         except Exception:  # noqa: BLE001 - vanished (freed/evicted) — fine
@@ -427,10 +499,9 @@ class LocalObjectManager:
             return False
         from ray_tpu._private.shm_store import TS_ERR, TS_OK
 
-        was_primary = self.is_pinned(oid_hex)
+        was_primary = self._capture_and_unpin(oid_hex)
         with self._spill_lock:
             self._spilled[oid_hex] = (path, was_primary)
-        self.unpin_object(oid_hex)
         rc = self.store.try_delete(oid)
         if rc == TS_ERR:
             # a reader still holds a ref: keep the shm copy authoritative —
@@ -591,17 +662,45 @@ class LocalObjectManager:
         """Make objects locally readable, pulling from peers as needed.
         Returns the list of oids that could NOT be made local in time.
         Waits are event-driven for locally-produced objects (the common
-        case): report_object notifies ``_local_cv``."""
+        case): report_object notifies ``_local_cv``.
+
+        Locations are resolved in BATCHED directory queries per wave:
+        per-oid GCS lookups inside the pull path cost one RPC per
+        not-yet-produced object per poll — at a 200k-object get that
+        melted the control plane."""
+        node = self._node
         deadline = time.monotonic() + timeout_s
         missing = [o for o in oids
                    if not self.store.contains(bytes.fromhex(o))]
         while missing and time.monotonic() < deadline:
+            locs: dict = {}
+            for i in range(0, len(missing), 5000):
+                part = missing[i:i + 5000]
+                try:
+                    with node._gcs_lock:
+                        locs.update(node._gcs.call(
+                            "get_object_locations", oids=part))
+                except Exception:  # noqa: BLE001 - GCS busy: retry wave
+                    break
             still = []
             for oid_hex in missing:
                 oid = bytes.fromhex(oid_hex)
                 if self.store.contains(oid):
                     continue
-                if not self.pulls.pull(oid_hex):
+                holders = locs.get(oid_hex) or []
+                sources = []
+                local_hint = False
+                for nid in holders:
+                    if nid == node.node_id:
+                        local_hint = True   # spilled here: restore path
+                        continue
+                    addr = node._peer_address(nid)
+                    if addr is not None:
+                        sources.append((nid, addr))
+                if not sources and not local_hint:
+                    still.append(oid_hex)   # not produced anywhere yet
+                    continue
+                if not self.pulls.pull(oid_hex, known_sources=sources):
                     still.append(oid_hex)
             missing = still
             if missing:
@@ -609,7 +708,7 @@ class LocalObjectManager:
                 # re-check remote locations on a coarser cadence
                 with self._local_cv:
                     self._local_cv.wait(
-                        timeout=min(0.1, max(deadline - time.monotonic(),
+                        timeout=min(0.2, max(deadline - time.monotonic(),
                                              0.0)))
         return missing
 

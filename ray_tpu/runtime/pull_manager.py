@@ -113,9 +113,14 @@ class PullManager:
 
     # -- pulling -------------------------------------------------------
 
-    def pull(self, oid_hex: str, timeout_s: float = 30.0) -> bool:
+    def pull(self, oid_hex: str, timeout_s: float = 30.0,
+             known_sources: list | None = None) -> bool:
         """Make the object local (spill restore or peer transfer).
-        Concurrent callers for one oid share a single transfer."""
+        Concurrent callers for one oid share a single transfer.
+        ``known_sources``: (node_id, address) candidates the caller
+        already resolved (ensure_local batches the directory lookup —
+        a per-oid GCS query here melted the control plane at
+        200k-object gets)."""
         import binascii
 
         oid = binascii.unhexlify(oid_hex)
@@ -132,17 +137,20 @@ class PullManager:
             pull.event.wait(timeout=timeout_s)
             return pull.ok or self._store.contains(oid)
         try:
-            pull.ok = self._do_pull(oid_hex, oid)
+            pull.ok = self._do_pull(oid_hex, oid, known_sources)
             return pull.ok
         finally:
             with self._pulls_lock:
                 self._pulls.pop(oid_hex, None)
             pull.event.set()
 
-    def _do_pull(self, oid_hex: str, oid: bytes) -> bool:
+    def _do_pull(self, oid_hex: str, oid: bytes,
+                 known_sources: list | None = None) -> bool:
         if self._fetch_local(oid_hex):
             return True
-        addrs = [tuple(a) for _, a in self._peer_addresses(oid_hex)]
+        pairs = (known_sources if known_sources is not None
+                 else self._peer_addresses(oid_hex))
+        addrs = [tuple(a) for _, a in pairs]
         if not addrs:
             return False
         # probe candidates for meta; large objects stripe across EVERY

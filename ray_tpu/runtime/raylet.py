@@ -80,14 +80,19 @@ class Raylet(RpcServer):
         self._hb_interval = (heartbeat_interval_s
                              if heartbeat_interval_s is not None
                              else _cfg.raylet_heartbeat_interval_s)
+        self._spillback_queue_depth = _cfg.scheduler_spillback_queue_depth
         # versioned resource sync (reference: ray_syncer.h:86): local
         # resource mutations push to the GCS at RPC latency; heartbeats
-        # carry only the version
+        # carry only the version. The view carries queue depth too so
+        # placement can prefer shallow queues when everyone is busy.
         from ray_tpu.runtime.resource_sync import ResourceSyncer
         self.resource_syncer = ResourceSyncer(
             self, self._avail_snapshot,
+            load_fn=lambda: len(self.scheduler.ready),
             push_delay_s=_cfg.resource_sync_push_delay_s)
         self.scheduler.on_resources_changed = \
+            self.resource_syncer.mark_changed
+        self.scheduler.on_queue_changed = \
             self.resource_syncer.mark_changed
         self._mem_threshold = _cfg.memory_usage_threshold
         self._mem_refresh_s = max(_cfg.memory_monitor_refresh_ms, 50) / 1e3
@@ -467,8 +472,17 @@ class Raylet(RpcServer):
                 # window — a fixed cluster still fails fast enough.
                 self.scheduler.park_infeasible(task, demand)
                 return {"ok": True, "parked": "infeasible"}
-        elif spill_count < 2 and not _fits(demand, self._avail_snapshot()):
-            # busy here: one spillback attempt through the GCS view
+        elif spill_count < 2 and (
+                not _fits(demand, self._avail_snapshot())
+                or len(self.scheduler.ready)
+                > self._spillback_queue_depth):
+            # busy OR deeply queued here: one spillback attempt through
+            # the GCS view. The QUEUE-DEPTH clause matters at flood
+            # scale: per-task acquire/release keeps `available` looking
+            # healthy on average, so without it a 200k-task burst piles
+            # onto one node's queue while the rest of the cluster idles
+            # (reference: hybrid policy scores utilization, and deep
+            # local queues spill — cluster_task_manager.cc).
             with self._gcs_lock:
                 target = self._gcs.call("pick_node", demand=demand,
                                         exclude=[self.node_id],
@@ -969,7 +983,8 @@ class Raylet(RpcServer):
                     # O(1) (the version) unless the GCS asks for a resync
                     reply = self._gcs.call(
                         "heartbeat", node_id=self.node_id,
-                        resource_version=self.resource_syncer.version,
+                        resource_version=self.resource_syncer
+                        .pushed_version,
                         host_stats=stats or None,
                         freed_acks=acks)
                 if acks:

@@ -38,6 +38,13 @@ class RefCounter:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # flusher wakeup: set by every lock-taking mutator so the flush
+        # loops can BLOCK instead of polling (2,000 idle workers polling
+        # at 5 Hz thrashed the host scheduler in the envelope run).
+        # on_destroyed cannot signal (it runs in __del__, where taking
+        # the Event's internal lock could deadlock mid-GC) — waiters
+        # treat a non-empty dead deque as an immediate wakeup instead.
+        self._signal = threading.Event()
         self._counts: dict[str, int] = {}       # oid hex -> live instances
         self._dead: deque = deque()             # oid hex death notices
         self._dirty: set[str] = set()           # count changed since flush
@@ -66,6 +73,11 @@ class RefCounter:
             self._created_epoch += 1
             if c == 0:
                 self._dirty.add(oid_hex)
+                signal = True
+            else:
+                signal = False
+        if signal:
+            self._signal.set()
 
     def on_destroyed(self, oid_hex: str):
         # lock-free: __del__ may run mid-GC inside a locked section
@@ -137,10 +149,12 @@ class RefCounter:
             return
         with self._lock:
             self._pins.append((task_id, list(oids)))
+        self._signal.set()
 
     def release_task_pin(self, task_id: str):
         with self._lock:
             self._pin_releases.append(task_id)
+        self._signal.set()
 
     def add_contains(self, outer_hex: str, inner_hexes) -> None:
         inner = [h for h in inner_hexes if h != outer_hex]
@@ -148,6 +162,7 @@ class RefCounter:
             return
         with self._lock:
             self._contains.append((outer_hex, inner))
+        self._signal.set()
 
     # ------------------------------------------------------------------
     # flushing
@@ -185,6 +200,19 @@ class RefCounter:
         return {"add": add, "remove": remove, "transient": transient,
                 "pins": pins, "pin_releases": rel, "contains": contains}
 
+    def wait_pending(self, timeout: float) -> bool:
+        """Block until flush-worthy state likely exists, or ``timeout``.
+        Returns True when a flush should run now. Death notices can't
+        signal (see ``_signal``), so a non-empty dead deque counts as an
+        immediate wakeup — the subsequent ``take_flush`` drains it."""
+        if self._dead:
+            self._signal.clear()
+            return True
+        if self._signal.wait(timeout):
+            self._signal.clear()
+            return True
+        return bool(self._dead)
+
     def force_resync(self):
         """The GCS reaped this client (heartbeat gap) and dropped every
         hold it believed we had: re-register the full held set on the
@@ -194,6 +222,7 @@ class RefCounter:
             for oid_hex, c in self._counts.items():
                 if c > 0:
                     self._dirty.add(oid_hex)
+        self._signal.set()
 
     def restore_flush(self, payload: dict):
         """Re-queue a flush whose send failed so the deltas are not
@@ -213,6 +242,7 @@ class RefCounter:
             self._pins[:0] = payload.get("pins", ())
             self._pin_releases[:0] = payload.get("pin_releases", ())
             self._contains[:0] = payload.get("contains", ())
+        self._signal.set()
 
     # ------------------------------------------------------------------
     # local mode (in-process runtime: release immediately, no RPC)

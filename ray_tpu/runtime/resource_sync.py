@@ -31,9 +31,11 @@ import threading
 class ResourceSyncer:
     """Raylet-side half: version tracking + the debounced pusher."""
 
-    def __init__(self, node, snapshot_fn, *, push_delay_s: float = 0.01):
+    def __init__(self, node, snapshot_fn, *, load_fn=None,
+                 push_delay_s: float = 0.01):
         self._node = node
         self._snapshot = snapshot_fn        # () -> dict available
+        self._load = load_fn or (lambda: 0)  # () -> ready-queue depth
         self._push_delay = push_delay_s
         self._cv = threading.Condition()
         self._version = 0
@@ -58,6 +60,15 @@ class ResourceSyncer:
     def version(self) -> int:
         with self._cv:
             return self._version
+
+    @property
+    def pushed_version(self) -> int:
+        """The last version KNOWN DELIVERED — what heartbeats should
+        report. Reporting the live version instead makes every beat on
+        a busy node look like a lost push (the debounced pusher is
+        always slightly behind) and triggers spurious full resyncs."""
+        with self._cv:
+            return max(self._pushed_version, 0)
 
     def force_push(self):
         """GCS requested a resync (heartbeat replied need_resources)."""
@@ -86,7 +97,8 @@ class ResourceSyncer:
                     node._gcs.call("resource_update",
                                    node_id=node.node_id,
                                    version=version,
-                                   available=self._snapshot())
+                                   available=self._snapshot(),
+                                   load=self._load())
                 with self._cv:
                     self._pushed_version = max(self._pushed_version,
                                                version)

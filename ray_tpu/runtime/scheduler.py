@@ -54,6 +54,9 @@ class TaskScheduler:
         # versioned resource syncer pushes the new view at RPC latency
         # (reference: ray_syncer RESOURCE_VIEW — runtime/resource_sync.py)
         self.on_resources_changed = lambda: None
+        # queue-depth changes feed the same versioned view (placement
+        # prefers shallow queues)
+        self.on_queue_changed = lambda: None
 
     def stop(self):
         """Cancel deferred timers and fail parked lease waiters (owners
@@ -79,6 +82,7 @@ class TaskScheduler:
         with self.cv:
             self.ready.append(task)
             self.cv.notify()
+        self.on_queue_changed()
 
     def defer_enqueue(self, task: dict, delay: float):
         """Re-enqueue after a delay (OOM backoff). Timers are tracked so
@@ -115,6 +119,7 @@ class TaskScheduler:
                 if matches(t):
                     task = t
                     del self.ready[i]
+                    self.on_queue_changed()
                     return task
         return None
 
@@ -133,6 +138,8 @@ class TaskScheduler:
                 else:
                     keep.append(task)
             self.ready = keep
+        if doomed:
+            self.on_queue_changed()
         return doomed
 
     # ------------------------------------------------------------------
@@ -185,6 +192,10 @@ class TaskScheduler:
                         task = t
                         del self.ready[i]
                         break
+            if task is not None:
+                # dequeues must reach the synced view too, or the GCS
+                # `load` only ever rises and placement shuns this node
+                self.on_queue_changed()
             self._serve_lease_waiters()
             if task is None:
                 # only lease waiters, or no fitting task: block until the

@@ -79,11 +79,19 @@ class TaskPushServer(RpcServer):
         # recv loop
         self._tag_lease_conn(conn)
         self._worker.push_task_thread = threading.current_thread()
+        # small returns ride the reply to the OWNER's store (reference:
+        # in-process memory store for direct-call returns) — no shm
+        # write, no pin report, no cross-node pull for tiny results
+        sink: dict = {}
+        task["_direct_sink"] = sink
         try:
             self._run_one(task)
         finally:
             self._worker.push_task_thread = None
-        return {"ok": True, "task_id": task.get("task_id")}
+        reply = {"ok": True, "task_id": task.get("task_id")}
+        if sink:
+            reply["results"] = sink
+        return reply
 
     def rpc_push_tasks(self, conn, send_lock, *, tasks: list):
         """Batched push: one RPC carries several tasks, executed in
@@ -91,12 +99,17 @@ class TaskPushServer(RpcServer):
         framed round trip instead of N)."""
         self._tag_lease_conn(conn)
         self._worker.push_task_thread = threading.current_thread()
+        sink: dict = {}
         try:
             for task in tasks:
+                task["_direct_sink"] = sink
                 self._run_one(task)
         finally:
             self._worker.push_task_thread = None
-        return {"ok": True}
+        reply = {"ok": True}
+        if sink:
+            reply["results"] = sink
+        return reply
 
     def rpc_submit_actor_task(self, conn, send_lock, *, task: dict):
         """DIRECT actor-task submission (owner → actor process, no raylet
@@ -431,9 +444,14 @@ class Worker:
     def _ref_flush_loop(self):
         import time as _time
 
-        last_beat = 0.0
+        last_beat = _time.monotonic()
         while True:
-            _time.sleep(0.2)
+            # event-driven: block until ref activity (or the ~2s
+            # client-liveness heartbeat is due) instead of polling —
+            # thousands of idle workers polling thrash the host scheduler
+            remain = 2.0 - (_time.monotonic() - last_beat)
+            if self._refs.wait_pending(max(remain, 0.05)):
+                _time.sleep(0.1)    # coalesce a burst into one RPC
             now = _time.monotonic()
             beat = now - last_beat >= 2.0   # client-liveness heartbeat
             if self._ref_flush_now(force_heartbeat=beat) or beat:
@@ -474,10 +492,43 @@ class Worker:
                 raise ValueError(
                     f"task declared {len(return_oids)} returns, got "
                     f"{len(values)}")
+        sink = task.get("_direct_sink")
         for oid_hex, value in zip(return_oids, values):
+            if sink is not None and self._try_direct_return(
+                    sink, oid_hex, value):
+                continue
             self._put_and_report(oid_hex, value)
 
-    def _put_and_report(self, oid_hex: str, value, is_error: bool = False):
+    # returns at or under this encoded size ride the push reply to the
+    # owner instead of the local shm store (reference:
+    # max_direct_call_object_size — small objects live in the owner's
+    # memory store, memory_store.h:43)
+    def _try_direct_return(self, sink: dict, oid_hex: str, value,
+                           is_error: bool = False) -> bool:
+        from ray_tpu.utils.config import get_config
+
+        limit = get_config().max_direct_call_object_size
+        try:
+            payload, obj, caught = object_codec.encode_bytes(
+                value, is_error=is_error, limit=limit)
+        except Exception:  # noqa: BLE001 - unpicklable: store path errors
+            return False
+        if payload is None:
+            # too large for the reply: shm store path, reusing the
+            # serialized form (a 1 GiB return must not pickle twice)
+            self._put_and_report(oid_hex, value, is_error=is_error,
+                                 preserialized=obj, contained=caught)
+            return True
+        if caught:
+            # the return value contains ObjectRefs: the contains-edges
+            # anchor on the return oid (which will materialize at the
+            # owner's store)
+            self._refs.add_contains(oid_hex, caught)
+        sink[oid_hex] = payload
+        return True
+
+    def _put_and_report(self, oid_hex: str, value, is_error: bool = False,
+                        preserialized=None, contained=None):
         """Put with a held ref, then report so the raylet pins the primary
         copy. The seal-HOLD stays live until the (batched) report flush
         confirms the pin — never a window in which the sealed object is
@@ -488,7 +539,8 @@ class Worker:
         oid = bytes.fromhex(oid_hex)
         size = object_codec.put_value_durable(
             self.store, oid, value, is_error=is_error,
-            request_space=self._request_space, hold=True)
+            request_space=self._request_space, hold=True,
+            preserialized=preserialized, contained=contained)
         with self._report_cv:
             self._report_buf.append((oid_hex, size))
             self._report_cv.notify()
@@ -520,9 +572,13 @@ class Worker:
         self.ctrl.call("request_space", nbytes=nbytes)
 
     def _store_error(self, task: dict, error: BaseException):
+        sink = task.get("_direct_sink")
         for oid_hex in task["return_oids"]:
             oid = bytes.fromhex(oid_hex)
             if self.store.contains(oid):
+                continue
+            if sink is not None and self._try_direct_return(
+                    sink, oid_hex, error, is_error=True):
                 continue
             try:
                 self._put_and_report(oid_hex, error, is_error=True)

@@ -94,8 +94,10 @@ class Config:
     # A client (driver or worker runtime) missing heartbeats this long is
     # dead: its ref contributions are dropped and its non-detached actors
     # killed (reference: GcsActorManager owner-death handling,
-    # gcs_actor_manager.cc:632).
-    client_timeout_s: float = 10.0
+    # gcs_actor_manager.cc:632). Generous by design: a falsely-reaped
+    # LIVE client loses objects and actors — under a 200k-task burst the
+    # control plane can delay beat processing by tens of seconds.
+    client_timeout_s: float = 45.0
     # Grace before contains-edge releases propagate to inner objects
     # (covers the borrower-incref-in-flight window).
     ref_release_grace_s: float = 0.5
@@ -109,6 +111,10 @@ class Config:
     # Debounce for event-driven resource pushes: a dispatch burst
     # becomes one push; scheduling-view staleness ~ RPC latency + this.
     resource_sync_push_delay_s: float = 0.01
+    # Ready-queue depth beyond which a submitted task spills back
+    # through the GCS view even though `available` looks healthy
+    # (per-task acquire/release hides saturation from averages).
+    scheduler_spillback_queue_depth: int = 32
 
     # --- submission pipeline ---
     # Max unacked actor tasks per actor (outbox + frames in flight).

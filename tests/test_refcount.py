@@ -184,10 +184,30 @@ else:
 
 
 @pytest.mark.parametrize("mode", ["clean", "kill"])
-def test_driver_exit_reaps_non_detached_actors(cluster, mode, tmp_path):
+def test_driver_exit_reaps_non_detached_actors(mode, tmp_path):
     """Owner-scoped lifetime: a driver's actors die with it — clean
     disconnect reaps immediately, a SIGKILL'd driver via heartbeat
     timeout. lifetime="detached" opts out and survives both."""
+    from ray_tpu.utils.config import reset_config
+
+    ray_tpu.shutdown()
+    # short client timeout so the kill-mode reap lands within the test
+    # window (the production default is 45s — generous against falsely
+    # reaping a live driver under control-plane load)
+    os.environ["RAY_TPU_CLIENT_TIMEOUT_S"] = "6"
+    reset_config()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=4)
+    try:
+        _drive_exit_case(cluster, mode, tmp_path)
+    finally:
+        os.environ.pop("RAY_TPU_CLIENT_TIMEOUT_S", None)
+        reset_config()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def _drive_exit_case(cluster, mode, tmp_path):
     child = tmp_path / "child.py"
     child.write_text(_CHILD)
     env = dict(os.environ)
