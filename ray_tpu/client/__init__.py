@@ -16,11 +16,12 @@ as ids and stay server-owned (values move only on ``get``).
 from __future__ import annotations
 
 import threading
+import uuid
 
 import cloudpickle
 
 from ray_tpu.runtime.object_ref import ObjectRef
-from ray_tpu.runtime.rpc import RpcClient
+from ray_tpu.runtime.rpc import ConnectionLost, ReconnectingRpcClient
 from ray_tpu.runtime.task_spec import TaskSpec, TaskType
 from ray_tpu.utils import exceptions as exc
 from ray_tpu.utils.ids import ActorID, ObjectID
@@ -37,15 +38,70 @@ def parse_client_address(address: str) -> tuple[str, int] | None:
     return (host or "127.0.0.1", int(port))
 
 
+class ClientSessionExpired(ConnectionError):
+    """The server reaped this client's session (outage exceeded the
+    reconnect grace): its refs/actors are gone, resuming would serve
+    dangling handles — fail loudly (reference: ray client raises
+    ConnectionError when the reconnect grace period is exceeded)."""
+
+
+class _SessionRpcClient(ReconnectingRpcClient):
+    """Redialing client that re-attaches the session after a redial so
+    the server rebinds the new connection to the token (and cancels the
+    pending session reap)."""
+
+    def __init__(self, address, runtime: "ClientRuntime"):
+        self._runtime = runtime
+        self._session_lost = False
+        super().__init__(address)
+
+    def call(self, method, timeout=None, **kwargs):
+        if self._session_lost:
+            raise ClientSessionExpired(
+                "client session expired: the server reaped it after the "
+                "reconnect grace window; re-init() for a fresh session")
+        try:
+            return super().call(method, timeout=timeout, **kwargs)
+        except (ConnectionLost, OSError) as e:
+            if self._session_lost:   # the redial just discovered it
+                raise ClientSessionExpired(
+                    "client session expired during reconnect: the "
+                    "server reaped it after the grace window") from e
+            raise
+
+    def _redial(self, failed) -> bool:
+        if not super()._redial(failed):
+            return False
+        try:
+            # direct call on the NEW underlying client: going through
+            # self.call would recurse into redial on failure
+            reply = self._client.call("client_hello",
+                                      session_token=self._runtime._token)
+        except (OSError, ConnectionLost):
+            return False
+        if not reply.get("resumed"):
+            # the server created a FRESH session under our token: the
+            # old one (and its refs/actors) is gone — don't silently
+            # continue against dangling state
+            self._session_lost = True
+            return False
+        return True
+
+
 class ClientRuntime:
     """Thin proxy implementing the runtime interface api.py drives."""
 
     is_client = True
 
     def __init__(self, address: tuple[str, int]):
-        self._rpc = RpcClient(address)
+        # a stable session token survives connection drops: the wrapped
+        # client redials and re-hellos, and the server resumes this
+        # session's refs/actors within its reconnect grace window
+        # (reference: client reconnect via _client_reconnect_grace)
+        self._token = uuid.uuid4().hex
+        self._rpc = _SessionRpcClient(address, self)
         self._lock = threading.Lock()
-        info = self._rpc.call("client_hello")
+        info = self._rpc.call("client_hello", session_token=self._token)
         self.job_id = info["job_id"]
 
     # -- objects --------------------------------------------------------
@@ -168,7 +224,11 @@ class ClientRuntime:
 
     def shutdown(self):
         try:
-            self._rpc.call("client_disconnect")
-        except (OSError, exc.RayTpuError):
+            # direct call on the live underlying connection: a goodbye
+            # to a dead server must not spend the 10s redial window, and
+            # ConnectionLost must not escape a teardown path
+            self._rpc._client.call("client_disconnect")
+        except (OSError, ConnectionLost, exc.RayTpuError,
+                ClientSessionExpired):
             pass
         self._rpc.close()

@@ -14,6 +14,10 @@ Then from anywhere:
 
 from __future__ import annotations
 
+import threading
+import time
+import uuid
+
 import cloudpickle
 
 from ray_tpu.runtime.object_ref import ObjectRef
@@ -51,27 +55,105 @@ class ClientServer(RpcServer):
         # objects — util/client/server/): remote clients hold no process-
         # local ObjectRefs, so the server retains one per client-visible
         # object or distributed refcounting would free them the moment
-        # the transient RPC-scope ref dropped. Scoped per CONNECTION and
-        # dropped on disconnect (a client session's objects die with it,
-        # matching the reference's per-client proxier lifetime); explicit
-        # client_free releases earlier.
-        self._held: dict[int, dict[str, ObjectRef]] = {}
+        # the transient RPC-scope ref dropped. State is scoped per
+        # SESSION (a client-chosen token), not per connection: a dropped
+        # TCP connection keeps the session alive for a reconnect grace
+        # window (reference: client proxier 30s reconnect grace), then
+        # the session's objects are released and its non-detached actors
+        # killed — the per-client-driver lifetime the reference gets
+        # from one ray instance per proxied client.
+        from ray_tpu.utils.config import get_config
+        self._grace = get_config().client_reconnect_grace_s
+        self._slock = threading.Lock()
+        self._sessions: dict[str, dict] = {}
+        self._conn_session: dict[int, str] = {}
+        self._reaper = threading.Thread(target=self._reap_loop,
+                                        daemon=True, name="client-reaper")
+        self._reaper.start()
+
+    def _session_for(self, conn) -> dict:
+        with self._slock:
+            token = self._conn_session.get(id(conn))
+            if token is None:
+                # hello-less legacy client: one implicit session per conn
+                token = f"conn-{id(conn)}"
+                self._conn_session[id(conn)] = token
+            sess = self._sessions.get(token)
+            if sess is None:
+                sess = self._new_session_locked(token)
+            sess["conns"].add(id(conn))
+            return sess
+
+    def _new_session_locked(self, token: str) -> dict:
+        sess = {"token": token, "held": {}, "actors": set(),
+                "conns": set(), "reap_at": None}
+        self._sessions[token] = sess
+        return sess
 
     def _retain(self, conn, refs):
-        table = self._held.setdefault(id(conn), {})
+        table = self._session_for(conn)["held"]
         for r in refs:
             table.setdefault(r.hex(), r)
 
     def on_disconnect(self, conn):
-        self._held.pop(id(conn), None)
+        with self._slock:
+            token = self._conn_session.pop(id(conn), None)
+            sess = self._sessions.get(token) if token else None
+            if sess is None:
+                return
+            sess["conns"].discard(id(conn))
+            if not sess["conns"]:
+                # grace window: a reconnecting client re-hellos with its
+                # token and cancels the reap
+                sess["reap_at"] = time.monotonic() + self._grace
+
+    def _reap_loop(self):
+        while not self._stopping:
+            time.sleep(0.25)
+            now = time.monotonic()
+            doomed = []
+            with self._slock:
+                for token, sess in list(self._sessions.items()):
+                    at = sess["reap_at"]
+                    if at is not None and now >= at and not sess["conns"]:
+                        doomed.append(self._sessions.pop(token))
+            for sess in doomed:
+                self._reap_session(sess)
+
+    def _reap_session(self, sess: dict):
+        """The session's objects die with it; its non-detached actors
+        are killed (owner-scoped lifetime for remote-client drivers)."""
+        sess["held"].clear()
+        for actor_hex in sess["actors"]:
+            try:
+                self._rt.kill_actor(ActorID.from_hex(actor_hex),
+                                    no_restart=True)
+            except Exception:  # noqa: BLE001 - already dead is fine
+                pass
 
     # -- session ---------------------------------------------------------
 
-    def rpc_client_hello(self, conn, send_lock):
+    def rpc_client_hello(self, conn, send_lock, *, session_token=None):
+        token = session_token or uuid.uuid4().hex
+        with self._slock:
+            sess = self._sessions.get(token)
+            resumed = sess is not None
+            if sess is None:
+                sess = self._new_session_locked(token)
+            sess["conns"].add(id(conn))
+            sess["reap_at"] = None          # reconnect cancels the reap
+            self._conn_session[id(conn)] = token
         job = getattr(self._rt, "job_id", None)
-        return {"job_id": job.hex() if job is not None else "cluster"}
+        return {"job_id": job.hex() if job is not None else "cluster",
+                "session_token": token, "resumed": resumed}
 
     def rpc_client_disconnect(self, conn, send_lock):
+        """Explicit goodbye: reap NOW, no grace."""
+        with self._slock:
+            token = self._conn_session.pop(id(conn), None)
+            sess = self._sessions.pop(token, None) if token else None
+        if sess is not None:
+            self._reap_session(sess)
         return {"ok": True}
 
     # -- objects ---------------------------------------------------------
@@ -100,7 +182,9 @@ class ClientServer(RpcServer):
                 "not_ready": [r.id.hex() for r in not_ready]}
 
     def rpc_client_free(self, conn, send_lock, *, oids):
-        for table in self._held.values():
+        with self._slock:
+            tables = [s["held"] for s in self._sessions.values()]
+        for table in tables:
             for o in oids:
                 table.pop(o, None)
         self._rt.free([ObjectRef(ObjectID.from_hex(o)) for o in oids])
@@ -185,6 +269,9 @@ class ClientServer(RpcServer):
                                              lifetime=lifetime)
         except ValueError as e:
             return {"error": str(e), "actor_id": None}
+        if lifetime != "detached":
+            # session-scoped lifetime: reaped with the client session
+            self._session_for(conn)["actors"].add(actor_id.hex())
         return {"error": None, "actor_id": actor_id.hex()}
 
     def rpc_client_kill_actor(self, conn, send_lock, *, actor_id,

@@ -192,18 +192,21 @@ class ClusterRuntime:
     # ------------------------------------------------------------------
 
     def _ref_flush_loop(self):
+        from ray_tpu.utils.config import get_config
+
+        period = get_config().ref_heartbeat_interval_s
         last_beat = time.monotonic()
         while not self._closed:
             # event-driven: block until ref activity or the heartbeat is
-            # due (an empty update every ~2s keeps the client-liveness
-            # heartbeat alive — actor lifetimes hang off it)
-            remain = 2.0 - (time.monotonic() - last_beat)
+            # due (an empty update keeps the client-liveness heartbeat
+            # alive — actor lifetimes hang off it)
+            remain = period - (time.monotonic() - last_beat)
             if self._refs.wait_pending(max(remain, 0.05)):
                 time.sleep(self._ref_interval)   # coalesce into one RPC
             if self._closed:
                 return
             now = time.monotonic()
-            beat = now - last_beat >= 2.0
+            beat = now - last_beat >= period
             if self._ref_flush_now(force_heartbeat=beat) or beat:
                 last_beat = now
 

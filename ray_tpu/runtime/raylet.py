@@ -65,6 +65,12 @@ class Raylet(RpcServer):
         self._gcs = ReconnectingRpcClient(self.gcs_address)
         self._gcs_lock = threading.Lock()   # RpcClient is thread-safe; lock
                                             # keeps call+interpret atomic
+        # LIVENESS gets its own connection + lock: on the shared channel
+        # a task-flood's pick_node/spillback burst queues hundreds of
+        # lock-waiters ahead of the beat, and the GCS falsely declares
+        # this node dead mid-flood (seen at the 2k-actor envelope tier).
+        self._gcs_beat = ReconnectingRpcClient(self.gcs_address)
+        self._gcs_beat_lock = threading.Lock()
         self._peers: dict[str, RpcClient] = {}
         self._peer_addrs: dict[str, tuple] = {}
         self._peers_lock = threading.Lock()
@@ -299,6 +305,10 @@ class Raylet(RpcServer):
         import shutil
 
         shutil.rmtree(self.log_dir, ignore_errors=True)
+        try:
+            self._gcs_beat.close()
+        except OSError:
+            pass
         self.store.close()
         self.objects.cleanup_disk()
 
@@ -977,11 +987,12 @@ class Raylet(RpcServer):
                         self.objects.spill_dir
                         if self.objects.spill_is_local else None)
                 acks = sorted(freed_acks) if freed_acks else None
-                with self._gcs_lock:
-                    # liveness only: the versioned syncer carries the
-                    # resource view at RPC latency; the beat's payload is
-                    # O(1) (the version) unless the GCS asks for a resync
-                    reply = self._gcs.call(
+                with self._gcs_beat_lock:
+                    # liveness only, on the DEDICATED beat channel: the
+                    # versioned syncer carries the resource view at RPC
+                    # latency; the beat's payload is O(1) (the version)
+                    # unless the GCS asks for a resync
+                    reply = self._gcs_beat.call(
                         "heartbeat", node_id=self.node_id,
                         resource_version=self.resource_syncer
                         .pushed_version,
@@ -990,8 +1001,8 @@ class Raylet(RpcServer):
                 if acks:
                     freed_acks.difference_update(acks)
                 if reply.get("reregister"):
-                    with self._gcs_lock:
-                        self._gcs.call(
+                    with self._gcs_beat_lock:
+                        self._gcs_beat.call(
                             "register_node", node_id=self.node_id,
                             address=self.address, store_name=self.store_name,
                             resources=self.total_resources,

@@ -444,16 +444,19 @@ class Worker:
     def _ref_flush_loop(self):
         import time as _time
 
+        from ray_tpu.utils.config import get_config
+
+        period = get_config().ref_heartbeat_interval_s
         last_beat = _time.monotonic()
         while True:
-            # event-driven: block until ref activity (or the ~2s
-            # client-liveness heartbeat is due) instead of polling —
-            # thousands of idle workers polling thrash the host scheduler
-            remain = 2.0 - (_time.monotonic() - last_beat)
+            # event-driven: block until ref activity (or the client-
+            # liveness heartbeat is due) instead of polling — thousands
+            # of idle workers polling thrash the host scheduler
+            remain = period - (_time.monotonic() - last_beat)
             if self._refs.wait_pending(max(remain, 0.05)):
                 _time.sleep(0.1)    # coalesce a burst into one RPC
             now = _time.monotonic()
-            beat = now - last_beat >= 2.0   # client-liveness heartbeat
+            beat = now - last_beat >= period
             if self._ref_flush_now(force_heartbeat=beat) or beat:
                 last_beat = now
 

@@ -166,6 +166,30 @@ def cmd_serve_status(args):
     print(json.dumps(serve.status(), indent=2, default=str))
 
 
+def cmd_debug(args):
+    """Attach to a remote breakpoint (reference: ``ray debug`` over
+    rpdb sessions registered in the GCS KV)."""
+    import ray_tpu
+    from ray_tpu.util import debug as rdbg
+
+    ray_tpu.init(address=args.address)
+    sessions = rdbg.active_sessions()
+    if not sessions:
+        print("no active breakpoints")
+        return
+    if len(sessions) == 1 or args.index is not None:
+        chosen = sessions[args.index or 0]
+    else:
+        for i, s in enumerate(sessions):
+            print(f"[{i}] session {s['session_id']} pid={s['pid']} "
+                  f"node={s.get('node_id', '')[:8]}")
+        chosen = sessions[int(input("attach to which? "))]
+    print(f"attaching to {chosen['session_id']} "
+          f"({chosen['host']}:{chosen['port']}) — 'c' continues, "
+          f"'q' aborts the task")
+    rdbg.connect(chosen)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="ray-tpu", description="ray_tpu cluster CLI")
@@ -228,6 +252,12 @@ def main(argv=None):
     p.add_argument("--block", action="store_true",
                    help="keep the process (and local cluster) alive")
     p.set_defaults(fn=cmd_serve_deploy)
+
+    p = sub.add_parser("debug", help="attach to a remote breakpoint")
+    p.add_argument("--address", default="127.0.0.1:6379")
+    p.add_argument("--index", type=int, default=None,
+                   help="session index (skip the picker)")
+    p.set_defaults(fn=cmd_debug)
 
     p = sub.add_parser("serve-status", help="serve deployment status")
     p.add_argument("--address", required=True)

@@ -18,6 +18,7 @@ from ray_tpu.train.data_parallel_trainer import (
     Result,
 )
 from ray_tpu.train.elastic import ElasticTrainer
+from ray_tpu.train.gbdt import GBTModel, LightGBMTrainer, XGBoostTrainer
 from ray_tpu.train.session import get_checkpoint_dir, get_context, report
 from ray_tpu.train.accelerate import AccelerateTrainer
 from ray_tpu.train.torch import TorchConfig, TorchTrainer
@@ -32,8 +33,10 @@ __all__ = [
     "DataParallelTrainer",
     "ElasticTrainer",
     "FailureConfig",
+    "GBTModel",
     "JaxMeshTrainer",
     "JaxTrainer",
+    "LightGBMTrainer",
     "Result",
     "RunConfig",
     "ScalingConfig",
@@ -42,6 +45,7 @@ __all__ = [
     "TransformersTrainer",
     "TrainConfig",
     "WorkerGroup",
+    "XGBoostTrainer",
     "get_checkpoint_dir",
     "get_context",
     "report",

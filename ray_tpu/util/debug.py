@@ -1,0 +1,256 @@
+"""Remote debugger for cluster tasks and actors.
+
+Reference analog: ``python/ray/util/rpdb.py`` — ``ray.util.rpdb.set_trace``
+opens a pdb bound to a TCP socket inside the worker, registers the
+session in the GCS KV, and ``ray debug`` connects to it. Same shape
+here: ``ray_tpu.util.debug.set_trace()`` / ``post_mortem()`` in task
+code, ``active_sessions()`` + ``connect(session)`` driver-side (wired
+to ``scripts/cli.py debug``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pdb
+import socket
+import sys
+import time
+import uuid
+
+from ray_tpu.experimental import internal_kv
+
+_KV_PREFIX = "rtpu_debugger:"
+
+
+def _reachable_host() -> str:
+    """The IP a remote ``ray-tpu debug`` should dial for THIS process:
+    the interface that routes toward the GCS (the cluster's network),
+    falling back to loopback for single-host runs."""
+    gcs_host = os.environ.get("RAY_TPU_GCS_HOST")
+    if gcs_host and gcs_host not in ("127.0.0.1", "localhost"):
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.connect((gcs_host, 1))       # no packet sent
+            return probe.getsockname()[0]
+        except OSError:
+            pass
+        finally:
+            probe.close()
+    return "127.0.0.1"
+
+
+class _SocketIO:
+    """File-like over a connected socket for Pdb stdin/stdout."""
+
+    def __init__(self, conn: socket.socket):
+        self._conn = conn
+        self._r = conn.makefile("r", encoding="utf-8", newline="\n")
+        self._w = conn.makefile("w", encoding="utf-8")
+
+    def readline(self):
+        return self._r.readline()
+
+    def write(self, data):
+        self._w.write(data)
+        return len(data)
+
+    def flush(self):
+        try:
+            self._w.flush()
+        except (BrokenPipeError, OSError):
+            pass
+
+    def close(self):
+        for f in (self._r, self._w):
+            try:
+                f.close()
+            except OSError:
+                pass
+
+
+class _RemotePdb(pdb.Pdb):
+    """Pdb listening on an ephemeral TCP port; blocks the worker until
+    a client attaches (the breakpoint IS the suspension point, like the
+    reference's remote pdb)."""
+
+    def __init__(self, session_id: str, timeout_s: float | None):
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR,
+                                  1)
+        # all interfaces: the attaching CLI may run on another host of
+        # the cluster (the announced host below is what it dials)
+        self._listener.bind(("", 0))
+        self._listener.listen(1)
+        self.port = self._listener.getsockname()[1]
+        self.session_id = session_id
+        self._announce(timeout_s)
+        if timeout_s is not None:
+            self._listener.settimeout(timeout_s)
+        try:
+            conn, _ = self._listener.accept()
+        except socket.timeout:
+            self.cleanup()   # nobody attached: deregister + close
+            raise
+        self._io = _SocketIO(conn)
+        super().__init__(stdin=self._io, stdout=self._io)
+        self.use_rawinput = False
+        self.prompt = "(rtpu-pdb) "
+
+    # pdb.set_trace installs a trace and RETURNS; the interaction fires
+    # at the caller's next line. Teardown therefore hangs off the detach
+    # commands, not the caller (the standard remote-pdb shape).
+    def do_continue(self, arg):
+        result = super().do_continue(arg)
+        self.cleanup()
+        return result
+
+    do_c = do_cont = do_continue
+
+    def do_quit(self, arg):
+        result = super().do_quit(arg)
+        self.cleanup()
+        return result
+
+    do_q = do_exit = do_quit
+
+    def do_EOF(self, arg):   # noqa: N802 - pdb naming
+        result = super().do_EOF(arg)
+        self.cleanup()
+        return result
+
+    def _announce(self, timeout_s):
+        entry = {
+            "session_id": self.session_id,
+            "host": _reachable_host(),
+            "port": self.port,
+            "pid": os.getpid(),
+            "worker_id": os.environ.get("RAY_TPU_WORKER_ID", "driver"),
+            "node_id": os.environ.get("RAY_TPU_NODE_ID", ""),
+            "created": time.time(),
+            "timeout_s": timeout_s,
+        }
+        try:
+            internal_kv.internal_kv_put(
+                _KV_PREFIX + self.session_id,
+                json.dumps(entry).encode())
+        except Exception:  # noqa: BLE001 - debugging must not kill work
+            pass
+
+    def cleanup(self):
+        """Idempotent: detach commands, timeouts, and the post-mortem
+        finally all funnel here."""
+        try:
+            internal_kv.internal_kv_del(_KV_PREFIX + self.session_id)
+        except Exception:  # noqa: BLE001
+            pass
+        if getattr(self, "_io", None) is not None:
+            self._io.close()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def set_trace(*, timeout_s: float | None = None):
+    """Breakpoint inside task/actor code: suspends this worker until a
+    client attaches (``ray_tpu debug`` CLI / ``connect()``) and drives
+    the pdb session. ``timeout_s`` bounds the wait for a client
+    (reference behavior: block indefinitely)."""
+    session_id = uuid.uuid4().hex[:12]
+    try:
+        remote_pdb = _RemotePdb(session_id, timeout_s)
+    except socket.timeout:
+        return   # nobody attached within the window: resume execution
+    # debug the CALLER's frame, like pdb.set_trace(); teardown happens
+    # in the detach commands (do_continue/do_quit), not here — the
+    # interaction hasn't happened yet when this returns
+    remote_pdb.set_trace(frame=sys._getframe().f_back)
+
+
+def post_mortem(tb=None, *, timeout_s: float | None = None):
+    """Remote post-mortem on the active exception's traceback."""
+    if tb is None:
+        tb = sys.exc_info()[2]
+    if tb is None:
+        raise ValueError("no traceback to post-mortem")
+    session_id = uuid.uuid4().hex[:12]
+    try:
+        remote_pdb = _RemotePdb(session_id, timeout_s)
+    except socket.timeout:
+        return
+    try:
+        remote_pdb.reset()
+        remote_pdb.interaction(None, tb)
+    finally:
+        remote_pdb.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+
+def active_sessions() -> list[dict]:
+    """Breakpoints currently waiting for (or holding) a client."""
+    out = []
+    try:
+        keys = internal_kv.internal_kv_list(_KV_PREFIX)
+    except Exception:  # noqa: BLE001
+        return out
+    for key in keys:
+        raw = internal_kv.internal_kv_get(key)
+        if raw:
+            try:
+                out.append(json.loads(raw))
+            except ValueError:
+                pass
+    return sorted(out, key=lambda e: e.get("created", 0))
+
+
+def connect(session: dict, *, stdin=None, stdout=None):
+    """Attach to a breakpoint session and pump stdin/stdout until the
+    debugger detaches (``c``/``q``). Used by ``scripts/cli.py debug``;
+    tests drive it with explicit streams."""
+    stdin = stdin if stdin is not None else sys.stdin
+    stdout = stdout if stdout is not None else sys.stdout
+    conn = socket.create_connection(
+        (session["host"], session["port"]), timeout=30)
+    rfile = conn.makefile("r", encoding="utf-8")
+    wfile = conn.makefile("w", encoding="utf-8")
+    import threading
+
+    done = threading.Event()
+
+    def pump_out():
+        try:
+            while True:
+                data = rfile.read(1)
+                if not data:
+                    break
+                stdout.write(data)
+                try:
+                    stdout.flush()
+                except Exception:  # noqa: BLE001
+                    pass
+        except OSError:
+            pass
+        finally:
+            done.set()
+
+    t = threading.Thread(target=pump_out, daemon=True)
+    t.start()
+    try:
+        while not done.is_set():
+            line = stdin.readline()
+            if not line:
+                break
+            try:
+                wfile.write(line)
+                wfile.flush()
+            except (BrokenPipeError, OSError):
+                break
+        done.wait(timeout=5)
+    finally:
+        conn.close()

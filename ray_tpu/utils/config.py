@@ -101,6 +101,14 @@ class Config:
     # Grace before contains-edge releases propagate to inner objects
     # (covers the borrower-incref-in-flight window).
     ref_release_grace_s: float = 0.5
+    # Ray-client (client://) session survival after its last connection
+    # drops: a reconnecting client resumes its refs/actors within this
+    # window (reference: client proxier 30s reconnect grace).
+    client_reconnect_grace_s: float = 30.0
+    # Client-liveness heartbeat period (empty ref_update when idle).
+    # 9x margin under client_timeout_s; at 2k workers/host this is the
+    # dominant idle GCS load, so it must stay coarse.
+    ref_heartbeat_interval_s: float = 5.0
 
     # --- resource sync (reference: ray_syncer.h:86 + the raylet
     # heartbeat period, ray_config_def.h raylet_report_resources_period) ---

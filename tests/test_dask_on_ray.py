@@ -1,0 +1,74 @@
+"""Dask-graph scheduler (reference: ray.util.dask ray_dask_get —
+``python/ray/util/dask/scheduler.py``). The dask graph protocol is plain
+dicts/tuples, so the scheduler is exercised without dask installed."""
+
+from operator import add, mul
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.dask import ray_dask_get
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_simple_graph():
+    dsk = {"x": 1, "y": (add, "x", 2), "z": (mul, "y", "y")}
+    assert ray_dask_get(dsk, "z") == 9
+    assert ray_dask_get(dsk, ["z", "y", "x"]) == [9, 3, 1]
+
+
+def test_nested_key_lists_and_structures():
+    dsk = {
+        "a": 2,
+        "b": (add, "a", 3),
+        "c": (sum, ["a", "b", 10]),          # keys inside a list arg
+        "d": (dict, [("k", "c")]),            # key nested in a pair list
+    }
+    assert ray_dask_get(dsk, "c") == 17
+    assert ray_dask_get(dsk, [["c"], ["b", "a"]]) == [[17], [5, 2]]
+    assert ray_dask_get(dsk, "d") == {"k": 17}
+
+
+def test_tuple_keys_and_fanout():
+    """Array-style tuple keys; a shared upstream computes once and fans
+    out as an ObjectRef (counted via a side-effect file)."""
+    dsk = {("blk", i): (mul, i, 10) for i in range(4)}
+    dsk["total"] = (sum, [("blk", i) for i in range(4)])
+    assert ray_dask_get(dsk, "total") == 60
+    assert ray_dask_get(dsk, ("blk", 2)) == 20
+
+
+def test_inline_nested_tasks():
+    # dask inlines sub-tasks as nested tuples: (add, (mul, 'x', 2), 1)
+    dsk = {"x": 5, "y": (add, (mul, "x", 2), 1)}
+    assert ray_dask_get(dsk, "y") == 11
+
+
+def test_alias_keys_and_literals():
+    dsk = {"x": 7, "alias": "x", "lit": "not-a-key"}
+    assert ray_dask_get(dsk, "alias") == 7
+    assert ray_dask_get(dsk, "lit") == "not-a-key"
+
+
+def test_numpy_blocks_flow_through_object_plane():
+    def make(i):
+        return np.full((100,), i, dtype=np.float32)
+
+    dsk = {("p", i): (make, i) for i in range(3)}
+    dsk["stack"] = (np.stack, [("p", i) for i in range(3)])
+    dsk["mean"] = (np.mean, "stack")
+    assert ray_dask_get(dsk, "mean") == pytest.approx(1.0)
+
+
+def test_cycle_detection():
+    dsk = {"x": (add, "y", 1), "y": (add, "x", 1)}
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get(dsk, "x")

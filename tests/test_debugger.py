@@ -1,0 +1,86 @@
+"""Remote debugger (reference: ray.util.rpdb — set_trace in a task
+opens a socket-bound pdb, registered in the KV; a client attaches and
+drives it)."""
+
+import io
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import debug as rdbg
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def _attach_and_send(commands: list[str], out: io.StringIO,
+                     deadline_s: float = 30.0):
+    """Poll for a session, attach, send commands, collect output."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        sessions = rdbg.active_sessions()
+        if sessions:
+            rdbg.connect(sessions[-1],
+                         stdin=io.StringIO("".join(commands)), stdout=out)
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_set_trace_suspends_until_continue():
+    @ray_tpu.remote
+    def task():
+        x = 41
+        rdbg.set_trace(timeout_s=25)
+        return x + 1
+
+    ref = task.remote()
+    out = io.StringIO()
+    attacher = threading.Thread(
+        target=_attach_and_send, args=(["p x\n", "c\n"], out), daemon=True)
+    attacher.start()
+    # the task resumes only after the client sends 'c'
+    assert ray_tpu.get(ref, timeout=60) == 42
+    attacher.join(timeout=10)
+    text = out.getvalue()
+    assert "41" in text          # `p x` printed the local
+    assert "(rtpu-pdb)" in text
+    # session deregistered after detach
+    assert not rdbg.active_sessions()
+
+
+def test_set_trace_timeout_resumes_without_client():
+    @ray_tpu.remote
+    def task():
+        rdbg.set_trace(timeout_s=0.5)   # nobody attaches
+        return "resumed"
+
+    assert ray_tpu.get(task.remote(), timeout=60) == "resumed"
+
+
+def test_post_mortem_inspects_exception_frame():
+    @ray_tpu.remote
+    def task():
+        try:
+            denom = 0
+            return 1 / denom
+        except ZeroDivisionError:
+            rdbg.post_mortem(timeout_s=25)
+            return "handled"
+
+    ref = task.remote()
+    out = io.StringIO()
+    attacher = threading.Thread(
+        target=_attach_and_send, args=(["p denom\n", "q\n"], out),
+        daemon=True)
+    attacher.start()
+    assert ray_tpu.get(ref, timeout=60) == "handled"
+    attacher.join(timeout=10)
+    assert "0" in out.getvalue()

@@ -1,0 +1,151 @@
+"""Distributed GBDT trainers (reference: train/xgboost + train/lightgbm
+over gbdt_trainer.py; here a native histogram implementation whose
+distributed mode sums worker histograms — tests check learning quality,
+exact 1-vs-N-worker determinism, and the Trainer API contract)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (LightGBMTrainer, Result, RunConfig,
+                           ScalingConfig, XGBoostTrainer)
+from ray_tpu.train.gbdt import GBTModel
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _regression_data(n=2000, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (2.0 * X[:, 0] - 1.5 * X[:, 1] * (X[:, 2] > 0)
+         + 0.5 * np.sin(3 * X[:, 3]) + rng.normal(scale=0.1, size=n))
+    return X, y
+
+
+def _as_dict(X, y):
+    d = {f"f{i}": X[:, i] for i in range(X.shape[1])}
+    d["label"] = y
+    return d
+
+
+def test_xgboost_regression_learns(tmp_path):
+    X, y = _regression_data()
+    trainer = XGBoostTrainer(
+        params={"objective": "reg:squarederror", "eta": 0.3,
+                "max_depth": 5},
+        label_column="label",
+        datasets={"train": _as_dict(X[:1500], y[:1500]),
+                  "valid": _as_dict(X[1500:], y[1500:])},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+        num_boost_round=20)
+    result = trainer.fit()
+    assert isinstance(result, Result)
+    hist = result.metrics_history
+    # boosting reduces train loss monotonically-ish and generalizes
+    assert hist[-1]["train-rmse"] < hist[0]["train-rmse"] * 0.5
+    assert result.metrics["valid-rmse"] < np.std(y) * 0.6
+    assert result.metrics["num_trees"] == 20
+
+
+def test_xgboost_binary_classification(tmp_path):
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(2000, 6))
+    y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(np.float64)
+    trainer = XGBoostTrainer(
+        params={"objective": "binary:logistic", "eta": 0.3,
+                "max_depth": 4},
+        label_column="label",
+        datasets={"train": _as_dict(X, y)},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+        num_boost_round=15)
+    result = trainer.fit()
+    assert result.metrics["train-error"] < 0.1
+    assert result.metrics["train-logloss"] < 0.3
+
+
+def test_worker_count_invariance(tmp_path):
+    """The distributed histogram sum is exact (float64): 1-worker and
+    3-worker training produce identical models — the determinism check
+    the wrapped-library reference can't make."""
+    X, y = _regression_data(n=1200, f=5, seed=2)
+    preds = []
+    for n_workers in (1, 3):
+        trainer = XGBoostTrainer(
+            params={"objective": "reg:squarederror", "max_depth": 4},
+            label_column="label",
+            datasets={"train": _as_dict(X, y)},
+            scaling_config=ScalingConfig(num_workers=n_workers),
+            run_config=RunConfig(storage_path=str(tmp_path)),
+            num_boost_round=8)
+        result = trainer.fit()
+        model = GBTModel.load(f"{result.checkpoint_dir}/model.pkl")
+        preds.append(model.predict(X[:200]))
+    np.testing.assert_allclose(preds[0], preds[1], rtol=1e-10)
+
+
+def test_lightgbm_leafwise_learns(tmp_path):
+    X, y = _regression_data(n=1500, f=6, seed=3)
+    trainer = LightGBMTrainer(
+        params={"objective": "reg:squarederror", "learning_rate": 0.2,
+                "num_leaves": 15},
+        label_column="label",
+        datasets={"train": _as_dict(X, y)},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+        num_boost_round=15)
+    result = trainer.fit()
+    hist = result.metrics_history
+    assert hist[-1]["train-rmse"] < hist[0]["train-rmse"] * 0.5
+    # leaf-wise growth respects the leaf budget
+    model = GBTModel.load(f"{result.checkpoint_dir}/model.pkl")
+    for tree in model.trees:
+        assert (tree.feature < 0).sum() <= 15 + 14  # leaves + internals
+
+
+def test_model_checkpoint_roundtrip(tmp_path):
+    X, y = _regression_data(n=600, f=4, seed=4)
+    trainer = XGBoostTrainer(
+        params={"max_depth": 3},
+        label_column="label",
+        datasets={"train": _as_dict(X, y)},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+        num_boost_round=5)
+    result = trainer.fit()
+    model = GBTModel.load(f"{result.checkpoint_dir}/model.pkl")
+    p1 = model.predict(X[:50])
+    # saved model survives a save/load cycle byte-identically
+    model.save(str(tmp_path / "again.pkl"))
+    p2 = GBTModel.load(str(tmp_path / "again.pkl")).predict(X[:50])
+    np.testing.assert_array_equal(p1, p2)
+    # raw-feature prediction tracks the training targets
+    assert np.corrcoef(model.predict(X), y)[0, 1] > 0.8
+
+
+def test_trains_from_ray_tpu_dataset(tmp_path):
+    """datasets= accepts ray_tpu.data Datasets (the reference's primary
+    ingestion path)."""
+    from ray_tpu import data as rd
+
+    X, y = _regression_data(n=800, f=4, seed=5)
+    items = [{"f0": float(X[i, 0]), "f1": float(X[i, 1]),
+              "f2": float(X[i, 2]), "f3": float(X[i, 3]),
+              "label": float(y[i])} for i in range(len(y))]
+    ds = rd.from_items(items)
+    trainer = XGBoostTrainer(
+        params={"max_depth": 4},
+        label_column="label",
+        datasets={"train": ds},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+        num_boost_round=8)
+    result = trainer.fit()
+    assert result.metrics["train-rmse"] < np.std(y)

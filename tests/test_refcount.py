@@ -195,6 +195,8 @@ def test_driver_exit_reaps_non_detached_actors(mode, tmp_path):
     # window (the production default is 45s — generous against falsely
     # reaping a live driver under control-plane load)
     os.environ["RAY_TPU_CLIENT_TIMEOUT_S"] = "6"
+    # beats must outpace the shortened timeout (production: 5s vs 45s)
+    os.environ["RAY_TPU_REF_HEARTBEAT_INTERVAL_S"] = "1"
     reset_config()
     cluster = Cluster()
     cluster.add_node(num_cpus=4)
@@ -202,6 +204,7 @@ def test_driver_exit_reaps_non_detached_actors(mode, tmp_path):
         _drive_exit_case(cluster, mode, tmp_path)
     finally:
         os.environ.pop("RAY_TPU_CLIENT_TIMEOUT_S", None)
+        os.environ.pop("RAY_TPU_REF_HEARTBEAT_INTERVAL_S", None)
         reset_config()
         ray_tpu.shutdown()
         cluster.shutdown()
