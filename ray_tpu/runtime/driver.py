@@ -97,6 +97,8 @@ class ClusterRuntime:
         # cached per-address actor-call clients (see _actor_client)
         self._actor_clients: dict[tuple, RpcClient] = {}
         self._actor_clients_lock = threading.Lock()
+        from ray_tpu.utils.config import get_config as _gc
+        self._actor_client_cap = _gc().actor_client_cache_size
         self.metrics: dict[str, Any] = {}
         # Lineage for object reconstruction (reference: ReferenceCounter
         # lineage pinning reference_count.h:67-115 + TaskManager::
@@ -956,16 +958,22 @@ class ClusterRuntime:
             # bounded: with direct actor push, keys are per-worker ports
             # (one per actor incarnation) — a driver churning actors
             # would otherwise leak a dead client per retired actor.
-            # Prefer CLOSED entries; only a (much higher) hard cap may
-            # evict a live client — evicting live ones at 256 would
-            # thrash drivers legitimately holding many live actors.
+            # ONLY closed entries are evicted below the hard cap:
+            # closing a LIVE client drops its in-flight submit frames,
+            # and at >cap live actors that cascades into an eviction/
+            # resend storm that stalls the whole submission plane (the
+            # 2k-actor envelope ran minutes-per-round-trip until this).
+            # The hard cap is a leak backstop sized far above any sane
+            # live-actor count per driver; sockets + parked reader
+            # threads are cheap, lost replies are not.
             if len(self._actor_clients) > 256:
                 for k, c in list(self._actor_clients.items()):
                     if c._closed and k != addr:
                         evicted = self._actor_clients.pop(k)
                         break
                 else:
-                    if len(self._actor_clients) > 1024:
+                    if len(self._actor_clients) > \
+                            self._actor_client_cap:
                         oldest = next(iter(self._actor_clients))
                         if oldest != addr:
                             evicted = self._actor_clients.pop(oldest)

@@ -218,6 +218,9 @@ class GcsServer(RpcServer):
         self._max_lost_objects = 100_000
         self._pgs: dict[str, PlacementGroupInfo] = {}
         self._jobs: dict[str, dict] = {}
+        # cached host_actor channels, one per raylet (see _schedule_actor)
+        self._placement_clients: dict[tuple, Any] = {}
+        self._placement_lock = threading.Lock()
         # pubsub: channel -> list of (conn, send_lock)
         self._subs: dict[str, list] = {}
         self._hb_timeout = heartbeat_timeout_s
@@ -445,6 +448,14 @@ class GcsServer(RpcServer):
 
     def stop(self):
         super().stop()
+        with self._placement_lock:
+            clients, self._placement_clients = \
+                dict(self._placement_clients), {}
+        for client in clients.values():
+            try:
+                client.close()
+            except OSError:
+                pass
         if self._persist is not None:
             try:
                 self._persist.snapshot(self._state_dict())
@@ -674,7 +685,6 @@ class GcsServer(RpcServer):
     def _schedule_actor(self, actor_id: str) -> str | None:
         """Pick a node for the actor and ask its raylet to host it
         (reference: GcsActorScheduler::Schedule, ScheduleByGcs)."""
-        from ray_tpu.runtime.rpc import RpcClient
         with self._lock:
             actor = self._actors.get(actor_id)
             if actor is None or actor.state == "DEAD":
@@ -698,19 +708,50 @@ class GcsServer(RpcServer):
                                     "reason": "unschedulable"})
             return None
         # Ask the raylet to host the actor (fire on a thread: raylet may
-        # itself call back into GCS during creation).
+        # itself call back into GCS during creation). The client is
+        # CACHED per raylet address — a 2k-actor flood through fresh
+        # sockets (connect + reader thread each) made placement the GCS
+        # bottleneck at the envelope tier.
         incarnation = actor.num_restarts
 
         def _place():
+            from ray_tpu.runtime.rpc import ConnectionLost
+            addr = tuple(node.address)
             try:
-                client = RpcClient(node.address)
+                client = self._placement_client(addr)
                 client.call("host_actor", actor_id=actor_id, spec=spec,
                             incarnation=incarnation)
-                client.close()
             except Exception as e:  # noqa: BLE001
+                if isinstance(e, (OSError, ConnectionLost)):
+                    # transport death only: an APPLICATION error (e.g. a
+                    # lost resource race re-raised by the handler) must
+                    # not close the SHARED channel under other in-flight
+                    # placements pipelined on it
+                    with self._placement_lock:
+                        stale = self._placement_clients.pop(addr, None)
+                    if stale is not None:
+                        try:
+                            stale.close()
+                        except OSError:
+                            pass
                 self._on_actor_failure_id(actor_id, f"placement failed: {e!r}")
         threading.Thread(target=_place, daemon=True).start()
         return node_id
+
+    def _placement_client(self, addr: tuple):
+        from ray_tpu.runtime.rpc import RpcClient
+        with self._placement_lock:
+            client = self._placement_clients.get(addr)
+            if client is not None and not client._closed:
+                return client
+        fresh = RpcClient(addr)
+        with self._placement_lock:
+            current = self._placement_clients.get(addr)
+            if current is not None and not current._closed:
+                fresh.close()
+                return current
+            self._placement_clients[addr] = fresh
+        return fresh
 
     def rpc_actor_ready(self, conn, send_lock, *, actor_id, node_id,
                         push_addr=None):
@@ -827,6 +868,10 @@ class GcsServer(RpcServer):
 
     def _pick_node(self, demand: dict, pg: PlacementGroupInfo | None = None,
                    exclude: set | None = None) -> str | None:
+        # zero-valued entries (num_cpus=0 actors arrive as {"CPU": 0.0})
+        # are not demand: they must take the occupancy-spread path below,
+        # not ride the resource-driven policy to node[0] forever
+        demand = {k: v for k, v in demand.items() if v > 0}
         if pg is not None and pg.bundle_nodes:
             for nid in pg.bundle_nodes:
                 n = self._nodes.get(nid)
@@ -842,7 +887,11 @@ class GcsServer(RpcServer):
         # keeps source checkouts working without `make -C src`
         from ray_tpu._private import scheduling as _sched
 
-        if _sched.available():
+        if demand and _sched.available():
+            # resource-driven picks: the native hybrid policy. Empty
+            # demands fall through to the Python score — they tie on
+            # utilization, and only the Python path knows queue depth
+            # and actor occupancy (the actual spread signals).
             nodes = list(self._nodes.values())
             return _sched.pick_node(
                 [n.node_id for n in nodes],
@@ -851,6 +900,18 @@ class GcsServer(RpcServer):
                 [n.alive for n in nodes],
                 exclude or set(), demand,
                 spread_threshold=0.0, top_k=1)
+        occupancy: dict[str, int] = {}
+        if not demand:
+            # zero-resource demands tie on utilization everywhere, so
+            # live-actor occupancy is the spread signal (reference:
+            # GcsActorScheduler spreads; without it an envelope flood
+            # stacks all 2,000 actors on node[0]). Recomputed per pick —
+            # drift-free vs incremental counts across the many death
+            # paths, and only empty-demand picks pay the O(actors) scan.
+            for a in self._actors.values():
+                if a.node_id and a.state in ("PENDING", "ALIVE",
+                                             "RESTARTING"):
+                    occupancy[a.node_id] = occupancy.get(a.node_id, 0) + 1
         best, best_score = None, None
         feasible_busy, busy_load = None, None
         for n in self._nodes.values():
@@ -864,7 +925,9 @@ class GcsServer(RpcServer):
                 # acquire/release averages out may still hold a deep
                 # ready queue — placement must prefer shallow queues
                 score = (_critical_utilization(demand, n)
-                         + min(n.load, 1000) * 0.001)
+                         + min(n.load, 1000) * 0.001
+                         + min(occupancy.get(n.node_id, 0), 100_000)
+                         * 1e-6)
                 if best_score is None or score < best_score:
                     best, best_score = n.node_id, score
             elif busy_load is None or n.load < busy_load:

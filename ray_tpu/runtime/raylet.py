@@ -566,9 +566,18 @@ class Raylet(RpcServer):
 
         def _deliver():
             # pip envs legitimately take minutes on a cold cache: give
-            # the worker's registration the install window, not 30s
+            # the worker's registration the install window. The plain
+            # window is generous too (flag): under an actor-flood spawn
+            # storm a freshly forked interpreter can take >30s just to
+            # get scheduled, and a worker that actually DIED is caught
+            # by poll() below, not by this deadline.
+            from ray_tpu.utils.config import get_config
             renv = (spec.get("runtime_env") or {})
-            deadline = time.monotonic() + (900 if renv.get("pip") else 30)
+            window = get_config().worker_register_timeout_s
+            if renv.get("pip"):
+                # an install never SHRINKS the window a plain env gets
+                window = max(900.0, window)
+            deadline = time.monotonic() + window
             while time.monotonic() < deadline and not self._stopping:
                 if handle.conn is not None:
                     try:
@@ -581,11 +590,16 @@ class Raylet(RpcServer):
                         self.workers.on_worker_gone(handle)
                     return
                 if handle.proc is not None and handle.proc.poll() is not None:
+                    reason = ("actor worker died during startup "
+                              f"(exit code {handle.proc.returncode})")
                     break
                 time.sleep(0.01)
+            else:
+                reason = ("actor worker failed to register within the "
+                          "deadline")
             with self._gcs_lock:
                 self._gcs.call("actor_failed", actor_id=actor_id,
-                               reason="actor worker failed to register")
+                               reason=reason)
         threading.Thread(target=_deliver, daemon=True).start()
         return {"ok": True}
 

@@ -310,8 +310,14 @@ class WorkerPool:
             n_alive = 0
             incoming = False  # replacement with this env already booting?
             for w in self.workers.values():
-                if w.state in ("idle", "busy", "starting", "actor",
-                               "leased"):
+                # DEDICATED actor workers are not pool capacity: they
+                # hold their own acquired resources for their lifetime.
+                # Counting them against max_workers starves every task
+                # on an actor-heavy node (500 idle actors on a 4-cpu
+                # node left ZERO task workers spawnable at the envelope
+                # tier — reference: worker_pool.cc caps the POOL, not
+                # dedicated workers).
+                if w.state in ("idle", "busy", "starting", "leased"):
                     n_alive += 1
                 if w.state == "starting" and w.env_key == key:
                     incoming = True

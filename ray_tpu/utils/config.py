@@ -123,7 +123,10 @@ class Config:
     # through the GCS view even though `available` looks healthy
     # (per-task acquire/release hides saturation from averages).
     scheduler_spillback_queue_depth: int = 32
-
+    # Hard cap on cached per-address actor-call clients (leak backstop
+    # for actor churn). Must exceed the driver's LIVE actor count:
+    # evicting a live client drops in-flight frames and storms resends.
+    actor_client_cache_size: int = 8192
     # --- submission pipeline ---
     # Max unacked actor tasks per actor (outbox + frames in flight).
     # Deep enough that the submitter never stalls waiting for enqueue
@@ -133,7 +136,12 @@ class Config:
 
     # --- workers ---
     num_workers: int = 0  # 0 = num_cpus
-    worker_register_timeout_s: float = 30.0
+    # How long a spawned worker may take to register before its actor
+    # creation is failed (reference: worker_register_timeout_seconds).
+    # A worker that DIED is detected by process polling, not this; the
+    # deadline only bounds hung-but-alive spawns, so it is generous —
+    # actor-flood fork storms starve fresh interpreters for >30s.
+    worker_register_timeout_s: float = 600.0
     worker_lease_timeout_s: float = 30.0
     # A granted lease whose owner never dials the worker's push port is
     # handed back after this long (runtime/worker_main.py watchdog).
