@@ -96,6 +96,12 @@ def _load():
     lib.store_abort.argtypes = [p, ctypes.c_char_p]
     lib.store_contains.restype = ctypes.c_int
     lib.store_contains.argtypes = [p, ctypes.c_char_p]
+    lib.store_get_many.restype = ctypes.c_int
+    lib.store_get_many.argtypes = [p, ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.POINTER(u64), ctypes.POINTER(u64),
+                                   ctypes.POINTER(ctypes.c_int)]
+    lib.store_release_many.restype = ctypes.c_int
+    lib.store_release_many.argtypes = [p, ctypes.c_char_p, ctypes.c_int]
     lib.store_evict_orphans.restype = ctypes.c_int
     lib.store_evict_orphans.argtypes = [p, u64]
     lib.store_release_pid.restype = ctypes.c_int
@@ -147,6 +153,31 @@ def _check(rc: int, what: str):
     raise ShmStoreError(f"{what}: rc={rc}")
 
 
+class _SegmentHandle:
+    """Owns the C store handle's lifetime. The store object AND the cached
+    whole-segment ctypes array both reference this handle (and nothing
+    refers back), so plain refcounting — no cyclic GC — munmaps exactly
+    when the last of {store object, escaped view} drops."""
+
+    __slots__ = ("_lib", "_h", "_closed")
+
+    def __init__(self, lib, h):
+        self._lib = lib
+        self._h = h
+        self._closed = False
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._lib.store_close(self._h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class ShmObjectStore:
     """One node's shared-memory object store (owner or attached client)."""
 
@@ -176,29 +207,32 @@ class ShmObjectStore:
         self._base = lib.store_base(self._h)
         self.capacity = lib.store_capacity(self._h)
         self._closed = False
+        # one whole-segment view, sliced per object: slicing a memoryview
+        # is ~5x cheaper than a fresh from_address + cast per get. The
+        # slice chain (slice -> segment array -> _anchor handle) keeps the
+        # MAPPING alive while views escape, without a cycle through this
+        # store object — see _SegmentHandle.
+        self._handle = _SegmentHandle(lib, self._h)
+        seg = (ctypes.c_ubyte * self.capacity).from_address(self._base)
+        seg._anchor = self._handle
+        self._seg_rw = memoryview(seg).cast("B")
+        self._seg_ro = self._seg_rw.toreadonly()
 
     # -- lifecycle ---------------------------------------------------------
     def close(self):
+        """Force-unmap (caller's contract: no views may be in use after).
+        Without an explicit close, the mapping is reclaimed by refcount
+        when the last of {this object, escaped views} drops — there is
+        deliberately no auto-close in __del__, which would munmap under
+        a still-escaped view the moment the store object is dropped."""
         if not self._closed:
             self._closed = True
-            self._lib.store_close(self._h)
-
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
+            self._handle.close()
 
     # -- object ops --------------------------------------------------------
     def _view(self, offset: int, size: int, readonly: bool) -> memoryview:
-        buf = (ctypes.c_ubyte * size).from_address(self._base + offset)
-        # The view must keep the segment mapped: anchor the store on the
-        # ctypes array so memoryview -> array -> store prevents GC-driven
-        # munmap while any view is alive (explicit close() is still the
-        # caller's contract, as with plasma buffers).
-        buf._store = self
-        mv = memoryview(buf).cast("B")
-        return mv.toreadonly() if readonly else mv
+        seg = self._seg_ro if readonly else self._seg_rw
+        return seg[offset:offset + size]
 
     def create(self, object_id: bytes, data_size: int,
                meta_size: int = 0) -> memoryview:
@@ -237,6 +271,25 @@ class ShmObjectStore:
 
     def release(self, object_id: bytes) -> None:
         self._lib.store_release(self._h, _key(object_id))
+
+    def get_many(self, object_ids: list[bytes]) -> list:
+        """Batched non-blocking get: one C call resolves the whole list.
+        Returns a view per id, or None where the object is absent/unsealed;
+        every non-None entry holds a read ref — pair with release_many over
+        the SAME hit set."""
+        n = len(object_ids)
+        keys = b"".join(map(_key, object_ids))
+        offs = (ctypes.c_uint64 * n)()
+        dszs = (ctypes.c_uint64 * n)()
+        rcs = (ctypes.c_int * n)()
+        self._lib.store_get_many(self._h, keys, n, offs, dszs, rcs)
+        seg = self._seg_ro
+        return [seg[offs[k]:offs[k] + dszs[k]] if rcs[k] == TS_OK else None
+                for k in range(n)]
+
+    def release_many(self, object_ids: list[bytes]) -> None:
+        keys = b"".join(map(_key, object_ids))
+        self._lib.store_release_many(self._h, keys, len(object_ids))
 
     def delete(self, object_id: bytes) -> bool:
         return self._lib.store_delete(self._h, _key(object_id)) == TS_OK

@@ -643,6 +643,18 @@ class GcsServer(RpcServer):
             doomed_actors = [a for a in self._actors.values()
                             if a.node_id == node_id
                             and a.state in ("ALIVE", "PENDING", "RESTARTING")]
+        # retire the dead node's cached placement channel — raylet
+        # restarts land on fresh ports, so entries left behind would
+        # accumulate one dead client per retired address forever
+        addr = tuple(node.address) if node.address else None
+        if addr is not None:
+            with self._placement_lock:
+                stale = self._placement_clients.pop(addr, None)
+            if stale is not None:
+                try:
+                    stale.close()
+                except OSError:
+                    pass
         self.publish(CH_NODE, {"event": "dead", "node_id": node_id,
                                "reason": reason})
         for actor in doomed_actors:
@@ -717,24 +729,42 @@ class GcsServer(RpcServer):
         def _place():
             from ray_tpu.runtime.rpc import ConnectionLost
             addr = tuple(node.address)
-            try:
-                client = self._placement_client(addr)
-                client.call("host_actor", actor_id=actor_id, spec=spec,
-                            incarnation=incarnation)
-            except Exception as e:  # noqa: BLE001
-                if isinstance(e, (OSError, ConnectionLost)):
+            last_err: Exception | None = None
+            for attempt in (0, 1):
+                client = None
+                try:
+                    client = self._placement_client(addr)
+                    client.call("host_actor", actor_id=actor_id, spec=spec,
+                                incarnation=incarnation)
+                    return
+                except (OSError, ConnectionLost) as e:
                     # transport death only: an APPLICATION error (e.g. a
                     # lost resource race re-raised by the handler) must
                     # not close the SHARED channel under other in-flight
-                    # placements pipelined on it
-                    with self._placement_lock:
-                        stale = self._placement_clients.pop(addr, None)
-                    if stale is not None:
+                    # placements pipelined on it. One RST drains EVERY
+                    # call pipelined on the cached channel with
+                    # ConnectionLost — retry once on a fresh dial so a
+                    # transient break doesn't permanently kill all
+                    # concurrent placements (safe: host_actor dedups on
+                    # (actor_id, incarnation) raylet-side).
+                    last_err = e
+                    if client is not None:
+                        # evict only OUR dead client: a concurrent retry
+                        # may already have installed a healthy fresh
+                        # channel at this address — popping that would
+                        # kill its pipelined in-flight placements
+                        with self._placement_lock:
+                            if self._placement_clients.get(addr) is client:
+                                self._placement_clients.pop(addr, None)
                         try:
-                            stale.close()
+                            client.close()
                         except OSError:
                             pass
-                self._on_actor_failure_id(actor_id, f"placement failed: {e!r}")
+                except Exception as e:  # noqa: BLE001
+                    last_err = e
+                    break
+            self._on_actor_failure_id(
+                actor_id, f"placement failed: {last_err!r}")
         threading.Thread(target=_place, daemon=True).start()
         return node_id
 

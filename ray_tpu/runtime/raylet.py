@@ -77,6 +77,9 @@ class Raylet(RpcServer):
 
         self.workers = WorkerPool(
             self, max_workers=max(1, int(resources.get("CPU", 1))))
+        # (actor_id, incarnation) placements currently inside spawn() —
+        # the host_actor idempotency window (see rpc_host_actor)
+        self._pending_hosts: set[tuple] = set()
         self.scheduler = TaskScheduler(
             self, resources=resources,
             infeasible_timeout_s=infeasible_timeout_s)
@@ -553,7 +556,31 @@ class Raylet(RpcServer):
                        incarnation=0):
         """Dedicate a fresh worker to the actor and hand it the creation
         task (reference: GcsActorScheduler::LeaseWorkerFromNode + the
-        worker-lease machinery in node_manager.cc:1778)."""
+        worker-lease machinery in node_manager.cc:1778).
+
+        IDEMPOTENT per (actor_id, incarnation): the GCS retries a
+        placement once when the shared placement channel dies mid-call
+        (it cannot know whether the first call landed), so a duplicate
+        for an actor already spawning/live here must be a no-op success
+        — hosting twice would run two copies of the actor. The pending
+        set covers the window where the first call is still inside
+        spawn() (worker fields are only set after it returns)."""
+        key = (actor_id, incarnation)
+        with self.workers.lock:
+            if key in self._pending_hosts:
+                return {"ok": True, "dedup": True}
+            for w in self.workers.workers.values():
+                if (w.state == "actor" and w.actor_id == actor_id
+                        and w.incarnation == incarnation):
+                    return {"ok": True, "dedup": True}
+            self._pending_hosts.add(key)
+        try:
+            return self._host_actor(actor_id, spec, incarnation)
+        finally:
+            with self.workers.lock:
+                self._pending_hosts.discard(key)
+
+    def _host_actor(self, actor_id, spec, incarnation):
         demand = spec.get("resources", {})
         if not self.scheduler.try_acquire(demand):
             raise RuntimeError(

@@ -1,0 +1,72 @@
+"""Sweep flash-attention block configs at the longctx bench shape.
+
+Measures achieved TFLOP/s of a full gradient (fwd + dq + dkv kernels)
+through ``ray_tpu.ops.flash_attention`` at the bench "longctx" shape
+(b=1, s=16384, 12 q heads / 4 kv heads, d=128) and the headline shape
+(b=8, s=2048).  Run on the real chip:  python scripts/sweep_flash.py
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.flash_attention import flash_attention
+
+
+def attn_flops(b, s, h, d, causal=True):
+    # fwd: 2 matmuls (QK^T, PV): 2 * 2*b*h*s*s*d ; causal halves it
+    f = 4 * b * h * s * s * d
+    if causal:
+        f //= 2
+    # bwd: dq pass (2 matmuls: dOV^T, dS K) + recomputed S (1) = 3
+    # dkv pass (dV, dK, recomputed S, dOV^T) = 4  -> 7 matmul-equivalents
+    bwd = 7 * 2 * b * h * s * s * d // (2 if causal else 1)
+    return f + bwd
+
+
+def bench_cfg(b, s, hq, hkv, d, bq, bk, iters=20):
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, s, hq, d), jnp.bfloat16)
+    k = jax.random.normal(key, (b, s, hkv, d), jnp.bfloat16)
+    v = jax.random.normal(key, (b, s, hkv, d), jnp.bfloat16)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    try:
+        r = g(q, k, v)
+        jax.block_until_ready(r)
+    except Exception as e:  # noqa: BLE001
+        print(f"  bq={bq} bk={bk}: FAIL {type(e).__name__}: {e}")
+        return None
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = g(q, k, v)
+    jax.block_until_ready(r)
+    dt = (time.perf_counter() - t0) / iters
+    fl = attn_flops(b, s, hq, d)
+    print(f"  bq={bq:5d} bk={bk:5d}: {dt*1e3:8.2f} ms  "
+          f"{fl/dt/1e12:6.2f} TF/s")
+    return dt
+
+
+def main():
+    print(f"devices: {jax.devices()}")
+    for (b, s, hq, hkv, d, tag) in [
+        (1, 16384, 12, 4, 128, "longctx"),
+        (8, 2048, 12, 4, 128, "headline"),
+    ]:
+        print(f"== {tag}: b={b} s={s} hq={hq} hkv={hkv} d={d}")
+        for bq, bk in [(512, 512), (512, 1024), (1024, 512), (1024, 1024),
+                       (1024, 2048), (2048, 1024), (2048, 2048)]:
+            if bq > s or bk > s:
+                continue
+            bench_cfg(b, s, hq, hkv, d, bq, bk)
+
+
+if __name__ == "__main__":
+    main()

@@ -311,6 +311,29 @@ bool track_reader(ObjectEntry& e, uint64_t pid) {
   return false;
 }
 
+// Bump a sealed entry's refcount for `pid` and touch its LRU tick — the
+// shared hit core of store_get and store_get_many (caller holds the lock).
+void acquire_locked(Header* h, ObjectEntry& e, uint64_t pid) {
+  e.refcount++;
+  if (!track_reader(e, pid)) e.untracked_refs++;
+  e.lru_tick = ++h->lru_clock;
+}
+
+// Drop one of `pid`'s references — the shared core of store_release and
+// store_release_many (caller holds the lock).
+void release_locked(ObjectEntry& e, uint64_t pid) {
+  if (e.refcount > 0) e.refcount--;
+  bool tracked = false;
+  for (uint32_t k = 0; k < kReaderSlots; k++) {
+    if (e.reader_pids[k] == pid && e.reader_counts[k] > 0) {
+      if (--e.reader_counts[k] == 0) e.reader_pids[k] = 0;
+      tracked = true;
+      break;
+    }
+  }
+  if (!tracked && e.untracked_refs > 0) e.untracked_refs--;
+}
+
 }  // namespace
 
 extern "C" {
@@ -569,10 +592,8 @@ int store_get(void* sp, const uint8_t* id, int64_t timeout_ms,
     uint64_t i = find(s, id);
     if (i != h->table_cap && s->table[i].state == kSealed) {
       ObjectEntry& e = s->table[i];
-      e.refcount++;
-      // record this reader's pid so a crash can be cleaned up
-      if (!track_reader(e, (uint64_t)getpid())) e.untracked_refs++;
-      e.lru_tick = ++h->lru_clock;
+      // records this reader's pid so a crash can be cleaned up
+      acquire_locked(h, e, (uint64_t)getpid());
       *offset_out = e.offset;
       *data_size_out = e.data_size;
       *meta_size_out = e.meta_size;
@@ -601,18 +622,7 @@ int store_release(void* sp, const uint8_t* id) {
     unlock(h);
     return TS_NOT_FOUND;
   }
-  ObjectEntry& e = s->table[i];
-  if (e.refcount > 0) e.refcount--;
-  uint64_t pid = (uint64_t)getpid();
-  bool tracked = false;
-  for (uint32_t k = 0; k < kReaderSlots; k++) {
-    if (e.reader_pids[k] == pid && e.reader_counts[k] > 0) {
-      if (--e.reader_counts[k] == 0) e.reader_pids[k] = 0;
-      tracked = true;
-      break;
-    }
-  }
-  if (!tracked && e.untracked_refs > 0) e.untracked_refs--;
+  release_locked(s->table[i], (uint64_t)getpid());
   unlock(h);
   return TS_OK;
 }
@@ -693,6 +703,48 @@ int store_contains(void* sp, const uint8_t* id) {
   int sealed = (i != h->table_cap && s->table[i].state == kSealed) ? 1 : 0;
   unlock(h);
   return sealed;
+}
+
+// Batched non-blocking get: ONE lock acquisition resolves n ids (the
+// driver's hot get([...]) path — per-object store_get/_release/_contains
+// round trips dominate a 1 KiB get). ids = n*kIdLen key bytes; per-id
+// results in offs/dszs/rcs (TS_OK or TS_NOT_FOUND). A hit bumps
+// refcount + reader tracking exactly like store_get.
+int store_get_many(void* sp, const uint8_t* ids, int n,
+                   uint64_t* offs, uint64_t* dszs, int* rcs) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  uint64_t pid = (uint64_t)getpid();
+  lock(h);
+  for (int k = 0; k < n; k++) {
+    uint64_t i = find(s, ids + (uint64_t)k * kIdLen);
+    if (i == h->table_cap || s->table[i].state != kSealed) {
+      rcs[k] = TS_NOT_FOUND;
+      continue;
+    }
+    ObjectEntry& e = s->table[i];
+    acquire_locked(h, e, pid);
+    offs[k] = e.offset;
+    dszs[k] = e.data_size;
+    rcs[k] = TS_OK;
+  }
+  unlock(h);
+  return TS_OK;
+}
+
+// Symmetric batched release for store_get_many hits.
+int store_release_many(void* sp, const uint8_t* ids, int n) {
+  Store* s = (Store*)sp;
+  Header* h = s->hdr;
+  uint64_t pid = (uint64_t)getpid();
+  lock(h);
+  for (int k = 0; k < n; k++) {
+    uint64_t i = find(s, ids + (uint64_t)k * kIdLen);
+    if (i == h->table_cap) continue;
+    release_locked(s->table[i], pid);
+  }
+  unlock(h);
+  return TS_OK;
 }
 
 // Drop created-but-never-sealed entries of crashed writers. pid == 0 means

@@ -184,3 +184,61 @@ def test_many_objects_fragmentation(store):
             store.delete(oid(1000 + i))
     st = store.stats()
     assert st["num_objects"] == 0
+
+
+def test_get_many_hits_and_misses(store):
+    store.put(oid(20), b"a" * 64)
+    store.put(oid(21), b"b" * 128)
+    store.create(oid(22), 16)  # created but UNSEALED -> miss
+    views = store.get_many([oid(20), oid(99), oid(21), oid(22)])
+    assert bytes(views[0]) == b"a" * 64
+    assert views[1] is None          # absent
+    assert bytes(views[2]) == b"b" * 128
+    assert views[3] is None          # unsealed
+    # hits hold read refs: delete refuses until released
+    assert not store.delete(oid(20))
+    del views
+    store.release_many([oid(20), oid(21)])
+    assert store.delete(oid(20))
+    assert store.delete(oid(21))
+
+
+def test_get_many_duplicate_ids_refcount_symmetry(store):
+    store.put(oid(30), b"dup")
+    ids = [oid(30)] * 5
+    views = store.get_many(ids)
+    assert all(bytes(v) == b"dup" for v in views)
+    del views
+    assert not store.delete(oid(30))   # 5 refs held
+    store.release_many(ids)            # symmetric: all 5 dropped
+    assert store.delete(oid(30))
+
+
+def test_release_many_absent_ids_noop(store):
+    store.put(oid(40), b"x")
+    # releasing ids that were never acquired must not underflow others
+    store.release_many([oid(40), oid(41), oid(40)])
+    assert store.delete(oid(40))
+
+
+def test_driver_get_fast_path_error_object_order():
+    """An error object mid-list raises (in order) through the batched
+    fast path, and the read refs are released (shutdown stays clean)."""
+    import ray_tpu
+    from ray_tpu.utils import exceptions as exc
+
+    ray_tpu.init()
+    try:
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("fastpath-err")
+
+        good = [ray_tpu.put(i) for i in range(10)]
+        bad = boom.remote()
+        done, _ = ray_tpu.wait([bad], timeout=30)
+        assert done
+        with pytest.raises(exc.TaskError, match="fastpath-err"):
+            ray_tpu.get(good + [bad] + good)
+        assert ray_tpu.get(good) == list(range(10))
+    finally:
+        ray_tpu.shutdown()
