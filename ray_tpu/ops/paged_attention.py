@@ -20,6 +20,8 @@ Layout:
 
 from __future__ import annotations
 
+import hashlib
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import jax
@@ -170,6 +172,99 @@ def paged_attention(q, cache: PagedKVCache, layer: int, *,
     out = jnp.einsum("bgrk,bkgd->bgrd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.reshape(b, nh, hd).astype(q.dtype)
+
+
+def page_hashes(tokens: np.ndarray, page_size: int) -> list[bytes]:
+    """Chained content hashes of the FULL pages of a token sequence —
+    hash i covers tokens[0 : (i+1)*page_size], so equal hash means equal
+    whole prefix (the prefix-cache key; vLLM's automatic prefix caching
+    uses the same chained-block-hash scheme). Partial trailing pages are
+    never hashed: only fully-written pages are shareable."""
+    out: list[bytes] = []
+    h = b""
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+    for i in range(len(toks) // page_size):
+        h = hashlib.blake2b(
+            h + toks[i * page_size:(i + 1) * page_size].tobytes(),
+            digest_size=16).digest()
+        out.append(h)
+    return out
+
+
+class PrefixCache:
+    """Host-side prefix-page registry: chained page hash -> page id, with
+    per-page refcounts and LRU eviction of unreferenced pages.
+
+    A page is in exactly one of three states: SHARED (refs > 0 — mapped
+    by at least one live slot's table; never evictable, never written),
+    CACHED-IDLE (refs == 0, still holds valid KV; evictable), or gone
+    (evicted — the id returned to the allocator's free list and its hash
+    mapping dropped, so no future lookup can see stale contents)."""
+
+    def __init__(self):
+        self._by_hash: dict[bytes, int] = {}
+        self._hash_of: dict[int, bytes] = {}
+        self._refs: dict[int, int] = {}
+        self._idle: OrderedDict[int, None] = OrderedDict()  # LRU order
+        self.hit_pages = 0
+        self.miss_pages = 0
+
+    def acquire(self, hashes: list[bytes]) -> list[int]:
+        """Longest contiguous run of cached pages for a hash chain; each
+        returned page's refcount is bumped (caller owns one release)."""
+        pages: list[int] = []
+        for hsh in hashes:
+            page = self._by_hash.get(hsh)
+            if page is None:
+                self.miss_pages += 1
+                break
+            pages.append(page)
+            self.hit_pages += 1
+            self._refs[page] = self._refs.get(page, 0) + 1
+            self._idle.pop(page, None)
+        return pages
+
+    def release(self, pages: list[int]):
+        """Drop one reference per page; unreferenced pages stay cached
+        but become evictable (most recently released = evicted last)."""
+        for page in pages:
+            n = self._refs.get(page, 0) - 1
+            if n > 0:
+                self._refs[page] = n
+            else:
+                self._refs.pop(page, None)
+                if page in self._hash_of:
+                    self._idle[page] = None
+                    self._idle.move_to_end(page)
+
+    def ref(self, page: int):
+        self._refs[page] = self._refs.get(page, 0) + 1
+        self._idle.pop(page, None)
+
+    def insert(self, hsh: bytes, page: int) -> bool:
+        """Register a freshly prefilled full page. False when the hash is
+        already cached (a concurrent identical prompt won registration;
+        the caller keeps its copy exclusive)."""
+        if hsh in self._by_hash:
+            return False
+        self._by_hash[hsh] = page
+        self._hash_of[page] = hsh
+        return True
+
+    def evictable(self) -> int:
+        return len(self._idle)
+
+    def evict(self, n: int) -> list[int]:
+        """Drop up to n least-recently-released idle pages from the
+        cache; the returned ids are free for reallocation (their hash
+        mappings are gone, so no lookup can alias the recycled page)."""
+        out: list[int] = []
+        while self._idle and len(out) < n:
+            page, _ = self._idle.popitem(last=False)
+            hsh = self._hash_of.pop(page)
+            self._by_hash.pop(hsh, None)
+            out.append(page)
+        return out
 
 
 # ---------------------------------------------------------------------------

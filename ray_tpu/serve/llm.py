@@ -109,6 +109,10 @@ class LLMEngine:
         # host-side slot state (mirrors cache.lengths but trusted copy)
         self._lengths = np.zeros((max_batch,), np.int32)
         self._last_tok = np.zeros((max_batch,), np.int32)
+        # bumped per admission into a slot: lets the pipelined loop tell
+        # "same slot, same request" from "same slot, NEW request" when
+        # deciding whether an in-flight chunk's tokens are still valid
+        self._slot_gen = np.zeros((max_batch,), np.int64)
         self._active: list[Request | None] = [None] * max_batch
         self._waiting: "queue.Queue[Request]" = queue.Queue()
         self._req_ids = itertools.count()
@@ -317,6 +321,16 @@ class LLMEngine:
         the request and stops admitting this round."""
         return True
 
+    def _pack_admit(self, req: "Request", slot: int, plen: int) -> tuple:
+        """Hook: build one admit item (req, slot, plen, padded) — the
+        tokens the prefill program must actually process, padded to a
+        power-of-two bucket (the paged engine packs only the
+        non-prefix-cached SUFFIX here)."""
+        bucket = min(_bucket(plen), self.max_len)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:plen] = req.prompt
+        return (req, slot, plen, padded)
+
     def _dispatch_prefill(self, part: list, bucket: int):
         """Hook: dispatch one prefill sub-batch (``part`` is a list of
         (req, slot, plen, padded)); returns the device first-tokens."""
@@ -361,10 +375,7 @@ class LLMEngine:
                 self._waiting.put(req)   # backpressure: retry later
                 self._admission_blocked = True
                 break
-            bucket = min(_bucket(plen), self.max_len)
-            padded = np.zeros((bucket,), np.int32)
-            padded[:plen] = req.prompt
-            admits.append((req, slot, plen, padded))
+            admits.append(self._pack_admit(req, slot, plen))
         if not admits:
             return
         # Group by bucket, then split each group into POWER-OF-TWO
@@ -395,6 +406,11 @@ class LLMEngine:
             req.first_token_t = time.monotonic()
             self.ttfts.append(req.ttft)
             self._active[slot] = req
+            # admission GENERATION: an in-flight decode chunk dispatched
+            # for this slot's PREVIOUS occupant must neither have its
+            # tokens emitted to the new request nor be chained from —
+            # slot indices alone can't tell the difference
+            self._slot_gen[slot] += 1
             self._lengths[slot] = plen
             self._emit(req, int(first))
         self._dev_dirty = True   # active set / lengths changed
@@ -526,10 +542,14 @@ class LLMEngine:
         # host mirror advances deterministically (+chunk per active
         # slot) — retired slots are reconciled at admission
         self._lengths[active_idx] += chunk
-        return toks, active_idx, chunk
+        gens = [int(self._slot_gen[i]) for i in active_idx]
+        return toks, active_idx, gens, chunk
 
-    def _emit_chunk(self, toks_np, active_idx):
-        for i in active_idx:
+    def _emit_chunk(self, toks_np, active_idx, gens):
+        for i, gen in zip(active_idx, gens):
+            if self._slot_gen[i] != gen:
+                continue   # slot re-admitted since dispatch: the chunk's
+                # tokens belong to the RETIRED occupant, not this request
             for t in range(toks_np.shape[0]):
                 req = self._active[i]
                 if req is None:
@@ -542,16 +562,16 @@ class LLMEngine:
         input token vector is chunk N's LAST row, left on device) — the
         per-chunk host sync + tunnel RTT overlaps compute instead of
         serializing with it."""
-        pending = None   # (device_toks, active_idx, chunk)
+        pending = None   # (device_toks, active_idx, gens, chunk)
         while not self._stop.is_set():
             self._admit()
             active_idx = [i for i, r in enumerate(self._active)
                           if r is not None]
             if not active_idx:
                 if pending is not None:
-                    toks, idxs, _ = pending
+                    toks, idxs, gens, _ = pending
                     pending = None
-                    self._emit_chunk(np.asarray(toks), idxs)
+                    self._emit_chunk(np.asarray(toks), idxs, gens)
                     continue
                 self._on_idle()
                 time.sleep(0.001)
@@ -560,15 +580,18 @@ class LLMEngine:
                 pending = self._dispatch_decode(
                     jnp.asarray(self._last_tok), active_idx)
                 continue
-            toks_prev, idx_prev, _ = pending
+            toks_prev, idx_prev, gens_prev, _ = pending
             # chain the next chunk on-device off the previous chunk's
-            # final token row, but only while the active set is stable
-            # (admission/retirement changes inputs host-side)
-            if idx_prev == active_idx:
+            # final token row, but only while the active set is stable —
+            # same slots AND same occupants (a slot retired and refilled
+            # between chunks would otherwise chain the new request's
+            # decode off the previous occupant's stale token row)
+            cur_gens = [int(self._slot_gen[i]) for i in active_idx]
+            if idx_prev == active_idx and gens_prev == cur_gens:
                 nxt = self._dispatch_decode(toks_prev[-1], active_idx)
             else:
                 nxt = None
-            self._emit_chunk(np.asarray(toks_prev), idx_prev)
+            self._emit_chunk(np.asarray(toks_prev), idx_prev, gens_prev)
             if nxt is None:
                 pending = None   # active set changed: re-dispatch fresh
             else:

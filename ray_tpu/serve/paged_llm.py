@@ -36,23 +36,35 @@ from ray_tpu.models import llama
 from ray_tpu.models.decoding import (_cached_attention,
                                      select_tokens)
 from ray_tpu.ops.norms import rms_norm
-from ray_tpu.ops.paged_attention import PageAllocator
+from ray_tpu.ops.paged_attention import (PageAllocator, PrefixCache,
+                                         page_hashes)
 from ray_tpu.ops.rope import apply_rope, rope_sin_cos
 from ray_tpu.serve.llm import LLMEngine, _bucket
 
 
 class PagedLLMEngine(LLMEngine):
-    """LLMEngine with a paged KV cache (see module docstring)."""
+    """LLMEngine with a paged KV cache (see module docstring).
+
+    With ``prefix_cache=True`` (default), full prompt pages are also a
+    content-addressed PREFIX CACHE (vLLM-style automatic prefix caching,
+    chained page hashes — reference repo has no serving engine at all):
+    a new request whose prompt starts with an already-cached page chain
+    reuses those pages read-only and prefills only the suffix, cutting
+    both TTFT and prefill compute for shared-system-prompt workloads.
+    Unreferenced cached pages stay resident and are evicted LRU only
+    when admission needs their space."""
 
     def __init__(self, cfg, params, *, max_batch: int = 8,
                  max_len: int = 2048, decode_chunk: int = 16,
-                 page_size: int = 128, num_pages: int | None = None):
+                 page_size: int = 128, num_pages: int | None = None,
+                 prefix_cache: bool = True):
         self.page_size = page_size
         self.max_pages_per_seq = -(-max_len // page_size)
         # default pool: half the dense equivalent — the paged layout's
         # raison d'être is NOT reserving worst-case length per slot
         self.num_pages = (num_pages if num_pages is not None
                           else max_batch * self.max_pages_per_seq // 2)
+        self._prefix_enabled = prefix_cache
         super().__init__(cfg, params, max_batch=max_batch,
                          max_len=max_len, decode_chunk=decode_chunk)
 
@@ -75,6 +87,13 @@ class PagedLLMEngine(LLMEngine):
         self._deferred_free: list[list[int]] = []
         self._decode_cache: dict[tuple[int, int], object] = {}
         self._prefill_cache: dict[int, object] = {}
+        # prefix cache state: shared (read-only, refcounted) pages per
+        # slot, the slot's cached-prefix token count, and the full-page
+        # hash chain awaiting registration after its prefill dispatch
+        self._prefix = PrefixCache()
+        self._shared: dict[int, list[int]] = {}
+        self._prefix_len = np.zeros((self.max_batch,), np.int32)
+        self._pending_hashes: dict[int, list[bytes]] = {}
 
     def _decode_paged(self, chunk: int, pages_bucket: int):
         key = (chunk, pages_bucket)
@@ -87,15 +106,25 @@ class PagedLLMEngine(LLMEngine):
             self._decode_cache[key] = fn
         return fn
 
-    def _prefill_paged(self):
-        fn = self._prefill_cache.get(0)
+    def _prefill_paged(self, window_pages: int):
+        """Prefill program gathering a ``window_pages``-page KV window —
+        bucketed like decode so a short-prompt batch reads a fraction of
+        the full window's KV bytes (the window must cover every row's
+        start + suffix)."""
+        fn = self._prefill_cache.get(window_pages)
         if fn is None:
             fn = jax.jit(
                 partial(self._paged_prefill_impl, self.cfg,
                         page_size=self.page_size),
                 donate_argnums=(1, 2))
-            self._prefill_cache[0] = fn
+            self._prefill_cache[window_pages] = fn
         return fn
+
+    def _window_pages(self, max_covered: int) -> int:
+        """Power-of-two page count covering ``max_covered`` tokens,
+        clamped to the table width."""
+        need = max(1, -(-max_covered // self.page_size))
+        return min(_bucket(need, minimum=1), self.max_pages_per_seq)
 
     # -- jitted programs ---------------------------------------------------
 
@@ -168,24 +197,33 @@ class PagedLLMEngine(LLMEngine):
 
     @staticmethod
     def _paged_prefill_impl(cfg, params, k_pages, v_pages, table_rows,
-                            tokens, plens, temps, key, *, page_size):
-        """Prefill ``n`` prompts (one padded bucket) with plain causal
-        self-attention, writing their KV into pages, and sample each
-        row's first token. table_rows: [n, max_pages_per_seq]."""
+                            tokens, slens, starts, temps, key, *,
+                            page_size):
+        """Prefill ``n`` prompt SUFFIXES (one padded bucket) into pages
+        and sample each row's first token. ``tokens`` holds only the
+        tokens past each row's cached prefix (``starts`` absolute
+        offsets; 0 = no prefix reuse, the plain prefill). Suffix KV is
+        written into the pages first, then attention runs over the
+        row's whole gathered page window, so suffix queries see the
+        reused prefix KV exactly as the original prompt computed it.
+        table_rows: [n, max_pages_per_seq]."""
         num_pages = k_pages.shape[1]
         n, t = tokens.shape
+        mp = table_rows.shape[1]
+        s = mp * page_size
         scale = cfg.head_dim ** -0.5
         x = params["embedding"][tokens]
-        positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+        rel = jnp.arange(t, dtype=jnp.int32)
+        positions = starts[:, None] + rel[None, :]            # [n, T]
         sin, cos = rope_sin_cos(positions, cfg.head_dim,
                                 theta=cfg.rope_theta)
-        pos = jnp.arange(t, dtype=jnp.int32)
-        pidx_all = table_rows[:, pos // page_size]            # [n, T]
-        valid = pos[None, :] < plens[:, None]                 # [n, T]
+        pidx_all = jnp.take_along_axis(
+            table_rows, positions // page_size, axis=1)       # [n, T]
+        valid = rel[None, :] < slens[:, None]                 # [n, T]
         pidx_all = jnp.where((pidx_all >= 0) & valid, pidx_all,
                              num_pages)
-        ip_all = jnp.broadcast_to(pos % page_size, (n, t))
-        start = jnp.zeros((n,), jnp.int32)
+        ip_all = positions % page_size
+        table_c = jnp.maximum(table_rows, 0)
 
         def block(x, xs):
             p, kp, vp = xs
@@ -199,8 +237,13 @@ class PagedLLMEngine(LLMEngine):
                                              mode="drop")
             vp = vp.at[pidx_all, ip_all].set(v.astype(vp.dtype),
                                              mode="drop")
-            # prompt-only causal self-attention (cache was empty)
-            attn = _cached_attention(q, k, v, start, scale=scale)
+            # gather the whole window AFTER the suffix writes: queries
+            # attend over cached prefix + their own fresh KV; positions
+            # beyond start+i are masked causally, stale page contents
+            # beyond the prompt never influence the result
+            kg = kp[table_c].reshape(n, s, cfg.n_kv_heads, cfg.head_dim)
+            vg = vp[table_c].reshape(n, s, cfg.n_kv_heads, cfg.head_dim)
+            attn = _cached_attention(q, kg, vg, starts, scale=scale)
             x = x + attn.reshape(n, t, -1) @ p["wo"]
             h = rms_norm(x, p["mlp_norm"], eps=cfg.rms_eps)
             gated = jax.nn.silu(h @ p["w_gate"]) * (h @ p["w_up"])
@@ -211,7 +254,7 @@ class PagedLLMEngine(LLMEngine):
             block, x, (params["blocks"], k_pages, v_pages))
         x = rms_norm(x, params["final_norm"], eps=cfg.rms_eps)
         x = jnp.take_along_axis(
-            x, (plens - 1)[:, None, None], axis=1).squeeze(1)
+            x, (slens - 1)[:, None, None], axis=1).squeeze(1)
         head = llama.lm_head_weights(cfg, params)
         logits = jnp.einsum("bd,dv->bv", x, head,
                             preferred_element_type=jnp.float32)
@@ -222,9 +265,11 @@ class PagedLLMEngine(LLMEngine):
 
     def _pages_bucket(self) -> int:
         """Power-of-two page count covering every live slot's RESERVED
-        pages (chained chunks may run ahead of the host's view of
-        lengths, but never past the reservation)."""
+        pages — exclusive AND shared-prefix (chained chunks may run
+        ahead of the host's view of lengths, but never past the
+        reservation)."""
         owned = [len(self._alloc.owned.get(i, ()))
+                 + len(self._shared.get(i, ()))
                  for i, r in enumerate(self._active) if r is not None]
         need = max(owned) if owned else 1
         pb = 1
@@ -254,7 +299,14 @@ class PagedLLMEngine(LLMEngine):
     def _reserve_slot_resources(self, req, slot: int) -> bool:
         """Reserve-on-admit: pages for prompt + token budget + one page
         of chained-dispatch overshoot; exhaustion = backpressure (the
-        base _admit requeues the request until pages free up)."""
+        base _admit requeues the request until pages free up).
+
+        With the prefix cache, cached full-prefix pages are mapped
+        read-only into the slot's table (refcounted, never re-written:
+        suffix writes start at the first non-reused page boundary and
+        decode writes past the prompt) and only the remainder is
+        allocated fresh; idle cached pages are LRU-evicted into the
+        free list when admission needs the space."""
         plen = len(req.prompt)
         budget = min(plen + req.max_new_tokens, self.max_len)
         pages = min(-(-budget // self.page_size) + 1,
@@ -268,33 +320,104 @@ class PagedLLMEngine(LLMEngine):
                 f"pool holds only {self.num_pages}; raise num_pages or "
                 f"lower max_new_tokens")
             return False
-        if len(self._alloc.free) < pages:
+        hits: list[int] = []
+        hashes: list[bytes] = []
+        if self._prefix_enabled:
+            prompt = np.asarray(req.prompt, np.int32)
+            hashes = page_hashes(prompt, self.page_size)
+            # keep at least one suffix token: the first output token is
+            # sampled from the suffix prefill's logits
+            max_reuse = (plen - 1) // self.page_size
+            hits = self._prefix.acquire(hashes[:max_reuse])
+        n_fresh = pages - len(hits)
+        if n_fresh > len(self._alloc.free) + self._prefix.evictable():
+            self._prefix.release(hits)   # nothing dispatched yet
             return False
-        page_ids = self._alloc.alloc(slot, pages)
+        if n_fresh > len(self._alloc.free):
+            self._alloc.free.extend(
+                self._prefix.evict(n_fresh - len(self._alloc.free)))
+        page_ids = self._alloc.alloc(slot, n_fresh)
         self._table[slot, :] = -1
-        self._table[slot, :pages] = page_ids
+        if hits:
+            self._table[slot, :len(hits)] = hits
+        self._table[slot, len(hits):pages] = page_ids
+        self._shared[slot] = list(hits)
+        self._prefix_len[slot] = len(hits) * self.page_size
+        if self._prefix_enabled:
+            self._pending_hashes[slot] = hashes
         return True
 
+    def _pack_admit(self, req, slot: int, plen: int) -> tuple:
+        """Pack only the SUFFIX past the slot's cached prefix — a
+        shared-prefix request prefills (and buckets) just its tail."""
+        start = int(self._prefix_len[slot])
+        suffix = np.asarray(req.prompt, np.int32)[start:]
+        bucket = min(_bucket(len(suffix)), self.max_len)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:len(suffix)] = suffix
+        return (req, slot, plen, padded)
+
     def _dispatch_prefill(self, part: list, bucket: int):
-        prefill = self._prefill_paged()
         tokens = jnp.asarray(np.stack([it[3] for it in part]))
-        plens = jnp.asarray(np.array([it[2] for it in part], np.int32))
+        starts_np = np.array([self._prefix_len[it[1]] for it in part],
+                             np.int32)
+        slens_np = np.array([it[2] for it in part], np.int32) - starts_np
+        wp = self._window_pages(int((starts_np + slens_np).max()))
+        prefill = self._prefill_paged(wp)
+        slens = jnp.asarray(slens_np)
         rows = jnp.asarray(np.stack(
-            [self._table[it[1]] for it in part]))
+            [self._table[it[1]][:wp] for it in part]))
         temps = jnp.asarray(np.array(
             [it[0].temperature for it in part], np.float32))
         self._k_pages, self._v_pages, firsts = prefill(
             self.params, self._k_pages, self._v_pages, rows, tokens,
-            plens, temps, self._next_key())
+            slens, jnp.asarray(starts_np), temps, self._next_key())
+        # the dispatch above is what makes each slot's full prompt pages
+        # valid on device: REGISTER them in the prefix cache now — any
+        # future admission's prefill program runs after this one on the
+        # device stream, so a reader can never observe unwritten pages
+        for req, slot, plen, _ in part:
+            self._register_prefix(slot, plen)
         return firsts
+
+    def _register_prefix(self, slot: int, plen: int):
+        """Move this slot's freshly prefilled FULL prompt pages into the
+        prefix cache (reused pages are already registered). A page that
+        becomes cached is reclassified exclusive -> shared so retirement
+        releases a reference instead of freeing it."""
+        hashes = self._pending_hashes.pop(slot, [])
+        if not hashes:
+            return
+        owned = self._alloc.owned.get(slot, [])
+        shared = self._shared.setdefault(slot, [])
+        n_shared = len(shared)
+        for i in range(n_shared, min(len(hashes), plen // self.page_size)):
+            page = int(self._table[slot, i])
+            if page < 0 or not self._prefix.insert(hashes[i], page):
+                # hash raced in from an identical concurrent prompt:
+                # keep our copy exclusive (freed normally at retirement)
+                continue
+            if page in owned:
+                owned.remove(page)
+            shared.append(page)
+            self._prefix.ref(page)
 
     def _on_slot_retired(self, slot: int):
         super()._on_slot_retired(slot)   # marks device inputs dirty
         # a chunk dispatched before this retirement was observed may
         # still write into the slot's own (reserved) pages: defer the
-        # free by two chunk syncs
+        # free by two chunk syncs. Shared prefix pages are released
+        # immediately — nothing ever WRITES them (suffix and decode
+        # positions lie past the prefix), and a stale in-flight read of
+        # a page later evicted + rewritten only feeds tokens the
+        # retired slot already discards.
         pages = self._alloc.owned.pop(slot, [])
+        shared = self._shared.pop(slot, [])
+        self._pending_hashes.pop(slot, None)
         self._table[slot, :] = -1
+        self._prefix_len[slot] = 0
+        if shared:
+            self._prefix.release(shared)
         if pages:
             self._deferred_free.append([2, pages])
 
@@ -308,8 +431,8 @@ class PagedLLMEngine(LLMEngine):
                 still.append(entry)
         self._deferred_free = still
 
-    def _emit_chunk(self, toks_np, active_idx):
-        super()._emit_chunk(toks_np, active_idx)
+    def _emit_chunk(self, toks_np, active_idx, gens):
+        super()._emit_chunk(toks_np, active_idx, gens)
         # one chunk sync elapsed: age the deferred frees
         self._age_deferred_frees()
 
@@ -321,19 +444,45 @@ class PagedLLMEngine(LLMEngine):
         if self._deferred_free:
             self._age_deferred_frees(drain_all=True)
 
-    def warmup(self, prompt_len: int):
-        """Compile the prefill program (each power-of-two group size at
-        this bucket) and the decode programs at every pages-bucket a
-        run can touch."""
-        bucket = min(_bucket(prompt_len), self.max_len)
-        prefill = self._prefill_paged()
+    def warmup_prefix(self, prefix_len: int, tail_len: int,
+                      max_n: int | None = None):
+        """Compile the SUFFIX prefill variants that prefix-cache hits
+        dispatch (tail bucket + the window covering prefix+tail), so a
+        deployment with a known system-prompt shape doesn't pay XLA
+        compilation inside the first shared-prefix request's TTFT.
+        ``warmup`` alone only covers the cold (starts=0) path."""
+        bucket = min(_bucket(tail_len), self.max_len)
+        wp = self._window_pages(prefix_len + bucket)
+        prefill = self._prefill_paged(wp)
         n = 1
-        while n <= self.max_batch:
-            rows = jnp.full((n, self.max_pages_per_seq), -1, jnp.int32)
+        top = max_n if max_n is not None else self.max_batch
+        while n <= top:
+            rows = jnp.full((n, wp), -1, jnp.int32)
             self._k_pages, self._v_pages, firsts = prefill(
                 self.params, self._k_pages, self._v_pages, rows,
                 jnp.zeros((n, bucket), jnp.int32),
                 jnp.ones((n,), jnp.int32),
+                jnp.full((n,), prefix_len, jnp.int32),
+                jnp.zeros((n,), jnp.float32), self._next_key())
+            np.asarray(firsts)
+            n *= 2
+
+    def warmup(self, prompt_len: int):
+        """Compile the prefill program (each power-of-two group size at
+        this bucket) and the decode programs at every pages-bucket a
+        run can touch. For shared-prefix workloads also call
+        ``warmup_prefix`` with the expected (prefix, tail) shape."""
+        bucket = min(_bucket(prompt_len), self.max_len)
+        wp = self._window_pages(bucket)
+        prefill = self._prefill_paged(wp)
+        n = 1
+        while n <= self.max_batch:
+            rows = jnp.full((n, wp), -1, jnp.int32)
+            self._k_pages, self._v_pages, firsts = prefill(
+                self.params, self._k_pages, self._v_pages, rows,
+                jnp.zeros((n, bucket), jnp.int32),
+                jnp.ones((n,), jnp.int32),
+                jnp.zeros((n,), jnp.int32),
                 jnp.zeros((n,), jnp.float32), self._next_key())
             np.asarray(firsts)
             n *= 2
@@ -365,6 +514,12 @@ class PagedLLMEngine(LLMEngine):
         out = super().stats()
         out["kv_pages_total"] = self.num_pages
         out["kv_pages_free"] = len(self._alloc.free)
+        out["prefix_cache"] = {
+            "enabled": self._prefix_enabled,
+            "hit_pages": self._prefix.hit_pages,
+            "miss_pages": self._prefix.miss_pages,
+            "cached_idle_pages": self._prefix.evictable(),
+        }
         out["kv_pages_bytes"] = int(
             self._k_pages.size * 2 * 2)   # K+V, bf16
         dense = (self.cfg.n_layers * self.max_batch * self.max_len

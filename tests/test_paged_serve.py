@@ -155,3 +155,90 @@ def test_temperature_sampling_runs(tiny):
     eng.stop()
     assert len(toks) == 12
     assert all(0 <= t < cfg.vocab_size for t in toks)
+
+
+def test_prefix_cache_reuse_and_correctness(tiny):
+    """Requests sharing a full-page prompt prefix reuse its cached KV
+    pages (suffix-only prefill) and produce EXACTLY the tokens a
+    prefix-cache-disabled engine produces."""
+    cfg, params = tiny
+    rng = np.random.default_rng(3)
+    base = rng.integers(1, cfg.vocab_size, 96)     # 3 full pages @ ps=32
+    prompts = [base,
+               np.concatenate([base, rng.integers(1, cfg.vocab_size, 20)]),
+               np.concatenate([base, rng.integers(1, cfg.vocab_size, 7)])]
+
+    ref = PagedLLMEngine(cfg=cfg, params=params, max_batch=2, max_len=256,
+                         page_size=32, prefix_cache=False)
+    _, out_ref = _run(ref, prompts)
+    st_ref = ref.stats()
+    ref.stop()
+    assert st_ref["prefix_cache"]["hit_pages"] == 0
+
+    eng = PagedLLMEngine(cfg=cfg, params=params, max_batch=2, max_len=256,
+                         page_size=32)
+    _, out = _run(eng, prompts)
+    st = eng.stats()
+    eng.stop()
+    assert out == out_ref
+    # at least the second wave's tailed prompt hit the base's 3 pages
+    assert st["prefix_cache"]["hit_pages"] >= 3
+
+
+def test_prefix_cache_eviction_under_pressure(tiny):
+    """Idle cached prefix pages are LRU-evicted when admission needs
+    their space; the engine keeps serving distinct prompts forever on a
+    small pool."""
+    cfg, params = tiny
+    rng = np.random.default_rng(4)
+    eng = PagedLLMEngine(cfg=cfg, params=params, max_batch=2, max_len=128,
+                         page_size=32, num_pages=8)
+    eng.start()
+    for _ in range(5):
+        r = eng.submit(rng.integers(1, cfg.vocab_size, 64),
+                       max_new_tokens=8)
+        assert len(list(r.tokens())) == 8
+    st = eng.stats()
+    eng.stop()
+    pc = st["prefix_cache"]
+    assert pc["cached_idle_pages"] + len(eng._alloc.free) <= eng.num_pages
+
+
+def test_prefix_cache_exact_prompt_repeat(tiny):
+    """Repeating an identical prompt reuses every full page except the
+    sampling tail (at least one suffix token always prefills so the
+    first output token has logits)."""
+    cfg, params = tiny
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, cfg.vocab_size, 64)   # exactly 2 full pages
+    eng = PagedLLMEngine(cfg=cfg, params=params, max_batch=1, max_len=128,
+                         page_size=32, num_pages=8)
+    eng.start()
+    r1 = eng.submit(prompt, max_new_tokens=6)
+    out1 = list(r1.tokens())
+    r2 = eng.submit(prompt, max_new_tokens=6)
+    out2 = list(r2.tokens())
+    st = eng.stats()
+    eng.stop()
+    assert out1 == out2                     # greedy + same prompt
+    # max reuse for plen 64 is (64-1)//32 = 1 page (suffix stays nonempty)
+    assert st["prefix_cache"]["hit_pages"] >= 1
+
+
+def test_warmup_prefix_compiles_suffix_variants(tiny):
+    """warmup_prefix pre-compiles the suffix-bucket programs so a
+    shared-prefix hit reuses a cached jit entry instead of compiling
+    inside its TTFT."""
+    cfg, params = tiny
+    eng = PagedLLMEngine(cfg=cfg, params=params, max_batch=2, max_len=256,
+                         page_size=32, num_pages=16)
+    eng.warmup_prefix(prefix_len=64, tail_len=20, max_n=2)
+    wp = eng._window_pages(64 + 32)    # tail bucket = 32
+    assert wp in eng._prefill_cache
+    rng = np.random.default_rng(6)
+    base = rng.integers(1, cfg.vocab_size, 64)
+    prompts = [base,
+               np.concatenate([base, rng.integers(1, cfg.vocab_size, 20)])]
+    _, outs = _run(eng, prompts, max_new=6)
+    eng.stop()
+    assert all(len(o) == 6 for o in outs)
