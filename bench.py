@@ -232,8 +232,11 @@ def bench_serve() -> dict:
     params = llama.init_params(model_cfg, jax.random.key(0))
     n_params = llama.num_params(params)
     eng = PagedLLMEngine(params=params, cfg=model_cfg,
+                         kv_dtype=os.environ.get("BENCH_KV_DTYPE", "bf16"),
                          max_batch=max_batch, max_len=max_len,
-                         decode_chunk=32 if preset != "small" else 8)
+                         decode_chunk=int(os.environ.get(
+                             "BENCH_DECODE_CHUNK",
+                             "32" if preset != "small" else "8")))
     # deterministic warmup BEFORE the loop starts: every prefill group
     # size + decode programs at every pages bucket compile now, so no
     # JIT lands inside a measured window

@@ -174,6 +174,24 @@ def paged_attention(q, cache: PagedKVCache, layer: int, *,
     return out.reshape(b, nh, hd).astype(q.dtype)
 
 
+def quantize_kv(x):
+    """Per-token-per-head symmetric int8 quantization of a K or V tensor
+    over its trailing head_dim axis: returns (int8 values, f32 scales
+    with the trailing axis dropped). Halves KV HBM (the pool holds 2x
+    the tokens) at <1% relative error — the standard serving-engine KV
+    compression (w8 KV in vLLM/TGI terms)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_kv(q, scale, dtype=jnp.bfloat16):
+    """Inverse of quantize_kv (scale broadcast over head_dim)."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def page_hashes(tokens: np.ndarray, page_size: int) -> list[bytes]:
     """Chained content hashes of the FULL pages of a token sequence —
     hash i covers tokens[0 : (i+1)*page_size], so equal hash means equal

@@ -19,6 +19,13 @@ continuous-batching loop of ``serve/llm.py``:
   backpressure when the pool is exhausted, and a mid-flight sequence
   can never fail an allocation — the deadlock-free policy (optimistic
   allocation + preemption is a future extension).
+- ``kv_dtype="int8"`` stores pages quantized (per-token-per-head
+  symmetric scales in a parallel scale pool): half the KV HBM, so the
+  same pool holds 2x the tokens in flight. Dequantization happens on
+  gather — a VPU cost per decode step — so it's a CAPACITY trade, the
+  right default only when KV footprint is the binding constraint
+  (long contexts / many concurrent slots); at small windows where
+  decode is weight-read-bound it measures ~35% slower (v5e, 0.5B).
 
 Engine mechanics (queues, continuous batching, chunked + pipelined
 decode, metrics) are inherited from ``LLMEngine``.
@@ -37,9 +44,33 @@ from ray_tpu.models.decoding import (_cached_attention,
                                      select_tokens)
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.paged_attention import (PageAllocator, PrefixCache,
-                                         page_hashes)
+                                         dequantize_kv, page_hashes,
+                                         quantize_kv)
 from ray_tpu.ops.rope import apply_rope, rope_sin_cos
 from ray_tpu.serve.llm import LLMEngine, _bucket
+
+
+def _write_gather_kv(kp, vp, ks, vs, k_new, v_new, pidx, ip, table_c,
+                     quantized):
+    """THE write-then-gather KV protocol, shared by decode and prefill
+    (shape-generic: decode writes one token per slot with [B] indices,
+    prefill a padded suffix with [n, T] indices). Writes k/v (+ scales
+    in int8 mode) at (pidx, ip) with out-of-bounds indices dropping,
+    then gathers the table_c page window, dequantizing if quantized."""
+    if quantized:
+        kq, ksc = quantize_kv(k_new)
+        vq, vsc = quantize_kv(v_new)
+        kp = kp.at[pidx, ip].set(kq, mode="drop")
+        vp = vp.at[pidx, ip].set(vq, mode="drop")
+        ks = ks.at[pidx, ip].set(ksc, mode="drop")
+        vs = vs.at[pidx, ip].set(vsc, mode="drop")
+        kg = dequantize_kv(kp[table_c], ks[table_c])
+        vg = dequantize_kv(vp[table_c], vs[table_c])
+    else:
+        kp = kp.at[pidx, ip].set(k_new.astype(kp.dtype), mode="drop")
+        vp = vp.at[pidx, ip].set(v_new.astype(vp.dtype), mode="drop")
+        kg, vg = kp[table_c], vp[table_c]
+    return kp, vp, ks, vs, kg, vg
 
 
 class PagedLLMEngine(LLMEngine):
@@ -57,7 +88,11 @@ class PagedLLMEngine(LLMEngine):
     def __init__(self, cfg, params, *, max_batch: int = 8,
                  max_len: int = 2048, decode_chunk: int = 16,
                  page_size: int = 128, num_pages: int | None = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, kv_dtype: str = "bf16"):
+        if kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"kv_dtype must be 'bf16' or 'int8', "
+                             f"got {kv_dtype!r}")
+        self.kv_dtype = kv_dtype
         self.page_size = page_size
         self.max_pages_per_seq = -(-max_len // page_size)
         # default pool: half the dense equivalent — the paged layout's
@@ -75,8 +110,15 @@ class PagedLLMEngine(LLMEngine):
         nkv = getattr(cfg, "n_kv_heads", None) or cfg.n_heads
         shape = (cfg.n_layers, self.num_pages, self.page_size, nkv,
                  cfg.head_dim)
-        self._k_pages = jnp.zeros(shape, jnp.bfloat16)
-        self._v_pages = jnp.zeros(shape, jnp.bfloat16)
+        page_dtype = jnp.int8 if self.kv_dtype == "int8" else jnp.bfloat16
+        self._k_pages = jnp.zeros(shape, page_dtype)
+        self._v_pages = jnp.zeros(shape, page_dtype)
+        # per-token-per-head dequant scales (int8 mode; tiny dummies in
+        # bf16 mode so every program shares one signature/donation set)
+        scale_shape = (shape[:-1] if self.kv_dtype == "int8"
+                       else (cfg.n_layers, 1, 1, 1))
+        self._k_scale = jnp.ones(scale_shape, jnp.float32)
+        self._v_scale = jnp.ones(scale_shape, jnp.float32)
         self._table = np.full((self.max_batch, self.max_pages_per_seq),
                               -1, np.int32)
         self._alloc = PageAllocator(self.num_pages)
@@ -101,8 +143,9 @@ class PagedLLMEngine(LLMEngine):
         if fn is None:
             fn = jax.jit(
                 partial(self._paged_decode_impl, self.cfg, chunk=chunk,
-                        page_size=self.page_size),
-                donate_argnums=(1, 2))
+                        page_size=self.page_size,
+                        quantized=self.kv_dtype == "int8"),
+                donate_argnums=(1, 2, 3, 4))
             self._decode_cache[key] = fn
         return fn
 
@@ -115,8 +158,9 @@ class PagedLLMEngine(LLMEngine):
         if fn is None:
             fn = jax.jit(
                 partial(self._paged_prefill_impl, self.cfg,
-                        page_size=self.page_size),
-                donate_argnums=(1, 2))
+                        page_size=self.page_size,
+                        quantized=self.kv_dtype == "int8"),
+                donate_argnums=(1, 2, 3, 4))
             self._prefill_cache[window_pages] = fn
         return fn
 
@@ -129,11 +173,14 @@ class PagedLLMEngine(LLMEngine):
     # -- jitted programs ---------------------------------------------------
 
     @staticmethod
-    def _paged_decode_impl(cfg, params, k_pages, v_pages, table, tokens,
-                           lengths, active, temps, key, *, chunk,
-                           page_size):
+    def _paged_decode_impl(cfg, params, k_pages, v_pages, k_scale,
+                           v_scale, table, tokens, lengths, active,
+                           temps, key, *, chunk, page_size, quantized):
         """``chunk`` decode steps over every slot; KV pages written and
-        gathered through the (bucketed) page table [B, PB]."""
+        gathered through the (bucketed) page table [B, PB]. In int8
+        mode (``quantized``) writes quantize per token+head and gathers
+        dequantize against the scale pages — half the KV bytes per
+        step."""
         num_pages = k_pages.shape[1]
         b, pb = table.shape
         s = pb * page_size
@@ -141,7 +188,7 @@ class PagedLLMEngine(LLMEngine):
         table_c = jnp.maximum(table, 0)
 
         def one_step(carry, _):
-            k_pages, v_pages, toks, lens, key = carry
+            k_pages, v_pages, k_scale, v_scale, toks, lens, key = carry
             key, sub = jax.random.split(key)
             pos = jnp.where(active, lens, 0)                    # [B]
             x = params["embedding"][toks[:, None]]              # [B,1,d]
@@ -155,7 +202,7 @@ class PagedLLMEngine(LLMEngine):
             ip = pos % page_size
 
             def block(x, xs):
-                p, kp, vp = xs
+                p, kp, vp, ks, vs = xs
                 h = rms_norm(x, p["attn_norm"], eps=cfg.rms_eps)
                 q = (h @ p["wq"]).reshape(b, 1, cfg.n_heads, cfg.head_dim)
                 k = (h @ p["wk"]).reshape(b, 1, cfg.n_kv_heads,
@@ -164,41 +211,42 @@ class PagedLLMEngine(LLMEngine):
                                           cfg.head_dim)
                 q = apply_rope(q, sin, cos)
                 k = apply_rope(k, sin, cos)
-                kp = kp.at[pidx, ip].set(k[:, 0].astype(kp.dtype),
-                                         mode="drop")
-                vp = vp.at[pidx, ip].set(v[:, 0].astype(vp.dtype),
-                                         mode="drop")
-                # gather this slot's window [B, PB, page, nkv, hd]
-                kg = kp[table_c].reshape(b, s, cfg.n_kv_heads,
-                                         cfg.head_dim)
-                vg = vp[table_c].reshape(b, s, cfg.n_kv_heads,
-                                         cfg.head_dim)
+                kp, vp, ks, vs, kg, vg = _write_gather_kv(
+                    kp, vp, ks, vs, k[:, 0], v[:, 0], pidx, ip,
+                    table_c, quantized)
+                # this slot's window [B, PB, page, nkv, hd]
+                kg = kg.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+                vg = vg.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
                 attn = _cached_attention(q, kg, vg, pos, scale=scale)
                 x = x + attn.reshape(b, 1, -1) @ p["wo"]
                 h = rms_norm(x, p["mlp_norm"], eps=cfg.rms_eps)
                 gated = jax.nn.silu(h @ p["w_gate"]) * (h @ p["w_up"])
                 x = x + gated @ p["w_down"]
-                return x, (kp, vp)
+                return x, (kp, vp, ks, vs)
 
-            x, (k_pages, v_pages) = jax.lax.scan(
-                block, x, (params["blocks"], k_pages, v_pages))
+            x, (k_pages, v_pages, k_scale, v_scale) = jax.lax.scan(
+                block, x,
+                (params["blocks"], k_pages, v_pages, k_scale, v_scale))
             x = rms_norm(x, params["final_norm"], eps=cfg.rms_eps)[:, 0]
             head = llama.lm_head_weights(cfg, params)
             logits = jnp.einsum("bd,dv->bv", x, head,
                                 preferred_element_type=jnp.float32)
             nxt = select_tokens(logits, temps, sub)
             lens = jnp.where(active, lens + 1, lens)
-            return (k_pages, v_pages, nxt, lens, key), nxt
+            return (k_pages, v_pages, k_scale, v_scale, nxt, lens,
+                    key), nxt
 
-        (k_pages, v_pages, _, lens, _), toks = jax.lax.scan(
-            one_step, (k_pages, v_pages, tokens, lengths, key), None,
-            length=chunk)
-        return k_pages, v_pages, toks, lens
+        (k_pages, v_pages, k_scale, v_scale, _, lens, _), toks = \
+            jax.lax.scan(
+                one_step,
+                (k_pages, v_pages, k_scale, v_scale, tokens, lengths,
+                 key), None, length=chunk)
+        return k_pages, v_pages, k_scale, v_scale, toks, lens
 
     @staticmethod
-    def _paged_prefill_impl(cfg, params, k_pages, v_pages, table_rows,
-                            tokens, slens, starts, temps, key, *,
-                            page_size):
+    def _paged_prefill_impl(cfg, params, k_pages, v_pages, k_scale,
+                            v_scale, table_rows, tokens, slens, starts,
+                            temps, key, *, page_size, quantized):
         """Prefill ``n`` prompt SUFFIXES (one padded bucket) into pages
         and sample each row's first token. ``tokens`` holds only the
         tokens past each row's cached prefix (``starts`` absolute
@@ -226,32 +274,32 @@ class PagedLLMEngine(LLMEngine):
         table_c = jnp.maximum(table_rows, 0)
 
         def block(x, xs):
-            p, kp, vp = xs
+            p, kp, vp, ks, vs = xs
             h = rms_norm(x, p["attn_norm"], eps=cfg.rms_eps)
             q = (h @ p["wq"]).reshape(n, t, cfg.n_heads, cfg.head_dim)
             k = (h @ p["wk"]).reshape(n, t, cfg.n_kv_heads, cfg.head_dim)
             v = (h @ p["wv"]).reshape(n, t, cfg.n_kv_heads, cfg.head_dim)
             q = apply_rope(q, sin, cos)
             k = apply_rope(k, sin, cos)
-            kp = kp.at[pidx_all, ip_all].set(k.astype(kp.dtype),
-                                             mode="drop")
-            vp = vp.at[pidx_all, ip_all].set(v.astype(vp.dtype),
-                                             mode="drop")
+            kp, vp, ks, vs, kg, vg = _write_gather_kv(
+                kp, vp, ks, vs, k, v, pidx_all, ip_all, table_c,
+                quantized)
             # gather the whole window AFTER the suffix writes: queries
             # attend over cached prefix + their own fresh KV; positions
             # beyond start+i are masked causally, stale page contents
             # beyond the prompt never influence the result
-            kg = kp[table_c].reshape(n, s, cfg.n_kv_heads, cfg.head_dim)
-            vg = vp[table_c].reshape(n, s, cfg.n_kv_heads, cfg.head_dim)
+            kg = kg.reshape(n, s, cfg.n_kv_heads, cfg.head_dim)
+            vg = vg.reshape(n, s, cfg.n_kv_heads, cfg.head_dim)
             attn = _cached_attention(q, kg, vg, starts, scale=scale)
             x = x + attn.reshape(n, t, -1) @ p["wo"]
             h = rms_norm(x, p["mlp_norm"], eps=cfg.rms_eps)
             gated = jax.nn.silu(h @ p["w_gate"]) * (h @ p["w_up"])
             x = x + gated @ p["w_down"]
-            return x, (kp, vp)
+            return x, (kp, vp, ks, vs)
 
-        x, (k_pages, v_pages) = jax.lax.scan(
-            block, x, (params["blocks"], k_pages, v_pages))
+        x, (k_pages, v_pages, k_scale, v_scale) = jax.lax.scan(
+            block, x, (params["blocks"], k_pages, v_pages, k_scale,
+                       v_scale))
         x = rms_norm(x, params["final_norm"], eps=cfg.rms_eps)
         x = jnp.take_along_axis(
             x, (slens - 1)[:, None, None], axis=1).squeeze(1)
@@ -259,7 +307,7 @@ class PagedLLMEngine(LLMEngine):
         logits = jnp.einsum("bd,dv->bv", x, head,
                             preferred_element_type=jnp.float32)
         first = select_tokens(logits, temps, key)
-        return k_pages, v_pages, first
+        return k_pages, v_pages, k_scale, v_scale, first
 
     # -- engine integration ------------------------------------------------
 
@@ -289,10 +337,11 @@ class PagedLLMEngine(LLMEngine):
             # writing table[slot] = -1 mid-transfer would hand the
             # in-flight chunk a torn table
             dev[key] = jnp.asarray(self._table[:, :pb].copy())
-        self._k_pages, self._v_pages, toks, lens = fn(
-            self.params, self._k_pages, self._v_pages, dev[key],
-            last_tok, dev["lens"], dev["active"], dev["temps"],
-            self._next_key(),
+        (self._k_pages, self._v_pages, self._k_scale, self._v_scale,
+         toks, lens) = fn(
+            self.params, self._k_pages, self._v_pages, self._k_scale,
+            self._v_scale, dev[key], last_tok, dev["lens"],
+            dev["active"], dev["temps"], self._next_key(),
         )
         return toks, lens
 
@@ -369,9 +418,11 @@ class PagedLLMEngine(LLMEngine):
             [self._table[it[1]][:wp] for it in part]))
         temps = jnp.asarray(np.array(
             [it[0].temperature for it in part], np.float32))
-        self._k_pages, self._v_pages, firsts = prefill(
-            self.params, self._k_pages, self._v_pages, rows, tokens,
-            slens, jnp.asarray(starts_np), temps, self._next_key())
+        (self._k_pages, self._v_pages, self._k_scale, self._v_scale,
+         firsts) = prefill(
+            self.params, self._k_pages, self._v_pages, self._k_scale,
+            self._v_scale, rows, tokens, slens, jnp.asarray(starts_np),
+            temps, self._next_key())
         # the dispatch above is what makes each slot's full prompt pages
         # valid on device: REGISTER them in the prefix cache now — any
         # future admission's prefill program runs after this one on the
@@ -458,8 +509,10 @@ class PagedLLMEngine(LLMEngine):
         top = max_n if max_n is not None else self.max_batch
         while n <= top:
             rows = jnp.full((n, wp), -1, jnp.int32)
-            self._k_pages, self._v_pages, firsts = prefill(
-                self.params, self._k_pages, self._v_pages, rows,
+            (self._k_pages, self._v_pages, self._k_scale,
+             self._v_scale, firsts) = prefill(
+                self.params, self._k_pages, self._v_pages,
+                self._k_scale, self._v_scale, rows,
                 jnp.zeros((n, bucket), jnp.int32),
                 jnp.ones((n,), jnp.int32),
                 jnp.full((n,), prefix_len, jnp.int32),
@@ -478,8 +531,10 @@ class PagedLLMEngine(LLMEngine):
         n = 1
         while n <= self.max_batch:
             rows = jnp.full((n, wp), -1, jnp.int32)
-            self._k_pages, self._v_pages, firsts = prefill(
-                self.params, self._k_pages, self._v_pages, rows,
+            (self._k_pages, self._v_pages, self._k_scale,
+             self._v_scale, firsts) = prefill(
+                self.params, self._k_pages, self._v_pages,
+                self._k_scale, self._v_scale, rows,
                 jnp.zeros((n, bucket), jnp.int32),
                 jnp.ones((n,), jnp.int32),
                 jnp.zeros((n,), jnp.int32),
@@ -499,8 +554,10 @@ class PagedLLMEngine(LLMEngine):
         for pb in buckets:
             for chunk in {self.decode_chunk, self._drain_chunk}:
                 fn = self._decode_paged(chunk, pb)
-                self._k_pages, self._v_pages, toks, _ = fn(
+                (self._k_pages, self._v_pages, self._k_scale,
+                 self._v_scale, toks, _) = fn(
                     self.params, self._k_pages, self._v_pages,
+                    self._k_scale, self._v_scale,
                     jnp.full((self.max_batch, pb), -1, jnp.int32),
                     jnp.zeros((self.max_batch,), jnp.int32),
                     jnp.zeros((self.max_batch,), jnp.int32), active,
@@ -520,8 +577,12 @@ class PagedLLMEngine(LLMEngine):
             "miss_pages": self._prefix.miss_pages,
             "cached_idle_pages": self._prefix.evictable(),
         }
+        out["kv_dtype"] = self.kv_dtype
+        scale_bytes = (self._k_scale.size * 4 * 2
+                       if self.kv_dtype == "int8" else 0)
         out["kv_pages_bytes"] = int(
-            self._k_pages.size * 2 * 2)   # K+V, bf16
+            self._k_pages.size * self._k_pages.dtype.itemsize * 2
+            + scale_bytes)   # K+V pages (+ dequant scales in int8 mode)
         dense = (self.cfg.n_layers * self.max_batch * self.max_len
                  * self._k_pages.shape[3] * self._k_pages.shape[4]
                  * 2 * 2)
