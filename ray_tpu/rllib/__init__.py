@@ -23,6 +23,7 @@ from ray_tpu.rllib.connectors import (
     UnsquashActions,
 )
 from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.dreamer import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.es import ARS, ARSConfig, ES, ESConfig
 from ray_tpu.rllib.env import (
     BanditEnv,
@@ -58,7 +59,7 @@ __all__ = ["A2C", "A2CConfig", "APPO", "APPOConfig", "ARS", "ARSConfig",
            "BC", "BCConfig", "BanditEnv", "BanditLinTS",
            "BanditLinTSConfig", "BanditLinUCB", "BanditLinUCBConfig",
            "CQL", "CQLConfig", "CartPole", "ContinuousBandit", "DQN",
-           "DQNConfig", "DatasetWriter", "ES", "ESConfig",
+           "DQNConfig", "DatasetWriter", "DreamerV3", "DreamerV3Config", "ES", "ESConfig",
            "GymEnvAdapter", "IMPALA", "IMPALAConfig", "LearnerGroup",
            "MARWIL",
            "MARWILConfig", "OfflineDataset", "PG", "PGConfig", "PPO",

@@ -36,6 +36,7 @@ class CartPole:
     def reset(self):
         self.state = self.rng.uniform(-0.05, 0.05, size=4)
         self.steps = 0
+        self.truncated = False
         return self.state.astype(np.float32)
 
     def step(self, action: int):
@@ -55,10 +56,13 @@ class CartPole:
         theta_dot = theta_dot + self.tau * thetaacc
         self.state = np.array([x, x_dot, theta, theta_dot])
         self.steps += 1
-        done = bool(
-            abs(x) > self.x_threshold
-            or abs(theta) > self.theta_threshold
-            or self.steps >= self.max_steps)
+        failed = bool(abs(x) > self.x_threshold
+                      or abs(theta) > self.theta_threshold)
+        done = failed or self.steps >= self.max_steps
+        # time-limit ends are TRUNCATIONS, not terminations — consumers
+        # that bootstrap values past episode ends (DreamerV3's continue
+        # head) must distinguish the two
+        self.truncated = bool(done and not failed)
         return self.state.astype(np.float32), 1.0, done, {}
 
 

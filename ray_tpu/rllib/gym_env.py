@@ -84,6 +84,9 @@ class GymEnvAdapter:
         else:
             act = int(np.asarray(action).reshape(-1)[0])
         raw, reward, terminated, truncated, info = self.env.step(act)
+        # exposed for consumers that must distinguish time-limit
+        # truncation from termination (value bootstrapping)
+        self.truncated = bool(truncated and not terminated)
         return (self._obs(raw), float(reward),
                 bool(terminated or truncated), info)
 
