@@ -14,7 +14,9 @@ stage() { echo; echo "=== CI stage: $1 ==="; }
 if [ "${1:-}" = "--nightly" ]; then
   stage "nightly scalability envelope (2k actors / 200k tasks / 5k args / 4 nodes)"
   python -m pytest tests/test_envelope_nightly.py -m nightly -q -s
-  echo "nightly envelope: green"
+  stage "nightly serve soak (paged engine page/refcount flatness)"
+  python -m pytest tests/test_serve_soak_nightly.py -m nightly -q -s
+  echo "nightly tiers: green"
   exit 0
 fi
 

@@ -30,28 +30,11 @@ from ray_tpu.rllib.env import make_env
 
 
 # ---------------------------------------------------------------------------
-# small pure-functional nets (mirrors the conventions of sac.py)
+# small pure-functional nets (shared MLP helpers come from sac.py, the
+# convention td3.py already follows)
 # ---------------------------------------------------------------------------
 
-def _init_mlp(key, sizes):
-    import jax
-
-    params = []
-    keys = jax.random.split(key, len(sizes) - 1)
-    for k, (n_in, n_out) in zip(keys, zip(sizes[:-1], sizes[1:])):
-        w = jax.random.normal(k, (n_in, n_out)) * (n_in ** -0.5)
-        params.append({"w": w, "b": np.zeros((n_out,), np.float32)})
-    return params
-
-
-def _mlp(params, x):
-    import jax.numpy as jnp
-
-    for i, layer in enumerate(params):
-        x = x @ layer["w"] + layer["b"]
-        if i < len(params) - 1:
-            x = jnp.tanh(x)
-    return x
+from ray_tpu.rllib.sac import _init_mlp, _mlp  # noqa: E402
 
 
 def _init_gru(key, x_dim, h_dim):
@@ -184,9 +167,12 @@ def _dreamer_update(params, target_critic, opt_wm, opt_actor, opt_critic,
                     tx_critic):
     """World model + imagination actor-critic in one program.
 
-    batch: obs [B,T,D], actions [B,T] int32, rewards [B,T],
-    is_first [B,T], cont [B,T] (1 - terminal). cfg_s is the static
-    (hashable) size/coef tuple."""
+    batch rows are ARRIVAL-ALIGNED (the reference DreamerV3 layout):
+    actions[t] is the action taken at t-1 that produced obs[t] (zero on
+    episode starts), rewards[t] arrived WITH obs[t], cont[t] is 0 iff
+    obs[t] is terminal. feat_t's GRU therefore encodes actions[t], which
+    is what makes the reward/continue heads' targets learnable for
+    action-dependent rewards. cfg_s is the static size/coef tuple."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -220,12 +206,10 @@ def _dreamer_update(params, target_critic, opt_wm, opt_actor, opt_critic,
 
         h0 = jnp.zeros((b, h_dim))
         z0 = jnp.zeros((b, z_dim))
-        # action fed at step t is the PREVIOUS step's action
-        a_prev = jnp.concatenate([jnp.zeros_like(acts[:, :1]),
-                                  acts[:, :-1]], axis=1)
+        # actions[t] already IS the action arriving at t (see docstring)
         (_, _, _), (hs, zs, prior_lg, post_lg) = jax.lax.scan(
             step, (h0, z0, k_wm),
-            (embed.transpose(1, 0, 2), a_prev.transpose(1, 0, 2),
+            (embed.transpose(1, 0, 2), acts.transpose(1, 0, 2),
              batch["is_first"].T))
         hs = hs.transpose(1, 0, 2)                              # [B,T,H]
         zs = zs.transpose(1, 0, 2)
@@ -237,9 +221,14 @@ def _dreamer_update(params, target_critic, opt_wm, opt_actor, opt_critic,
         rew_pred = _mlp(wm["reward"], feat)[..., 0]
         cont_pred = _mlp(wm["cont"], feat)[..., 0]              # logits
         recon_loss = jnp.mean(jnp.sum((recon - obs) ** 2, -1))
-        rew_loss = jnp.mean((rew_pred - symlog(batch["rewards"])) ** 2)
-        cont_loss = jnp.mean(
-            optax.sigmoid_binary_cross_entropy(cont_pred, batch["cont"]))
+        # fresh-reset rows have no arriving transition: mask their
+        # reward/continue targets (their stored values are placeholders)
+        m = 1.0 - batch["is_first"]
+        denom = jnp.maximum(jnp.sum(m), 1.0)
+        rew_loss = jnp.sum(
+            m * (rew_pred - symlog(batch["rewards"])) ** 2) / denom
+        cont_loss = jnp.sum(m * optax.sigmoid_binary_cross_entropy(
+            cont_pred, batch["cont"])) / denom
         # KL balancing with free bits (reference: dyn 0.5 / rep 0.1)
         kl_d = _kl_cats(jax.lax.stop_gradient(post_lg), prior_lg,
                         n_cats, n_classes)
@@ -286,40 +275,45 @@ def _dreamer_update(params, target_critic, opt_wm, opt_actor, opt_critic,
         f_last = jnp.concatenate([hl, zl], -1)
         return feats, logps, ents, f_last                     # [H,N,...]
 
-    feats, logps, ents, f_last = imagine(params["actor"])
-    feats_sg = jax.lax.stop_gradient(feats)
-    rewards = symexp(_mlp(wm_sg["reward"], feats_sg)[..., 0])   # [H,N]
-    conts = jax.nn.sigmoid(_mlp(wm_sg["cont"], feats_sg)[..., 0])
-    disc = gamma * conts
-
-    # lambda-returns bootstrapped with the EMA target critic
-    v_last = symexp(_mlp(target_critic, f_last)[..., 0])
-    vs = symexp(_mlp(target_critic, feats_sg)[..., 0])          # [H,N]
-
-    def ret_step(nxt, xs):
-        r, d, v = xs
-        ret = r + d * ((1.0 - lam) * v + lam * nxt)
-        return ret, ret
-
-    _, returns = jax.lax.scan(
-        ret_step, v_last,
-        (rewards[::-1], disc[::-1],
-         jnp.concatenate([vs[1:], v_last[None]], 0)[::-1]))
-    returns = returns[::-1]                                     # [H,N]
-
-    # percentile return normalization (EMA of the 5-95 range)
-    rng95 = jnp.percentile(returns, 95) - jnp.percentile(returns, 5)
-    ret_scale = 0.99 * ret_scale + 0.01 * jnp.maximum(rng95, 1.0)
-    adv = jax.lax.stop_gradient((returns - vs) / ret_scale)
-
     def actor_loss(actor):
-        _, lp, en, _ = imagine(actor)
-        return -jnp.mean(adv * lp) - entropy_coef * jnp.mean(en)
+        feats, logps, ents, f_last = imagine(actor)
+        feats_sg = jax.lax.stop_gradient(feats)
+        f_last_sg = jax.lax.stop_gradient(f_last)
+        # arrival-aligned heads: the reward/continue of taking a_k at
+        # f_k are predicted from the POST-transition features f_{k+1}
+        # (whose GRU encodes a_k) — matching the world-model targets
+        feats_next = jnp.concatenate([feats_sg[1:], f_last_sg[None]], 0)
+        rewards = symexp(_mlp(wm_sg["reward"], feats_next)[..., 0])
+        conts = jax.nn.sigmoid(_mlp(wm_sg["cont"], feats_next)[..., 0])
+        disc = gamma * conts                                   # [H,N]
 
-    # gradients only through logp/entropy (advantages are stopped); the
-    # imagination is re-run under the grad trace with the SAME keys so
-    # the sampled trajectory matches the one `adv` was computed for
-    a_loss, a_grads = jax.value_and_grad(actor_loss)(params["actor"])
+        # lambda-returns bootstrapped with the EMA target critic
+        vs = symexp(_mlp(target_critic, feats_sg)[..., 0])     # [H,N]
+        v_last = symexp(_mlp(target_critic, f_last_sg)[..., 0])
+        v_next = jnp.concatenate([vs[1:], v_last[None]], 0)
+
+        def ret_step(nxt, xs):
+            r, d, v = xs
+            ret = r + d * ((1.0 - lam) * v + lam * nxt)
+            return ret, ret
+
+        _, returns = jax.lax.scan(
+            ret_step, v_last,
+            (rewards[::-1], disc[::-1], v_next[::-1]))
+        returns = returns[::-1]                                 # [H,N]
+
+        # percentile return normalization (EMA of the 5-95 range)
+        rng95 = jnp.percentile(returns, 95) - jnp.percentile(returns, 5)
+        scale_new = 0.99 * ret_scale + 0.01 * jnp.maximum(rng95, 1.0)
+        adv = jax.lax.stop_gradient((returns - vs) / scale_new)
+        loss = -jnp.mean(adv * logps) - entropy_coef * jnp.mean(ents)
+        # ONE imagination pass serves everything: gradients flow only
+        # through logps/ents; returns/features come out as aux for the
+        # critic update
+        return loss, (feats_sg, returns, scale_new, jnp.mean(ents))
+
+    (a_loss, (feats_sg, returns, ret_scale, ent_mean)), a_grads = \
+        jax.value_and_grad(actor_loss, has_aux=True)(params["actor"])
     upd, opt_actor = tx_actor.update(a_grads, opt_actor, params["actor"])
     actor_new = optax.apply_updates(params["actor"], upd)
 
@@ -345,7 +339,7 @@ def _dreamer_update(params, target_critic, opt_wm, opt_actor, opt_critic,
         "actor_loss": a_loss,
         "critic_loss": c_loss,
         "imag_return_mean": jnp.mean(returns),
-        "policy_entropy": jnp.mean(ents),
+        "policy_entropy": ent_mean,
     }
     return (params, target_critic, opt_wm, opt_actor, opt_critic,
             ret_scale, metrics)
@@ -419,18 +413,34 @@ class _DreamerRolloutWorker:
         self.first = True
         self.h = np.zeros((self.h_dim,), np.float32)
         self.z = np.zeros((self.n_cats * self.n_classes,), np.float32)
-        self.a_prev = np.zeros((self.n_actions,), np.float32)
+        self.prev_action = 0
+        self.prev_reward = 0.0
         self.ep_ret = 0.0
 
     def sample(self, wm_np, actor_np, num_steps: int) -> dict:
+        """Collect ``num_steps`` env steps as ARRIVAL-ALIGNED rows:
+        row t = (obs_t, the action that produced obs_t, the reward that
+        arrived with obs_t, is_first, cont). Episode ends additionally
+        emit the terminal observation's row (cont=0 on termination, 1 on
+        time-limit truncation), so terminal rewards are trainable."""
         obs_l, act_l, rew_l, first_l, cont_l = [], [], [], [], []
         episode_returns = []
-        a_prev = self.a_prev   # carried across fragments mid-episode
         for _ in range(num_steps):
             if self.first:
                 self.h[:] = 0.0
                 self.z[:] = 0.0
-                a_prev[:] = 0.0
+                self.prev_action = 0
+                self.prev_reward = 0.0
+            # the row for the CURRENT (non-terminal) observation
+            obs_l.append(self.obs)
+            act_l.append(self.prev_action)
+            rew_l.append(self.prev_reward)
+            first_l.append(float(self.first))
+            cont_l.append(1.0)
+            # filtering policy: posterior over (h advanced by the
+            # arriving action, current obs embedding)
+            a_prev = np.zeros((self.n_actions,), np.float32)
+            a_prev[self.prev_action] = 1.0
             obs_sym = _np_symlog(self.obs)
             e = _np_mlp(wm_np["encoder"], obs_sym.astype(np.float32))
             self.h = _np_gru(wm_np["gru"],
@@ -443,29 +453,28 @@ class _DreamerRolloutWorker:
             for c in range(self.n_cats):
                 z[c, self.rng.choice(self.n_classes, p=probs[c])] = 1.0
             self.z = z.reshape(-1).astype(np.float32)
-            lg = _np_mlp(actor_np,
-                         np.concatenate([self.h, self.z]))
+            lg = _np_mlp(actor_np, np.concatenate([self.h, self.z]))
             a = int(self.rng.choice(self.n_actions, p=_np_softmax(lg)))
             next_obs, reward, done, _ = self.env.step(a)
-            obs_l.append(self.obs)
-            act_l.append(a)
-            rew_l.append(reward)
-            first_l.append(float(self.first))
-            terminal = bool(done) and not bool(
-                getattr(self.env, "truncated", False))
-            cont_l.append(0.0 if terminal else 1.0)
-            a_prev = np.zeros((self.n_actions,), np.float32)
-            a_prev[a] = 1.0
             self.ep_ret += reward
             self.first = False
             if done:
+                # terminal observation's row carries the final reward
+                terminal = not bool(getattr(self.env, "truncated",
+                                            False))
+                obs_l.append(next_obs)
+                act_l.append(a)
+                rew_l.append(reward)
+                first_l.append(0.0)
+                cont_l.append(0.0 if terminal else 1.0)
                 episode_returns.append(self.ep_ret)
                 self.ep_ret = 0.0
                 self.obs = self.env.reset()
                 self.first = True
             else:
+                self.prev_action = a
+                self.prev_reward = reward
                 self.obs = next_obs
-        self.a_prev = a_prev
         return {"obs": np.asarray(obs_l, np.float32),
                 "actions": np.asarray(act_l, np.int32),
                 "rewards": np.asarray(rew_l, np.float32),

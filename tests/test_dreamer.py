@@ -94,10 +94,10 @@ def test_cartpole_truncation_distinguished():
     done = False
     while not done:
         _, _, done, _ = env.step(0)
-    # ended either by falling or the 3-step cap; if capped without
-    # falling it must be marked truncated
-    if env.steps >= env.max_steps:
-        assert env.truncated in (True, False)  # attribute exists
+    # 3 steps from a near-zero init cannot tip the pole: the episode
+    # deterministically ended by the cap, which MUST read as truncation
+    assert env.steps == 3
+    assert env.truncated is True
     env.reset()
     assert env.truncated is False
 
@@ -127,3 +127,39 @@ def test_sequence_replay_marks_writer_joints():
     rng = np.random.default_rng(0)
     starts = [rng.integers(0, buf.size - 4 + 1) for _ in range(50)]
     assert max(starts) == buf.size - 4
+
+
+def test_reward_head_learns_action_dependent_rewards(algo):
+    """The arrival-aligned layout makes action-dependent rewards
+    learnable: rewards[t] is caused by actions[t], which feat_t's GRU
+    encodes. Synthetic batches where reward == action must drive the
+    reward loss well below the action-marginal floor (~0.25 MSE)."""
+    import jax
+
+    rng = np.random.default_rng(0)
+    c = algo.config
+
+    def batch():
+        acts = rng.integers(0, 2, (4, c.seq_len)).astype(np.int32)
+        obs = rng.standard_normal((4, c.seq_len,
+                                   algo.obs_dim)).astype(np.float32)
+        first = np.zeros((4, c.seq_len), np.float32)
+        first[:, 0] = 1.0
+        acts[:, 0] = 0
+        rew = acts.astype(np.float32)       # reward == arriving action
+        rew[:, 0] = 0.0
+        return {"obs": obs, "actions": acts, "rewards": rew,
+                "is_first": first, "cont": np.ones((4, c.seq_len),
+                                                   np.float32)}
+
+    state = (algo.params, algo.target_critic, algo.opt_wm,
+             algo.opt_actor, algo.opt_critic, algo.ret_scale)
+    key = jax.random.key(11)
+    loss = None
+    for _ in range(60):
+        key, sub = jax.random.split(key)
+        *state, metrics = algo._update(*state, batch(), sub)
+        loss = float(metrics["reward_loss"])
+    # symlog(1)=0.693: the marginal-mean predictor floors at ~0.12 in
+    # symlog MSE; conditioning on the action must beat it decisively
+    assert loss < 0.06, loss

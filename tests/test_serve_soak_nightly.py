@@ -1,0 +1,120 @@
+"""Nightly serve-engine soak: sustained load must hold KV pages and
+prefix-cache state flat — no page leak, no refcount drift, no deferred
+frees stranded (the failure mode VERDICT r3 flagged for long-running
+workloads generally: resources that only ever grow).
+
+Run via ``ci/run_ci.sh --nightly`` (``pytest -m nightly``); the CI
+default tier skips it (minutes of decode on CPU).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from ray_tpu.models import llama
+from ray_tpu.serve.paged_llm import PagedLLMEngine
+
+pytestmark = pytest.mark.nightly
+
+
+def _soak(eng, vocab, *, rounds, concurrency, rng, shared_prefix=None):
+    done = []
+    lock = threading.Lock()
+    remaining = [rounds - concurrency]
+
+    def consume(req):
+        toks = list(req.tokens())
+        with lock:
+            done.append(len(toks))
+            go = remaining[0] > 0
+            if go:
+                remaining[0] -= 1
+        if go:
+            threading.Thread(target=consume, args=(_submit(),),
+                             daemon=True).start()
+
+    def _submit():
+        tail = rng.integers(1, vocab, int(rng.integers(8, 48)))
+        prompt = (np.concatenate([shared_prefix, tail])
+                  if shared_prefix is not None else tail)
+        return eng.submit(prompt, max_new_tokens=int(rng.integers(4, 24)))
+
+    for _ in range(concurrency):
+        threading.Thread(target=consume, args=(_submit(),),
+                         daemon=True).start()
+    import time
+    deadline = time.monotonic() + 300
+    while True:
+        with lock:
+            if len(done) >= rounds:
+                return done
+        assert time.monotonic() < deadline, \
+            f"soak stalled: {len(done)}/{rounds} done"
+        assert eng.error is None, eng.error
+        time.sleep(0.05)
+
+
+def test_serve_soak_pages_flat():
+    """Hundreds of randomized requests (varying prompt + output lengths,
+    a shared prefix mixed in): at idle, every non-cached page is back in
+    the free list, refcounts are zero, and deferred frees drained."""
+    cfg = llama.llama_tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    eng = PagedLLMEngine(cfg=cfg, params=params, max_batch=4,
+                         max_len=256, page_size=32, num_pages=24,
+                         decode_chunk=8)
+    eng.start()
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab_size, 64)   # 2 cacheable pages
+
+    done = _soak(eng, cfg.vocab_size, rounds=120, concurrency=4, rng=rng,
+                 shared_prefix=shared)
+    assert len(done) == 120
+
+    # drain: give the engine loop a few idle passes to age deferred frees
+    import time
+    for _ in range(100):
+        st = eng.stats()
+        idle = st["prefix_cache"]["cached_idle_pages"]
+        free = st["kv_pages_free"]
+        if free + idle == eng.num_pages:
+            break
+        time.sleep(0.05)
+    st = eng.stats()
+    eng.stop()
+    idle = st["prefix_cache"]["cached_idle_pages"]
+    # EVERY page is either free or cached-idle — nothing leaked, nothing
+    # still "owned" by a retired slot, no refcount held by a dead request
+    assert st["kv_pages_free"] + idle == eng.num_pages, st
+    assert not eng._alloc.owned, eng._alloc.owned
+    assert not eng._prefix._refs, eng._prefix._refs
+    assert not eng._deferred_free
+    assert eng.total_finished == 120
+
+
+def test_serve_soak_int8_pages_flat():
+    """Same invariant under the int8 KV layout."""
+    cfg = llama.llama_tiny()
+    params = llama.init_params(cfg, jax.random.key(1))
+    eng = PagedLLMEngine(cfg=cfg, params=params, max_batch=2,
+                         max_len=128, page_size=32, num_pages=12,
+                         decode_chunk=8, kv_dtype="int8")
+    eng.start()
+    rng = np.random.default_rng(2)
+    done = _soak(eng, cfg.vocab_size, rounds=40, concurrency=2, rng=rng)
+    assert len(done) == 40
+    import time
+    for _ in range(100):
+        st = eng.stats()
+        if (st["kv_pages_free"]
+                + st["prefix_cache"]["cached_idle_pages"]) == eng.num_pages:
+            break
+        time.sleep(0.05)
+    st = eng.stats()
+    eng.stop()
+    assert (st["kv_pages_free"]
+            + st["prefix_cache"]["cached_idle_pages"]) == eng.num_pages, st
+    assert not eng._alloc.owned
