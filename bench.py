@@ -398,9 +398,12 @@ def bench_core() -> dict:
     ray_tpu.init(address=c.gcs_address)
     results = {}
 
-    def best_of(fn, rounds: int = 2) -> float:
-        """Steady-state rate: best of N rounds (ray_perf-style repeat —
-        one scheduler hiccup must not define the recorded number)."""
+    def best_of(fn, rounds: int = 5) -> float:
+        """Steady-state rate: best of N rounds (ray_perf-style repeat).
+        Five rounds, not two: this box has ONE cpu, and host scheduling
+        noise swings a single round of the pure-Python RPC ops by ±35%
+        between identical runs — the max over five draws is what a
+        quiet machine reproducibly measures."""
         best = 0.0
         for _ in range(rounds):
             t0 = time.perf_counter()
@@ -491,22 +494,39 @@ def bench_core_subprocess() -> dict:
 def bench_all() -> dict:
     """Train headline + serve/core sub-benchmarks folded into detail.
     Sub-bench failures degrade to an error string: the train number must
-    still land in the round artifact."""
-    result = bench_train()
-    subs = [("serve", bench_serve), ("core", bench_core_subprocess)]
+    still land in the round artifact.
+
+    The core leg runs FIRST: on a small host (this CI box has ONE cpu)
+    the parent's jax dispatch + device-tunnel threads — once any train
+    or serve leg has initialized them — steal enough timeslices from
+    the core subprocess's cluster processes to depress a pure-Python
+    RPC benchmark ~25%. Before jax is ever imported, the parent is an
+    idle wait and the child's numbers match a standalone run."""
+    subs = [("core", bench_core_subprocess), ("serve", bench_serve)]
     if os.environ.get("BENCH_PRESET", "base") != "small":
         # the ~1B entry is a real-chip measurement; a CPU smoke run
         # (BENCH_PRESET=small) must not train a 1B model on host
-        subs.insert(0, ("train_large", lambda: bench_train("large")))
-        subs.insert(1, ("train_longctx", lambda: bench_train("longctx")))
+        subs.insert(1, ("train_large", lambda: bench_train("large")))
+        subs.insert(2, ("train_longctx", lambda: bench_train("longctx")))
+    pre: dict = {}
     for name, fn in subs:
         try:
             sub = fn()
-            result["detail"][name] = {
+            pre[name] = {
                 "metric": sub["metric"], "value": sub["value"],
                 "unit": sub["unit"], **sub["detail"]}
         except Exception as e:  # noqa: BLE001
-            result["detail"][name] = {"error": f"{type(e).__name__}: {e}"}
+            pre[name] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        result = bench_train()
+    except Exception as e:  # noqa: BLE001 — a late headline failure
+        # (e.g. chip preemption) must not discard the completed sub
+        # results: degrade to an artifact that carries them + the error
+        result = {"metric": "llama_train_tokens_per_sec_per_chip",
+                  "value": 0.0, "unit": "tokens/s/chip",
+                  "vs_baseline": None,
+                  "detail": {"error": f"{type(e).__name__}: {e}"}}
+    result["detail"].update(pre)
     return result
 
 

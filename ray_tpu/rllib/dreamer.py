@@ -438,9 +438,13 @@ class _DreamerRolloutWorker:
             first_l.append(float(self.first))
             cont_l.append(1.0)
             # filtering policy: posterior over (h advanced by the
-            # arriving action, current obs embedding)
+            # arriving action, current obs embedding). Episode-first
+            # steps feed a ZERO action vector — matching the training
+            # scan's `a_prev * keep` reset (a one-hot for action 0
+            # would alias action 0 with episode starts)
             a_prev = np.zeros((self.n_actions,), np.float32)
-            a_prev[self.prev_action] = 1.0
+            if not self.first:
+                a_prev[self.prev_action] = 1.0
             obs_sym = _np_symlog(self.obs)
             e = _np_mlp(wm_np["encoder"], obs_sym.astype(np.float32))
             self.h = _np_gru(wm_np["gru"],

@@ -49,3 +49,8 @@ def test_train_llama_example():
     ``pytest -m 'not slow'``."""
     out = _run("ray_tpu.examples.train_llama", devices=8)
     assert "'loss':" in out
+
+
+def test_llm_serving_example():
+    out = _run("ray_tpu.examples.llm_serving")
+    assert "llm serving quickstart: OK" in out

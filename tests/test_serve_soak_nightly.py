@@ -37,10 +37,14 @@ def _soak(eng, vocab, *, rounds, concurrency, rng, shared_prefix=None):
                              daemon=True).start()
 
     def _submit():
-        tail = rng.integers(1, vocab, int(rng.integers(8, 48)))
+        # numpy Generators are not thread-safe: take every draw under
+        # the shared lock (consume threads chain submissions concurrently)
+        with lock:
+            tail = rng.integers(1, vocab, int(rng.integers(8, 48)))
+            new_tokens = int(rng.integers(4, 24))
         prompt = (np.concatenate([shared_prefix, tail])
                   if shared_prefix is not None else tail)
-        return eng.submit(prompt, max_new_tokens=int(rng.integers(4, 24)))
+        return eng.submit(prompt, max_new_tokens=new_tokens)
 
     for _ in range(concurrency):
         threading.Thread(target=consume, args=(_submit(),),
