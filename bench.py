@@ -463,10 +463,11 @@ def bench_core() -> dict:
 
 
 def bench_core_subprocess() -> dict:
-    """Core microbenchmarks in a FRESH interpreter: after the train and
-    serve phases this process carries jax dispatch + TPU-tunnel threads
-    whose GIL slices depress a pure-Python RPC benchmark ~30% — the
-    standalone number is the honest one (ray_perf runs standalone too)."""
+    """Core microbenchmarks in a FRESH interpreter, for parity with a
+    standalone ``BENCH_MODE=core`` run (ray_perf runs standalone too).
+    bench_all also orders this leg FIRST so the parent hasn't imported
+    jax yet — on the 1-cpu host even an idle parent's dispatch/tunnel
+    threads would steal timeslices from the child's cluster."""
     import signal
     import subprocess
 

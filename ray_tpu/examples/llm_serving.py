@@ -35,15 +35,15 @@ def main():
     # registration happens at its prefill dispatch)
     system = rng.integers(1, cfg.vocab_size, 64)
 
-    def chat(i, temperature=0.0):
+    def chat(temperature=0.0):
         return eng.submit(
             np.concatenate([system, rng.integers(1, cfg.vocab_size, 12)]),
             max_new_tokens=12, temperature=temperature)
 
-    first = chat(0)
+    first = chat()
     print(f"request 0: {len(list(first.tokens()))} tokens, "
           f"ttft={first.ttft:.3f}s (cold: registers the system prompt)")
-    reqs = [chat(i, temperature=0.0 if i % 2 == 0 else 0.7)
+    reqs = [chat(temperature=0.0 if i % 2 == 0 else 0.7)
             for i in range(1, 4)]
     for i, r in enumerate(reqs, start=1):
         toks = list(r.tokens())          # streaming: consume as they land

@@ -126,11 +126,17 @@ def test_streaming_generator_cluster(two_cpu_cluster):
     assert isinstance(g, ObjectRefGenerator)
     first_ref = next(g)
     first_at = time.monotonic() - t0
-    # first yield consumable WHILE the task is still producing the rest
-    assert first_at < 0.6, first_at
     out = [ray_tpu.get(first_ref)] + [ray_tpu.get(r) for r in g]
+    total = time.monotonic() - t0
     assert out == [0, 10, 20, 30, 40]
-    assert time.monotonic() - t0 >= 0.7   # the stream outlived first item
+    # the STREAMING property: the first yield was consumable well
+    # before the stream finished. Stated relative to the total (the
+    # remaining 4 yields take >= 0.6s) — an absolute bound on first_at
+    # entangles worker-spawn latency, which is SECONDS on a loaded
+    # 1-cpu box deep into a full-suite run (flaked twice there while
+    # passing 5/5 in isolation)
+    assert first_at < total - 0.3, (first_at, total)
+    assert total >= 0.7   # the stream outlived the first item
 
 
 def test_streaming_generator_inprocess(ray_tpu_start):
