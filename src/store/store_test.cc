@@ -34,6 +34,9 @@ int store_release(void* sp, const uint8_t* id);
 int store_abort(void* sp, const uint8_t* id);
 int store_delete(void* sp, const uint8_t* id);
 int store_contains(void* sp, const uint8_t* id);
+int store_get_many(void* sp, const uint8_t* ids, int n, uint64_t* offs,
+                   uint64_t* dszs, int* rcs);
+int store_release_many(void* sp, const uint8_t* ids, int n);
 }
 
 enum { TS_OK = 0, TS_ERR = -1, TS_EXISTS = -2, TS_NOT_FOUND = -3 };
@@ -98,6 +101,26 @@ int main() {
   CHECK(store_delete(store, oid) == TS_OK);
   CHECK(store_contains(store, oid) == 0);
 
+  // batched get/release: hits + a miss in one call; duplicate ids hold
+  // one ref each (release_many must drop all of them before delete)
+  uint8_t oid3[20];
+  fill_oid(oid3, 3);
+  CHECK(put(store, oid3, payload, 32) == TS_OK);
+  uint8_t batch_ids[4 * 20];
+  fill_oid(batch_ids + 0, 3);
+  fill_oid(batch_ids + 20, 999);   // absent
+  fill_oid(batch_ids + 40, 3);     // duplicate
+  fill_oid(batch_ids + 60, 3);
+  uint64_t offs[4], dszs[4];
+  int rcs[4];
+  CHECK(store_get_many(store, batch_ids, 4, offs, dszs, rcs) == TS_OK);
+  CHECK(rcs[0] == TS_OK && rcs[2] == TS_OK && rcs[3] == TS_OK);
+  CHECK(rcs[1] == TS_NOT_FOUND);
+  CHECK(dszs[0] == 32 && offs[0] == offs[2]);
+  CHECK(store_delete(store, oid3) != TS_OK);   // 3 refs held
+  CHECK(store_release_many(store, batch_ids, 4) == TS_OK);  // absent: no-op
+  CHECK(store_delete(store, oid3) == TS_OK);
+
   // concurrent storm: writers create distinct objects, readers chase a
   // neighbor's objects, deleters race over a shared range — each
   // thread attaches its OWN handle, like real worker processes
@@ -118,14 +141,26 @@ int main() {
       for (int i = 0; i < kObjects; ++i) {
         fill_oid(o, 1000 + t * kObjects + i);
         if (put(s, o, buf, sizeof(buf)) != TS_OK) errors.fetch_add(1);
-        // read a NEIGHBOR thread's recent object, if it exists yet
-        fill_oid(o, 1000 + ((t + 1) % kThreads) * kObjects + (i / 2));
-        uint64_t ro = 0, rd = 0, rm = 0;
-        if (store_get(s, o, 0, &ro, &rd, &rm) == TS_OK) {
-          volatile uint8_t sink = store_base(s)[ro];
-          (void)sink;
-          store_release(s, o);
+        // BATCH-read two of a NEIGHBOR thread's recent objects, if
+        // they exist yet (the driver's hot get([...]) path under TSan)
+        uint8_t pair[2 * 20];
+        fill_oid(pair, 1000 + ((t + 1) % kThreads) * kObjects + (i / 2));
+        fill_oid(pair + 20,
+                 1000 + ((t + 1) % kThreads) * kObjects + (i / 4));
+        uint64_t ros[2], rds[2];
+        int rrcs[2];
+        store_get_many(s, pair, 2, ros, rds, rrcs);
+        uint8_t rel[2 * 20];
+        int nrel = 0;
+        for (int k = 0; k < 2; ++k) {
+          if (rrcs[k] == TS_OK) {
+            volatile uint8_t sink = store_base(s)[ros[k]];
+            (void)sink;
+            std::memcpy(rel + nrel * 20, pair + k * 20, 20);
+            ++nrel;
+          }
         }
+        if (nrel) store_release_many(s, rel, nrel);
         // race create/delete over a small shared id range
         fill_oid(o, 5000 + (i % 32));
         put(s, o, buf, 64);
