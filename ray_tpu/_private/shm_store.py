@@ -272,24 +272,38 @@ class ShmObjectStore:
     def release(self, object_id: bytes) -> None:
         self._lib.store_release(self._h, _key(object_id))
 
+    # one C call holds the process-shared store mutex for its whole
+    # batch: chunking here bounds the lock-hold time as a property of
+    # the API, not of any one caller (the driver's 4096 get window was
+    # previously the only thing keeping a huge batch from stalling
+    # every other store client on the node)
+    BATCH_WINDOW = 4096
+
     def get_many(self, object_ids: list[bytes]) -> list:
-        """Batched non-blocking get: one C call resolves the whole list.
-        Returns a view per id, or None where the object is absent/unsealed;
-        every non-None entry holds a read ref — pair with release_many over
-        the SAME hit set."""
-        n = len(object_ids)
-        keys = b"".join(map(_key, object_ids))
-        offs = (ctypes.c_uint64 * n)()
-        dszs = (ctypes.c_uint64 * n)()
-        rcs = (ctypes.c_int * n)()
-        self._lib.store_get_many(self._h, keys, n, offs, dszs, rcs)
+        """Batched non-blocking get, chunked to ``BATCH_WINDOW`` ids per
+        C call. Returns a view per id, or None where the object is
+        absent/unsealed; every non-None entry holds a read ref — pair
+        with release_many over the SAME hit set."""
         seg = self._seg_ro
-        return [seg[offs[k]:offs[k] + dszs[k]] if rcs[k] == TS_OK else None
-                for k in range(n)]
+        out: list = []
+        for i in range(0, len(object_ids), self.BATCH_WINDOW):
+            part = object_ids[i:i + self.BATCH_WINDOW]
+            n = len(part)
+            keys = b"".join(map(_key, part))
+            offs = (ctypes.c_uint64 * n)()
+            dszs = (ctypes.c_uint64 * n)()
+            rcs = (ctypes.c_int * n)()
+            self._lib.store_get_many(self._h, keys, n, offs, dszs, rcs)
+            out.extend(
+                seg[offs[k]:offs[k] + dszs[k]] if rcs[k] == TS_OK
+                else None for k in range(n))
+        return out
 
     def release_many(self, object_ids: list[bytes]) -> None:
-        keys = b"".join(map(_key, object_ids))
-        self._lib.store_release_many(self._h, keys, len(object_ids))
+        for i in range(0, len(object_ids), self.BATCH_WINDOW):
+            part = object_ids[i:i + self.BATCH_WINDOW]
+            keys = b"".join(map(_key, part))
+            self._lib.store_release_many(self._h, keys, len(part))
 
     def delete(self, object_id: bytes) -> bool:
         return self._lib.store_delete(self._h, _key(object_id)) == TS_OK

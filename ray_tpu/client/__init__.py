@@ -102,7 +102,52 @@ class ClientRuntime:
         self._rpc = _SessionRpcClient(address, self)
         self._lock = threading.Lock()
         info = self._rpc.call("client_hello", session_token=self._token)
+        if info.get("redirect"):
+            # per-job proxier (reference: proxier.py:113 ProxyManager):
+            # the public endpoint spawned/located this session's OWN
+            # server process — reconnect there and re-hello
+            self._rpc.close()
+            self._rpc = _SessionRpcClient(tuple(info["redirect"]), self)
+            info = self._rpc.call("client_hello",
+                                  session_token=self._token)
         self.job_id = info["job_id"]
+        # -- incremental ref release on client GC (reference: the
+        # client's ReleaseObject protocol, util/client/): dropped
+        # client-side ObjectRefs release their server-side session hold
+        # instead of pinning everything until disconnect. Installed only
+        # when no other runtime in this process owns the ref-drain (a
+        # same-process ClientServer test shares the global counter). --
+        from ray_tpu.runtime import refcount as _refcount
+
+        self._release_buf: list[str] = []
+        self._release_lock = threading.Lock()
+        self._closed = False
+        self._track_gc = not _refcount.is_active()
+        if self._track_gc:
+            _refcount.global_counter.set_local_release(self._on_ref_zero)
+            threading.Thread(target=self._release_loop, daemon=True,
+                             name="client-ref-release").start()
+
+    def _on_ref_zero(self, oid_hex: str):
+        with self._release_lock:
+            self._release_buf.append(oid_hex)
+
+    def _release_loop(self):
+        import time as _time
+
+        from ray_tpu.runtime.refcount import global_counter
+
+        while not self._closed:
+            _time.sleep(0.2)
+            global_counter.poll_local()   # fires _on_ref_zero
+            with self._release_lock:
+                batch, self._release_buf = self._release_buf, []
+            if batch and not self._closed:
+                try:
+                    self._rpc.call("client_release", oids=batch)
+                except Exception:  # noqa: BLE001 - requeue on failure
+                    with self._release_lock:
+                        self._release_buf[:0] = batch
 
     # -- objects --------------------------------------------------------
 
@@ -223,6 +268,11 @@ class ClientRuntime:
         return None  # class names resolve server-side only
 
     def shutdown(self):
+        self._closed = True
+        if self._track_gc:
+            from ray_tpu.runtime.refcount import global_counter
+
+            global_counter.set_local_release(None)
         try:
             # direct call on the live underlying connection: a goodbye
             # to a dead server must not spend the 10s redial window, and

@@ -1,0 +1,137 @@
+"""Per-job client proxier: one dedicated server PROCESS per client job.
+
+Reference analog: ``python/ray/util/client/server/proxier.py:113``
+(``ProxyManager``) — the public ``ray://`` endpoint doesn't host client
+state itself; it spawns a ``SpecificServer`` process per client job and
+routes the client there, so one job's driver state (objects, actors,
+crashes) is process-isolated from every other job's.
+
+Here the public endpoint answers only ``client_hello``: it spawns (or
+finds, for a reconnecting token) the session's own ``ClientServer``
+subprocess and replies with a redirect; the client redials the child
+directly — no per-request proxy hop (the reference proxies the gRPC
+stream; a redirect is the cheaper equivalent for our framed-TCP
+transport since the child is equally reachable). Children self-expire
+via ``--exit-when-idle`` after their last session reaps.
+
+Run standalone:
+    python -m ray_tpu.client.proxier --port 10001 [--address GCS:PORT]
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+import uuid
+
+from ray_tpu.runtime.rpc import RpcServer
+
+
+class ProxyManager(RpcServer):
+    """Public client endpoint that redirects each session to its own
+    per-job server process."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 10001, *,
+                 gcs_address=None, num_cpus: float | None = None,
+                 child_idle_exit_s: float = 60.0):
+        super().__init__(host, port)
+        self._host = host
+        self._gcs = gcs_address
+        self._num_cpus = num_cpus
+        self._idle_exit = child_idle_exit_s
+        self._lock = threading.Lock()
+        # token -> {"proc": Popen, "addr": (host, port)}
+        self._children: dict[str, dict] = {}
+
+    def _spawn_child(self) -> dict:
+        cmd = [sys.executable, "-m", "ray_tpu.client.server",
+               "--host", self._host, "--port", "0",
+               "--exit-when-idle", str(self._idle_exit)]
+        if self._gcs is not None:
+            cmd += ["--address", f"{self._gcs[0]}:{self._gcs[1]}"]
+        if self._num_cpus is not None:
+            cmd += ["--num-cpus", str(self._num_cpus)]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+        # first stdout line: "client server on HOST:PORT"
+        deadline = time.monotonic() + 60.0
+        line = ""
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if "client server on" in line:
+                break
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"per-job client server died at startup (rc="
+                    f"{proc.returncode})")
+        hostport = line.rsplit(" ", 1)[-1].strip()
+        h, _, p = hostport.rpartition(":")
+        if not p.isdigit():
+            proc.kill()
+            raise RuntimeError(
+                f"per-job client server announced no address: {line!r}")
+        # drain further output so the child never blocks on a full pipe
+        threading.Thread(target=lambda: [None for _ in proc.stdout],
+                         daemon=True).start()
+        return {"proc": proc, "addr": (h, int(p))}
+
+    def rpc_client_hello(self, conn, send_lock, *, session_token=None):
+        token = session_token or uuid.uuid4().hex
+        with self._lock:
+            child = self._children.get(token)
+            if child is not None and child["proc"].poll() is not None:
+                child = None   # exited (idle or crash): respawn
+            if child is None:
+                # reap dead children while here (bounded table)
+                for t, c in list(self._children.items()):
+                    if c["proc"].poll() is not None:
+                        self._children.pop(t)
+                child = self._spawn_child()
+                self._children[token] = child
+        return {"redirect": list(child["addr"]), "session_token": token,
+                "job_id": "proxied"}
+
+    def stop(self):
+        super().stop()
+        with self._lock:
+            children = list(self._children.values())
+            self._children.clear()
+        for c in children:
+            try:
+                c["proc"].terminate()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="ray-tpu-client-proxier",
+        description="per-job client server manager (proxier analog)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=10001)
+    parser.add_argument("--address", help="GCS host:port to attach to")
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--child-idle-exit", type=float, default=60.0)
+    args = parser.parse_args(argv)
+
+    gcs = None
+    if args.address:
+        host, _, port = args.address.rpartition(":")
+        gcs = (host or "127.0.0.1", int(port))
+    server = ProxyManager(args.host, args.port, gcs_address=gcs,
+                          num_cpus=args.num_cpus,
+                          child_idle_exit_s=args.child_idle_exit).start()
+    print(f"client proxier on {server.address[0]}:{server.address[1]}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -41,9 +41,19 @@ class ClientServer(RpcServer):
     """Serves client_* RPCs against an owned driver runtime."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 10001, *,
-                 gcs_address=None, num_cpus: float | None = None):
+                 gcs_address=None, num_cpus: float | None = None,
+                 exit_when_idle_s: float | None = None):
         super().__init__(host, port)
         import ray_tpu
+
+        # proxied per-job servers exit when their last session reaps
+        # (reference: the proxier's SpecificServer lifetime follows its
+        # client job). Armed from PROCESS START: a child whose client
+        # dies before ever establishing a session must also expire, or
+        # every failed hello leaks a full driver-runtime process.
+        self._exit_when_idle_s = exit_when_idle_s
+        self._idle_since: float | None = (
+            time.monotonic() if exit_when_idle_s is not None else None)
 
         if gcs_address is not None:
             self._rt = ray_tpu.init(address=gcs_address)
@@ -108,6 +118,8 @@ class ClientServer(RpcServer):
                 sess["reap_at"] = time.monotonic() + self._grace
 
     def _reap_loop(self):
+        import os
+
         while not self._stopping:
             time.sleep(0.25)
             now = time.monotonic()
@@ -117,8 +129,17 @@ class ClientServer(RpcServer):
                     at = sess["reap_at"]
                     if at is not None and now >= at and not sess["conns"]:
                         doomed.append(self._sessions.pop(token))
+                if self._sessions:
+                    self._idle_since = None
+                elif self._idle_since is None:
+                    self._idle_since = now
             for sess in doomed:
                 self._reap_session(sess)
+            if (self._exit_when_idle_s is not None
+                    and self._idle_since is not None
+                    and now - self._idle_since >= self._exit_when_idle_s):
+                # proxied per-job server: job over, process over
+                os._exit(0)
 
     def _reap_session(self, sess: dict):
         """The session's objects die with it; its non-detached actors
@@ -143,9 +164,12 @@ class ClientServer(RpcServer):
             sess["conns"].add(id(conn))
             sess["reap_at"] = None          # reconnect cancels the reap
             self._conn_session[id(conn)] = token
+        import os
+
         job = getattr(self._rt, "job_id", None)
         return {"job_id": job.hex() if job is not None else "cluster",
-                "session_token": token, "resumed": resumed}
+                "session_token": token, "resumed": resumed,
+                "server_pid": os.getpid()}
 
     def rpc_client_disconnect(self, conn, send_lock):
         """Explicit goodbye: reap NOW, no grace."""
@@ -180,6 +204,21 @@ class ClientServer(RpcServer):
                                          timeout=wait_timeout)
         return {"ready": [r.id.hex() for r in ready],
                 "not_ready": [r.id.hex() for r in not_ready]}
+
+    def rpc_client_release(self, conn, send_lock, *, oids):
+        """Incremental release: the client's local ObjectRefs for these
+        oids were garbage collected (reference: the client's
+        ReleaseObject calls) — drop the session holds; the server-side
+        refs die with them and the cluster refcount protocol takes it
+        from there."""
+        table = self._session_for(conn)["held"]
+        for o in oids:
+            table.pop(o, None)
+        return {"ok": True}
+
+    def rpc_client_held_count(self, conn, send_lock):
+        """Debug/observability: how many objects this session pins."""
+        return {"held": len(self._session_for(conn)["held"])}
 
     def rpc_client_free(self, conn, send_lock, *, oids):
         with self._slock:
@@ -328,6 +367,9 @@ def main(argv=None):
     parser.add_argument("--port", type=int, default=10001)
     parser.add_argument("--address", help="GCS host:port to attach to")
     parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--exit-when-idle", type=float, default=None,
+                        help="exit after this many seconds with no live "
+                             "sessions (per-job proxied servers)")
     args = parser.parse_args(argv)
 
     gcs = None
@@ -335,7 +377,8 @@ def main(argv=None):
         host, _, port = args.address.rpartition(":")
         gcs = (host or "127.0.0.1", int(port))
     server = ClientServer(args.host, args.port, gcs_address=gcs,
-                          num_cpus=args.num_cpus).start()
+                          num_cpus=args.num_cpus,
+                          exit_when_idle_s=args.exit_when_idle).start()
     print(f"client server on {server.address[0]}:{server.address[1]}",
           flush=True)
     try:

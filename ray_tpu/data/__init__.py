@@ -30,6 +30,7 @@ from ray_tpu.data.dataset import (
 from ray_tpu.data.execution import ExecutionOptions, StreamingExecutor
 from ray_tpu.data.grouped import GroupedData
 from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.sql import read_sql, write_sql
 
 __all__ = [
     "BlockAccessor",
@@ -51,6 +52,8 @@ __all__ = [
     "read_images",
     "read_json",
     "read_parquet",
+    "read_sql",
+    "write_sql",
     "read_text",
     "read_tfrecords",
     "read_avro",

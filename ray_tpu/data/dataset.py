@@ -283,6 +283,13 @@ class Dataset:
                 for r in builtins.range(n):
                     w.writerow([batch[k][r] for k in keys])
 
+    def write_sql(self, sql: str, connection_factory) -> None:
+        """Write rows through a parameterized INSERT over a DB-API 2
+        connection (reference: Dataset.write_sql, sql_datasource.py)."""
+        from ray_tpu.data.sql import write_sql as _write_sql
+
+        _write_sql(self, sql, connection_factory)
+
     def write_json(self, path: str) -> None:
         import json as _json
         import os

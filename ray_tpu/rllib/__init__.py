@@ -35,6 +35,20 @@ from ray_tpu.rllib.env import (
 from ray_tpu.rllib.gym_env import GymEnvAdapter
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.learner_group import LearnerGroup
+from ray_tpu.rllib.recurrent import (
+    MemoryCueEnv,
+    RecurrentPPO,
+    RecurrentPPOConfig,
+    StatelessCartPole,
+)
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentCartPole,
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    MultiAgentReplay,
+    PolicyMap,
+)
 from ray_tpu.rllib.estimators import (
     ImportanceSampling,
     WeightedImportanceSampling,

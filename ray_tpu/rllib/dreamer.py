@@ -387,9 +387,19 @@ class SequenceReplay:
             self.is_first[self.pos] = 1.0
 
     def sample(self, batch_size: int, seq_len: int, rng) -> dict:
-        starts = rng.integers(0, self.size - seq_len + 1,
-                              size=batch_size)
-        idx = starts[:, None] + np.arange(seq_len)[None, :]
+        if self.size == self.capacity:
+            # full ring: sample starts over the WHOLE ring modulo
+            # capacity — windows spanning the capacity-1 -> 0 boundary
+            # are temporally contiguous (the write head marks is_first
+            # where continuity actually breaks), and excluding them
+            # permanently under-samples the steps just after index 0
+            starts = rng.integers(0, self.size, size=batch_size)
+            idx = (starts[:, None] + np.arange(seq_len)[None, :]) \
+                % self.capacity
+        else:
+            starts = rng.integers(0, self.size - seq_len + 1,
+                                  size=batch_size)
+            idx = starts[:, None] + np.arange(seq_len)[None, :]
         return {
             "obs": self.obs[idx],
             "actions": self.actions[idx],
@@ -463,7 +473,24 @@ class _DreamerRolloutWorker:
             self.ep_ret += reward
             self.first = False
             if done:
-                # terminal observation's row carries the final reward
+                # terminal observation's row carries the final reward.
+                # ``truncated`` is part of the env protocol (env.py sets
+                # it on every builtin env): a time-limit end must train
+                # the continue head as cont=1 (bootstrappable), not as a
+                # true termination. Envs lacking the attribute get one
+                # warning — silently treating their truncations as
+                # terminations biases value bootstrapping.
+                if not hasattr(self.env, "truncated") and \
+                        not getattr(self, "_warned_truncated", False):
+                    self._warned_truncated = True
+                    import warnings
+
+                    warnings.warn(
+                        f"{type(self.env).__name__} does not expose "
+                        f"'truncated'; episode ends will all be treated "
+                        f"as true terminations (cont=0), which biases "
+                        f"DreamerV3's continue head on time-limit envs",
+                        stacklevel=2)
                 terminal = not bool(getattr(self.env, "truncated",
                                             False))
                 obs_l.append(next_obs)

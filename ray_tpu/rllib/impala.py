@@ -27,22 +27,40 @@ class _TrajectoryWorker:
     runs one batched policy forward for all envs (reference: vectorized
     EnvRunner — the round-3 one-env-per-forward weakness)."""
 
-    def __init__(self, env_name, seed: int, num_envs: int = 1):
+    def __init__(self, env_name, seed: int, num_envs: int = 1,
+                 cell: str | None = None):
         self.envs = [make_env(env_name, seed=seed + i)
                      for i in range(num_envs)]
         self.rng = np.random.default_rng(seed)
         self.obs = np.stack([e.reset() for e in self.envs])   # [E, obs]
         self.ep_ret = np.zeros(num_envs)
         self.num_envs = num_envs
+        # recurrent core (reference: recurrent_net.py:25): the worker
+        # CARRIES its state across unrolls and records the state at
+        # each unroll's first step so the learner's scan replays it
+        self.cell = cell
+        self.state = None
 
     def sample(self, params_np: dict, unroll_length: int):
         from ray_tpu.rllib.ppo import _sample_actions, _softmax_rows
 
         T, ne = unroll_length, self.num_envs
+        recurrent = self.cell is not None
+        if recurrent:
+            from ray_tpu.rllib.recurrent import (np_recurrent_step,
+                                                 zero_state)
+
+            if self.state is None:
+                self.state = zero_state(params_np, ne)
+            h0 = self.state.copy()
         obs_l, act_l, logits_l, rew_l, done_l = [], [], [], [], []
         episode_returns = []
         for _ in range(T):
-            logits, _ = _np_forward(params_np, self.obs)      # [E, A]
+            if recurrent:
+                logits, _, self.state = np_recurrent_step(
+                    params_np, self.obs, self.state)
+            else:
+                logits, _ = _np_forward(params_np, self.obs)  # [E, A]
             probs = _softmax_rows(logits)
             actions = _sample_actions(self.rng, probs)
             obs_l.append(self.obs.copy())
@@ -59,12 +77,14 @@ class _TrajectoryWorker:
                     episode_returns.append(float(self.ep_ret[i]))
                     self.ep_ret[i] = 0.0
                     o = env.reset()
+                    if recurrent:
+                        self.state[i] = 0.0   # fresh episode, fresh memory
                 self.obs[i] = o
             rew_l.append(step_rew)
             done_l.append(step_done)
         # [T, E, ...] -> [E, T, ...] (the learner stacks over the batch
         # axis; each env is one trajectory)
-        return {
+        out = {
             "obs": np.stack(obs_l).swapaxes(0, 1).astype(np.float32),
             "actions": np.stack(act_l).swapaxes(0, 1).astype(np.int32),
             "behavior_logits": np.stack(logits_l).swapaxes(0, 1).astype(
@@ -74,6 +94,9 @@ class _TrajectoryWorker:
             "bootstrap_obs": self.obs.copy().astype(np.float32),
             "episode_returns": episode_returns,
         }
+        if recurrent:
+            out["h0"] = h0
+        return out
 
 
 @dataclass
@@ -93,6 +116,9 @@ class IMPALAConfig:
     # clipped surrogate (see rllib/appo.py)
     clip_param: float | None = None
     hidden: int = 64
+    # recurrent policy core (reference: recurrent_net.py:25 — LSTM/GRU
+    # wrapping for POMDP envs): None = feedforward MLP
+    cell: str | None = None
     seed: int = 0
     # multi-learner plane (reference: LearnerGroup learner_group.py:61)
     num_learners: int = 0
@@ -130,13 +156,14 @@ class IMPALA:
         worker_cls = ray_tpu.remote(_TrajectoryWorker)
         self.workers = [
             worker_cls.remote(config.env, config.seed + 1000 * (i + 1),
-                              config.num_envs_per_worker)
+                              config.num_envs_per_worker, config.cell)
             for i in range(config.num_rollout_workers)
         ]
         grad_fn = partial(
             _impala_grads, gamma=config.gamma, rho_clip=config.rho_clip,
             c_clip=config.c_clip, entropy_coeff=config.entropy_coeff,
-            vf_coeff=config.vf_coeff, clip_param=config.clip_param)
+            vf_coeff=config.vf_coeff, clip_param=config.clip_param,
+            cell=config.cell)
         if config.num_learners > 0:
             from ray_tpu.rllib.learner_group import LearnerGroup
 
@@ -144,9 +171,21 @@ class IMPALA:
             # the whole algorithm into every learner actor's ctor blob
             obs_dim, n_actions, hidden = (self.obs_dim, self.n_actions,
                                           config.hidden)
+            cell = config.cell
+            if cell is not None:
+                from ray_tpu.rllib.recurrent import init_recurrent_module
+
+                def _init(key):
+                    full = init_recurrent_module(key, obs_dim, n_actions,
+                                                 hidden, cell)
+                    # the string tag stays out of the optimizer pytree
+                    return {k: v for k, v in full.items()
+                            if k != "cell_type"}
+            else:
+                def _init(key):
+                    return init_module(key, obs_dim, n_actions, hidden)
             self.learners = LearnerGroup(
-                init_fn=lambda key: init_module(
-                    key, obs_dim, n_actions, hidden),
+                init_fn=_init,
                 grad_fn=grad_fn, tx=self.tx,
                 num_learners=config.num_learners,
                 mode=config.learner_mode, seed=config.seed)
@@ -154,24 +193,39 @@ class IMPALA:
             self.opt_state = None
         else:
             self.learners = None
-            self.params = init_module(jax.random.key(config.seed),
-                                      self.obs_dim, self.n_actions,
-                                      config.hidden)
+            if config.cell is not None:
+                from ray_tpu.rllib.recurrent import init_recurrent_module
+
+                full = init_recurrent_module(
+                    jax.random.key(config.seed), self.obs_dim,
+                    self.n_actions, config.hidden, config.cell)
+                # the string tag stays out of the optimizer pytree; the
+                # worker-facing params re-add it in _params_np
+                self.params = {k: v for k, v in full.items()
+                               if k != "cell_type"}
+            else:
+                self.params = init_module(jax.random.key(config.seed),
+                                          self.obs_dim, self.n_actions,
+                                          config.hidden)
             self.opt_state = self.tx.init(self.params)
             self._update = jax.jit(partial(
                 _impala_update, tx=self.tx, gamma=config.gamma,
                 rho_clip=config.rho_clip, c_clip=config.c_clip,
                 entropy_coeff=config.entropy_coeff,
                 vf_coeff=config.vf_coeff,
-                clip_param=config.clip_param))
+                clip_param=config.clip_param, cell=config.cell))
         self._inflight = None  # refs sampled with lagged params
 
     def _params_np(self):
         import jax
 
         if self.learners is not None:
-            return self.learners.get_params()
-        return jax.tree.map(np.asarray, self.params)
+            params = self.learners.get_params()
+        else:
+            params = jax.tree.map(np.asarray, self.params)
+        if self.config.cell is not None:
+            params = {**params, "cell_type": self.config.cell}
+        return params
 
     def train(self) -> dict:
         cfg = self.config
@@ -192,10 +246,12 @@ class IMPALA:
                            for r in b["episode_returns"]]
         # concatenate env trajectories to [B, T, ...] (each worker
         # contributes num_envs_per_worker trajectories)
+        keys = ["obs", "actions", "behavior_logits", "rewards",
+                "dones", "bootstrap_obs"]
+        if cfg.cell is not None:
+            keys.append("h0")
         batch = {
-            k: np.concatenate([b[k] for b in batches])
-            for k in ("obs", "actions", "behavior_logits", "rewards",
-                      "dones", "bootstrap_obs")
+            k: np.concatenate([b[k] for b in batches]) for k in keys
         }
         if self.learners is not None:
             stats = self.learners.update(batch)
@@ -214,7 +270,17 @@ class IMPALA:
             "mean_rho": float(stats["mean_rho"]),
         }
 
-    def compute_action(self, obs) -> int:
+    def compute_action(self, obs, state=None):
+        if self.config.cell is not None:
+            from ray_tpu.rllib.recurrent import (np_recurrent_step,
+                                                 zero_state)
+
+            params = self._params_np()
+            if state is None:
+                state = zero_state(params, 1)
+            logits, _, state = np_recurrent_step(
+                params, np.asarray(obs, np.float32)[None], state)
+            return int(np.argmax(logits[0])), state
         logits, _ = _np_forward(self._params_np(), np.asarray(obs)[None])
         return int(np.argmax(logits[0]))
 
@@ -276,9 +342,12 @@ def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
 
 
 def _impala_grads(params, batch, *, gamma, rho_clip, c_clip,
-                  entropy_coeff, vf_coeff, clip_param=None):
+                  entropy_coeff, vf_coeff, clip_param=None, cell=None):
     """Pure gradient fn (Learner.compute_gradients analog); under a
-    dp-sharded batch axis the mean-loss grads are globally averaged."""
+    dp-sharded batch axis the mean-loss grads are globally averaged.
+    ``cell``: recurrent core — the forward becomes a lax.scan over the
+    unroll with the worker-recorded initial state (batch["h0"]), episode
+    boundaries resetting the carried state in-scan."""
     import jax
     import jax.numpy as jnp
 
@@ -291,10 +360,29 @@ def _impala_grads(params, batch, *, gamma, rho_clip, c_clip,
 
     def loss_fn(p):
         T, B = actions.shape
-        logits, values = forward_module(p, obs.reshape(T * B, -1))
-        logits = logits.reshape(T, B, -1)
-        values = values.reshape(T, B)
-        _, bootstrap_value = forward_module(p, batch["bootstrap_obs"])
+        if cell is not None:
+            from ray_tpu.rllib.recurrent import (_cell_step,
+                                                 forward_recurrent_seq)
+
+            pf = {**p, "cell_type": cell}
+            logits_bt, values_bt, h_final = forward_recurrent_seq(
+                pf, batch["obs"], batch["h0"], batch["dones"])
+            logits = jnp.swapaxes(logits_bt, 0, 1)
+            values = jnp.swapaxes(values_bt, 0, 1)
+            # bootstrap value: one more cell step from the carried
+            # state (zeroed where the last unroll step ended an episode
+            # — the bootstrap obs is then a fresh reset)
+            h_boot = h_final * (1.0 - batch["dones"][:, -1])[:, None]
+            x = jnp.tanh(batch["bootstrap_obs"] @ pf["enc"]["w"]
+                         + pf["enc"]["b"])
+            h, _ = _cell_step(pf, x, h_boot, jnp)
+            bootstrap_value = (h @ pf["vf"]["w"]
+                               + pf["vf"]["b"]).squeeze(-1)
+        else:
+            logits, values = forward_module(p, obs.reshape(T * B, -1))
+            logits = logits.reshape(T, B, -1)
+            values = values.reshape(T, B)
+            _, bootstrap_value = forward_module(p, batch["bootstrap_obs"])
 
         logp_all = jax.nn.log_softmax(logits)
         target_logp = jnp.take_along_axis(
@@ -330,13 +418,14 @@ def _impala_grads(params, batch, *, gamma, rho_clip, c_clip,
 
 
 def _impala_update(params, opt_state, batch, *, tx, gamma, rho_clip,
-                   c_clip, entropy_coeff, vf_coeff, clip_param=None):
+                   c_clip, entropy_coeff, vf_coeff, clip_param=None,
+                   cell=None):
     import jax
 
     grads, stats = _impala_grads(
         params, batch, gamma=gamma, rho_clip=rho_clip, c_clip=c_clip,
         entropy_coeff=entropy_coeff, vf_coeff=vf_coeff,
-        clip_param=clip_param)
+        clip_param=clip_param, cell=cell)
     updates, opt_state = tx.update(grads, opt_state, params)
     params = jax.tree.map(lambda p, u: p + u, params, updates)
     return params, opt_state, stats

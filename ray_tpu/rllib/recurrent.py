@@ -1,0 +1,449 @@
+"""Recurrent (GRU/LSTM) policy cores + sequence-aware PPO.
+
+Reference analog: ``rllib/models/torch/recurrent_net.py:25`` (LSTM
+wrapper adding memory to any policy net, driven by ``max_seq_len``
+fragments with stored initial state) and the sequence handling in
+``rllib/policy/rnn_sequencing.py`` (pad_batch_to_sequences_of_same_size:
+fragments carry their initial recurrent state; padding is masked out of
+the loss).
+
+TPU-first shape: the time axis is a ``lax.scan`` inside ONE jitted
+update — [B, T] fragments, static shapes, the MXU sees the cell's fused
+matmuls batched over B. Episode boundaries INSIDE a fragment reset the
+carried state via a per-step done mask (no dynamic control flow).
+
+Rollout workers run the cell step in numpy (envs are host-bound); each
+collected fragment stores the state vector the worker carried at its
+first step (``h0``) so the learner's scan replays exactly what the
+behavior policy saw.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.ppo import _sample_actions, _softmax_rows
+
+
+# ---------------------------------------------------------------------------
+# Cells
+# ---------------------------------------------------------------------------
+
+def _dense(key, fan_in, fan_out):
+    import jax
+
+    scale = (2.0 / fan_in) ** 0.5
+    return {"w": jax.random.normal(key, (fan_in, fan_out)) * scale,
+            "b": jax.numpy.zeros((fan_out,))}
+
+
+def init_recurrent_module(key, obs_dim: int, n_actions: int,
+                          hidden: int = 64, cell: str = "gru") -> dict:
+    """Encoder -> GRU/LSTM cell -> pi/vf heads. The cell's gate matmuls
+    are fused into single [in+hidden, k*hidden] products (one MXU call
+    per gate block per step)."""
+    import jax
+
+    if cell not in ("gru", "lstm"):
+        raise ValueError(f"cell must be 'gru' or 'lstm', got {cell!r}")
+    k_enc, k_cell, k_pi, k_vf = jax.random.split(key, 4)
+    gates = 3 if cell == "gru" else 4
+    return {
+        "cell_type": cell,
+        "enc": _dense(k_enc, obs_dim, hidden),
+        # one fused weight for all gates: [enc+hidden, gates*hidden]
+        "cell": _dense(k_cell, 2 * hidden, gates * hidden),
+        "pi": _dense(k_pi, hidden, n_actions),
+        "vf": _dense(k_vf, hidden, 1),
+    }
+
+
+def state_size(params) -> int:
+    h = params["cell"]["w"].shape[0] // 2
+    return 2 * h if params["cell_type"] == "lstm" else h
+
+
+def zero_state(params, batch: int) -> np.ndarray:
+    return np.zeros((batch, state_size(params)), np.float32)
+
+
+def _cell_step(params, x, state, np_mod):
+    """One recurrent step. ``x``: [B, H] encoded obs; ``state``: [B, S].
+    Shared between jax (np_mod=jnp) and numpy (np_mod=np) callers —
+    the rollout worker must replay bit-for-bit what the learner scans."""
+    np_ = np_mod
+    hidden = params["enc"]["w"].shape[1]
+    if params["cell_type"] == "gru":
+        h = state
+        zin = np_.concatenate([x, h], axis=-1)
+        g = zin @ params["cell"]["w"] + params["cell"]["b"]
+        z = _sigmoid(g[:, :hidden], np_)
+        r = _sigmoid(g[:, hidden:2 * hidden], np_)
+        # candidate uses the RESET-gated hidden: recompute its block
+        # with r*h (the fused matmul covers z/r; the candidate's hidden
+        # half re-projects through the same weight slice)
+        w_xc = params["cell"]["w"][:hidden, 2 * hidden:]
+        w_hc = params["cell"]["w"][hidden:, 2 * hidden:]
+        c = np_.tanh(x @ w_xc + (r * h) @ w_hc
+                     + params["cell"]["b"][2 * hidden:])
+        h_new = (1 - z) * h + z * c
+        return h_new, h_new
+    # lstm: state = [h | c]
+    h, c = state[:, :hidden], state[:, hidden:]
+    zin = np_.concatenate([x, h], axis=-1)
+    g = zin @ params["cell"]["w"] + params["cell"]["b"]
+    i = _sigmoid(g[:, :hidden], np_)
+    f = _sigmoid(g[:, hidden:2 * hidden] + 1.0, np_)   # forget bias 1
+    o = _sigmoid(g[:, 2 * hidden:3 * hidden], np_)
+    cand = np_.tanh(g[:, 3 * hidden:])
+    c_new = f * c + i * cand
+    h_new = o * np_.tanh(c_new)
+    return h_new, np_.concatenate([h_new, c_new], axis=-1)
+
+
+def _sigmoid(x, np_):
+    return 1.0 / (1.0 + np_.exp(-x))
+
+
+def forward_recurrent_seq(params, obs_seq, h0, dones):
+    """Jitted sequence forward: ``obs_seq`` [B, T, obs], ``h0`` [B, S],
+    ``dones`` [B, T] (1.0 AFTER the step at t ended an episode — the
+    carried state is zeroed before step t+1). Returns (logits [B,T,A],
+    values [B,T], h_final [B,S]) via one ``lax.scan`` over T."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.tanh(obs_seq @ params["enc"]["w"] + params["enc"]["b"])
+
+    def step(state, xs):
+        xt, done_prev = xs                      # [B, H], [B]
+        state = state * (1.0 - done_prev)[:, None]
+        h, state = _cell_step(params, xt, state, jnp)
+        return state, h
+
+    # done BEFORE each step: shift the per-step dones right by one
+    done_prev = jnp.concatenate(
+        [jnp.zeros_like(dones[:, :1]), dones[:, :-1]], axis=1)
+    x_t = jnp.swapaxes(x, 0, 1)                 # [T, B, H]
+    d_t = jnp.swapaxes(done_prev, 0, 1)         # [T, B]
+    h_final, hs = jax.lax.scan(step, h0, (x_t, d_t))
+    hs = jnp.swapaxes(hs, 0, 1)                 # [B, T, H]
+    logits = hs @ params["pi"]["w"] + params["pi"]["b"]
+    values = (hs @ params["vf"]["w"] + params["vf"]["b"]).squeeze(-1)
+    return logits, values, h_final
+
+
+def np_recurrent_step(params, obs, state):
+    """Rollout-side single step (numpy): [B, obs] x [B, S] ->
+    (logits [B, A], values [B], new_state [B, S])."""
+    x = np.tanh(obs @ params["enc"]["w"] + params["enc"]["b"])
+    h, state = _cell_step(params, x, state, np)
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    values = (h @ params["vf"]["w"] + params["vf"]["b"]).squeeze(-1)
+    return logits, values, state
+
+
+# ---------------------------------------------------------------------------
+# Memory envs (POMDPs)
+# ---------------------------------------------------------------------------
+
+class MemoryCueEnv:
+    """T-maze-style memory probe: step 0 shows a cue (+1/-1), steps
+    1..delay show zeros, and on the LAST step the agent must pick the
+    action matching the cue. A memoryless policy earns 0.5 on average;
+    remembering the cue earns 1.0 — clean, fast signal for recurrent
+    policies (reference: the LSTM-requiring debug envs,
+    rllib/examples/env/stateless_cartpole.py class of tests)."""
+
+    obs_dim = 2
+    n_actions = 2
+
+    def __init__(self, seed: int | None = None, delay: int = 3):
+        self.rng = np.random.default_rng(seed)
+        self.delay = delay
+        self.t = 0
+        self.cue = 1.0
+
+    def reset(self):
+        self.t = 0
+        self.cue = float(self.rng.choice([-1.0, 1.0]))
+        return np.array([self.cue, 0.0], np.float32)
+
+    def step(self, action: int):
+        self.t += 1
+        last = self.t >= self.delay
+        if last:
+            reward = 1.0 if (self.cue > 0) == (int(action) == 1) else 0.0
+            return np.zeros(2, np.float32), reward, True, {}
+        # countdown channel so the step index is observable (the TASK
+        # stays memoryful: the cue itself is long gone)
+        return np.array([0.0, (self.delay - self.t) / self.delay],
+                        np.float32), 0.0, False, {}
+
+
+class StatelessCartPole:
+    """CartPole with the velocity components masked out (reference:
+    ``rllib/examples/env/stateless_cartpole.py``): position + angle
+    only — balancing requires estimating velocities from history."""
+
+    obs_dim = 2
+    n_actions = 2
+
+    def __init__(self, seed: int | None = None):
+        from ray_tpu.rllib.env import CartPole
+
+        self.env = CartPole(seed=seed)
+
+    def reset(self):
+        return self.env.reset()[[0, 2]]
+
+    def step(self, action):
+        obs, r, d, i = self.env.step(action)
+        self.truncated = self.env.truncated
+        return obs[[0, 2]], r, d, i
+
+
+# ---------------------------------------------------------------------------
+# Recurrent PPO
+# ---------------------------------------------------------------------------
+
+class _RecurrentRolloutWorker:
+    """Collects FRAGMENTS of up to ``max_seq_len`` steps, each carrying
+    the recurrent state at its first step (reference: rnn_sequencing's
+    seq_lens + state_in batches). Fragments never cross episode ends;
+    short fragments are zero-padded and masked."""
+
+    def __init__(self, env_name, seed: int, max_seq_len: int):
+        self.env = make_env(env_name, seed=seed)
+        self.rng = np.random.default_rng(seed)
+        self.max_seq_len = max_seq_len
+
+    def sample(self, params_np: dict, num_steps: int, gamma: float,
+               lam: float):
+        from ray_tpu.rllib.ppo import _gae
+
+        env = self.env
+        T = self.max_seq_len
+        frags = []     # dicts of [T, ...] padded columns
+        episode_returns = []
+        obs = env.reset()
+        state = zero_state(params_np, 1)
+        ep_ret = 0.0
+        steps = 0
+        while steps < num_steps:
+            h0 = state[0].copy()
+            cols = {k: [] for k in ("obs", "actions", "logp", "values",
+                                    "rewards", "dones")}
+            t = 0
+            done = False
+            while t < T and steps < num_steps:
+                logits, value, state = np_recurrent_step(
+                    params_np, obs[None], state)
+                probs = _softmax_rows(logits)
+                action = int(_sample_actions(self.rng, probs)[0])
+                cols["obs"].append(obs.copy())
+                cols["actions"].append(action)
+                cols["logp"].append(
+                    float(np.log(probs[0, action] + 1e-8)))
+                cols["values"].append(float(value[0]))
+                obs, r, done, _ = env.step(action)
+                ep_ret += r
+                cols["rewards"].append(float(r))
+                cols["dones"].append(float(done))
+                t += 1
+                steps += 1
+                if done:
+                    episode_returns.append(ep_ret)
+                    ep_ret = 0.0
+                    obs = env.reset()
+                    state = zero_state(params_np, 1)
+                    break
+            if done:
+                last_v = 0.0
+            else:
+                _, v, _ = np_recurrent_step(params_np, obs[None], state)
+                last_v = float(v[0])
+            adv, ret = _gae(np.asarray(cols["rewards"]),
+                            np.asarray(cols["values"]),
+                            np.asarray(cols["dones"]), last_v,
+                            gamma, lam)
+            pad = T - t
+            frag = {
+                "obs": np.pad(np.asarray(cols["obs"], np.float32),
+                              ((0, pad), (0, 0))),
+                "actions": np.pad(
+                    np.asarray(cols["actions"], np.int32), (0, pad)),
+                "logp": np.pad(
+                    np.asarray(cols["logp"], np.float32), (0, pad)),
+                "advantages": np.pad(adv.astype(np.float32), (0, pad)),
+                "returns": np.pad(ret.astype(np.float32), (0, pad)),
+                "dones": np.pad(
+                    np.asarray(cols["dones"], np.float32), (0, pad)),
+                "mask": np.pad(np.ones(t, np.float32), (0, pad)),
+                "h0": h0,
+            }
+            frags.append(frag)
+        batch = {k: np.stack([f[k] for f in frags]) for k in frags[0]}
+        batch["episode_returns"] = episode_returns
+        return batch
+
+
+@dataclass
+class RecurrentPPOConfig:
+    env: str = "CartPole-v1"
+    cell: str = "gru"                  # "gru" | "lstm"
+    max_seq_len: int = 16
+    num_rollout_workers: int = 1
+    rollout_fragment_length: int = 256
+    lr: float = 3e-3
+    gamma: float = 0.99
+    lam: float = 0.95
+    clip_eps: float = 0.2
+    entropy_coeff: float = 0.01
+    vf_coeff: float = 0.5
+    num_sgd_iter: int = 4
+    hidden: int = 32
+    seed: int = 0
+
+    def environment(self, env) -> "RecurrentPPOConfig":
+        return replace(self, env=env)
+
+    def rollouts(self, **kw) -> "RecurrentPPOConfig":
+        return replace(self, **kw)
+
+    def training(self, **kw) -> "RecurrentPPOConfig":
+        return replace(self, **kw)
+
+    def build(self) -> "RecurrentPPO":
+        return RecurrentPPO(self)
+
+
+class RecurrentPPO:
+    """PPO over padded [B, T] fragments with per-fragment initial state
+    (reference: the LSTM auto-wrapped PPO, recurrent_net.py:25). The
+    whole update — scan forward, masked clipped surrogate, Adam — is
+    one jit."""
+
+    def __init__(self, config: RecurrentPPOConfig):
+        import jax
+        import optax
+
+        self.config = config
+        env = make_env(config.env, seed=config.seed)
+        self.tx = optax.adam(config.lr)
+        self.params = init_recurrent_module(
+            jax.random.key(config.seed), env.obs_dim, env.n_actions,
+            config.hidden, config.cell)
+        self.opt_state = self.tx.init(
+            {k: v for k, v in self.params.items() if k != "cell_type"})
+        self._update = jax.jit(partial(
+            _rppo_update, tx=self.tx, cell=config.cell,
+            clip_eps=config.clip_eps,
+            entropy_coeff=config.entropy_coeff,
+            vf_coeff=config.vf_coeff))
+        worker_cls = ray_tpu.remote(_RecurrentRolloutWorker)
+        self.workers = [
+            worker_cls.remote(config.env, config.seed + 1000 * (i + 1),
+                              config.max_seq_len)
+            for i in range(config.num_rollout_workers)
+        ]
+        self.iteration = 0
+
+    def _params_np(self):
+        import jax
+
+        out = {k: (v if k == "cell_type" else jax.tree.map(np.asarray, v))
+               for k, v in self.params.items()}
+        return out
+
+    def train(self) -> dict:
+        cfg = self.config
+        params_np = self._params_np()
+        batches = ray_tpu.get([
+            w.sample.remote(params_np, cfg.rollout_fragment_length,
+                            cfg.gamma, cfg.lam)
+            for w in self.workers
+        ])
+        episode_returns = [r for b in batches
+                           for r in b["episode_returns"]]
+        batch = {k: np.concatenate([b[k] for b in batches])
+                 for k in ("obs", "actions", "logp", "advantages",
+                           "returns", "dones", "mask", "h0")}
+        # masked advantage normalization
+        m = batch["mask"]
+        adv = batch["advantages"]
+        mean = (adv * m).sum() / m.sum()
+        std = np.sqrt(((adv - mean) ** 2 * m).sum() / m.sum()) + 1e-8
+        batch["advantages"] = (adv - mean) / std * m
+        stats = None
+        weights = {k: v for k, v in self.params.items()
+                   if k != "cell_type"}
+        for _ in range(cfg.num_sgd_iter):
+            weights, self.opt_state, stats = self._update(
+                weights, self.opt_state, batch)
+        self.params = {**weights, "cell_type": cfg.cell}
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (float(np.mean(episode_returns))
+                                    if episode_returns else 0.0),
+            "num_episodes": len(episode_returns),
+            "policy_loss": float(stats["policy_loss"]),
+            "entropy": float(stats["entropy"]),
+            "num_env_steps_sampled": int(m.sum()),
+        }
+
+    def compute_action(self, obs, state=None):
+        params_np = self._params_np()
+        if state is None:
+            state = zero_state(params_np, 1)
+        logits, _, state = np_recurrent_step(
+            params_np, np.asarray(obs, np.float32)[None], state)
+        return int(np.argmax(logits[0])), state
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _rppo_update(params, opt_state, batch, *, tx, cell, clip_eps,
+                 entropy_coeff, vf_coeff):
+    import jax
+    import jax.numpy as jnp
+
+    full = {**params, "cell_type": cell}
+
+    def loss_fn(p):
+        pf = {**p, "cell_type": cell}
+        logits, values, _ = forward_recurrent_seq(
+            pf, batch["obs"], batch["h0"], batch["dones"])
+        m = batch["mask"]
+        n = m.sum()
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1).squeeze(-1)
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        unclipped = ratio * adv
+        clipped = jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * adv
+        policy_loss = -(jnp.minimum(unclipped, clipped) * m).sum() / n
+        vf_loss = (((values - batch["returns"]) ** 2) * m).sum() / n
+        ent = -(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+                * m).sum() / n
+        total = policy_loss + vf_coeff * vf_loss - entropy_coeff * ent
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": ent}
+
+    del full
+    (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = jax.tree.map(lambda p, u: p + u, params, updates)
+    return params, opt_state, stats

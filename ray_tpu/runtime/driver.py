@@ -159,6 +159,29 @@ class ClusterRuntime:
         # for retry by the flusher (never silently dropped)
         self._direct_retry: list[tuple[str, bytes]] = []
         self._put_report_cv = threading.Condition()
+        # In-process memory store for small direct task returns
+        # (reference: CoreWorkerMemoryStore, memory_store.h:43): encoded
+        # payloads keyed by oid hex, ZERO store/raylet/GCS traffic per
+        # object. Entries are evicted when their refs die (refcount
+        # release hook) and PROMOTED to the shm store the moment their
+        # ref is serialized off-process (serialize hook) so remote
+        # consumers always find a cluster-visible copy. Requires ref
+        # counting (the death signal); disabled with it.
+        self._memstore: dict[str, bytes] = {}
+        self._mem_cv = threading.Condition()   # direct-result arrivals
+        self._mem_arrivals = 0                 # arrival epoch (see get)
+        # refs serialized off-process BEFORE their object arrived (a
+        # pending task's return passed straight into another task): the
+        # object must become cluster-visible the moment it lands, or the
+        # consuming worker never finds it. _mem_cv guards the
+        # check-miss-then-mark vs update-then-check interleavings.
+        self._promote_pending: set[str] = set()
+        self._use_memstore = self._ref_enabled
+        if self._use_memstore:
+            self._memstore_release_hook = self._evict_mem_objects
+            self._memstore_serialize_hook = self._promote_mem_object
+            self._refs.add_release_hook(self._memstore_release_hook)
+            self._refs.add_serialize_hook(self._memstore_serialize_hook)
         threading.Thread(target=self._put_report_loop, daemon=True,
                          name="put-report-flusher").start()
         # a nested in-worker runtime must NOT claim: the Worker loop owns
@@ -211,6 +234,31 @@ class ClusterRuntime:
             beat = now - last_beat >= period
             if self._ref_flush_now(force_heartbeat=beat) or beat:
                 last_beat = now
+            if beat:
+                self._sweep_promote_pending()
+
+    def _sweep_promote_pending(self):
+        """Drop promotion-on-arrival promises whose objects became
+        cluster-visible some other way (large returns land in the
+        executing node's shm + location directory, never through the
+        direct-return path) — without this sweep a long-lived driver
+        passing pending refs into tasks grows the set without bound."""
+        with self._mem_cv:
+            candidates = list(self._promote_pending)
+        if not candidates:
+            return
+        visible = [o for o in candidates
+                   if self.store.contains(bytes.fromhex(o))]
+        remote = [o for o in candidates if o not in set(visible)]
+        if remote:
+            try:
+                locs = self._gcs.call("get_object_locations", oids=remote)
+                visible += [o for o, nodes in locs.items() if nodes]
+            except Exception:  # noqa: BLE001 - GCS busy: next beat
+                pass
+        if visible:
+            with self._mem_cv:
+                self._promote_pending.difference_update(visible)
 
     def _ref_flush_now(self, force_heartbeat: bool = False) -> bool:
         """Send pending ref deltas (serialized by a lock so the loop and
@@ -255,12 +303,80 @@ class ClusterRuntime:
                 self._put_report_cv.notify()
         return ObjectRef(oid)
 
+    def _evict_mem_objects(self, oids: list):
+        """Refcount release hook: every local ref to these oids died —
+        drop the in-process copies (the authoritative release of any
+        PROMOTED shm copy rides the normal ref protocol)."""
+        pop = self._memstore.pop
+        for oid_hex in oids:
+            pop(oid_hex, None)
+
+    def _promote_mem_object(self, oid_hex: str):
+        """Serialize hook: an ObjectRef is being pickled (task arg, put
+        payload, client channel...). If its object lives only in this
+        process's memory store, write it through to the shm store + pin
+        report NOW — the serialized ref may travel to a process that can
+        only resolve cluster-visible objects. Runs before the enclosing
+        dumps() returns, so promotion always precedes the send. A ref
+        serialized BEFORE its direct return arrived is marked for
+        promotion-on-arrival instead (the object exists nowhere yet;
+        when the push reply lands it must go cluster-visible, not just
+        into this process's memory)."""
+        if self._closed:
+            return
+        with self._mem_cv:
+            payload = self._memstore.get(oid_hex)
+            if payload is None:
+                # not here yet: if it's not already cluster-visible,
+                # promote when (if ever) it arrives as a direct return
+                if not self.store.contains(bytes.fromhex(oid_hex)):
+                    self._promote_pending.add(oid_hex)
+                return
+        from ray_tpu._private.shm_store import (ObjectExistsError,
+                                                StoreFullError)
+
+        try:
+            object_codec.put_raw(self.store, bytes.fromhex(oid_hex),
+                                 payload, hold=True)
+        except ObjectExistsError:
+            return  # already cluster-visible
+        except StoreFullError:
+            try:
+                self._raylet.call("request_space", nbytes=len(payload))
+                object_codec.put_raw(self.store, bytes.fromhex(oid_hex),
+                                     payload, hold=True)
+            except Exception:  # noqa: BLE001 - keep the mem copy; a
+                return        # remote consumer degrades to ObjectLost
+        with self._put_report_cv:
+            self._put_report_buf.append((oid_hex, len(payload)))
+            self._put_report_cv.notify()
+
     def _accept_direct_results(self, results: dict):
         """Small task returns that rode the push reply (reference: the
         owner's in-process memory store for direct-call returns,
-        memory_store.h:43): land each in the LOCAL store and register
-        its pin through the batched put-report path. First write wins
-        against a racing duplicate execution's store copy."""
+        memory_store.h:43): land each in the process-local memory store
+        — no shm write, no pin RPC, no location tracking. Falls back to
+        the durable shm path when ref counting is off (nothing would
+        ever evict the memory copies)."""
+        if self._use_memstore and not self._closed:
+            with self._mem_cv:
+                self._memstore.update(results)
+                self._mem_arrivals += 1
+                promote = ([o for o in results
+                            if o in self._promote_pending]
+                           if self._promote_pending else ())
+                self._promote_pending.difference_update(promote)
+                self._mem_cv.notify_all()
+            for oid_hex in promote:
+                self._promote_mem_object(oid_hex)
+                if self._refs.count(oid_hex) == 0:
+                    # every local ref died while the result was in
+                    # flight (submit-and-forget chains): the promoted
+                    # shm copy serves the consumer; keeping the memory
+                    # copy would leak — no death notice will ever come
+                    # again for this oid
+                    self._memstore.pop(oid_hex, None)
+            return
         from ray_tpu._private.shm_store import (ObjectExistsError,
                                                 StoreFullError)
 
@@ -347,46 +463,56 @@ class ClusterRuntime:
                     pass
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None):
-        # FAST PATH: every object already local and sealed (local puts,
-        # direct small returns — the common case) resolves through ONE
-        # batched store call instead of contains + get + release C round
-        # trips per object (reference analog: the owner's in-process
-        # memory store hit, memory_store.h:43). Capped at the slow
-        # path's 4096 window: get_many holds the store's process-shared
-        # mutex for the whole batch, and a 200k-ref envelope get must
-        # not stall every other client on the node for that long.
-        bins = [r.id.binary() for r in refs] if len(refs) <= 4096 else None
-        views = self.store.get_many(bins) if bins is not None else [None]
-        if all(v is not None for v in views):
-            epoch0 = self._refs.created_epoch() if self._ref_enabled else 0
-            out = []
-            err = None
-            try:
-                for v in views:
-                    value, is_error = object_codec.decode_view(v)
-                    if is_error:
-                        err = value
-                        break
-                    out.append(value)
-            finally:
-                del views
-                self.store.release_many(bins)
-            if err is not None:
-                raise err
-            if self._ref_enabled and self._refs.created_epoch() != epoch0:
-                self._ref_flush_now()
-            return out
-        # drop the partial hits' read refs; the slow path re-reads per
-        # object as each becomes local
+        # FAST PATH: every object already local — in the process memory
+        # store (direct small returns: zero syscalls) or sealed in shm
+        # (local puts) — resolves through dict hits + ONE batched store
+        # call instead of contains + get + release C round trips per
+        # object (reference analog: the owner's in-process memory store
+        # hit, memory_store.h:43). No size cap: the store's get_many /
+        # release_many chunk internally (shm_store.BATCH_WINDOW), so the
+        # process-shared mutex hold stays bounded per C call even for a
+        # 200k-ref envelope get.
+        mem = self._memstore if self._use_memstore else None
+        bins = [r.id.binary() for r in refs]
         if bins is not None:
-            hits = [b for b, v in zip(bins, views) if v is not None]
+            payloads = [mem.get(r.hex()) for r in refs] if mem \
+                else [None] * len(refs)
+            misses = [b for b, p in zip(bins, payloads) if p is None]
+            views = self.store.get_many(misses) if misses else []
+            if all(v is not None for v in views):
+                epoch0 = (self._refs.created_epoch()
+                          if self._ref_enabled else 0)
+                out = []
+                err = None
+                it = iter(views)
+                try:
+                    for p in payloads:
+                        v = memoryview(p) if p is not None else next(it)
+                        value, is_error = object_codec.decode_view(v)
+                        if is_error:
+                            err = value
+                            break
+                        out.append(value)
+                finally:
+                    del views, it
+                    if misses:
+                        self.store.release_many(misses)
+                if err is not None:
+                    raise err
+                if self._ref_enabled and \
+                        self._refs.created_epoch() != epoch0:
+                    self._ref_flush_now()
+                return out
+            # drop the partial hits' read refs; the slow path re-reads
+            # per object as each becomes local
+            hits = [b for b, v in zip(misses, views) if v is not None]
             del views
             if hits:
                 self.store.release_many(hits)
         oids = [r.id.hex() for r in refs]
         deadline = None if timeout is None else time.monotonic() + timeout
-        pending = [o for o in oids
-                   if not self.store.contains(bytes.fromhex(o))]
+        pending = [o for o in oids if not (mem and o in mem)
+                   and not self.store.contains(bytes.fromhex(o))]
         recover_tick = 0.0
         while pending:
             # Local completions (direct small returns, same-host tasks)
@@ -399,22 +525,38 @@ class ClusterRuntime:
             # Re-filter BEFORE the deadline check: a final ensure_local
             # that localized everything while eating the budget must
             # exit success, not GetTimeoutError.
-            pending = [o for o in pending
-                       if not self.store.contains(bytes.fromhex(o))]
+            pending = [o for o in pending if not (mem and o in mem)
+                       and not self.store.contains(bytes.fromhex(o))]
             if not pending:
                 break
-            step = 5.0
+            if deadline is not None and deadline - time.monotonic() <= 0:
+                raise exc.GetTimeoutError(
+                    f"get() timed out waiting for {len(pending)} objects")
+            # Two arrival planes, two waits. Direct returns land in the
+            # process MEMORY store, which the raylet cannot observe —
+            # parking inside the raylet while they piled up locally ate
+            # its whole timeout. So: wait briefly on the direct-arrival
+            # cv; only when that plane is quiet (no notify AND no
+            # arrival since), park in the raylet's ensure_local, which
+            # wakes event-driven on local shm seals and triggers remote
+            # pulls. A direct result landing mid-park costs at most the
+            # 0.25 s park timeout.
+            if self._use_memstore:
+                with self._mem_cv:
+                    arrivals0 = self._mem_arrivals
+                    woke = self._mem_cv.wait(timeout=0.02)
+                if woke or self._mem_arrivals != arrivals0:
+                    continue
+            # short park only when the direct-arrival blind spot exists
+            # (memstore on): without it the raylet's event-driven wait
+            # covers every arrival path, and 0.25s parks would 8x the
+            # blocked-get RPC churn for nothing
+            step = 0.25 if self._use_memstore else 2.0
             if deadline is not None:
-                remain = deadline - time.monotonic()
-                if remain <= 0:
-                    raise exc.GetTimeoutError(
-                        f"get() timed out waiting for {len(pending)} objects")
-                step = min(step, remain)
+                step = min(step, max(deadline - time.monotonic(), 0.01))
             window = pending[:4096]
-            # RpcClient multiplexes by request id — no lock needed, and
-            # holding one across the blocking poll would stall submits
             leftover = self._raylet.call("ensure_local", oids=window,
-                                         timeout_s=min(step, 2.0))
+                                         timeout_s=step)
             now = time.monotonic()
             if leftover and now - recover_tick >= 2.0:
                 recover_tick = now
@@ -563,6 +705,14 @@ class ClusterRuntime:
         ensure_local and the read (LRU pressure), re-pull and retry."""
         from ray_tpu._private.shm_store import ObjectNotFoundError
 
+        if self._use_memstore:
+            payload = self._memstore.get(oid_hex)
+            if payload is not None:
+                value, is_error = object_codec.decode_view(
+                    memoryview(payload))
+                if is_error:
+                    raise value
+                return value
         for _ in range(3):
             try:
                 value, is_error = object_codec.get_value(
@@ -589,10 +739,12 @@ class ClusterRuntime:
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: list = []
         not_ready = list(refs)
+        mem = self._memstore if self._use_memstore else None
         while True:
             still = []
             for r in not_ready:
-                if self.store.contains(r.id.binary()):
+                if (mem and r.id.hex() in mem) or \
+                        self.store.contains(r.id.binary()):
                     ready.append(r)
                 else:
                     still.append(r)
@@ -620,6 +772,8 @@ class ClusterRuntime:
         with self._lineage_lock:
             for o in oids:
                 self._lineage.pop(o, None)
+        for o in oids:
+            self._memstore.pop(o, None)
         try:
             self._raylet.call("free_objects", oids=oids)
         except (OSError, ConnectionLost):
@@ -676,6 +830,19 @@ class ClusterRuntime:
                 for a in spec.args]
         kwargs = {k: ("__objref__", v.hex()) if isinstance(v, ObjectRef)
                   else v for k, v in spec.kwargs.items()}
+        if self._use_memstore:
+            # top-level ref args never hit ObjectRef.__reduce__ (markers
+            # replace them before pickling), so the serialize-hook
+            # promotion doesn't fire — promote memory-store residents
+            # (or mark not-yet-arrived results promote-on-arrival)
+            # here: the executing worker resolves args from the
+            # cluster-visible store
+            for a in spec.args:
+                if isinstance(a, ObjectRef):
+                    self._promote_mem_object(a.hex())
+            for v in spec.kwargs.values():
+                if isinstance(v, ObjectRef):
+                    self._promote_mem_object(v.hex())
         if pin_sink is not None:
             pin_sink.update(a[1] for a in args
                             if type(a) is tuple and len(a) == 2
@@ -701,12 +868,14 @@ class ClusterRuntime:
         return blob
 
     def _function_blob(self, fn):
-        """Pickle-once function export (reference: the GCS function table
-        — ``_private/function_manager.py:228`` exports each function once;
-        executors fetch by id). Re-pickling the closure on EVERY submit
-        dominates the hot path for small tasks.
+        """Pickle-once, EXPORT-once function table (reference:
+        ``_private/function_manager.py:228`` — each function is exported
+        to the GCS once; executors fetch by id and cache). Tasks then
+        carry only the 16-byte content id: at 10k+ submits/s, shipping
+        the ~500-byte closure blob per task (and hashing it per task on
+        the worker) was a measurable slice of the frame encode/decode.
 
-        Returns ``(blob, closure_oids)`` — ObjectRefs captured in the
+        Returns ``(fn_id, closure_oids)`` — ObjectRefs captured in the
         function's CLOSURE are task dependencies too: every submit pins
         them alongside the args (the cache keeps the captured set, so
         repeat submits pin without re-pickling)."""
@@ -714,14 +883,21 @@ class ClusterRuntime:
         hit = self._fn_blobs.get(key)
         if hit is not None and hit[0] is fn:
             return hit[1], hit[2]
+        import hashlib
+
         with self._refs.capture() as cap:
             blob = cloudpickle.dumps(fn, protocol=5)
         closure_oids = frozenset(cap.oids)
+        fn_id = hashlib.blake2b(blob, digest_size=16).hexdigest()
+        # registration must land BEFORE any task referencing the id is
+        # pushed (synchronous; once per function per driver). Content-
+        # addressed: re-registering the same id is an idempotent no-op.
+        self._gcs.call("kv_put", ns="__functions__", key=fn_id, value=blob)
         if len(self._fn_blobs) > 512:
             self._fn_blobs.clear()
         # fn ref pins id(fn) stable
-        self._fn_blobs[key] = (fn, blob, closure_oids)
-        return blob, closure_oids
+        self._fn_blobs[key] = (fn, fn_id, closure_oids)
+        return fn_id, closure_oids
 
     def submit_task(self, spec: TaskSpec) -> list[ObjectRef]:
         streaming = spec.num_returns in ("streaming", "dynamic")
@@ -742,12 +918,12 @@ class ClusterRuntime:
             self._submit_actor_task(spec)
         else:
             pin_oids: set = set()
-            fn_blob, closure_oids = self._function_blob(spec.function)
+            fn_id, closure_oids = self._function_blob(spec.function)
             pin_oids.update(closure_oids)
             task = {
                 "task_id": spec.task_id.hex(),
                 "name": spec.function_name,
-                "function_blob": fn_blob,
+                "function_id": fn_id,
                 "args_blob": self._wire_args(spec, pin_oids),
                 "return_oids": [o.hex() for o in spec.return_ids],
                 "resources": dict(spec.resources.resources),
@@ -816,13 +992,19 @@ class ClusterRuntime:
         if task.get("pinned"):
             # the task will never run to release its arg pins itself
             self._refs.release_task_pin(task.get("task_id", ""))
+        with self._mem_cv:
+            # no result will ever arrive for these: drop any promised
+            # promotion-on-arrival (the error object sealed below is
+            # cluster-visible on its own)
+            self._promote_pending.difference_update(
+                task.get("return_oids", ()))
         for oid_hex in task.get("return_oids", ()):
             if locs.get(oid_hex):
                 continue  # the task actually finished before the break
             oid = bytes.fromhex(oid_hex)
             if self._closed:
                 return  # store may be unmapped mid-shutdown: never touch
-            if self.store.contains(oid):
+            if oid_hex in self._memstore or self.store.contains(oid):
                 continue
             try:
                 size = object_codec.put_value_durable(
@@ -1286,6 +1468,12 @@ class ClusterRuntime:
             from ray_tpu.runtime import refcount as _refcount
             _refcount.release_flusher(self.client_id)
             self._refs.reset()
+        if self._use_memstore:
+            # reset() clears hooks wholesale for the flusher owner; a
+            # nested runtime must unhook only its own
+            self._refs.remove_release_hook(self._memstore_release_hook)
+            self._refs.remove_serialize_hook(self._memstore_serialize_hook)
+            self._memstore.clear()
         self._closed = True
         if self._log_sub is not None:
             self._log_sub.close()

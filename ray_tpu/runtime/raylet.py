@@ -79,7 +79,13 @@ class Raylet(RpcServer):
             self, max_workers=max(1, int(resources.get("CPU", 1))))
         # (actor_id, incarnation) placements currently inside spawn() —
         # the host_actor idempotency window (see rpc_host_actor)
-        self._pending_hosts: set[tuple] = set()
+        # (actor_id, incarnation) -> in-flight hosting attempt: event +
+        # outcome, so a deduped GCS retry can RETURN THE FIRST CALL'S
+        # RESULT instead of unconditional success (an unconditional ok
+        # for a first call that then failed — with its error reply lost
+        # on the dead channel that caused the retry — left actors
+        # PENDING forever with no failure report)
+        self._pending_hosts: dict[tuple, dict] = {}
         self.scheduler = TaskScheduler(
             self, resources=resources,
             infeasible_timeout_s=infeasible_timeout_s)
@@ -562,23 +568,45 @@ class Raylet(RpcServer):
         placement once when the shared placement channel dies mid-call
         (it cannot know whether the first call landed), so a duplicate
         for an actor already spawning/live here must be a no-op success
-        — hosting twice would run two copies of the actor. The pending
-        set covers the window where the first call is still inside
-        spawn() (worker fields are only set after it returns)."""
+        — hosting twice would run two copies of the actor. A duplicate
+        arriving while the first call is STILL INSIDE spawn() waits for
+        and returns the first call's actual outcome — its synchronous
+        failure (try_acquire rejection) must not be masked by an
+        unconditional ok when the first reply died with its channel."""
         key = (actor_id, incarnation)
         with self.workers.lock:
-            if key in self._pending_hosts:
-                return {"ok": True, "dedup": True}
-            for w in self.workers.workers.values():
-                if (w.state == "actor" and w.actor_id == actor_id
-                        and w.incarnation == incarnation):
-                    return {"ok": True, "dedup": True}
-            self._pending_hosts.add(key)
+            entry = self._pending_hosts.get(key)
+            if entry is None:
+                for w in self.workers.workers.values():
+                    if (w.state == "actor" and w.actor_id == actor_id
+                            and w.incarnation == incarnation):
+                        return {"ok": True, "dedup": True}
+                entry = {"ev": threading.Event(), "result": None,
+                         "error": None}
+                self._pending_hosts[key] = entry
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            entry["ev"].wait(timeout=60.0)
+            if entry["error"] is not None:
+                raise entry["error"]
+            if entry["result"] is not None:
+                return {**entry["result"], "dedup": True}
+            # first call still inside spawn after 60s: treat as in
+            # progress (a dead spawn is caught by its own deliver path)
+            return {"ok": True, "dedup": True}
         try:
-            return self._host_actor(actor_id, spec, incarnation)
+            result = self._host_actor(actor_id, spec, incarnation)
+            entry["result"] = result
+            return result
+        except BaseException as e:
+            entry["error"] = e
+            raise
         finally:
+            entry["ev"].set()
             with self.workers.lock:
-                self._pending_hosts.discard(key)
+                self._pending_hosts.pop(key, None)
 
     def _host_actor(self, actor_id, spec, incarnation):
         demand = spec.get("resources", {})

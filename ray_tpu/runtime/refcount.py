@@ -61,6 +61,16 @@ class RefCounter:
         # local-mode immediate release callback (no flusher): called with
         # the oid hex when its count drops to zero
         self._local_release_cb = None
+        # process-wide release hooks: called (outside the lock) with the
+        # oids whose local count dropped to zero in a flush window —
+        # the owner's in-process memory store evicts through this, no
+        # matter which loop (driver or worker) drains the counter
+        self._release_hooks: list = []
+        # serialization hook: called with the oid hex of every ObjectRef
+        # pickled in this process (any path — task args, puts, client
+        # channels); the owner memory store promotes through it so a
+        # ref shipped off-process always has a cluster-visible object
+        self._serialize_hooks: list = []
 
     # ------------------------------------------------------------------
     # instance tracking (ObjectRef hooks)
@@ -76,7 +86,11 @@ class RefCounter:
                 signal = True
             else:
                 signal = False
-        if signal:
+        # is_set guard: Event.set() takes the Event's condition lock and
+        # notifies even when already set — at 10k+ ref creations/s that
+        # lock+notify per ref measurably stalls the submitting thread on
+        # a small host (the flusher clears the flag only when it drains)
+        if signal and not self._signal.is_set():
             self._signal.set()
 
     def on_destroyed(self, oid_hex: str):
@@ -132,13 +146,37 @@ class RefCounter:
         cap = getattr(self._tl, "capture", None)
         if cap is not None:
             cap.add(oid_hex)
+        for hook in self._serialize_hooks:
+            try:
+                hook(oid_hex)
+            except Exception:  # noqa: BLE001 - promotion is best-effort
+                pass
+
+    def add_serialize_hook(self, cb):
+        self._serialize_hooks.append(cb)
+
+    def remove_serialize_hook(self, cb):
+        if cb in self._serialize_hooks:
+            self._serialize_hooks.remove(cb)
+
+    def add_release_hook(self, cb):
+        self._release_hooks.append(cb)
+
+    def remove_release_hook(self, cb):
+        if cb in self._release_hooks:
+            self._release_hooks.remove(cb)
+
+    def count(self, oid_hex: str) -> int:
+        """Current local instance count (GIL-atomic dict read)."""
+        return self._counts.get(oid_hex, 0)
 
     def created_epoch(self) -> int:
         """Monotone counter of ObjectRef constructions in this process;
         callers compare before/after a deserialize to decide whether a
-        synchronous flush is needed (borrower registration)."""
-        with self._lock:
-            return self._created_epoch
+        synchronous flush is needed (borrower registration). Lock-free:
+        a single int read is GIL-atomic, and callers only compare for
+        inequality across their own critical section."""
+        return self._created_epoch
 
     # ------------------------------------------------------------------
     # task pins + contains edges
@@ -149,12 +187,14 @@ class RefCounter:
             return
         with self._lock:
             self._pins.append((task_id, list(oids)))
-        self._signal.set()
+        if not self._signal.is_set():
+            self._signal.set()
 
     def release_task_pin(self, task_id: str):
         with self._lock:
             self._pin_releases.append(task_id)
-        self._signal.set()
+        if not self._signal.is_set():
+            self._signal.set()
 
     def add_contains(self, outer_hex: str, inner_hexes) -> None:
         inner = [h for h in inner_hexes if h != outer_hex]
@@ -162,7 +202,8 @@ class RefCounter:
             return
         with self._lock:
             self._contains.append((outer_hex, inner))
-        self._signal.set()
+        if not self._signal.is_set():
+            self._signal.set()
 
     # ------------------------------------------------------------------
     # flushing
@@ -195,6 +236,13 @@ class RefCounter:
             pins, self._pins = self._pins, []
             rel, self._pin_releases = self._pin_releases, []
             contains, self._contains = self._contains, []
+        if (remove or transient) and self._release_hooks:
+            dead = remove + transient
+            for hook in self._release_hooks:
+                try:
+                    hook(dead)
+                except Exception:  # noqa: BLE001 - eviction is best-effort
+                    pass
         if not (add or remove or transient or pins or rel or contains):
             return None
         return {"add": add, "remove": remove, "transient": transient,
@@ -290,6 +338,8 @@ class RefCounter:
             self._pin_releases.clear()
             self._contains.clear()
             self._local_release_cb = None
+            self._release_hooks.clear()
+            self._serialize_hooks.clear()
 
 
 def flush_once(counter: "RefCounter", call, client_id: str, kind: str,

@@ -270,6 +270,7 @@ class Worker:
         self._push_conn_lock = threading.Lock()
         self._lease_watch_gen = 0
         self._fn_cache: dict[int, tuple] = {}   # hash(blob) -> (blob, fn)
+        self._fn_id_cache: dict[str, object] = {}   # fn_id -> fn
         self._report_buf: list[tuple[str, int]] = []
         self._report_cv = threading.Condition()
         threading.Thread(target=self._report_flush_loop, daemon=True,
@@ -284,6 +285,7 @@ class Worker:
         _cfg = _get_config()
         self._refs = _refcount.global_counter
         self._ref_enabled = _cfg.ref_counting_enabled
+        self._direct_limit = _cfg.max_direct_call_object_size
         self._ref_send_lock = threading.Lock()
         if self._ref_enabled:
             _refcount.claim_flusher(self.worker_id)
@@ -415,7 +417,13 @@ class Worker:
     # argument / result plumbing
     # ------------------------------------------------------------------
 
+    _EMPTY_ARGS_BLOB = cloudpickle.dumps(([], {}), protocol=5)
+
     def _resolve_args(self, task: dict):
+        if task["args_blob"] == self._EMPTY_ARGS_BLOB:
+            # no-arg calls dominate microbench/fan-out loads: skip the
+            # per-task unpickle (and the marker scan) entirely
+            return [], {}
         epoch0 = (self._refs.created_epoch() if self._ref_enabled else 0)
         args, kwargs = cloudpickle.loads(task["args_blob"])
         dep_oids = [a[1] for a in _iter_markers(args, kwargs)]
@@ -508,9 +516,7 @@ class Worker:
     # memory store, memory_store.h:43)
     def _try_direct_return(self, sink: dict, oid_hex: str, value,
                            is_error: bool = False) -> bool:
-        from ray_tpu.utils.config import get_config
-
-        limit = get_config().max_direct_call_object_size
+        limit = self._direct_limit
         try:
             payload, obj, caught = object_codec.encode_bytes(
                 value, is_error=is_error, limit=limit)
@@ -654,6 +660,25 @@ class Worker:
         self._fn_cache[key] = (blob, fn)
         return fn
 
+    def _load_function_id(self, fn_id: str):
+        """Function-TABLE path: the task carries a 16-byte content id;
+        the blob is fetched from the GCS table once per (worker,
+        function) and cached by id (content-addressed — no blob compare
+        needed on hits)."""
+        hit = self._fn_id_cache.get(fn_id)
+        if hit is not None:
+            return hit
+        blob = self._gcs.call("kv_get", ns="__functions__", key=fn_id)
+        if blob is None:
+            raise exc.TaskError(
+                "?", RuntimeError(f"function {fn_id} not in the GCS "
+                                  f"function table"))
+        fn = cloudpickle.loads(blob)
+        if len(self._fn_id_cache) > 256:
+            self._fn_id_cache.clear()
+        self._fn_id_cache[fn_id] = fn
+        return fn
+
     def _execute(self, task: dict):
         from ray_tpu.runtime_context import (reset_task_namespace,
                                              set_task_namespace)
@@ -680,6 +705,9 @@ class Worker:
                 fn = resolve_function_ref(task["function_ref"])
                 args = list(task.get("args") or [])
                 kwargs = dict(task.get("kwargs") or {})
+            elif "function_id" in task:
+                fn = self._load_function_id(task["function_id"])
+                args, kwargs = self._resolve_args(task)
             else:
                 fn = self._load_function(task["function_blob"])
                 args, kwargs = self._resolve_args(task)
@@ -687,19 +715,31 @@ class Worker:
             self._store_error(task, e)
             self._report_task_event(task, started, False)
             return
+        def _call():
+            result = fn(*args, **kwargs)
+            if _iscoroutine(result):
+                # async def remote function: drive it to completion
+                # on a per-task loop (reference: async tasks run on
+                # the worker's event loop)
+                import asyncio
+
+                result = asyncio.run(result)
+            return result
+
         try:
-            from ray_tpu.util.tracing import execution_span
+            trace_ctx = task.get("trace_ctx")
+            if trace_ctx is None:
+                # tracing off (the default): no generator-contextmanager
+                # frame on the per-task hot path
+                result = _call()
+            else:
+                from ray_tpu.util.tracing import execution_span
 
-            with execution_span(task.get("name", "?"),
-                                task.get("trace_ctx")):
-                result = fn(*args, **kwargs)
-                if _iscoroutine(result):
-                    # async def remote function: drive it to completion
-                    # on a per-task loop (reference: async tasks run on
-                    # the worker's event loop)
-                    import asyncio
-
-                    result = asyncio.run(result)
+                # the coroutine drive stays INSIDE the span: an async
+                # task's real execution happens in asyncio.run, not at
+                # the call that returns the coroutine
+                with execution_span(task.get("name", "?"), trace_ctx):
+                    result = _call()
         except BaseException as e:  # noqa: BLE001
             self._store_error(
                 task, exc.TaskError(task.get("name", "?"), e,

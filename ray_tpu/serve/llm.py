@@ -93,7 +93,11 @@ class LLMEngine:
 
     def __init__(self, cfg, params, *, max_batch: int = 8,
                  max_len: int = 2048, prefill_chunk: int = 1024,
-                 decode_chunk: int = 16):
+                 decode_chunk: int | None = None,
+                 drain_chunk: int | None = None):
+        from ray_tpu.utils.config import get_config
+
+        _cfg = get_config()
         self.cfg = cfg
         self.params = params
         self.max_batch = max_batch
@@ -104,8 +108,12 @@ class LLMEngine:
         # chip sits behind a network tunnel where each sync costs an RTT,
         # and still fewer dispatches on local chips. Admission of waiting
         # requests happens between chunks (adds <= chunk * step_time to
-        # queueing latency).
+        # queueing latency). Default: flag serve_decode_chunk.
+        if decode_chunk is None:
+            decode_chunk = _cfg.serve_decode_chunk
         self.decode_chunk = max(1, decode_chunk)
+        self._drain_chunk_flag = (drain_chunk if drain_chunk is not None
+                                  else _cfg.serve_drain_chunk)
         # host-side slot state (mirrors cache.lengths but trusted copy)
         self._lengths = np.zeros((max_batch,), np.int32)
         self._last_tok = np.zeros((max_batch,), np.int32)
@@ -134,9 +142,11 @@ class LLMEngine:
         # round — gates the free-slot drain clause
         self._admission_blocked = False
         # drain-mode decode: a SHORT chunk used when a slot is about to
-        # retire while requests wait, so admission happens within ~8
-        # steps instead of a full chunk (TTFT <- admission latency)
-        self._drain_chunk = max(1, min(8, self.decode_chunk))
+        # retire while requests wait, so admission happens within a few
+        # steps instead of a full chunk (TTFT <- admission latency);
+        # flag serve_drain_chunk
+        self._drain_chunk = max(1, min(self._drain_chunk_flag,
+                                       self.decode_chunk))
         self._setup_device_state()
 
     def _setup_device_state(self):

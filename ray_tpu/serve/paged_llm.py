@@ -86,9 +86,17 @@ class PagedLLMEngine(LLMEngine):
     when admission needs their space."""
 
     def __init__(self, cfg, params, *, max_batch: int = 8,
-                 max_len: int = 2048, decode_chunk: int = 16,
-                 page_size: int = 128, num_pages: int | None = None,
-                 prefix_cache: bool = True, kv_dtype: str = "bf16"):
+                 max_len: int = 2048, decode_chunk: int | None = None,
+                 page_size: int | None = None,
+                 num_pages: int | None = None,
+                 prefix_cache: bool | None = None, kv_dtype: str = "bf16"):
+        from ray_tpu.utils.config import get_config
+
+        _cfg = get_config()
+        if page_size is None:
+            page_size = _cfg.serve_kv_page_size    # flag
+        if prefix_cache is None:
+            prefix_cache = _cfg.serve_prefix_cache_enabled   # flag
         if kv_dtype not in ("bf16", "int8"):
             raise ValueError(f"kv_dtype must be 'bf16' or 'int8', "
                              f"got {kv_dtype!r}")

@@ -21,6 +21,7 @@ from ray_tpu.train.elastic import ElasticTrainer
 from ray_tpu.train.gbdt import GBTModel, LightGBMTrainer, XGBoostTrainer
 from ray_tpu.train.session import get_checkpoint_dir, get_context, report
 from ray_tpu.train.accelerate import AccelerateTrainer
+from ray_tpu.train.lightning import LightningTrainer
 from ray_tpu.train.torch import TorchConfig, TorchTrainer
 from ray_tpu.train.transformers import TransformersTrainer
 from ray_tpu.train.trainer import JaxTrainer, TrainConfig
@@ -28,6 +29,7 @@ from ray_tpu.train.worker_group import BackendExecutor, WorkerGroup
 
 __all__ = [
     "AccelerateTrainer",
+    "LightningTrainer",
     "BackendExecutor",
     "CheckpointConfig",
     "DataParallelTrainer",

@@ -1,0 +1,194 @@
+"""LightningTrainer: PyTorch-Lightning modules inside the rank-actor
+harness.
+
+Reference analog: ``train/lightning/lightning_trainer.py:241`` — the
+reference wraps a ``LightningModule`` + trainer config and runs
+``pl.Trainer.fit`` on every rank worker over the torch process group.
+
+Two execution paths, same contract:
+
+- **pytorch_lightning installed**: the user's module runs under a real
+  ``pl.Trainer`` (one device per rank; Lightning's DDP picks up the
+  torch.distributed env the torch backend exports), with a callback
+  bridging per-epoch metrics into ``session.report``.
+- **not installed** (this image): a built-in LOOP ADAPTER drives any
+  object conforming to the LightningModule protocol —
+  ``training_step(batch, batch_idx)`` → loss, ``configure_optimizers()``,
+  ``train_dataloader()``, optional ``validation_step`` /
+  ``val_dataloader`` / ``on_train_epoch_end`` — with gradient averaging
+  over the gloo group (the DDP the reference's strategy provides) and
+  the same per-epoch reports. The protocol, not the import, is the
+  integration surface.
+
+Checkpoint bridge: rank 0 saves ``state_dict()`` per epoch into the
+trial dir and attaches it to the report (``train/lightning``'s
+RayModelCheckpoint analog), so Tune/AIR restore works unchanged.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train import session
+from ray_tpu.train.torch import TorchConfig, TorchTrainer
+
+
+def _has_lightning():
+    try:
+        import pytorch_lightning  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def _wrap_lightning(module_init_per_worker, trainer_kwargs: dict):
+    max_epochs = int(trainer_kwargs.get("max_epochs", 1))
+    max_steps = trainer_kwargs.get("max_steps")
+
+    def lightning_loop(config):
+        import torch
+
+        module = module_init_per_worker(config)
+        for attr in ("training_step", "configure_optimizers",
+                     "train_dataloader"):
+            if not callable(getattr(module, attr, None)):
+                raise TypeError(
+                    f"module must follow the LightningModule protocol; "
+                    f"missing {attr}()")
+        if _has_lightning():
+            _fit_with_pl(module, trainer_kwargs)
+            return
+        # ---- built-in loop adapter (no lightning in the image) ----
+        ctx = session.get_context()
+        world = ctx.get_world_size()
+        optimizers = module.configure_optimizers()
+        if isinstance(optimizers, (list, tuple)):
+            optimizers = list(optimizers)
+            if optimizers and isinstance(optimizers[0], (list, tuple)):
+                optimizers = list(optimizers[0])   # ([opts], [scheds])
+        else:
+            optimizers = [optimizers]
+        step = 0
+        for epoch in range(max_epochs):
+            if callable(getattr(module, "on_train_epoch_start", None)):
+                module.on_train_epoch_start()
+            losses = []
+            for batch_idx, batch in enumerate(module.train_dataloader()):
+                for opt in optimizers:
+                    opt.zero_grad()
+                loss = module.training_step(batch, batch_idx)
+                if isinstance(loss, dict):
+                    loss = loss["loss"]
+                loss.backward()
+                if world > 1:
+                    # DDP gradient averaging over the gloo group the
+                    # torch backend initialized (reference: Lightning's
+                    # ddp strategy does exactly this inside pl)
+                    for p in module.parameters():
+                        if p.grad is not None:
+                            torch.distributed.all_reduce(p.grad)
+                            p.grad /= world
+                for opt in optimizers:
+                    opt.step()
+                losses.append(float(loss.detach()))
+                step += 1
+                if max_steps is not None and step >= max_steps:
+                    break
+            if callable(getattr(module, "on_train_epoch_end", None)):
+                module.on_train_epoch_end()
+            val_loss = _run_validation(module)
+            metrics = {"epoch": epoch, "step": step,
+                       "train_loss": (sum(losses) / len(losses)
+                                      if losses else 0.0)}
+            if val_loss is not None:
+                metrics["val_loss"] = val_loss
+            ckpt_dir = _save_checkpoint(module, ctx, epoch)
+            session.report(metrics, checkpoint_dir=ckpt_dir)
+            if max_steps is not None and step >= max_steps:
+                break
+
+    return lightning_loop
+
+
+def _run_validation(module):
+    if not callable(getattr(module, "validation_step", None)) or \
+            not callable(getattr(module, "val_dataloader", None)):
+        return None
+    import torch
+
+    vals = []
+    with torch.no_grad():
+        for i, batch in enumerate(module.val_dataloader()):
+            out = module.validation_step(batch, i)
+            if isinstance(out, dict):
+                out = out.get("val_loss", out.get("loss"))
+            if out is not None:
+                vals.append(float(out))
+    return sum(vals) / len(vals) if vals else None
+
+
+def _save_checkpoint(module, ctx, epoch: int):
+    if ctx.get_world_rank() != 0:
+        return None
+    import os
+
+    import torch
+
+    ckpt_dir = os.path.join(ctx.get_trial_dir(), f"lightning_ep{epoch}")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    torch.save({"state_dict": module.state_dict(), "epoch": epoch},
+               os.path.join(ckpt_dir, "checkpoint.pt"))
+    return ckpt_dir
+
+
+def _fit_with_pl(module, trainer_kwargs: dict):
+    import pytorch_lightning as pl
+
+    class _ReportCallback(pl.Callback):
+        def on_train_epoch_end(self, trainer, pl_module):
+            metrics = {k: float(v) for k, v in
+                       trainer.callback_metrics.items()}
+            metrics["epoch"] = trainer.current_epoch
+            session.report(metrics)
+
+    kwargs = dict(trainer_kwargs)
+    kwargs.setdefault("enable_progress_bar", False)
+    kwargs.setdefault("logger", False)
+    callbacks = list(kwargs.pop("callbacks", []))
+    callbacks.append(_ReportCallback())
+    trainer = pl.Trainer(callbacks=callbacks, **kwargs)
+    trainer.fit(module)
+
+
+class LightningTrainer(TorchTrainer):
+    """Run a LightningModule(-protocol) training loop on every rank.
+
+    Usage::
+
+        class Model(torch.nn.Module):     # or pl.LightningModule
+            def training_step(self, batch, i): ...
+            def configure_optimizers(self): ...
+            def train_dataloader(self): ...
+
+        result = LightningTrainer(
+            lambda cfg: Model(),
+            trainer_kwargs={"max_epochs": 2},
+            scaling_config=ScalingConfig(num_workers=2),
+        ).fit()
+    """
+
+    def __init__(self, module_init_per_worker, *,
+                 trainer_kwargs: dict | None = None,
+                 train_loop_config: dict | None = None,
+                 torch_config: TorchConfig | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 datasets: dict | None = None):
+        super().__init__(
+            _wrap_lightning(module_init_per_worker, trainer_kwargs or {}),
+            train_loop_config=train_loop_config,
+            torch_config=torch_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+        )

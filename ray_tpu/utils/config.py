@@ -177,6 +177,26 @@ class Config:
     # Checkpointing: async by default.
     async_checkpointing: bool = True
 
+    # --- serve LLM engine (ray_tpu.serve.llm / paged_llm) ---
+    # Steady-state decode steps per device dispatch: large chunks
+    # amortize per-dispatch/tunnel overhead (throughput), small chunks
+    # bound how long a new request waits behind in-flight work (TTFT).
+    serve_decode_chunk: int = 16
+    # Short chunk used while admissions are imminent (_use_drain_chunk).
+    serve_drain_chunk: int = 8
+    # KV page size (tokens) for the paged engine.
+    serve_kv_page_size: int = 128
+    # Prefix cache on shared prompt prefixes (chat/system prompts).
+    serve_prefix_cache_enabled: bool = True
+
+    # --- envelope / benchmark tiers (tests/test_envelope*.py) ---
+    envelope_actors: int = 200
+    envelope_queued_tasks: int = 20_000
+    envelope_task_args: int = 500
+    envelope_nightly_actors: int = 2_000
+    envelope_nightly_queued_tasks: int = 1_000_000
+    envelope_nightly_task_args: int = 5_000
+
     # --- observability ---
     metrics_report_interval_s: float = 2.0
     event_buffer_size: int = 10000

@@ -1,0 +1,152 @@
+"""Recurrent (GRU/LSTM) policies: cell math, sequence forward parity,
+fragment collection with stored initial state, and learning on a
+memory env (reference: rllib/models/torch/recurrent_net.py:25,
+rllib/policy/rnn_sequencing.py)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.recurrent import (
+    MemoryCueEnv,
+    RecurrentPPOConfig,
+    _RecurrentRolloutWorker,
+    forward_recurrent_seq,
+    init_recurrent_module,
+    np_recurrent_step,
+    zero_state,
+)
+
+
+@pytest.fixture
+def rt(ray_tpu_start):
+    return ray_tpu_start
+
+
+@pytest.mark.parametrize("cell", ["gru", "lstm"])
+def test_np_and_jax_forward_agree(cell):
+    """The rollout worker's numpy step must replay bit-for-bit what the
+    learner's lax.scan computes (same params, same inputs)."""
+    import jax
+
+    params = init_recurrent_module(jax.random.key(0), 3, 2, hidden=8,
+                                   cell=cell)
+    params_np = {k: (v if k == "cell_type"
+                     else jax.tree.map(np.asarray, v))
+                 for k, v in params.items()}
+    B, T = 2, 5
+    rng = np.random.default_rng(0)
+    obs_seq = rng.normal(size=(B, T, 3)).astype(np.float32)
+    dones = np.zeros((B, T), np.float32)
+    logits_j, values_j, hT = forward_recurrent_seq(
+        params, obs_seq, zero_state(params_np, B), dones)
+    state = zero_state(params_np, B)
+    for t in range(T):
+        logits_n, values_n, state = np_recurrent_step(
+            params_np, obs_seq[:, t], state)
+        np.testing.assert_allclose(logits_n, np.asarray(logits_j[:, t]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(values_n, np.asarray(values_j[:, t]),
+                                   rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(state, np.asarray(hT), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_done_resets_state_inside_fragment():
+    """A done at step t must zero the carried state before t+1 — the
+    scan's mask, not a host branch."""
+    import jax
+
+    params = init_recurrent_module(jax.random.key(1), 2, 2, hidden=4)
+    B, T = 1, 4
+    obs = np.ones((B, T, 2), np.float32)
+    dones = np.zeros((B, T), np.float32)
+    dones[0, 1] = 1.0   # episode ends after step 1
+    logits, _, _ = forward_recurrent_seq(
+        params, obs, zero_state(params, B), dones)
+    # step 2 saw zeroed state + same obs as step 0 -> identical logits
+    np.testing.assert_allclose(np.asarray(logits[0, 2]),
+                               np.asarray(logits[0, 0]), rtol=1e-5)
+
+
+def test_fragments_store_initial_state():
+    import jax
+
+    params = init_recurrent_module(jax.random.key(0), 2, 2, hidden=8)
+    params_np = {k: (v if k == "cell_type"
+                     else jax.tree.map(np.asarray, v))
+                 for k, v in params.items()}
+    w = _RecurrentRolloutWorker(MemoryCueEnv, seed=0, max_seq_len=4)
+    batch = w.sample(params_np, num_steps=32, gamma=0.99, lam=0.95)
+    assert batch["h0"].shape[1] == 8
+    assert batch["obs"].shape[1] == 4          # padded to max_seq_len
+    assert set(np.unique(batch["mask"])) <= {0.0, 1.0}
+    # MemoryCueEnv episodes are 3 steps; every fragment starts at an
+    # episode boundary here, so its stored state is the zero state
+    np.testing.assert_allclose(batch["h0"], 0.0)
+
+
+def test_memory_env_requires_memory():
+    """Sanity: a memoryless optimal play of MemoryCueEnv caps at 0.5
+    expected reward (the cue is unobservable at decision time)."""
+    env = MemoryCueEnv(seed=0)
+    total = 0.0
+    episodes = 200
+    for _ in range(episodes):
+        env.reset()
+        done = False
+        while not done:
+            _, r, done, _ = env.step(1)   # constant action
+            total += r
+    assert 0.3 <= total / episodes <= 0.7
+
+
+@pytest.mark.parametrize("cell", ["gru", "lstm"])
+def test_recurrent_ppo_learns_memory_env(rt, cell):
+    algo = (RecurrentPPOConfig()
+            .environment(MemoryCueEnv)
+            .rollouts(num_rollout_workers=1,
+                      rollout_fragment_length=256)
+            .training(cell=cell, max_seq_len=4, lr=5e-3, hidden=32,
+                      num_sgd_iter=4, seed=0)
+            .build())
+    try:
+        best = -np.inf
+        for _ in range(40):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 0.9:
+                break
+        # memoryless ceiling is 0.5; >=0.9 proves the cue is remembered
+        assert best >= 0.9, f"{cell} failed to learn memory task: {best}"
+    finally:
+        algo.stop()
+
+
+def test_impala_recurrent_learns_memory_env(rt):
+    from ray_tpu.rllib.impala import IMPALAConfig
+
+    algo = (IMPALAConfig()
+            .environment(MemoryCueEnv)
+            .rollouts(num_rollout_workers=1, num_envs_per_worker=8)
+            .training(cell="gru", unroll_length=32, lr=5e-3, hidden=32,
+                      seed=0)
+            .build())
+    try:
+        best = -np.inf
+        for _ in range(60):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 0.9:
+                break
+        assert best >= 0.9, f"recurrent IMPALA failed on memory: {best}"
+    finally:
+        algo.stop()
+
+
+def test_stateless_cartpole_obs_dim():
+    from ray_tpu.rllib.recurrent import StatelessCartPole
+
+    env = StatelessCartPole(seed=0)
+    assert env.reset().shape == (2,)
+    obs, r, d, _ = env.step(0)
+    assert obs.shape == (2,)
