@@ -231,12 +231,18 @@ def bench_serve() -> dict:
 
     params = llama.init_params(model_cfg, jax.random.key(0))
     n_params = llama.num_params(params)
+    # decode_chunk 16: the measured latency/throughput knee on a
+    # ~95ms-RTT tunneled chip (async first-token pipeline). 32 gives
+    # ~+14% sustained tokens/s at ~+35ms p50 TTFT; 8 is RTT-bound.
+    # Sustained p50 TTFT floors at ~full-throughput pipeline depth
+    # (~100ms in-flight compute) + prefill + one-way ship time ≈
+    # 185ms here — a local-PCIe chip would sit near ~90ms.
     eng = PagedLLMEngine(params=params, cfg=model_cfg,
                          kv_dtype=os.environ.get("BENCH_KV_DTYPE", "bf16"),
                          max_batch=max_batch, max_len=max_len,
                          decode_chunk=int(os.environ.get(
                              "BENCH_DECODE_CHUNK",
-                             "32" if preset != "small" else "8")))
+                             "16" if preset != "small" else "8")))
     # deterministic warmup BEFORE the loop starts: every prefill group
     # size + decode programs at every pages bucket compile now, so no
     # JIT lands inside a measured window

@@ -138,6 +138,16 @@ class LLMEngine:
         # device-resident loop inputs (see _device_inputs)
         self._dev_inputs: dict | None = None
         self._dev_dirty = True
+        # device-resident last-token vector (chained through decode
+        # programs and prefill scatters; see _dispatch_decode)
+        self._last_dev = None
+        self._scatter_fn = jax.jit(
+            lambda last, slots, firsts:
+            last.at[slots].set(firsts.astype(last.dtype)))
+        # prefill batches whose first tokens haven't reached the host
+        # yet: (dispatch_seq_at, items, firsts_device)
+        self._pending_firsts: list = []
+        self._dispatch_seq = 0
         # set when an admission failed on resources (not slots) this
         # round — gates the free-slot drain clause
         self._admission_blocked = False
@@ -202,7 +212,12 @@ class LLMEngine:
 
         (cache, _, lens, _), toks = jax.lax.scan(
             step, (cache, tokens, lengths, key), None, length=chunk)
-        return cache, toks, lens
+        # merged last-token vector: chunk-active slots advance to their
+        # newest token, others keep their prior value — the loop chains
+        # every next dispatch off this DEVICE array, so admissions /
+        # retirements never force a host round trip to rebuild last_tok
+        new_last = jnp.where(active, toks[-1], tokens)
+        return cache, toks, lens, new_last
 
     @staticmethod
     def _prefill_impl(cfg, params, cache: KVCache, tokens, plen, slot, *,
@@ -255,6 +270,8 @@ class LLMEngine:
         or user-facing TTFT)."""
         bucket = min(_bucket(prompt_len), self.max_len)
         tokens = jnp.zeros((1, bucket), jnp.int32)
+        if self._last_dev is None:
+            self._last_dev = jnp.asarray(self._last_tok)
         n = 1
         while n <= self.max_batch:
             toks = jnp.broadcast_to(tokens, (n, bucket))
@@ -263,13 +280,19 @@ class LLMEngine:
                 jnp.ones((n,), jnp.int32),
                 jnp.arange(n, dtype=jnp.int32),
                 jnp.zeros((n,), jnp.float32), self._next_key())
+            # warm the firsts scatter at this group size too: it
+            # specializes per slots-shape, and a compile inside _admit
+            # stalls the loop ~0.5s per NEW burst size (measured)
+            self._last_dev = self._scatter_fn(
+                self._last_dev, jnp.arange(n, dtype=jnp.int32), firsts)
             np.asarray(firsts)
             n *= 2
+        self._last_dev = jnp.asarray(self._last_tok)
         active = jnp.zeros((self.max_batch,), bool)
         for fn in {id(self._decode_fn): self._decode_fn,
                    id(self._decode_fn_drain):
                        self._decode_fn_drain}.values():
-            self._cache, toks, _ = fn(
+            self._cache, toks, _, _ = fn(
                 self.params, self._cache,
                 jnp.zeros((self.max_batch,), jnp.int32),
                 jnp.zeros((self.max_batch,), jnp.int32), active,
@@ -391,8 +414,7 @@ class LLMEngine:
         # Group by bucket, then split each group into POWER-OF-TWO
         # sub-batches: one batched-prefill dispatch per sub-batch (a
         # 16-burst = 1 dispatch; 15 = 8+4+2+1 = 4) with one stacked
-        # prompt upload each, and ONE host sync for all first tokens at
-        # the end. Per-dispatch and per-sync tunnel RTTs would otherwise
+        # prompt upload each. Per-dispatch tunnel RTTs would otherwise
         # dominate burst TTFT.
         groups: dict[int, list] = {}
         for item in admits:
@@ -408,22 +430,62 @@ class LLMEngine:
                 i += m
                 batches.append((part, self._dispatch_prefill(part,
                                                              bucket)))
-        all_firsts = np.asarray(jnp.concatenate(
-            [f for _, f in batches])) if batches else []
-        flat = [it for part, _ in batches for it in part]
-        for (req, slot, plen, _), first in zip(flat, all_firsts):
-            req.slot = slot
-            req.first_token_t = time.monotonic()
-            self.ttfts.append(req.ttft)
-            self._active[slot] = req
-            # admission GENERATION: an in-flight decode chunk dispatched
-            # for this slot's PREVIOUS occupant must neither have its
-            # tokens emitted to the new request nor be chained from —
-            # slot indices alone can't tell the difference
-            self._slot_gen[slot] += 1
-            self._lengths[slot] = plen
-            self._emit(req, int(first))
+        # ASYNC first tokens: scatter each batch's firsts into the
+        # device last-token vector (so the very next decode chunk
+        # covers the new slots with no host round trip) and activate
+        # the slots NOW; the host-side emission of the first tokens
+        # happens in _drain_firsts when the async copy lands. Blocking
+        # here for the sync RTT stalled the whole decode pipeline once
+        # per admission round — with small chunks that stall WAS the
+        # sustained-TTFT/throughput ceiling.
+        for part, firsts in batches:
+            slots = jnp.asarray(np.array([it[1] for it in part],
+                                         np.int32))
+            self._last_dev = self._scatter_fn(self._last_dev, slots,
+                                              firsts)
+            try:
+                firsts.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - backend without async copy
+                pass
+            for (req, slot, plen, _) in part:
+                req.slot = slot
+                self._active[slot] = req
+                # admission GENERATION: an in-flight decode chunk
+                # dispatched for this slot's PREVIOUS occupant must
+                # neither have its tokens emitted to the new request
+                # nor be chained from
+                self._slot_gen[slot] += 1
+                self._lengths[slot] = plen
+            # any chunk dispatched from here on (seq >= _dispatch_seq)
+            # executes after this prefill on the device stream
+            self._pending_firsts.append(
+                (self._dispatch_seq, part, firsts))
         self._dev_dirty = True   # active set / lengths changed
+
+    def _drain_firsts(self, completed_seq: int | None = None):
+        """Emit first tokens whose prefill results reached the host.
+        ``completed_seq``: a decode chunk with this dispatch seq has
+        been READ on the host — every prefill dispatched before it is
+        device-complete, so blocking on those firsts costs only the
+        (already overlapped) copy."""
+        if not self._pending_firsts:
+            return
+        keep = []
+        for seq_at, part, firsts in self._pending_firsts:
+            # NOTE: no is_ready() polling — on tunneled backends the
+            # readiness query is itself a blocking RTT, which (measured)
+            # serialized the whole loop. Readiness is derived purely
+            # from device-stream ordering via completed_seq.
+            if completed_seq is None or seq_at > completed_seq:
+                keep.append((seq_at, part, firsts))
+                continue
+            vals = np.asarray(firsts)
+            now = time.monotonic()
+            for (req, slot, plen, _), first in zip(part, vals):
+                req.first_token_t = now
+                self.ttfts.append(req.ttft)
+                self._emit(req, int(first))
+        self._pending_firsts = keep
 
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
@@ -521,26 +583,31 @@ class LLMEngine:
 
     def _decode_call(self, chunk: int, last_tok, dev):
         """Hook: run the compiled decode program for one chunk and
-        return (token_matrix, advanced_lens) — the ONLY piece the paged
-        engine overrides; the pipeline tail below stays shared."""
+        return (token_matrix, advanced_lens, merged_last_tok) — the
+        ONLY piece the paged engine overrides; the pipeline tail below
+        stays shared."""
         decode = (self._decode_fn_drain if chunk == self._drain_chunk
                   and self._decode_fn_drain is not self._decode_fn
                   else self._decode_fn)
-        self._cache, toks, lens = decode(
+        self._cache, toks, lens, new_last = decode(
             self.params, self._cache, last_tok,
             dev["lens"], dev["active"], dev["temps"], self._next_key(),
         )
-        return toks, lens
+        return toks, lens, new_last
 
-    def _dispatch_decode(self, last_tok, active_idx):
-        """Dispatch one decode chunk (no host sync). ``last_tok`` may be
-        a DEVICE array from the previous chunk's output — the data
-        dependency then stays on-device, so consecutive chunks chain
-        without a host round trip between them."""
+    def _dispatch_decode(self, active_idx):
+        """Dispatch one decode chunk (no host sync), chained off the
+        DEVICE-resident last-token vector — admissions (prefill firsts
+        scattered into it) and chunk outputs (merged in the decode
+        program) both update it on device, so consecutive dispatches
+        never need a host round trip no matter how the active set
+        changed in between."""
         drain = self._use_drain_chunk()
         chunk = self._drain_chunk if drain else self.decode_chunk
         dev = self._device_inputs(active_idx)
-        toks, lens = self._decode_call(chunk, last_tok, dev)
+        toks, lens, new_last = self._decode_call(chunk, self._last_dev,
+                                                 dev)
+        self._last_dev = new_last
         dev["lens"] = lens   # stays on device for the chained chunk
         # start the token matrix's device->host copy NOW: it overlaps
         # the next chunk's compute instead of adding a serial RTT to
@@ -553,7 +620,9 @@ class LLMEngine:
         # slot) — retired slots are reconciled at admission
         self._lengths[active_idx] += chunk
         gens = [int(self._slot_gen[i]) for i in active_idx]
-        return toks, active_idx, gens, chunk
+        seq = self._dispatch_seq
+        self._dispatch_seq += 1
+        return toks, active_idx, gens, chunk, seq
 
     def _emit_chunk(self, toks_np, active_idx, gens):
         for i, gen in zip(active_idx, gens):
@@ -567,45 +636,53 @@ class LLMEngine:
                 self._emit(req, int(toks_np[t, i]))
 
     def _run_loop(self):
-        """Double-buffered decode: while chunk N's tokens copy back to
-        the host and get emitted, chunk N+1 already runs on device (its
-        input token vector is chunk N's LAST row, left on device) — the
-        per-chunk host sync + tunnel RTT overlaps compute instead of
-        serializing with it."""
-        pending = None   # (device_toks, active_idx, gens, chunk)
+        """Double-buffered decode over a device-resident last-token
+        vector: while chunk N's tokens copy back to the host and get
+        emitted, chunk N+1 already runs on device. Admissions scatter
+        their (still on-device) first tokens into the vector, so the
+        pipeline NEVER stalls for a prefill sync — first tokens are
+        emitted asynchronously when their copy lands (_drain_firsts).
+        Emission order per request is preserved: firsts dispatched
+        before chunk N are force-drained right after chunk N's sync,
+        before the chunk's tokens are emitted."""
+        pending = None   # (device_toks, active_idx, gens, chunk, seq)
+        self._last_dev = jnp.asarray(self._last_tok)
         while not self._stop.is_set():
             self._admit()
             active_idx = [i for i, r in enumerate(self._active)
                           if r is not None]
             if not active_idx:
                 if pending is not None:
-                    toks, idxs, gens, _ = pending
+                    toks, idxs, gens, _, seq = pending
                     pending = None
-                    self._emit_chunk(np.asarray(toks), idxs, gens)
+                    toks_np = np.asarray(toks)
+                    self._drain_firsts(completed_seq=seq)
+                    self._emit_chunk(toks_np, idxs, gens)
+                    continue
+                if self._pending_firsts:
+                    # every active request is brand-new and nothing is
+                    # in flight (e.g. max_new_tokens=1 bursts): block
+                    # for the outstanding firsts
+                    self._drain_firsts(completed_seq=self._dispatch_seq)
                     continue
                 self._on_idle()
                 time.sleep(0.001)
                 continue
             if pending is None:
-                pending = self._dispatch_decode(
-                    jnp.asarray(self._last_tok), active_idx)
+                pending = self._dispatch_decode(active_idx)
                 continue
-            toks_prev, idx_prev, gens_prev, _ = pending
-            # chain the next chunk on-device off the previous chunk's
-            # final token row, but only while the active set is stable —
-            # same slots AND same occupants (a slot retired and refilled
-            # between chunks would otherwise chain the new request's
-            # decode off the previous occupant's stale token row)
-            cur_gens = [int(self._slot_gen[i]) for i in active_idx]
-            if idx_prev == active_idx and gens_prev == cur_gens:
-                nxt = self._dispatch_decode(toks_prev[-1], active_idx)
-            else:
-                nxt = None
-            self._emit_chunk(np.asarray(toks_prev), idx_prev, gens_prev)
-            if nxt is None:
-                pending = None   # active set changed: re-dispatch fresh
-            else:
-                pending = nxt
+            nxt = self._dispatch_decode(active_idx)
+            toks_prev, idx_prev, gens_prev, _, seq_prev = pending
+            # EVERY pending prefill was dispatched before nxt: block for
+            # their firsts now (bounded by chunk N + prefill compute —
+            # chunk N+1 is already queued behind them, so this wait
+            # steals no device time) and emit them FIRST. Waiting for
+            # the next chunk's sync instead cost a whole extra chunk of
+            # first-token latency.
+            self._drain_firsts(completed_seq=self._dispatch_seq)
+            toks_np = np.asarray(toks_prev)     # chunk N host sync
+            self._emit_chunk(toks_np, idx_prev, gens_prev)
+            pending = nxt
 
     # -- metrics -----------------------------------------------------------
 

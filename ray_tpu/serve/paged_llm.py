@@ -249,7 +249,9 @@ class PagedLLMEngine(LLMEngine):
                 one_step,
                 (k_pages, v_pages, k_scale, v_scale, tokens, lengths,
                  key), None, length=chunk)
-        return k_pages, v_pages, k_scale, v_scale, toks, lens
+        # merged device-resident last-token vector (see llm._decode_impl)
+        new_last = jnp.where(active, toks[-1], tokens)
+        return k_pages, v_pages, k_scale, v_scale, toks, lens, new_last
 
     @staticmethod
     def _paged_prefill_impl(cfg, params, k_pages, v_pages, k_scale,
@@ -346,12 +348,12 @@ class PagedLLMEngine(LLMEngine):
             # in-flight chunk a torn table
             dev[key] = jnp.asarray(self._table[:, :pb].copy())
         (self._k_pages, self._v_pages, self._k_scale, self._v_scale,
-         toks, lens) = fn(
+         toks, lens, new_last) = fn(
             self.params, self._k_pages, self._v_pages, self._k_scale,
             self._v_scale, dev[key], last_tok, dev["lens"],
             dev["active"], dev["temps"], self._next_key(),
         )
-        return toks, lens
+        return toks, lens, new_last
 
     def _reserve_slot_resources(self, req, slot: int) -> bool:
         """Reserve-on-admit: pages for prompt + token budget + one page
@@ -536,6 +538,8 @@ class PagedLLMEngine(LLMEngine):
         bucket = min(_bucket(prompt_len), self.max_len)
         wp = self._window_pages(bucket)
         prefill = self._prefill_paged(wp)
+        if self._last_dev is None:
+            self._last_dev = jnp.asarray(self._last_tok)
         n = 1
         while n <= self.max_batch:
             rows = jnp.full((n, wp), -1, jnp.int32)
@@ -547,8 +551,14 @@ class PagedLLMEngine(LLMEngine):
                 jnp.ones((n,), jnp.int32),
                 jnp.zeros((n,), jnp.int32),
                 jnp.zeros((n,), jnp.float32), self._next_key())
+            # warm the firsts scatter at this group size (it
+            # specializes per slots-shape; compiling inside _admit
+            # stalls the loop ~0.5s — measured)
+            self._last_dev = self._scatter_fn(
+                self._last_dev, jnp.arange(n, dtype=jnp.int32), firsts)
             np.asarray(firsts)
             n *= 2
+        self._last_dev = jnp.asarray(self._last_tok)
         active = jnp.zeros((self.max_batch,), bool)
         # every pages-bucket a run can touch: powers of two PLUS the
         # non-power-of-two cap (_pages_bucket clamps to it — e.g.
@@ -563,7 +573,7 @@ class PagedLLMEngine(LLMEngine):
             for chunk in {self.decode_chunk, self._drain_chunk}:
                 fn = self._decode_paged(chunk, pb)
                 (self._k_pages, self._v_pages, self._k_scale,
-                 self._v_scale, toks, _) = fn(
+                 self._v_scale, toks, _, _) = fn(
                     self.params, self._k_pages, self._v_pages,
                     self._k_scale, self._v_scale,
                     jnp.full((self.max_batch, pb), -1, jnp.int32),

@@ -1,9 +1,10 @@
 """Measure the serve engine's latency anatomy on the real chip:
-per-dispatch overhead vs chunk size, decode step time vs batch, and
-prefill time — the numbers that decide the TTFT/throughput tradeoff
-(tunnel RTT ~100ms is the TTFT floor; chunk time is the queue-wait).
+per-dispatch overhead vs chunk size, decode step time, and prefill
+time — the numbers that decide the TTFT/throughput tradeoff (tunnel
+RTT ~100ms is the TTFT floor; chunk time is the queue-wait).
 
-Usage: cd /root/repo && python scripts/measure_serve.py
+Usage:
+  PYTHONPATH=/root/repo:/root/.axon_site python scripts/measure_serve.py
 """
 
 import time
@@ -31,59 +32,75 @@ def main():
     for _ in range(5):
         np.asarray(f(x))
     rtt = (time.perf_counter() - t) / 5
-    print(f"sync RTT: {rtt*1e3:.1f} ms")
+    print(f"sync RTT: {rtt*1e3:.1f} ms", flush=True)
 
-    for chunk in (1, 2, 4, 8, 16, 32):
-        eng = PagedLLMEngine(params=params, cfg=cfg, max_batch=20,
-                             max_len=2048, decode_chunk=chunk)
-        eng.warmup(128)
-        # simulate the decode loop: N chained chunk dispatches with one
-        # final sync — measures per-chunk cost incl. dispatch overhead.
-        # MUST chain through a data dependency (relay memoizes identical
-        # dispatches).
-        dev = {
-            "lens": jnp.asarray(np.full(20, 128, np.int32)),
-            "active": jnp.asarray(np.ones(20, bool)),
-            "temps": jnp.asarray(np.zeros(20, np.float32)),
-        }
-        last = jnp.asarray(np.ones(20, np.int32))
-        # warm the decode program
-        toks, lens = eng._decode_call(chunk, last, dev)
+    eng = PagedLLMEngine(params=params, cfg=cfg, max_batch=20,
+                         max_len=2048, decode_chunk=32)
+    dev = {
+        "lens": jnp.asarray(np.full(20, 128, np.int32)),
+        "active": jnp.asarray(np.ones(20, bool)),
+        "temps": jnp.asarray(np.zeros(20, np.float32)),
+    }
+    last = jnp.asarray(np.ones(20, np.int32))
+
+    for chunk in (2, 4, 8, 16, 32):
+        t0 = time.perf_counter()
+        toks, lens, _ = eng._decode_call(chunk, last, dev)
         np.asarray(toks)
-        reps = max(1, 64 // chunk)
+        compile_s = time.perf_counter() - t0
         dev["lens"] = jnp.asarray(np.full(20, 128, np.int32))
+        reps = max(2, 96 // chunk)
         t0 = time.perf_counter()
         cur = last
         for _ in range(reps):
-            toks, lens = eng._decode_call(chunk, cur, dev)
+            toks, lens, _ = eng._decode_call(chunk, cur, dev)
             dev["lens"] = lens
-            cur = toks[-1]
+            cur = toks[-1]          # data dependency: relay can't memoize
         np.asarray(toks)
         el = time.perf_counter() - t0
         per_chunk = el / reps
-        per_step = per_chunk / chunk
         print(f"chunk {chunk:2d}: {per_chunk*1e3:7.1f} ms/chunk  "
-              f"{per_step*1e3:6.2f} ms/step  "
-              f"({20*chunk/per_chunk:.0f} tok/s at batch 20)")
-        eng.stop()
+              f"{per_chunk/chunk*1e3:6.2f} ms/step  "
+              f"{20*chunk/per_chunk:6.0f} tok/s@b20  "
+              f"(compile {compile_s:.1f}s)", flush=True)
 
-    # --- prefill time (batch 1 and 4, 128 tokens) ---
-    eng = PagedLLMEngine(params=params, cfg=cfg, max_batch=20,
-                         max_len=2048, decode_chunk=8)
-    eng.warmup(128)
+    # --- prefill dispatch+sync time at a couple of batch sizes ---
     rng = np.random.default_rng(0)
-    for nb in (1, 2, 4):
-        # time via engine submit of nb requests at once, measuring the
-        # admit dispatch+sync inside; approximate with direct call:
+    for nb in (1, 4):
+        # reserve slots 0..nb-1 manually via the engine internals
+        class R:
+            temperature = 0.0
+            max_new_tokens = 4
+        items = []
+        for s in range(nb):
+            r = R()
+            r.prompt = rng.integers(1, 32000, 128).astype(np.int32)
+            ok = eng._reserve_slot_resources(r, s)
+            assert ok
+            items.append(eng._pack_admit(r, s, 128))
         t0 = time.perf_counter()
-        reqs = [eng.submit(rng.integers(1, 32000, 128), max_new_tokens=1)
-                for _ in range(nb)]
-        for r in reqs:
-            list(r.tokens())
+        firsts = eng._dispatch_prefill(items, len(items[0][3]))
+        np.asarray(firsts)
         el = time.perf_counter() - t0
-        print(f"prefill batch {nb}: {el*1e3:.1f} ms end-to-end "
-              f"(incl ~1 RTT + loop latency)")
-    eng.stop()
+        # free the pages again
+        for s in range(nb):
+            eng._on_slot_retired(s)
+        eng._age_deferred_frees(drain_all=True)
+        print(f"prefill b{nb} (dispatch+sync, first incl compile): "
+              f"{el*1e3:.1f} ms", flush=True)
+        t0 = time.perf_counter()
+        for s in range(nb):
+            r = R()
+            r.prompt = rng.integers(1, 32000, 128).astype(np.int32)
+            eng._reserve_slot_resources(r, s)
+        items = [eng._pack_admit(r, s, 128) for s in range(nb)]
+        firsts = eng._dispatch_prefill(items, len(items[0][3]))
+        np.asarray(firsts)
+        el = time.perf_counter() - t0
+        for s in range(nb):
+            eng._on_slot_retired(s)
+        eng._age_deferred_frees(drain_all=True)
+        print(f"prefill b{nb} warm: {el*1e3:.1f} ms", flush=True)
 
 
 if __name__ == "__main__":
