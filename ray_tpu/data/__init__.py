@@ -30,6 +30,7 @@ from ray_tpu.data.dataset import (
 from ray_tpu.data.execution import ExecutionOptions, StreamingExecutor
 from ray_tpu.data.grouped import GroupedData
 from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.mongo import read_mongo, write_mongo
 from ray_tpu.data.sql import read_sql, write_sql
 
 __all__ = [
@@ -52,7 +53,9 @@ __all__ = [
     "read_images",
     "read_json",
     "read_parquet",
+    "read_mongo",
     "read_sql",
+    "write_mongo",
     "write_sql",
     "read_text",
     "read_tfrecords",

@@ -1,0 +1,72 @@
+"""MongoDB datasource: ``read_mongo`` / ``write_mongo``.
+
+Reference analog: ``python/ray/data/datasource/mongo_datasource.py`` —
+Ray Data's Mongo reader takes connection parameters + db/collection and
+materializes documents as rows; the writer inserts rows back.
+
+Connection crosses task boundaries as a FACTORY (live clients aren't
+picklable), same contract as ``read_sql``. The factory must return an
+object with the pymongo ``Collection`` surface (``find``,
+``insert_many``, plus ``database.client.close`` if closable) — pymongo
+itself is therefore an optional dependency: anything duck-typing the
+Collection API (a test double, a REST shim) works."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ray_tpu.data.dataset import Dataset, from_items
+
+
+def read_mongo(collection_factory: Callable, *,
+               query: dict | None = None,
+               projection: dict | None = None,
+               num_blocks: int = 8) -> Dataset:
+    """Materialize ``collection.find(query, projection)`` as a row
+    Dataset (reference: ``ray.data.read_mongo``). The ``_id`` field is
+    stringified (ObjectId isn't a plain-data type)."""
+
+    def source():
+        coll = collection_factory()
+        try:
+            cursor = (coll.find(query or {}, projection)
+                      if projection is not None else coll.find(query or {}))
+            rows = []
+            for doc in cursor:
+                doc = dict(doc)
+                if "_id" in doc:
+                    doc["_id"] = str(doc["_id"])
+                rows.append(doc)
+        finally:
+            _close(coll)
+        return from_items(rows, num_blocks=num_blocks)._source_fn()
+
+    return Dataset(source)
+
+
+def write_mongo(ds: Dataset, collection_factory: Callable) -> None:
+    """Insert every row as a document (reference:
+    ``Dataset.write_mongo``): ``insert_many`` per block."""
+    coll = collection_factory()
+    try:
+        for batch in ds.iter_batches():
+            keys = list(batch)
+            n = len(batch[keys[0]]) if keys else 0
+            docs = [{k: _py(batch[k][i]) for k in keys}
+                    for i in range(n)]
+            if docs:
+                coll.insert_many(docs)
+    finally:
+        _close(coll)
+
+
+def _close(coll):
+    try:
+        coll.database.client.close()
+    except Exception:  # noqa: BLE001 - duck-typed double without close
+        pass
+
+
+def _py(v):
+    item = getattr(v, "item", None)
+    return item() if item is not None and getattr(v, "ndim", 0) == 0 else v

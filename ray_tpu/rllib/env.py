@@ -156,9 +156,48 @@ class ContinuousBandit:
         return self.reset(), reward, True, {}
 
 
+class PixelCartPole:
+    """CartPole with Atari-shaped observations: the 4-dim state is
+    rendered into an 84x84 uint8 frame (cart position / pole angle
+    drawn as bright bars — the policy must read the picture). The
+    large-obs env for rollout/learner THROUGHPUT measurement
+    (reference: the Atari suites in release_tests.yaml) without
+    shipping ROMs."""
+
+    obs_dim = 84 * 84
+    n_actions = 2
+
+    def __init__(self, seed: int | None = None):
+        self.env = CartPole(seed=seed)
+
+    def _render(self, state) -> np.ndarray:
+        x, x_dot, theta, theta_dot = state
+        frame = np.zeros((84, 84), np.float32)
+        cart_col = int(np.clip((x / 2.4 + 1.0) * 41.5, 0, 83))
+        frame[70:74, max(cart_col - 4, 0):cart_col + 5] = 1.0
+        tip_col = int(np.clip(cart_col + 30 * np.sin(theta), 0, 83))
+        tip_row = int(np.clip(70 - 30 * np.cos(theta), 0, 83))
+        rr = np.linspace(70, tip_row, 30).astype(int)
+        cc = np.linspace(cart_col, tip_col, 30).astype(int)
+        frame[rr, cc] = 1.0
+        # velocity channels as intensity rows (keeps it an MDP)
+        frame[0, :] = np.clip(x_dot / 3.0 + 0.5, 0, 1)
+        frame[1, :] = np.clip(theta_dot / 3.0 + 0.5, 0, 1)
+        return frame.reshape(-1)
+
+    def reset(self):
+        return self._render(self.env.reset())
+
+    def step(self, action):
+        obs, r, d, i = self.env.step(action)
+        self.truncated = self.env.truncated
+        return self._render(obs), r, d, i
+
+
 ENV_REGISTRY = {"CartPole-v1": CartPole, "Bandit-v0": BanditEnv,
                 "Pendulum-v1": Pendulum,
-                "ContinuousBandit-v0": ContinuousBandit}
+                "ContinuousBandit-v0": ContinuousBandit,
+                "PixelCartPole-v0": PixelCartPole}
 
 
 def make_env(name_or_cls, seed=None):

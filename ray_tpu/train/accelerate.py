@@ -1,26 +1,78 @@
 """AccelerateTrainer: HuggingFace Accelerate loops on rank workers.
 
-Reference analog: ``train/huggingface/accelerate/accelerate_trainer.py``.
-``accelerate.Accelerator()`` constructed inside ``train_loop_per_worker``
-discovers the torch.distributed (gloo) process group the torch backend
-already initialized — RANK/WORLD_SIZE env vars are set per rank actor —
-so ``accelerator.prepare(model, optimizer, loader)`` gives the standard
-Accelerate DDP behavior with no extra configuration.
+Reference analog: ``train/huggingface/accelerate/accelerate_trainer.py``
+— the reference validates an ``accelerate_config`` (path or dict),
+materializes it per rank worker (Accelerate reads its config through
+env vars / a config file at ``Accelerator()`` construction), and runs
+the user loop under the torch process group. Same contract here:
+``accelerate.Accelerator()`` constructed inside the loop discovers the
+gloo process group the torch backend already initialized — RANK /
+WORLD_SIZE env vars are set per rank actor — so
+``accelerator.prepare(model, optimizer, loader)`` gives the standard
+Accelerate DDP behavior.
 """
 
 from __future__ import annotations
 
-from ray_tpu.train.torch import TorchTrainer
+import os
+
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.torch import TorchConfig, TorchTrainer
+
+# accelerate_config keys materialized as ACCELERATE_* env vars (the
+# subset Accelerate reads from the environment; reference:
+# accelerate_trainer.py's AccelerateConfig handling)
+_ENV_KEYS = {
+    "mixed_precision": "ACCELERATE_MIXED_PRECISION",
+    "cpu": "ACCELERATE_USE_CPU",
+    "dynamo_backend": "ACCELERATE_DYNAMO_BACKEND",
+    "gradient_accumulation_steps": "ACCELERATE_GRADIENT_ACCUMULATION_STEPS",
+}
+
+
+def _wrap_accelerate(train_loop_per_worker, accelerate_config: dict):
+    def accelerate_loop(config):
+        try:
+            import accelerate  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "AccelerateTrainer requires the `accelerate` package on "
+                "every rank worker (pip runtime_env or host install)"
+            ) from e
+        for key, env in _ENV_KEYS.items():
+            if key in accelerate_config:
+                value = accelerate_config[key]
+                if isinstance(value, bool):
+                    value = "true" if value else "false"
+                os.environ[env] = str(value)
+        # any remaining keys ride a config file (Accelerate's own
+        # loader picks ACCELERATE_CONFIG_FILE up at Accelerator())
+        rest = {k: v for k, v in accelerate_config.items()
+                if k not in _ENV_KEYS}
+        if rest:
+            import json
+            import tempfile
+
+            fd, path = tempfile.mkstemp(prefix="accel_cfg_",
+                                        suffix=".json")
+            with os.fdopen(fd, "w") as f:
+                json.dump({"compute_environment": "LOCAL_MACHINE",
+                           "distributed_type": "MULTI_CPU", **rest}, f)
+            os.environ["ACCELERATE_CONFIG_FILE"] = path
+        return train_loop_per_worker(config)
+
+    return accelerate_loop
 
 
 class AccelerateTrainer(TorchTrainer):
-    """``TorchTrainer`` whose contract is an Accelerate-style loop.
+    """``TorchTrainer`` that materializes an Accelerate config on every
+    rank before running an Accelerate-style loop.
 
     Usage::
 
         def train_loop(config):
             from accelerate import Accelerator
-            accelerator = Accelerator(cpu=True)
+            accelerator = Accelerator()   # reads the materialized config
             model, opt, loader = accelerator.prepare(model, opt, loader)
             for batch in loader:
                 loss = model(**batch)
@@ -29,5 +81,41 @@ class AccelerateTrainer(TorchTrainer):
                 session.report({"loss": float(loss)})
 
         AccelerateTrainer(train_loop,
+                          accelerate_config={"mixed_precision": "no",
+                                             "cpu": True},
                           scaling_config=ScalingConfig(num_workers=2)).fit()
     """
+
+    def __init__(self, train_loop_per_worker, *,
+                 accelerate_config: dict | str | None = None,
+                 train_loop_config: dict | None = None,
+                 torch_config: TorchConfig | None = None,
+                 scaling_config: ScalingConfig | None = None,
+                 run_config: RunConfig | None = None,
+                 datasets: dict | None = None):
+        if isinstance(accelerate_config, str):
+            # a path to an Accelerate yaml/json config: parsed here so a
+            # bad path fails at submission, not on every rank
+            import json
+
+            with open(accelerate_config) as f:
+                text = f.read()
+            try:
+                accelerate_config = json.loads(text)
+            except json.JSONDecodeError:
+                # minimal yaml (key: value lines) without a yaml dep
+                accelerate_config = {
+                    k.strip(): v.strip()
+                    for k, v in (line.split(":", 1)
+                                 for line in text.splitlines()
+                                 if ":" in line and not
+                                 line.lstrip().startswith("#"))}
+        super().__init__(
+            _wrap_accelerate(train_loop_per_worker,
+                             accelerate_config or {}),
+            train_loop_config=train_loop_config,
+            torch_config=torch_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+        )

@@ -74,3 +74,48 @@ def test_dataset_write_sql_method(db, tmp_path):
     conn = sqlite3.connect(out)
     assert conn.execute("SELECT COUNT(*) FROM t").fetchone()[0] == 3
     conn.close()
+
+
+class _FakeCollection:
+    """pymongo Collection double (the datasource is duck-typed so the
+    real pymongo stays optional)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def find(self, query=None, projection=None):
+        rows = [dict(d) for d in self.store]
+        if query:
+            rows = [r for r in rows
+                    if all(r.get(k) == v for k, v in query.items())]
+        if projection:
+            keep = {k for k, v in projection.items() if v}
+            rows = [{k: r[k] for k in r if k in keep or k == "_id"}
+                    for r in rows]
+        return iter(rows)
+
+    def insert_many(self, docs):
+        self.store.extend(dict(d) for d in docs)
+
+
+def test_read_mongo_rows_and_query():
+    from ray_tpu.data.mongo import read_mongo
+
+    store = [{"_id": i, "name": f"u{i}", "score": i * 2} for i in range(8)]
+    ds = read_mongo(lambda: _FakeCollection(store))
+    rows = ds.take_all()
+    assert len(rows) == 8 and rows[0]["_id"] == "0"   # _id stringified
+    ds2 = read_mongo(lambda: _FakeCollection(store),
+                     query={"name": "u3"})
+    assert [r["score"] for r in ds2.take_all()] == [6]
+
+
+def test_write_mongo_roundtrip():
+    import ray_tpu.data as rd
+    from ray_tpu.data.mongo import write_mongo
+
+    src = [{"_id": i, "v": i} for i in range(5)]
+    sink: list = []
+    ds = rd.read_mongo(lambda: _FakeCollection(src))
+    write_mongo(ds, lambda: _FakeCollection(sink))
+    assert sorted(int(d["v"]) for d in sink) == list(range(5))
