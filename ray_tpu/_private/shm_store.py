@@ -186,6 +186,12 @@ class ShmObjectStore:
         lib = get_lib()
         self._lib = lib
         self.name = name
+        try:
+            from ray_tpu.utils.config import get_config
+
+            self.BATCH_WINDOW = get_config().store_batch_window
+        except Exception:  # noqa: BLE001 - standalone use: class default
+            pass
         if create:
             if capacity < (1 << 12):
                 raise ValueError(
@@ -276,7 +282,8 @@ class ShmObjectStore:
     # batch: chunking here bounds the lock-hold time as a property of
     # the API, not of any one caller (the driver's 4096 get window was
     # previously the only thing keeping a huge batch from stalling
-    # every other store client on the node)
+    # every other store client on the node). Flag store_batch_window
+    # (instance attr set at construction; class attr documents default).
     BATCH_WINDOW = 4096
 
     def get_many(self, object_ids: list[bytes]) -> list:

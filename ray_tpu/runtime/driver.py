@@ -99,6 +99,7 @@ class ClusterRuntime:
         self._actor_clients_lock = threading.Lock()
         from ray_tpu.utils.config import get_config as _gc
         self._actor_client_cap = _gc().actor_client_cache_size
+        self._actor_client_soft_cap = _gc().actor_client_soft_cap
         self.metrics: dict[str, Any] = {}
         # Lineage for object reconstruction (reference: ReferenceCounter
         # lineage pinning reference_count.h:67-115 + TaskManager::
@@ -438,7 +439,9 @@ class ClusterRuntime:
                 if self._closed:
                     return
             if self._put_report_buf:
-                time.sleep(0.0005)   # coalesce a burst of puts
+                from ray_tpu.utils.config import get_config as _gc
+
+                time.sleep(_gc().put_report_linger_s)   # coalesce burst
             with self._put_report_cv:
                 batch, self._put_report_buf = self._put_report_buf, []
             if not batch:
@@ -893,7 +896,9 @@ class ClusterRuntime:
         # pushed (synchronous; once per function per driver). Content-
         # addressed: re-registering the same id is an idempotent no-op.
         self._gcs.call("kv_put", ns="__functions__", key=fn_id, value=blob)
-        if len(self._fn_blobs) > 512:
+        from ray_tpu.utils.config import get_config as _gc
+
+        if len(self._fn_blobs) > _gc().fn_export_cache_size:
             self._fn_blobs.clear()
         # fn ref pins id(fn) stable
         self._fn_blobs[key] = (fn, fn_id, closure_oids)
@@ -1184,7 +1189,7 @@ class ClusterRuntime:
             # The hard cap is a leak backstop sized far above any sane
             # live-actor count per driver; sockets + parked reader
             # threads are cheap, lost replies are not.
-            if len(self._actor_clients) > 256:
+            if len(self._actor_clients) > self._actor_client_soft_cap:
                 for k, c in list(self._actor_clients.items()):
                     if c._closed and k != addr:
                         evicted = self._actor_clients.pop(k)

@@ -84,18 +84,25 @@ class LeaseManager:
                  legacy_submit: Callable[[dict], None],
                  on_task_failed: Callable[[dict, BaseException], None],
                  on_direct_results: Callable[[dict], None] | None = None,
-                 max_leases_per_shape: int = 64,
+                 max_leases_per_shape: int | None = None,
                  lease_block_s: float | None = None):
         from ray_tpu.utils.config import get_config
 
+        cfg = get_config()
         self._raylet = raylet_client
         self._legacy_submit = legacy_submit
         self._on_task_failed = on_task_failed
         # small task returns riding the push reply (owner-store path)
         self._on_direct_results = on_direct_results
-        self._max_per_shape = max_leases_per_shape
+        self._max_per_shape = (max_leases_per_shape
+                               if max_leases_per_shape is not None
+                               else cfg.max_leases_per_shape)
         self._lease_block_s = (lease_block_s if lease_block_s is not None
-                               else get_config().lease_block_s)
+                               else cfg.lease_block_s)
+        # flags lease_group_size / lease_pipeline_depth (class attrs
+        # keep the measured defaults as documentation)
+        self.GROUP_SIZE = cfg.lease_group_size
+        self.PIPELINE_DEPTH = cfg.lease_pipeline_depth
         self._lock = threading.Lock()
         self._queues: dict[tuple, deque] = {}
         self._pushers: dict[tuple, int] = {}

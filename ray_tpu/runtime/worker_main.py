@@ -286,6 +286,10 @@ class Worker:
         self._refs = _refcount.global_counter
         self._ref_enabled = _cfg.ref_counting_enabled
         self._direct_limit = _cfg.max_direct_call_object_size
+        self._fn_cache_cap = _cfg.worker_fn_cache_size
+        self._event_batch = _cfg.task_event_batch_size
+        self._event_flush_s = _cfg.task_event_flush_interval_s
+        self._report_linger_s = _cfg.put_report_linger_s
         self._ref_send_lock = threading.Lock()
         if self._ref_enabled:
             _refcount.claim_flusher(self.worker_id)
@@ -561,7 +565,7 @@ class Worker:
             with self._report_cv:
                 while not self._report_buf:
                     self._report_cv.wait()
-            _time.sleep(0.001)  # linger: coalesce a burst of returns
+            _time.sleep(self._report_linger_s)  # coalesce return burst
             with self._report_cv:
                 batch, self._report_buf = self._report_buf, []
             try:
@@ -618,11 +622,13 @@ class Worker:
                 "state": "FINISHED" if ok else "FAILED",
                 "thread": f"worker-{self.worker_id[:8]}",
             })
-            # large batch threshold: at 10k+ calls/s a flush-per-8 means
-            # >1k GCS RPCs/s of pure observability; the 1s timer flusher
-            # bounds staleness for sparse workloads
-            full = len(self._event_buf) >= 128
-        if full or _time.monotonic() - self._last_flush > 2.0:
+            # large batch threshold (flag task_event_batch_size): at
+            # 10k+ calls/s a flush-per-8 means >1k GCS RPCs/s of pure
+            # observability; the timer flusher bounds staleness for
+            # sparse workloads
+            full = len(self._event_buf) >= self._event_batch
+        if full or _time.monotonic() - self._last_flush > \
+                self._event_flush_s:
             self._flush_task_events()
 
     def _flush_loop(self):
@@ -655,7 +661,7 @@ class Worker:
         if hit is not None and hit[0] == blob:
             return hit[1]
         fn = cloudpickle.loads(blob)
-        if len(self._fn_cache) > 256:
+        if len(self._fn_cache) > self._fn_cache_cap:
             self._fn_cache.clear()
         self._fn_cache[key] = (blob, fn)
         return fn
@@ -674,7 +680,7 @@ class Worker:
                 "?", RuntimeError(f"function {fn_id} not in the GCS "
                                   f"function table"))
         fn = cloudpickle.loads(blob)
-        if len(self._fn_id_cache) > 256:
+        if len(self._fn_id_cache) > self._fn_cache_cap:
             self._fn_id_cache.clear()
         self._fn_id_cache[fn_id] = fn
         return fn
