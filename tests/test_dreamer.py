@@ -163,3 +163,32 @@ def test_reward_head_learns_action_dependent_rewards(algo):
     # symlog(1)=0.693: the marginal-mean predictor floors at ~0.12 in
     # symlog MSE; conditioning on the action must beat it decisively
     assert loss < 0.06, loss
+
+
+def test_sequence_replay_samples_across_ring_wrap():
+    """Full-ring sampling draws windows across the capacity-1 -> 0
+    boundary (they are temporally contiguous; the write head marks
+    is_first where continuity actually breaks) — advisor finding:
+    excluding them permanently under-sampled steps after index 0."""
+    import numpy as np
+
+    from ray_tpu.rllib.dreamer import SequenceReplay
+
+    rep = SequenceReplay(capacity=32, obs_dim=2)
+    for i in range(40):   # wraps: pos ends at 8, ring full
+        rep.add_batch({
+            "obs": np.full((1, 2), i, np.float32),
+            "actions": np.zeros((1,), np.int32),
+            "rewards": np.zeros((1,), np.float32),
+            "is_first": np.zeros((1,), np.float32),
+            "cont": np.ones((1,), np.float32),
+        })
+    assert rep.size == rep.capacity
+    rng = np.random.default_rng(0)
+    wrapped = 0
+    for _ in range(200):
+        batch = rep.sample(4, seq_len=8, rng=rng)
+        # a window wraps iff its obs sequence is non-monotonic
+        firsts = batch["obs"][:, :, 0]
+        wrapped += int((np.diff(firsts, axis=1) < 0).any())
+    assert wrapped > 0, "no sampled window ever crossed the ring wrap"

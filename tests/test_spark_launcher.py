@@ -1,0 +1,35 @@
+"""Ray-on-Spark launcher protocol (reference:
+python/ray/util/spark/cluster_init.py). pyspark isn't bundled: the
+launch protocol is unit-tested via the factored command builder; entry
+points must raise a clear ImportError."""
+
+import sys
+
+import pytest
+
+from ray_tpu.util.spark import (
+    MAX_NUM_WORKER_NODES,
+    _worker_start_cmd,
+    setup_ray_cluster,
+    shutdown_ray_cluster,
+)
+
+
+def test_worker_start_cmd_protocol():
+    cmd = _worker_start_cmd(("10.0.0.1", 6379), num_cpus=8, num_tpus=4)
+    assert cmd[0] == sys.executable
+    assert "--address" in cmd and "10.0.0.1:6379" in cmd
+    assert cmd[cmd.index("--num-cpus") + 1] == "8"
+    assert cmd[cmd.index("--num-tpus") + 1] == "4"
+    assert "--block" in cmd          # long-lived barrier task
+
+
+def test_max_worker_nodes_sentinel():
+    assert MAX_NUM_WORKER_NODES == -1
+
+
+def test_entry_points_require_pyspark():
+    with pytest.raises(ImportError, match="pyspark"):
+        setup_ray_cluster(num_worker_nodes=2)
+    with pytest.raises(ImportError, match="pyspark"):
+        shutdown_ray_cluster()
