@@ -12,6 +12,12 @@ import numpy as np
 import pytest
 
 import ray_tpu
+from ray_tpu.utils.config import get_config
+
+# CI-tier sizes are flags (RAY_TPU_ENVELOPE_* env overrides)
+_N_ACTORS = get_config().envelope_actors
+_N_QUEUED = get_config().envelope_queued_tasks
+_N_ARGS = get_config().envelope_task_args
 
 
 @pytest.fixture
@@ -30,9 +36,9 @@ def test_many_actors_alive(rt):
         def who(self):
             return self.i
 
-    actors = [A.remote(i) for i in range(200)]
+    actors = [A.remote(i) for i in range(_N_ACTORS)]
     got = ray_tpu.get([a.who.remote() for a in actors])
-    assert got == list(range(200))
+    assert got == list(range(_N_ACTORS))
     for a in actors:
         ray_tpu.kill(a)
 
@@ -44,21 +50,22 @@ def test_deep_task_queue_drains(rt):
     def nop(i):
         return i
 
-    n = 20_000
+    n = _N_QUEUED
     refs = [nop.remote(i) for i in range(n)]
     out = ray_tpu.get(refs)
     assert out[0] == 0 and out[-1] == n - 1 and len(out) == n
 
 
 def test_many_object_args_to_one_task(rt):
-    """One task taking 1,000 ObjectRef args (envelope axis: 10k+)."""
-    refs = [ray_tpu.put(i) for i in range(1000)]
+    """One task taking many ObjectRef args (envelope axis: 10k+;
+    flag envelope_task_args)."""
+    refs = [ray_tpu.put(i) for i in range(_N_ARGS)]
 
     @ray_tpu.remote
     def consume(*xs):
         return sum(xs)
 
-    assert ray_tpu.get(consume.remote(*refs)) == sum(range(1000))
+    assert ray_tpu.get(consume.remote(*refs)) == sum(range(_N_ARGS))
 
 
 def test_many_returns_from_one_task(rt):
