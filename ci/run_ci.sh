@@ -16,6 +16,10 @@ if [ "${1:-}" = "--nightly" ]; then
   python -m pytest tests/test_envelope_nightly.py -m nightly -q -s
   stage "nightly serve soak (paged engine page/refcount flatness)"
   python -m pytest tests/test_serve_soak_nightly.py -m nightly -q -s
+  stage "nightly RL plane (pixel-obs throughput + learning)"
+  # conftest forces the 8-device virtual CPU platform the mesh
+  # learners need
+  python -m pytest tests/test_rllib_extras.py -m nightly -q -s
   echo "nightly tiers: green"
   exit 0
 fi

@@ -47,13 +47,12 @@ def read_mongo(collection_factory: Callable, *,
 def write_mongo(ds: Dataset, collection_factory: Callable) -> None:
     """Insert every row as a document (reference:
     ``Dataset.write_mongo``): ``insert_many`` per block."""
+    from ray_tpu.data.sql import rows_from_batch
+
     coll = collection_factory()
     try:
         for batch in ds.iter_batches():
-            keys = list(batch)
-            n = len(batch[keys[0]]) if keys else 0
-            docs = [{k: _py(batch[k][i]) for k in keys}
-                    for i in range(n)]
+            docs = rows_from_batch(batch)
             if docs:
                 coll.insert_many(docs)
     finally:
@@ -65,8 +64,3 @@ def _close(coll):
         coll.database.client.close()
     except Exception:  # noqa: BLE001 - duck-typed double without close
         pass
-
-
-def _py(v):
-    item = getattr(v, "item", None)
-    return item() if item is not None and getattr(v, "ndim", 0) == 0 else v

@@ -47,15 +47,21 @@ def write_sql(ds: Dataset, sql: str, connection_factory: Callable) -> None:
     try:
         cur = conn.cursor()
         for batch in ds.iter_batches():
-            keys = list(batch)
-            n = len(batch[keys[0]]) if keys else 0
-            rows = [tuple(_py(batch[k][i]) for k in keys)
-                    for i in range(n)]
+            rows = [tuple(r.values()) for r in rows_from_batch(batch)]
             if rows:
                 cur.executemany(sql, rows)
         conn.commit()
     finally:
         conn.close()
+
+
+def rows_from_batch(batch: dict) -> list[dict]:
+    """Columnar batch -> row dicts with numpy scalars coerced to native
+    Python (DB drivers reject np.int64 etc.). Shared by the SQL and
+    Mongo writers."""
+    keys = list(batch)
+    n = len(batch[keys[0]]) if keys else 0
+    return [{k: _py(batch[k][i]) for k in keys} for i in range(n)]
 
 
 def _py(v):

@@ -216,7 +216,7 @@ class Config:
     # --- envelope / benchmark tiers (tests/test_envelope*.py) ---
     envelope_actors: int = 200
     envelope_queued_tasks: int = 20_000
-    envelope_task_args: int = 500
+    envelope_task_args: int = 1000
     envelope_nightly_actors: int = 2_000
     envelope_nightly_queued_tasks: int = 1_000_000
     envelope_nightly_task_args: int = 5_000
