@@ -178,6 +178,7 @@ class ClusterRuntime:
         # check-miss-then-mark vs update-then-check interleavings.
         self._promote_pending: set[str] = set()
         self._use_memstore = self._ref_enabled
+        self._memstore_put_limit = _cfg.max_direct_call_object_size
         if self._use_memstore:
             self._memstore_release_hook = self._evict_mem_objects
             self._memstore_serialize_hook = self._promote_mem_object
@@ -288,16 +289,37 @@ class ClusterRuntime:
     # ------------------------------------------------------------------
 
     def put(self, value) -> ObjectRef:
-        """Seal into shm with a held read ref and return immediately; the
-        pin registration is BATCHED (one raylet RPC per flush, not per
-        put — same protocol as the worker's task-return reports). The
-        seal-hold keeps the object eviction-safe until the pin lands;
-        the report flusher releases it after."""
+        """Small values land in the owner's in-process MEMORY store
+        (reference: small ``ray.put`` objects live in the owner's
+        CoreWorkerMemoryStore, memory_store.h:43) — zero store/raylet
+        RPCs; the serialize/arrival hooks promote to shm the moment the
+        ref travels off-process. Large values seal into shm with a held
+        read ref; the pin registration is BATCHED (one raylet RPC per
+        flush — same protocol as the worker's task-return reports), the
+        seal-hold keeping the object eviction-safe until the pin lands."""
         oid = ObjectID.from_random()
-        size = object_codec.put_value_durable(
-            self.store, oid.binary(), value, hold=True,
-            request_space=lambda n: self._raylet.call("request_space",
-                                                      nbytes=n))
+        if self._use_memstore:
+            payload, obj, caught = object_codec.encode_bytes(
+                value, limit=self._memstore_put_limit)
+            if payload is not None:
+                oid_hex = oid.hex()
+                self._memstore[oid_hex] = payload
+                if caught:
+                    # the put value contains ObjectRefs: contains-edges
+                    # anchor on the outer oid (same as direct returns)
+                    self._refs.add_contains(oid_hex, caught)
+                return ObjectRef(oid)
+            # too large for the memory tier: reuse the serialized form
+            size = object_codec.put_value_durable(
+                self.store, oid.binary(), value, hold=True,
+                preserialized=obj, contained=caught,
+                request_space=lambda n: self._raylet.call(
+                    "request_space", nbytes=n))
+        else:
+            size = object_codec.put_value_durable(
+                self.store, oid.binary(), value, hold=True,
+                request_space=lambda n: self._raylet.call("request_space",
+                                                          nbytes=n))
         if size > 0:
             with self._put_report_cv:
                 self._put_report_buf.append((oid.hex(), size))
