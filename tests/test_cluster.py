@@ -280,3 +280,27 @@ def test_placement_group_basic(cluster):
     assert len(info["bundle_nodes"]) == 2
     client.call("remove_placement_group", pg_id="pg1")
     client.close()
+
+
+def test_memstore_put_refs_resolve_everywhere(cluster):
+    """Small puts live in the owner's memory store until their ref is
+    serialized: top-level args, refs NESTED in containers, and refs
+    returned through tasks must all resolve on workers (promotion
+    hooks) and back on the driver (memstore read)."""
+    a = ray_tpu.put(20)
+    b = ray_tpu.put(22)
+
+    @ray_tpu.remote
+    def add_nested(pair):
+        x, y = pair
+        return ray_tpu.get(x) + ray_tpu.get(y)
+
+    assert ray_tpu.get(add_nested.remote((a, b))) == 42
+
+    @ray_tpu.remote
+    def passthrough(rs):
+        return rs   # refs round-trip through the worker un-resolved
+        # (top-level ref args resolve to values; nested ones don't)
+
+    back = ray_tpu.get(passthrough.remote([a]))
+    assert ray_tpu.get(back[0]) == 20
