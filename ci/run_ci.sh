@@ -20,6 +20,11 @@ if [ "${1:-}" = "--nightly" ]; then
   # conftest forces the 8-device virtual CPU platform the mesh
   # learners need
   python -m pytest tests/test_rllib_extras.py -m nightly -q -s
+  stage "nightly chaos matrix (raylet<->raylet + owner<->worker partitions)"
+  # the full partition matrix holds each cut across >= 2 heartbeat
+  # timeouts; the fast default tier runs only the driver<->GCS smoke
+  JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_partitions.py \
+    -m nightly -q -s
   echo "nightly tiers: green"
   exit 0
 fi

@@ -69,8 +69,8 @@ class _SessionRpcClient(ReconnectingRpcClient):
                     "server reaped it after the grace window") from e
             raise
 
-    def _redial(self, failed) -> bool:
-        if not super()._redial(failed):
+    def _redial(self, failed, deadline=None) -> bool:
+        if not super()._redial(failed, deadline):
             return False
         try:
             # direct call on the NEW underlying client: going through

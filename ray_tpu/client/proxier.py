@@ -35,17 +35,26 @@ class ProxyManager(RpcServer):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 10001, *,
                  gcs_address=None, num_cpus: float | None = None,
-                 child_idle_exit_s: float = 60.0):
+                 child_idle_exit_s: float = 60.0,
+                 child_spawn_timeout_s: float = 60.0):
         super().__init__(host, port)
         self._host = host
         self._gcs = gcs_address
         self._num_cpus = num_cpus
         self._idle_exit = child_idle_exit_s
+        self._spawn_timeout = child_spawn_timeout_s
         self._lock = threading.Lock()
-        # token -> {"proc": Popen, "addr": (host, port)}
+        # token -> {"proc": Popen|None, "addr": (host, port)|None,
+        #           "event": Event, "error": str|None}. addr None while
+        # the spawn is in flight; waiters block on "event" OUTSIDE the
+        # manager lock.
         self._children: dict[str, dict] = {}
+        # test hook: command override for the per-job server child
+        self._spawn_cmd: list[str] | None = None
 
-    def _spawn_child(self) -> dict:
+    def _child_cmd(self) -> list[str]:
+        if self._spawn_cmd is not None:
+            return list(self._spawn_cmd)
         cmd = [sys.executable, "-m", "ray_tpu.client.server",
                "--host", self._host, "--port", "0",
                "--exit-when-idle", str(self._idle_exit)]
@@ -53,42 +62,93 @@ class ProxyManager(RpcServer):
             cmd += ["--address", f"{self._gcs[0]}:{self._gcs[1]}"]
         if self._num_cpus is not None:
             cmd += ["--num-cpus", str(self._num_cpus)]
-        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
-        # first stdout line: "client server on HOST:PORT"
-        deadline = time.monotonic() + 60.0
-        line = ""
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline()
-            if "client server on" in line:
-                break
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"per-job client server died at startup (rc="
-                    f"{proc.returncode})")
+        return cmd
+
+    def _spawn_child(self) -> dict:
+        proc = subprocess.Popen(self._child_cmd(), stdout=subprocess.PIPE,
+                                text=True)
+        # First stdout line: "client server on HOST:PORT". The read runs
+        # on a helper thread so the deadline is REAL — a child that
+        # starts but never announces (wedged import, stolen stdout) used
+        # to park this thread in readline() forever, the deadline only
+        # checked between lines that never came.
+        announced = threading.Event()
+        state = {"line": ""}
+
+        def _read_announce():
+            for line in proc.stdout:
+                if "client server on" in line:
+                    state["line"] = line
+                    announced.set()
+                    break
+            announced.set()   # EOF/exit with no announce: wake the waiter
+            # keep draining so the child never blocks on a full pipe
+            for _ in proc.stdout:
+                pass
+
+        threading.Thread(target=_read_announce, daemon=True,
+                         name="proxier-announce-reader").start()
+        if not announced.wait(timeout=self._spawn_timeout):
+            proc.kill()
+            raise RuntimeError(
+                f"per-job client server did not announce within "
+                f"{self._spawn_timeout}s")
+        line = state["line"]
+        if not line:
+            rc = proc.poll()
+            proc.kill()
+            raise RuntimeError(
+                f"per-job client server died at startup (rc={rc})")
         hostport = line.rsplit(" ", 1)[-1].strip()
         h, _, p = hostport.rpartition(":")
         if not p.isdigit():
             proc.kill()
             raise RuntimeError(
                 f"per-job client server announced no address: {line!r}")
-        # drain further output so the child never blocks on a full pipe
-        threading.Thread(target=lambda: [None for _ in proc.stdout],
-                         daemon=True).start()
         return {"proc": proc, "addr": (h, int(p))}
 
     def rpc_client_hello(self, conn, send_lock, *, session_token=None):
         token = session_token or uuid.uuid4().hex
+        spawn_needed = False
         with self._lock:
             child = self._children.get(token)
-            if child is not None and child["proc"].poll() is not None:
+            if child is not None and child["proc"] is not None \
+                    and child["proc"].poll() is not None:
+                self._children.pop(token, None)
                 child = None   # exited (idle or crash): respawn
             if child is None:
                 # reap dead children while here (bounded table)
                 for t, c in list(self._children.items()):
-                    if c["proc"].poll() is not None:
+                    if c["proc"] is not None and c["proc"].poll() is not None:
                         self._children.pop(t)
-                child = self._spawn_child()
+                # publish a placeholder and spawn OUTSIDE the lock: a
+                # slow child startup used to serialize EVERY hello (all
+                # sessions, not just this token) behind this one spawn
+                child = {"proc": None, "addr": None,
+                         "event": threading.Event(), "error": None}
                 self._children[token] = child
+                spawn_needed = True
+        if spawn_needed:
+            try:
+                spawned = self._spawn_child()
+                child["proc"] = spawned["proc"]
+                child["addr"] = spawned["addr"]
+            except Exception as e:  # noqa: BLE001 - report to all waiters
+                child["error"] = repr(e)
+                with self._lock:
+                    if self._children.get(token) is child:
+                        self._children.pop(token)
+                child["event"].set()
+                raise
+            child["event"].set()
+        elif child["addr"] is None:
+            # concurrent hello with the same token: wait (outside the
+            # lock) for the in-flight spawn
+            if not child["event"].wait(timeout=self._spawn_timeout + 5):
+                raise RuntimeError("per-job client server spawn timed out")
+            if child["error"] is not None:
+                raise RuntimeError(
+                    f"per-job client server spawn failed: {child['error']}")
         return {"redirect": list(child["addr"]), "session_token": token,
                 "job_id": "proxied"}
 

@@ -44,8 +44,13 @@ class ClusterRuntime:
                  namespace: str | None = None,
                  log_to_driver: bool = False):
         self.gcs_address = tuple(gcs_address)
+        # arm the fault-injection plane (no-op unless the config flag is
+        # set) BEFORE any channel dials: a startup plan must see every
+        # connection this runtime makes
+        from ray_tpu.runtime import fault_injection as _fi
+        _fi.maybe_init_from_config(self.gcs_address)
         # reconnecting: survives a GCS restart (file-backed recovery)
-        self._gcs = ReconnectingRpcClient(self.gcs_address)
+        self._gcs = ReconnectingRpcClient(self.gcs_address, label="driver")
         self.caller_id = WorkerID.from_random().hex()
         # ref-counting client identity: inside a pool worker the PROCESS
         # id (the Worker's flusher owns the channel there — one client
@@ -72,13 +77,20 @@ class ClusterRuntime:
             store_name = head["store_name"]
             self.node_id = head["node_id"]
         else:
-            info = RpcClient(tuple(raylet_address)).call("node_info")
+            info = RpcClient(tuple(raylet_address),
+                             label="driver").call("node_info")
             store_name = info["store_name"]
             self.node_id = info["node_id"]
-        self._raylet = RpcClient(tuple(raylet_address))
+        self._raylet = RpcClient(tuple(raylet_address), label="driver")
         self.store = ShmObjectStore(store_name)
         self._actor_locations: dict[str, tuple] = {}   # id -> (addr, incarnation)
         self._actor_seq: dict[str, int] = {}           # id -> next seq
+        # incarnation the seq numbering was issued against — tracked
+        # SEPARATELY from the location cache: evicting a cached location
+        # (e.g. on a transport error) must not restart numbering at 0
+        # while the worker's ordered cursor sits at N, or every later
+        # call is silently deduped as stale and the actor wedges
+        self._actor_seq_inc: dict[str, int] = {}
         # pipelined actor submits: id -> deque[(tasks, PendingCall, addr,
         # sent_at)] — each window entry is one BATCH frame in flight
         self._actor_windows: dict[str, deque] = {}
@@ -140,7 +152,8 @@ class ClusterRuntime:
                 self.gcs_address,
                 {"method": "subscribe", "channels": ["log"]},
                 self._print_worker_logs,
-                reconnect=True)   # survive a GCS restart like _gcs does
+                reconnect=True,   # survive a GCS restart like _gcs does
+                label="driver")
         # --- distributed refcounting (reference: reference_count.h:61;
         # see runtime/refcount.py): this runtime flushes the process's
         # ref deltas to the GCS and doubles as the client heartbeat that
@@ -392,13 +405,17 @@ class ClusterRuntime:
                 self._mem_cv.notify_all()
             for oid_hex in promote:
                 self._promote_mem_object(oid_hex)
-                if self._refs.count(oid_hex) == 0:
-                    # every local ref died while the result was in
-                    # flight (submit-and-forget chains): the promoted
-                    # shm copy serves the consumer; keeping the memory
-                    # copy would leak — no death notice will ever come
-                    # again for this oid
-                    self._memstore.pop(oid_hex, None)
+            # every local ref may have died while a result was in flight
+            # (submit-and-forget chains): the release hook already fired
+            # for those oids, so no death notice will ever come again —
+            # any copy kept now leaks the memstore forever. Applies to
+            # EVERY arriving oid, not just promote-pending ones (a
+            # promoted shm copy serves any remote consumer).
+            dead = [o for o in results if self._refs.count(o) == 0]
+            if dead:
+                with self._mem_cv:
+                    for oid_hex in dead:
+                        self._memstore.pop(oid_hex, None)
             return
         from ray_tpu._private.shm_store import (ObjectExistsError,
                                                 StoreFullError)
@@ -468,16 +485,25 @@ class ClusterRuntime:
                 batch, self._put_report_buf = self._put_report_buf, []
             if not batch:
                 continue
-            try:
-                self._raylet.call("report_objects", entries=batch)
-            except Exception:  # noqa: BLE001 - raylet unreachable
-                # the seal-holds are what keep these objects alive until
-                # their pins land: requeue and retry rather than
-                # releasing unpinned sole copies into LRU eviction
-                if not self._closed:
-                    with self._put_report_cv:
-                        self._put_report_buf[:0] = batch
+            # One idempotency token per logical batch, held across
+            # retries: a reply lost AFTER the raylet applied the pins
+            # (healed partition, transient reset) makes the retry a
+            # server-side no-op instead of a double-apply. The seal-holds
+            # are what keep these objects alive until their pins land:
+            # retry rather than releasing unpinned sole copies into LRU
+            # eviction.
+            import uuid as _uuid
+            token = _uuid.uuid4().hex
+            sent = False
+            while not self._closed:
+                try:
+                    self._raylet.call("report_objects", entries=batch,
+                                      token=token)
+                    sent = True
+                    break
+                except Exception:  # noqa: BLE001 - raylet unreachable
                     time.sleep(0.05)
+            if not sent:
                 continue
             if self._closed:
                 continue   # store may be unmapped: never touch
@@ -539,6 +565,7 @@ class ClusterRuntime:
         pending = [o for o in oids if not (mem and o in mem)
                    and not self.store.contains(bytes.fromhex(o))]
         recover_tick = 0.0
+        mem_skips = 0
         while pending:
             # Local completions (direct small returns, same-host tasks)
             # resolve with a cheap contains scan — only a WINDOW of the
@@ -570,8 +597,17 @@ class ClusterRuntime:
                 with self._mem_cv:
                     arrivals0 = self._mem_arrivals
                     woke = self._mem_cv.wait(timeout=0.02)
-                if woke or self._mem_arrivals != arrivals0:
+                # BOUNDED skip: a sustained direct-result stream wakes
+                # this cv every cycle, and skipping ensure_local on
+                # every wake would starve the remote-pull path forever
+                # (a shm-only object on another node never gets its pull
+                # issued). At most ~5 consecutive wakes (~100 ms) defer
+                # the ensure_local window.
+                if (woke or self._mem_arrivals != arrivals0) \
+                        and mem_skips < 5:
+                    mem_skips += 1
                     continue
+            mem_skips = 0
             # short park only when the direct-arrival blind spot exists
             # (memstore on): without it the raylet's event-driven wait
             # covers every arrival path, and 0.25s parks would 8x the
@@ -1116,9 +1152,9 @@ class ClusterRuntime:
                 addr = info.get("push_addr") or info["address"]
                 entry = (tuple(addr), info.get("num_restarts", 0))
                 with self._seq_lock:
-                    old = self._actor_locations.get(actor_id_hex)
-                    if old is None or old[1] != entry[1]:
+                    if self._actor_seq_inc.get(actor_id_hex) != entry[1]:
                         self._actor_seq[actor_id_hex] = 0
+                        self._actor_seq_inc[actor_id_hex] = entry[1]
                     self._actor_locations[actor_id_hex] = entry
                 return entry
             if info["state"] == "DEAD":
@@ -1192,7 +1228,7 @@ class ClusterRuntime:
                 return client
         # connect OUTSIDE the lock: one unreachable raylet (30s connect
         # timeout) must not stall submissions to every other node
-        fresh = RpcClient(addr)
+        fresh = RpcClient(addr, label="owner")
         evicted = None
         with self._actor_clients_lock:
             client = self._actor_clients.get(addr)
@@ -1314,12 +1350,19 @@ class ClusterRuntime:
 
     def _resend_actor_task(self, task: dict, actor_hex: str,
                            first_err: BaseException, addr_used):
-        """One synchronous retry with a refreshed location (reference:
-        client resend protocol on actor restart). Seq handling: same
-        incarnation keeps the ORIGINAL seq (the actor never consumed it;
-        duplicates dedup worker-side), a new incarnation renumbers from
-        the reset counter — either way no gap stalls the actor's ordered
-        queue."""
+        """Retry with a refreshed location under a bounded redial window
+        (reference: client resend protocol on actor restart). A single
+        shot here condemned LIVE actors during transient partitions of
+        the owner link: the retry dial failed inside the same cut and
+        the task came back ActorDiedError even though the actor process
+        never died. Transport errors now drop the cached client, back
+        off (config ``rpc_backoff_*``), and redial until
+        ``rpc_redial_window_s`` closes; an ActorDiedError /
+        ActorUnavailableError from the GCS is authoritative and stops
+        the loop at once. Seq handling: same incarnation keeps the
+        ORIGINAL seq (the actor never consumed it; duplicates dedup
+        worker-side), a new incarnation renumbers from the reset counter
+        — either way no gap stalls the actor's ordered queue."""
         if self._closed:
             return  # store may be unmapped mid-shutdown: never touch
         if isinstance(first_err, (OSError, ConnectionLost)) \
@@ -1331,21 +1374,49 @@ class ClusterRuntime:
             except Exception:  # noqa: BLE001
                 pass
         self._actor_locations.pop(actor_hex, None)
-        try:
-            addr, incarnation = self._actor_location(actor_hex)
-            if incarnation != task.get("incarnation"):
-                with self._seq_lock:
-                    seq = self._actor_seq.get(actor_hex, 0)
-                    self._actor_seq[actor_hex] = seq + 1
-                task["seq"] = seq
-                task["incarnation"] = incarnation
-            client = self._actor_client(addr)
-            client.call("submit_actor_task", task=task)
-            return
-        except (exc.ActorDiedError, exc.ActorUnavailableError, OSError,
-                ConnectionLost, LookupError, TimeoutError) as e:
-            err = e if isinstance(e, exc.RayTpuError) else \
-                exc.ActorDiedError(actor_hex, repr(e))
+        from ray_tpu.utils.config import get_config as _gc
+        cfg = _gc()
+        deadline = time.monotonic() + cfg.rpc_redial_window_s
+        attempt = 0
+        err: BaseException = first_err
+        while True:
+            attempt += 1
+            addr = None
+            try:
+                addr, incarnation = self._actor_location(actor_hex)
+                if incarnation != task.get("incarnation"):
+                    with self._seq_lock:
+                        seq = self._actor_seq.get(actor_hex, 0)
+                        self._actor_seq[actor_hex] = seq + 1
+                    task["seq"] = seq
+                    task["incarnation"] = incarnation
+                client = self._actor_client(addr)
+                client.call("submit_actor_task", task=task, timeout=30)
+                return
+            except (exc.ActorDiedError, exc.ActorUnavailableError) as e:
+                err = e      # GCS verdict: no amount of redialing helps
+                break
+            except (OSError, ConnectionLost, LookupError,
+                    TimeoutError) as e:
+                err = e
+                if addr is not None:
+                    try:
+                        self._drop_actor_client(addr)
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._actor_locations.pop(actor_hex, None)
+                import random as _random
+                delay = min(cfg.rpc_backoff_max_s,
+                            cfg.rpc_backoff_initial_s
+                            * cfg.rpc_backoff_multiplier ** (attempt - 1))
+                if cfg.rpc_backoff_jitter:
+                    delay *= 1.0 + cfg.rpc_backoff_jitter * (
+                        2.0 * _random.random() - 1.0)
+                if time.monotonic() + delay >= deadline or self._closed:
+                    break
+                time.sleep(delay)
+        err = err if isinstance(err, exc.RayTpuError) else \
+            exc.ActorDiedError(actor_hex, repr(err))
         if task.get("pinned"):
             self._refs.release_task_pin(task.get("task_id", ""))
         for oid_hex in task.get("return_oids", ()):

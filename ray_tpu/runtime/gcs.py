@@ -21,6 +21,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
+from ray_tpu.runtime import fault_injection as _fi
 from ray_tpu.runtime.rpc import RpcServer, send_msg
 
 # Pubsub channels (reference: pubsub.proto:28 channel enum).
@@ -201,6 +202,8 @@ class GcsServer(RpcServer):
                  heartbeat_timeout_s: float = 5.0,
                  persistence_dir: str | None = None):
         super().__init__(host, port)
+        self.fault_label = "gcs"   # fault-injection endpoint label
+        _fi.maybe_init_from_config()
         self._lock = threading.RLock()
         self._nodes: dict[str, NodeInfo] = {}
         self._actors: dict[str, ActorInfo] = {}
@@ -674,9 +677,16 @@ class GcsServer(RpcServer):
         # lifetime="detached" (or an ownerless registration) opts out
         detached = (lifetime == "detached") or owner_id is None
         with self._lock:
+            # idempotent by actor_id: a retried registration (the reply
+            # was lost to a partition, or the delivery was duplicated)
+            # acks the registration that already exists instead of
+            # rejecting its own name as taken
+            existing = self._actors.get(actor_id)
+            if existing is not None and existing.state != "DEAD":
+                return {"ok": True, "node_id": existing.node_id}
             if name is not None:
                 key = _ns_key(namespace, name)
-                if key in self._named_actors:
+                if self._named_actors.get(key, actor_id) != actor_id:
                     raise ValueError(
                         f"Actor name {name!r} already taken in namespace "
                         f"{namespace!r}")
@@ -774,7 +784,7 @@ class GcsServer(RpcServer):
             client = self._placement_clients.get(addr)
             if client is not None and not client._closed:
                 return client
-        fresh = RpcClient(addr)
+        fresh = RpcClient(addr, label="gcs")
         with self._placement_lock:
             current = self._placement_clients.get(addr)
             if current is not None and not current._closed:
@@ -1357,6 +1367,14 @@ class GcsServer(RpcServer):
                 return {"ok": False}
             table[key] = value
             self._log("kv", (ns, key), value)
+        if ns == _fi.KV_NS and key == _fi.KV_KEY:
+            # the fault-plan switch key: other processes poll it, the
+            # GCS applies it to its own plane at write time (outside the
+            # KV lock — load_plan takes the plane's own lock)
+            try:
+                _fi.plane.load_plan(_fi.decode_plan(value))
+            except Exception:  # noqa: BLE001 - bad plan must not break KV
+                pass
         return {"ok": True}
 
     def rpc_kv_get(self, conn, send_lock, *, ns, key):

@@ -40,7 +40,8 @@ class Lease:
 
     def __init__(self, addr, worker_id: str, node_id: str, raylet_addr):
         self.addr = tuple(addr)
-        self.client = RpcClient(self.addr)
+        # "owner" labels the owner↔worker push plane for fault injection
+        self.client = RpcClient(self.addr, label="owner")
         self.worker_id = worker_id
         self.node_id = node_id
         self.raylet_addr = tuple(raylet_addr)  # the granting raylet
@@ -354,9 +355,17 @@ class LeaseManager:
         """
         home: RpcClient | None = None
         transient: RpcClient | None = None
+        # One idempotency token per logical acquisition, held across
+        # transport retries: a grant whose reply was lost (reset,
+        # healed partition) is returned AGAIN by the raylet instead of
+        # leasing a second worker — without it every lost reply leaked a
+        # granted worker until the never-dialed watchdog reclaimed it.
+        import uuid as _uuid
+        token = _uuid.uuid4().hex
+        transport_failures = 0
         try:
             try:
-                home = RpcClient(self._raylet.address)
+                home = RpcClient(self._raylet.address, label="driver")
             except OSError:
                 return None
             target = home
@@ -370,9 +379,28 @@ class LeaseManager:
                         runtime_env=task.get("runtime_env"),
                         timeout_s=self._lease_block_s,
                         spill_count=hops,
+                        token=token,
                         timeout=self._lease_block_s + 5.0)
                 except (ConnectionLost, OSError, TimeoutError, EOFError):
-                    return None  # raylet unreachable: legacy fallback
+                    transport_failures += 1
+                    if self._stopping or transport_failures > 2:
+                        return None  # raylet unreachable: legacy fallback
+                    # the request may have been APPLIED with the reply
+                    # lost: redial and retry with the SAME token so an
+                    # already-granted worker is reused, not duplicated
+                    time.sleep(0.2)
+                    if transient is not None:
+                        transient.close()
+                        transient = None
+                    home.close()
+                    try:
+                        home = RpcClient(self._raylet.address,
+                                         label="driver")
+                    except OSError:
+                        return None
+                    target = home
+                    hops = 0
+                    continue
                 if resp.get("ok"):
                     try:
                         return Lease(resp["worker_addr"], resp["worker_id"],
@@ -398,7 +426,8 @@ class LeaseManager:
                         transient.close()
                         transient = None
                     try:
-                        transient = RpcClient(tuple(resp["redirect"]))
+                        transient = RpcClient(tuple(resp["redirect"]),
+                                              label="driver")
                     except OSError:
                         return None
                     target = transient
@@ -434,7 +463,8 @@ class LeaseManager:
     def _death_info(self, lease: Lease) -> dict:
         client = None
         try:
-            client = RpcClient(lease.raylet_addr, timeout=5)
+            client = RpcClient(lease.raylet_addr, timeout=5,
+                               label="driver")
             return client.call("worker_death_info",
                                worker_id=lease.worker_id) or {}
         except Exception:  # noqa: BLE001 - node died with the worker
@@ -504,7 +534,8 @@ class LeaseManager:
             return ("queued", task)
         client = None
         try:
-            client = RpcClient(lease.raylet_addr, timeout=10)
+            client = RpcClient(lease.raylet_addr, timeout=10,
+                               label="driver")
             client.call("cancel_leased", worker_id=lease.worker_id,
                         task=task, force=force)
         except (ConnectionLost, OSError, TimeoutError):

@@ -188,6 +188,7 @@ class LocalObjectManager:
                 int(store_capacity
                     * cfg.object_transfer_inflight_fraction),
                 cfg.object_transfer_chunk_bytes),
+            fault_label=getattr(node, "fault_label", None),
         )
 
     def stop(self):

@@ -41,7 +41,8 @@ class PullManager:
                  on_pulled: Callable[[str, int], None],
                  chunk_size: int = 5 << 20,
                  max_in_flight_bytes: int = 256 << 20,
-                 conns_per_peer: int = 4):
+                 conns_per_peer: int = 4,
+                 fault_label: str | None = None):
         """fetch_local(oid) -> restored from spill locally;
         peer_addresses(oid) -> [(node_id, address), ...] candidate
         sources; on_pulled(oid, size) -> track + register location."""
@@ -61,6 +62,10 @@ class PullManager:
         self._conns: dict[tuple, list] = {}
         self._conns_lock = threading.Lock()
         self._conns_per_peer = conns_per_peer
+        # transfer connections carry the owning node's fault-injection
+        # label: an injected raylet<->raylet partition must sever the
+        # data plane too, not just the control RPCs
+        self._fault_label = fault_label
         self._stopping = False
 
     def stop(self):
@@ -99,7 +104,7 @@ class PullManager:
             pool = self._conns.get(addr)
             if pool:
                 return pool.pop()
-        return RpcClient(addr)
+        return RpcClient(addr, label=self._fault_label)
 
     def _checkin(self, addr: tuple, client: RpcClient):
         if client._closed:

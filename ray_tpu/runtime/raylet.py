@@ -47,8 +47,11 @@ class Raylet(RpcServer):
                  heartbeat_interval_s: float | None = None,
                  infeasible_timeout_s: float = 10.0):
         super().__init__(host, 0)
+        self.fault_label = "raylet"   # fault-injection endpoint label
         self.node_id = node_id
         self.gcs_address = tuple(gcs_address)
+        from ray_tpu.runtime import fault_injection as _fi
+        _fi.maybe_init_from_config(self.gcs_address)
         self.store_name = f"/raytpu_{os.getpid()}_{node_id[:8]}"
         self.store = ShmObjectStore(self.store_name, capacity=store_capacity,
                                     create=True)
@@ -62,14 +65,16 @@ class Raylet(RpcServer):
             prefix=f"raytpu-logs-{node_id[:8]}-")
 
         # reconnecting: survives a GCS restart (file-backed recovery)
-        self._gcs = ReconnectingRpcClient(self.gcs_address)
+        self._gcs = ReconnectingRpcClient(self.gcs_address,
+                                          label="raylet")
         self._gcs_lock = threading.Lock()   # RpcClient is thread-safe; lock
                                             # keeps call+interpret atomic
         # LIVENESS gets its own connection + lock: on the shared channel
         # a task-flood's pick_node/spillback burst queues hundreds of
         # lock-waiters ahead of the beat, and the GCS falsely declares
         # this node dead mid-flood (seen at the 2k-actor envelope tier).
-        self._gcs_beat = ReconnectingRpcClient(self.gcs_address)
+        self._gcs_beat = ReconnectingRpcClient(self.gcs_address,
+                                               label="raylet")
         self._gcs_beat_lock = threading.Lock()
         self._peers: dict[str, RpcClient] = {}
         self._peer_addrs: dict[str, tuple] = {}
@@ -86,6 +91,10 @@ class Raylet(RpcServer):
         # on the dead channel that caused the retry — left actors
         # PENDING forever with no failure report)
         self._pending_hosts: dict[tuple, dict] = {}
+        # report_objects idempotency: token -> first reply (bounded)
+        from collections import OrderedDict
+        self._report_tokens: OrderedDict[str, dict] = OrderedDict()
+        self._report_tokens_lock = threading.Lock()
         self.scheduler = TaskScheduler(
             self, resources=resources,
             infeasible_timeout_s=infeasible_timeout_s)
@@ -537,7 +546,7 @@ class Raylet(RpcServer):
         for n in nodes:
             if n["node_id"] == node_id:
                 try:
-                    client = RpcClient(n["address"])
+                    client = RpcClient(n["address"], label="raylet")
                 except OSError:
                     return None
                 with self._peers_lock:
@@ -834,15 +843,32 @@ class Raylet(RpcServer):
             return {"ok": False, "reason": "object not present to pin"}
         return {"ok": True}
 
-    def rpc_report_objects(self, conn, send_lock, *, entries: list):
+    def rpc_report_objects(self, conn, send_lock, *, entries: list,
+                           token: str | None = None):
         """Batched report_object (workers buffer their task-return
         reports and flush together; each object is protected by its
-        writer's seal-hold until the pin lands here)."""
+        writer's seal-hold until the pin lands here).
+
+        ``token`` makes the batch idempotent: the reporter holds one
+        token across redials of the same batch, and a duplicate delivery
+        (reply lost to a partition, or an injected duplicate) replays the
+        first reply instead of re-running the pins."""
+        if token is not None:
+            with self._report_tokens_lock:
+                cached = self._report_tokens.get(token)
+            if cached is not None:
+                return cached
         ok = []
         for oid, size in entries:
             if self.objects.report_object(oid, size):
                 ok.append(oid)
-        return {"ok": ok}
+        reply = {"ok": ok}
+        if token is not None:
+            with self._report_tokens_lock:
+                self._report_tokens[token] = reply
+                while len(self._report_tokens) > 4096:
+                    self._report_tokens.popitem(last=False)
+        return reply
 
     def rpc_request_space(self, conn, send_lock, *, nbytes: int = 0):
         return {"spilled": self.objects.request_space(nbytes)}
@@ -907,9 +933,10 @@ class Raylet(RpcServer):
 
     def rpc_request_lease(self, conn, send_lock, *, demand: dict,
                           runtime_env: dict | None = None,
-                          timeout_s: float = 10.0, spill_count: int = 0):
+                          timeout_s: float = 10.0, spill_count: int = 0,
+                          token: str | None = None):
         return self.scheduler.request_lease(demand, runtime_env, timeout_s,
-                                            spill_count)
+                                            spill_count, token=token)
 
     def rpc_cancel_leased(self, conn, send_lock, *, worker_id: str,
                           task: dict, force: bool = False):
@@ -983,7 +1010,7 @@ class Raylet(RpcServer):
         def query(wid, addr):
             client = None
             try:
-                client = RpcClient(addr, timeout=5)
+                client = RpcClient(addr, timeout=5, label="raylet")
                 stacks = client.call("dump_stacks")
             except Exception as e:  # noqa: BLE001 - worker busy/gone
                 stacks = {"error": repr(e)}
@@ -1015,7 +1042,8 @@ class Raylet(RpcServer):
         _, addr = targets[0]
         client = None
         try:
-            client = RpcClient(addr, timeout=duration_s + 30)
+            client = RpcClient(addr, timeout=duration_s + 30,
+                               label="raylet")
             return client.call("profile", duration_s=duration_s, hz=hz)
         except Exception as e:  # noqa: BLE001
             return {"error": repr(e)}

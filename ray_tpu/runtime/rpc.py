@@ -17,11 +17,14 @@ exposing ``rpc_<method>`` handlers; handlers run on a thread per connection.
 from __future__ import annotations
 
 import pickle
+import random
 import socket
 import struct
 import threading
 import time
 from typing import Any, Callable
+
+from ray_tpu.runtime import fault_injection as _fi
 
 _LEN = struct.Struct(">Q")
 
@@ -97,6 +100,9 @@ class RpcServer:
         self._sock.bind((host, port))
         self._sock.listen(256)
         self.address = self._sock.getsockname()
+        # endpoint label for the fault-injection plane (subclasses set a
+        # role name: "gcs", "raylet", "worker")
+        self.fault_label = type(self).__name__
         self._stopping = False
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
@@ -195,43 +201,31 @@ class RpcServer:
                     return
                 req_id = req.pop("_id", None)
                 method = req.pop("method")
-                handler = getattr(self, f"rpc_{method}", None)
-                try:
-                    if handler is None:
-                        raise AttributeError(f"no rpc method {method!r}")
-                    result = handler(conn, send_lock, **req)
-                except BaseException as e:  # noqa: BLE001 - ship to caller
+                deliveries = 1
+                if _fi.plane.active:
                     try:
-                        send_msg(conn, {"_id": req_id, "error": e},
-                                 send_lock, fmt=fmt)
+                        peer = conn.getpeername()
                     except OSError:
-                        return  # peer gone; nothing to reply to
-                    except Exception:  # unpicklable exception payload
-                        try:
-                            send_msg(conn,
-                                     {"_id": req_id,
-                                      "error": RuntimeError(repr(e))},
-                                     send_lock, fmt=fmt)
-                        except OSError:
-                            return
-                    continue
-                if result is RpcServer.HELD:
-                    # handler owns the connection; it STAYS in _conns so
-                    # stop() can sever it — the owner calls release_conn
-                    # when the channel is truly finished
-                    held = True
-                    return
-                try:
-                    send_msg(conn, {"_id": req_id, "result": result},
-                             send_lock, fmt=fmt)
-                except OSError:
-                    return  # peer closed mid-reply (e.g. returned lease)
-                except Exception as e:  # noqa: BLE001 - unencodable result
-                    try:
-                        send_msg(conn, {"_id": req_id,
-                                        "error": RuntimeError(repr(e))},
-                                 send_lock, fmt=fmt)
-                    except OSError:
+                        peer = ("?", 0)
+                    action = _fi.plane.consult(self.fault_label, "recv",
+                                               peer, method)
+                    if action == _fi.DROP:
+                        continue   # request lost before dispatch
+                    if action == _fi.RESET:
+                        return     # finally: discard + on_disconnect
+                    if action == _fi.DUPLICATE:
+                        deliveries = 2
+                for delivery in range(deliveries):
+                    # an injected duplicate re-dispatches from a fresh
+                    # deserialization — handlers may mutate their payload
+                    payload = (req if delivery == deliveries - 1
+                               else pickle.loads(pickle.dumps(req)))
+                    outcome = self._dispatch_one(conn, send_lock, fmt,
+                                                 method, req_id, payload)
+                    if outcome == "held":
+                        held = True
+                        return
+                    if outcome == "gone":
                         return
         finally:
             if not held:
@@ -240,6 +234,68 @@ class RpcServer:
             if not self._stopping:
                 self.on_disconnect(conn)
 
+    def _dispatch_one(self, conn, send_lock, fmt, method, req_id,
+                      payload) -> str:
+        """Dispatch one request and send its reply. Returns "ok", "held"
+        (handler took the connection), or "gone" (peer unreachable)."""
+        handler = getattr(self, f"rpc_{method}", None)
+        try:
+            if handler is None:
+                raise AttributeError(f"no rpc method {method!r}")
+            result = handler(conn, send_lock, **payload)
+        except BaseException as e:  # noqa: BLE001 - ship to caller
+            try:
+                self._send_reply(conn, {"_id": req_id, "error": e},
+                                 send_lock, fmt, method)
+            except OSError:
+                return "gone"  # peer gone; nothing to reply to
+            except Exception:  # unpicklable exception payload
+                try:
+                    self._send_reply(conn,
+                                     {"_id": req_id,
+                                      "error": RuntimeError(repr(e))},
+                                     send_lock, fmt, method)
+                except OSError:
+                    return "gone"
+            return "ok"
+        if result is RpcServer.HELD:
+            # handler owns the connection; it STAYS in _conns so
+            # stop() can sever it — the owner calls release_conn
+            # when the channel is truly finished
+            return "held"
+        try:
+            self._send_reply(conn, {"_id": req_id, "result": result},
+                             send_lock, fmt, method)
+        except OSError:
+            return "gone"  # peer closed mid-reply (e.g. returned lease)
+        except Exception as e:  # noqa: BLE001 - unencodable result
+            try:
+                self._send_reply(conn, {"_id": req_id,
+                                        "error": RuntimeError(repr(e))},
+                                 send_lock, fmt, method)
+            except OSError:
+                return "gone"
+        return "ok"
+
+    def _send_reply(self, conn, obj, send_lock, fmt, method):
+        if _fi.plane.active:
+            try:
+                peer = conn.getpeername()
+            except OSError:
+                peer = ("?", 0)
+            action = _fi.plane.consult(self.fault_label, "send", peer,
+                                       method)
+            if action == _fi.DROP:
+                return   # reply lost in flight (handler still applied)
+            if action == _fi.RESET:
+                raise _fi.InjectedConnectionReset(
+                    f"injected reset replying to {method}")
+            send_msg(conn, obj, send_lock, fmt=fmt)
+            if action == _fi.DUPLICATE:
+                send_msg(conn, obj, send_lock, fmt=fmt)
+            return
+        send_msg(conn, obj, send_lock, fmt=fmt)
+
     def on_disconnect(self, conn: socket.socket):
         """Override: called when a non-held connection drops."""
 
@@ -247,13 +303,17 @@ class RpcServer:
 class RpcClient:
     """Blocking request/response client, thread-safe, auto-reconnect off."""
 
-    def __init__(self, address: tuple[str, int], timeout: float | None = None):
+    def __init__(self, address: tuple[str, int], timeout: float | None = None,
+                 label: str | None = None):
         self.address = tuple(address)
+        self._label = label   # fault-injection endpoint of the channel
+        if _fi.plane.active:
+            _fi.plane.check_connect(label, self.address)
         self._sock = socket.create_connection(self.address, timeout=30)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock.settimeout(timeout)
         self._send_lock = threading.Lock()
-        self._pending: dict[int, list] = {}  # id -> [event, reply]
+        self._pending: dict[int, list] = {}  # id -> [event, reply, method]
         self._pending_lock = threading.Lock()
         self._next_id = 0
         self._reader_started = False
@@ -273,21 +333,41 @@ class RpcClient:
             try:
                 msg = recv_msg(self._sock)
             except (ConnectionLost, OSError, EOFError):
-                with self._pending_lock:
-                    pending = list(self._pending.values())
-                    self._pending.clear()
-                    self._closed = True
-                for ev_reply in pending:
-                    ev_reply[1] = {"error": ConnectionLost(
-                        f"connection to {self.address} lost")}
-                    ev_reply[0].set()
+                self._fail_pending()
                 return
             msg_id = msg.get("_id")
+            if _fi.plane.active:
+                with self._pending_lock:
+                    entry = self._pending.get(msg_id)
+                method = entry[2] if entry else None
+                action = _fi.plane.consult(self._label, "recv",
+                                           self.address, method)
+                if action == _fi.DROP:
+                    continue   # reply lost in flight; caller times out
+                if action == _fi.RESET:
+                    self._fail_pending()
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    return
+                # a duplicated reply delivery is inert: the pending
+                # entry is popped exactly once below
             with self._pending_lock:
                 ev_reply = self._pending.pop(msg_id, None)
             if ev_reply is not None:
                 ev_reply[1] = msg
                 ev_reply[0].set()
+
+    def _fail_pending(self):
+        with self._pending_lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+            self._closed = True
+        for ev_reply in pending:
+            ev_reply[1] = {"error": ConnectionLost(
+                f"connection to {self.address} lost")}
+            ev_reply[0].set()
 
     def call(self, method: str, timeout: float | None = None, **kwargs):
         return self.call_async(method, **kwargs).result(timeout=timeout)
@@ -307,10 +387,27 @@ class RpcClient:
                 raise ConnectionLost(f"client to {self.address} closed")
             msg_id = self._next_id
             self._next_id += 1
-            ev_reply = [threading.Event(), None]
+            ev_reply = [threading.Event(), None, method]
             self._pending[msg_id] = ev_reply
         kwargs["method"] = method
         kwargs["_id"] = msg_id
+        if _fi.plane.active:
+            action = _fi.plane.consult(self._label, "send", self.address,
+                                       method)
+            if action == _fi.DROP:
+                # request lost in the network: the pending entry waits
+                # out the caller's timeout, as a real drop would
+                return PendingCall(self, method, msg_id, ev_reply)
+            if action == _fi.RESET:
+                self.close()   # reader wakes and drains pending
+                raise ConnectionLost(
+                    f"injected reset: {self._label} -> {self.address}")
+            send_msg(self._sock, kwargs, self._send_lock)
+            if action == _fi.DUPLICATE:
+                # same frame (same _id) on the wire twice: the server
+                # dispatches both; the client keeps the first reply
+                send_msg(self._sock, kwargs, self._send_lock)
+            return PendingCall(self, method, msg_id, ev_reply)
         send_msg(self._sock, kwargs, self._send_lock)
         return PendingCall(self, method, msg_id, ev_reply)
 
@@ -334,23 +431,55 @@ class ReconnectingRpcClient:
     side of control-plane fault tolerance (reference: GCS clients retry
     through ``gcs_rpc_client.h`` when the GCS restarts). One transparent
     retry per call after a successful redial; GCS mutations are
-    idempotent (registry upserts), so a request that was applied right
-    before the connection died is safe to repeat."""
+    idempotent (registry upserts + idempotency tokens on the
+    side-effecting RPCs), so a request that was applied right before the
+    connection died is safe to repeat.
+
+    Redials run under a UNIFORM deadline with exponential backoff plus
+    jitter and a bounded attempt budget (config ``rpc_redial_*`` /
+    ``rpc_backoff_*``): a per-call ``timeout`` caps the redial window
+    too, so a caller's deadline covers the whole call including
+    reconnects — not a fresh window per attempt."""
 
     def __init__(self, address: tuple, timeout: float | None = None,
-                 redial_window_s: float = 10.0):
+                 redial_window_s: float | None = None,
+                 label: str | None = None):
+        from ray_tpu.utils.config import get_config
+
+        cfg = get_config()
         self.address = tuple(address)
         self._timeout = timeout
-        self._window = redial_window_s
-        self._client = RpcClient(self.address, timeout=timeout)
+        self._label = label
+        self._window = (cfg.rpc_redial_window_s if redial_window_s is None
+                        else redial_window_s)
+        self._max_redials = cfg.rpc_redial_max_attempts
+        self._backoff_init = cfg.rpc_backoff_initial_s
+        self._backoff_mult = cfg.rpc_backoff_multiplier
+        self._backoff_max = cfg.rpc_backoff_max_s
+        self._jitter = cfg.rpc_backoff_jitter
+        self._client = RpcClient(self.address, timeout=timeout,
+                                 label=label)
         self._dial_lock = threading.Lock()
 
     @property
     def _closed(self):
         return self._client._closed
 
-    def _redial(self, failed: RpcClient) -> bool:
-        deadline = time.monotonic() + self._window
+    def _backoff(self, attempt: int) -> float:
+        """Exponential backoff with jitter: attempt 1 sleeps ~initial,
+        doubling (by multiplier) to the cap; jitter desynchronizes a
+        thundering herd of clients redialing one restarted server."""
+        delay = min(self._backoff_max,
+                    self._backoff_init * self._backoff_mult ** (attempt - 1))
+        if self._jitter:
+            delay *= 1.0 + self._jitter * (2.0 * random.random() - 1.0)
+        return max(delay, 0.0)
+
+    def _redial(self, failed: RpcClient,
+                deadline: float | None = None) -> bool:
+        window_end = time.monotonic() + self._window
+        if deadline is not None:
+            window_end = min(window_end, deadline)
         with self._dial_lock:
             # compare against the CLIENT THAT FAILED, not _closed: a send
             # error can precede the reader thread marking the client
@@ -359,23 +488,33 @@ class ReconnectingRpcClient:
             if self._client is not failed and not self._client._closed:
                 return True  # another caller already reconnected
             failed.close()
-            while time.monotonic() < deadline:
+            attempt = 0
+            while True:
+                attempt += 1
+                if self._max_redials and attempt > self._max_redials:
+                    return False   # redial budget exhausted
                 try:
                     self._client = RpcClient(self.address,
-                                             timeout=self._timeout)
+                                             timeout=self._timeout,
+                                             label=self._label)
                     return True
                 except OSError:
-                    time.sleep(0.2)
-        return False
+                    delay = self._backoff(attempt)
+                    if time.monotonic() + delay >= window_end:
+                        return False
+                    time.sleep(delay)
 
     def call(self, method: str, timeout: float | None = None, **kwargs):
+        deadline = None if timeout is None else time.monotonic() + timeout
         client = self._client
         try:
             return client.call(method, timeout=timeout, **kwargs)
         except (ConnectionLost, OSError):
-            if not self._redial(client):
+            if not self._redial(client, deadline):
                 raise
-            return self._client.call(method, timeout=timeout, **kwargs)
+            remaining = (None if deadline is None
+                         else max(deadline - time.monotonic(), 0.001))
+            return self._client.call(method, timeout=remaining, **kwargs)
 
     def call_async(self, method: str, **kwargs):
         client = self._client
@@ -424,18 +563,22 @@ class PushSubscriber:
     def __init__(self, address: tuple[str, int], subscribe_msg: dict,
                  callback: Callable[[Any], None], *,
                  reconnect: bool = False,
-                 reconnect_delay_s: float = 1.0):
+                 reconnect_delay_s: float = 1.0,
+                 label: str | None = None):
         self._address = tuple(address)
         self._subscribe_msg = subscribe_msg
         self._callback = callback
         self._reconnect = reconnect
         self._reconnect_delay_s = reconnect_delay_s
+        self._label = label
         self._closed = False
         self._sock = self._dial()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def _dial(self):
+        if _fi.plane.active:
+            _fi.plane.check_connect(self._label, self._address)
         sock = socket.create_connection(self._address, timeout=30)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         send_msg(sock, self._subscribe_msg)
@@ -445,6 +588,17 @@ class PushSubscriber:
         while not self._closed:
             try:
                 msg = recv_msg(self._sock)
+                if _fi.plane.active:
+                    action = _fi.plane.consult(self._label, "recv",
+                                               self._address, None)
+                    if action == _fi.DROP:
+                        continue   # pushed message lost (pubsub allows)
+                    if action == _fi.RESET:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        raise ConnectionLost("injected reset")
             except (ConnectionLost, OSError, EOFError):
                 if not self._reconnect or self._closed:
                     return

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 
 from ray_tpu.runtime.gcs import _fits
 from ray_tpu.runtime.rpc import send_msg
@@ -50,6 +50,11 @@ class TaskScheduler:
         # OOM-backoff timers (cancelled by stop())
         self._deferred_timers: set[threading.Timer] = set()
         self._timers_lock = threading.Lock()
+        # idempotency: token -> granted reply, so a retried request_lease
+        # (owner redialled after a partition ate the reply) re-reads the
+        # grant it already holds instead of burning a second worker
+        self._grant_tokens: OrderedDict[str, dict] = OrderedDict()
+        self._grant_lock = threading.Lock()
         # set by the raylet: notified on every acquire/release so the
         # versioned resource syncer pushes the new view at RPC latency
         # (reference: ray_syncer RESOURCE_VIEW — runtime/resource_sync.py)
@@ -259,14 +264,24 @@ class TaskScheduler:
     # ------------------------------------------------------------------
 
     def request_lease(self, demand: dict, runtime_env: dict | None,
-                      timeout_s: float, spill_count: int) -> dict:
+                      timeout_s: float, spill_count: int,
+                      token: str | None = None) -> dict:
         """Grant a worker lease: the reply carries the worker's push
         address, and the owner pushes tasks to it directly for as long as
         it holds the lease (= keeps its connection to the worker open).
         Replies: {ok, worker_addr, worker_id, node_id} | {redirect: addr}
         (spillback — caller retries there) | {retry: True} (parked past
-        timeout_s — caller may re-request) | {infeasible: True}."""
+        timeout_s — caller may re-request) | {infeasible: True}.
+
+        ``token`` makes the grant idempotent: a retry carrying the same
+        token (the owner's transport died after the grant but before the
+        reply landed) gets the SAME grant back as long as that worker is
+        still leased, instead of a second worker."""
         node = self._node
+        if token is not None:
+            cached = self._token_grant(token)
+            if cached is not None:
+                return cached
         if not _fits(demand, self.total_resources):
             with node._gcs_lock:
                 target = node._gcs.call("pick_node", demand=demand,
@@ -303,9 +318,35 @@ class TaskScheduler:
                 # nobody ever dials
                 waiter["event"].wait(timeout=5.0)
                 if waiter["result"]:
+                    self._cache_grant(token, waiter["result"])
                     return waiter["result"]
             return {"retry": True}
+        self._cache_grant(token, waiter["result"])
         return waiter["result"]
+
+    def _cache_grant(self, token: str | None, result: dict | None):
+        if token is None or not (result and result.get("ok")):
+            return
+        with self._grant_lock:
+            self._grant_tokens[token] = result
+            while len(self._grant_tokens) > 1024:
+                self._grant_tokens.popitem(last=False)
+
+    def _token_grant(self, token: str) -> dict | None:
+        """Replay a cached grant — but only while its worker is still in
+        state ``leased`` (the owner may have dialed + finished + returned
+        the lease between the retries; replaying then would hand out a
+        stale address for a worker someone else now holds)."""
+        with self._grant_lock:
+            cached = self._grant_tokens.get(token)
+        if cached is None:
+            return None
+        worker = self._node.workers.workers.get(cached.get("worker_id"))
+        if worker is not None and worker.state == "leased":
+            return cached
+        with self._grant_lock:
+            self._grant_tokens.pop(token, None)
+        return None
 
     def _serve_lease_waiters(self):
         """Grant parked lease requests FIFO while workers + resources are

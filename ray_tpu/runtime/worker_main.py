@@ -45,9 +45,53 @@ class TaskPushServer(RpcServer):
     tells its raylet so the lease's worker+resources return to the pool.
     """
 
+    # push-reply replay cache bounds (entries AND payload bytes: direct
+    # results ride replies, so cached replies hold real data)
+    REPLY_CACHE_ENTRIES = 512
+    REPLY_CACHE_BYTES = 8 << 20
+
     def __init__(self, worker: "Worker"):
         super().__init__("127.0.0.1", 0)
+        self.fault_label = "worker"   # fault-injection endpoint label
         self._worker = worker
+        # task push idempotency: a duplicated delivery (lost reply →
+        # owner re-push, or an injected duplicate) must NOT re-execute —
+        # and must return the FIRST reply VERBATIM, because direct
+        # results ride the reply and exist nowhere else. key: task_id
+        # (singular push) or tuple of task_ids (batched push).
+        from collections import OrderedDict
+        self._push_replies: OrderedDict = OrderedDict()
+        self._push_reply_bytes = 0
+        self._push_reply_lock = threading.Lock()
+
+    def _cached_push_reply(self, key):
+        if not key:
+            return None
+        with self._push_reply_lock:
+            entry = self._push_replies.get(key)
+        return entry[0] if entry is not None else None
+
+    @staticmethod
+    def _reply_nbytes(reply: dict) -> int:
+        n = 256
+        for v in (reply.get("results") or {}).values():
+            try:
+                n += len(v)
+            except TypeError:
+                n += 256
+        return n
+
+    def _remember_push_reply(self, key, reply: dict):
+        if not key:
+            return
+        nbytes = self._reply_nbytes(reply)
+        with self._push_reply_lock:
+            self._push_replies[key] = (reply, nbytes)
+            self._push_reply_bytes += nbytes
+            while (len(self._push_replies) > self.REPLY_CACHE_ENTRIES
+                   or self._push_reply_bytes > self.REPLY_CACHE_BYTES):
+                _, (_, old) = self._push_replies.popitem(last=False)
+                self._push_reply_bytes -= old
 
     def _run_one(self, task: dict):
         w = self._worker
@@ -78,6 +122,9 @@ class TaskPushServer(RpcServer):
         # THIS thread — the main thread only runs the raylet-channel
         # recv loop
         self._tag_lease_conn(conn)
+        cached = self._cached_push_reply(task.get("task_id"))
+        if cached is not None:
+            return cached
         self._worker.push_task_thread = threading.current_thread()
         # small returns ride the reply to the OWNER's store (reference:
         # in-process memory store for direct-call returns) — no shm
@@ -91,6 +138,7 @@ class TaskPushServer(RpcServer):
         reply = {"ok": True, "task_id": task.get("task_id")}
         if sink:
             reply["results"] = sink
+        self._remember_push_reply(task.get("task_id"), reply)
         return reply
 
     def rpc_push_tasks(self, conn, send_lock, *, tasks: list):
@@ -98,6 +146,10 @@ class TaskPushServer(RpcServer):
         order (the owner packs bursts of small same-shape tasks — one
         framed round trip instead of N)."""
         self._tag_lease_conn(conn)
+        batch_key = tuple(t.get("task_id", "") for t in tasks)
+        cached = self._cached_push_reply(batch_key)
+        if cached is not None:
+            return cached
         self._worker.push_task_thread = threading.current_thread()
         sink: dict = {}
         try:
@@ -109,6 +161,7 @@ class TaskPushServer(RpcServer):
         reply = {"ok": True}
         if sink:
             reply["results"] = sink
+        self._remember_push_reply(batch_key, reply)
         return reply
 
     def rpc_submit_actor_task(self, conn, send_lock, *, task: dict):
@@ -224,12 +277,16 @@ class Worker:
         self.worker_id = os.environ["RAY_TPU_WORKER_ID"]
         self.node_id = os.environ["RAY_TPU_NODE_ID"]
         self.raylet_addr = (host, port)
+        from ray_tpu.runtime import fault_injection as _fi
+        _fi.maybe_init_from_config((os.environ["RAY_TPU_GCS_HOST"],
+                                    int(os.environ["RAY_TPU_GCS_PORT"])))
         self.store = ShmObjectStore(os.environ["RAY_TPU_STORE_NAME"])
         # control client: request/response to the raylet (ensure_local etc.)
-        self.ctrl = RpcClient(self.raylet_addr)
+        self.ctrl = RpcClient(self.raylet_addr, label="worker")
         # task-event reporting to the GCS sink (lazy buffer)
         self._gcs = ReconnectingRpcClient((os.environ["RAY_TPU_GCS_HOST"],
-                               int(os.environ["RAY_TPU_GCS_PORT"])))
+                               int(os.environ["RAY_TPU_GCS_PORT"])),
+                               label="worker")
         self._event_buf: list[dict] = []
         self._event_lock = threading.Lock()
         self._last_flush = 0.0
@@ -431,13 +488,38 @@ class Worker:
         epoch0 = (self._refs.created_epoch() if self._ref_enabled else 0)
         args, kwargs = cloudpickle.loads(task["args_blob"])
         dep_oids = [a[1] for a in _iter_markers(args, kwargs)]
-        if dep_oids:
-            missing = self.ctrl.call("ensure_local", oids=dep_oids,
-                                     timeout_s=60.0)
+        # Results of EARLIER tasks in the SAME pushed batch live only in
+        # the batch's direct-return sink: the reply that publishes them
+        # to the owner cannot be sent until this very task finishes, so
+        # asking the raylet (ensure_local) for them deadlocks the whole
+        # lease pipeline for the full dependency timeout. Resolve those
+        # straight from the sink; pull everything else as usual.
+        sink = task.get("_direct_sink") or {}
+        values = {}
+        pull = []
+        for oid_hex in dep_oids:
+            payload = sink.get(oid_hex)
+            if payload is None:
+                pull.append(oid_hex)
+            elif oid_hex not in values:
+                value, is_error = object_codec.decode_view(
+                    memoryview(payload).cast("B"))
+                if is_error:
+                    raise value
+                values[oid_hex] = value
+        if pull:
+            # bounded client wait: a lost reply on a live control channel
+            # must not hang the worker forever
+            try:
+                missing = self.ctrl.call("ensure_local", oids=pull,
+                                         timeout_s=60.0, timeout=65.0)
+            except TimeoutError:
+                missing = pull
             if missing:
                 raise exc.ObjectLostError(missing[0], "dependency not found")
-        values = {}
         for _, oid_hex in _iter_markers(args, kwargs):
+            if oid_hex in values:
+                continue
             value, is_error = object_codec.get_value(
                 self.store, bytes.fromhex(oid_hex), timeout_ms=0)
             if is_error:
@@ -569,8 +651,13 @@ class Worker:
             with self._report_cv:
                 batch, self._report_buf = self._report_buf, []
             try:
+                # one token per batch: if the reply is lost and a retry
+                # layer redelivers, the raylet pins each object once
+                import uuid as _uuid
+
                 self.ctrl.call("report_objects",
-                               entries=[(o, s) for o, s in batch])
+                               entries=[(o, s) for o, s in batch],
+                               token=_uuid.uuid4().hex)
             except Exception:  # noqa: BLE001 - raylet gone; exiting soon
                 pass
             finally:

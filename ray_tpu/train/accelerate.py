@@ -30,6 +30,58 @@ _ENV_KEYS = {
 }
 
 
+def _parse_accelerate_config(text: str, path: str = "<config>") -> dict:
+    """Parse an Accelerate config file: JSON, then real YAML when the
+    ``yaml`` package is importable, then a flat ``key: value`` fallback.
+
+    The fallback REJECTS structured YAML instead of silently mangling it
+    — the old line-splitter turned nested blocks into garbage entries
+    like ``{"deepspeed_config": "", "zero_stage": "3"}``, flattening
+    child keys into the top level and erasing which section they
+    belonged to."""
+    import json
+
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    try:
+        import yaml
+    except ImportError:
+        yaml = None
+    if yaml is not None:
+        loaded = yaml.safe_load(text)
+        if not isinstance(loaded, dict):
+            raise ValueError(
+                f"accelerate config {path!r} must parse to a mapping, "
+                f"got {type(loaded).__name__}")
+        return loaded
+    out = {}
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#") or line == "---":
+            continue
+        indented = raw[:1] in (" ", "\t")
+        if indented or line.startswith("- "):
+            raise ValueError(
+                f"accelerate config {path!r} line {lineno}: nested YAML "
+                f"structure ({line!r}) needs the `yaml` package, which "
+                f"is not installed — flatten the config or use JSON")
+        key, sep, value = line.partition(":")
+        if not sep:
+            raise ValueError(
+                f"accelerate config {path!r} line {lineno}: expected "
+                f"'key: value', got {line!r}")
+        value = value.split("#", 1)[0].strip()
+        if not value:
+            raise ValueError(
+                f"accelerate config {path!r} line {lineno}: {key.strip()!r}"
+                f" opens a nested block, which needs the `yaml` package — "
+                f"flatten the config or use JSON")
+        out[key.strip()] = value
+    return out
+
+
 def _wrap_accelerate(train_loop_per_worker, accelerate_config: dict):
     def accelerate_loop(config):
         try:
@@ -96,20 +148,10 @@ class AccelerateTrainer(TorchTrainer):
         if isinstance(accelerate_config, str):
             # a path to an Accelerate yaml/json config: parsed here so a
             # bad path fails at submission, not on every rank
-            import json
-
             with open(accelerate_config) as f:
                 text = f.read()
-            try:
-                accelerate_config = json.loads(text)
-            except json.JSONDecodeError:
-                # minimal yaml (key: value lines) without a yaml dep
-                accelerate_config = {
-                    k.strip(): v.strip()
-                    for k, v in (line.split(":", 1)
-                                 for line in text.splitlines()
-                                 if ":" in line and not
-                                 line.lstrip().startswith("#"))}
+            accelerate_config = _parse_accelerate_config(
+                text, path=accelerate_config)
         super().__init__(
             _wrap_accelerate(train_loop_per_worker,
                              accelerate_config or {}),

@@ -192,6 +192,31 @@ class Config:
     actor_max_restarts: int = 0
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5
+    # --- control-plane RPC retry/backoff (ReconnectingRpcClient) ---
+    # Total redial window after a connection loss before the failure is
+    # surfaced to the caller.
+    rpc_redial_window_s: float = 10.0
+    # Hard cap on redial attempts inside the window (0 = window only).
+    rpc_redial_max_attempts: int = 0
+    # Exponential backoff between redials: initial delay, multiplier,
+    # ceiling, and jitter fraction (reference: the gRPC client retry
+    # policy's exponential backoff with jitter).
+    rpc_backoff_initial_s: float = 0.05
+    rpc_backoff_multiplier: float = 2.0
+    rpc_backoff_max_s: float = 2.0
+    rpc_backoff_jitter: float = 0.2
+
+    # --- fault injection (runtime/fault_injection.py; env overrides
+    # RAY_TPU_FAULT_INJECTION_* — the chaos tier's knobs) ---
+    # Master switch: off = the plane is never consulted beyond one
+    # boolean read per message.
+    fault_injection_enabled: bool = False
+    # Base seed for probabilistic rules (deterministic replay).
+    fault_injection_seed: int = 0
+    # Startup plan: inline JSON, or @/path/to/plan.json.
+    fault_injection_plan: str = ""
+    # Poll period for the GCS KV plan key (runtime open/heal switch).
+    fault_injection_kv_poll_s: float = 0.25
 
     # --- TPU / device plane ---
     # Logical mesh axis names, outer to inner. ICI-contiguous inner axes.

@@ -1,0 +1,464 @@
+"""Chaos tier: sustained network partitions + duplicate-delivery
+idempotency, driven through the deterministic fault-injection plane
+(``ray_tpu/runtime/fault_injection.py``).
+
+Reference analog: ``python/ray/tests/chaos`` — but deterministic: every
+fault here is a seeded rule switched on and off through the GCS KV key
+mid-workload, not a random killer.
+
+Default tier runs the driver↔GCS partition smoke; the raylet↔raylet and
+worker↔owner matrices are ``slow`` (ci/run_ci.sh runs them nightly).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.runtime import fault_injection as fi
+from ray_tpu.runtime.task_spec import SchedulingStrategy
+
+HEARTBEAT_S = 1.0
+# hold every partition across >= 2 heartbeat timeouts: liveness machinery
+# (GCS health checks, raylet beats) must fire while the wire is down
+PARTITION_S = 2.2 * HEARTBEAT_S
+
+
+@pytest.fixture
+def chaos_cluster():
+    ray_tpu.shutdown()
+    fi.plane.clear()
+    c = Cluster(heartbeat_timeout_s=HEARTBEAT_S)
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2, resources={"side": 4})
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    fi.plane.clear()     # never leave a partition open across teardown
+    ray_tpu.shutdown()
+    fi.stop_kv_watcher()
+    c.shutdown()
+    fi.plane.clear()
+
+
+def _addr(address) -> str:
+    return f"{address[0]}:{address[1]}"
+
+
+def _open_partition(cluster, *, src, dst_name, dst_addrs, version):
+    """Switch a partition ON through the GCS KV key (the runtime path:
+    every process applies it from the KV watch; in-process test clusters
+    share one plane, applied by the GCS at kv_put time)."""
+    fi.put_plan(cluster.gcs_address, {
+        "version": version, "seed": 7,
+        "endpoints": {dst_name: [_addr(a) for a in dst_addrs]},
+        "rules": [{"id": f"cut-{src}-{dst_name}", "fault": "partition",
+                   "src": src, "dst": dst_name, "direction": "both"}]})
+    assert fi.plane.active
+
+
+def _heal(cluster, *, version):
+    fi.put_plan(cluster.gcs_address, {"version": version, "rules": []})
+    assert not fi.plane.active
+
+
+def _wait(predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _assert_no_leaks(cluster, actor_workers=()):
+    """After the workload drains: no worker stuck in 'leased', and the
+    GCS ref tables empty once the driver's release flush lands."""
+    import gc
+
+    # exception tracebacks (pytest.raises) pin the test frame — and with
+    # it every ObjectRef local — in a reference CYCLE that only a full
+    # collection breaks; without this the "leak" is the test's own frame
+    gc.collect()
+    def no_leased():
+        for h in cluster.nodes.values():
+            if h.raylet is None:
+                continue
+            for w in h.raylet.workers.workers.values():
+                if w.worker_id in actor_workers:
+                    continue
+                if w.state == "leased":
+                    return False
+        return True
+
+    _wait(no_leased, 30, "leases to drain")
+    _wait(lambda: not cluster.gcs._ref_holders, 30,
+          f"object refs to drain (left: "
+          f"{list(cluster.gcs._ref_holders)[:5]})")
+    _wait(lambda: not cluster.gcs._ref_pin_count, 30,
+          "object pins to drain")
+
+
+@ray_tpu.remote
+class Ordered:
+    """Records call order — partitions must never reorder or duplicate
+    a single caller's actor calls (seq-buffer contract)."""
+
+    def __init__(self):
+        self.log = []
+
+    def add(self, i):
+        self.log.append(i)
+        return i
+
+    def snapshot(self):
+        return list(self.log)
+
+
+@ray_tpu.remote(max_retries=3)
+def double(i):
+    return i * 2
+
+
+@ray_tpu.remote(max_retries=3)
+def sgd_step(w, x):
+    # small dense train step (the chaos workload's "training" leg)
+    g = 2.0 * x.T @ (x @ w)
+    return w - 0.01 * g
+
+
+# ----------------------------------------------------------------------
+# default-tier smoke: driver <-> GCS control partition mid-workload
+# ----------------------------------------------------------------------
+
+def test_driver_gcs_partition_smoke(chaos_cluster):
+    c = chaos_cluster
+
+    # -- workload part 1: start everything BEFORE the cut ---------------
+    actor = Ordered.remote()
+    actor_futs = [actor.add.remote(i) for i in range(10)]
+    task_refs = [double.remote(i) for i in range(20)]
+    w = np.eye(4)
+    x = np.ones((8, 4))
+    w_ref = sgd_step.remote(w, x)
+
+    # -- cut the driver's control channels to the GCS -------------------
+    _open_partition(c, src="driver", dst_name="gcs",
+                    dst_addrs=[c.gcs_address], version=1)
+    t_cut = time.monotonic()
+
+    # the data plane (driver->raylet, owner->worker) stays up: keep
+    # submitting THROUGH the partition
+    actor_futs += [actor.add.remote(i) for i in range(10, 20)]
+    task_refs += [double.remote(i) for i in range(20, 40)]
+    w_ref = sgd_step.remote(w_ref, x)
+
+    # hold the partition across >= 2 heartbeat timeouts, then heal
+    time.sleep(max(0.0, PARTITION_S - (time.monotonic() - t_cut)))
+    _heal(c, version=2)
+
+    # -- workload part 2: control plane must be back --------------------
+    actor2 = Ordered.remote()          # actor creation needs the GCS
+    post_fut = actor2.add.remote(99)
+    w_ref = sgd_step.remote(w_ref, x)
+
+    # -- everything completes, in order, with correct values ------------
+    assert ray_tpu.get(task_refs, timeout=60) == [i * 2 for i in range(40)]
+    assert ray_tpu.get(actor_futs, timeout=60) == list(range(20))
+    assert ray_tpu.get(post_fut, timeout=60) == 99
+    log = ray_tpu.get(actor.snapshot.remote(), timeout=60)
+    assert log == list(range(20)), "actor call order broken by partition"
+    final_w = ray_tpu.get(w_ref, timeout=60)
+    assert final_w.shape == (4, 4)
+    assert np.all(np.isfinite(final_w))
+
+    # the plane actually fired (the partition was real, not a no-op)
+    assert any("cut-driver-gcs" in rid for rid in fi.plane.stats), \
+        f"partition rule never fired: {fi.plane.stats}"
+
+    # -- zero leaks after heal + drain ----------------------------------
+    hosting = {w.worker_id
+               for h in c.nodes.values() if h.raylet
+               for w in h.raylet.workers.workers.values()
+               if getattr(w, "actor_id", None)}
+    del task_refs, actor_futs, post_fut, w_ref, final_w, log
+    ray_tpu.kill(actor)
+    ray_tpu.kill(actor2)
+    _assert_no_leaks(c, actor_workers=hosting)
+
+
+# ----------------------------------------------------------------------
+# slow tier: raylet <-> raylet data-plane partition
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.nightly
+def test_raylet_raylet_partition_blocks_then_heals(chaos_cluster):
+    c = chaos_cluster
+    side = next(h for h in c.nodes.values()
+                if h.raylet is not None
+                and "side" in h.raylet.total_resources)
+
+    @ray_tpu.remote(max_retries=3, scheduling_strategy=SchedulingStrategy(
+        kind="NODE_AFFINITY", node_id=side.node_id))
+    def make(i):
+        return np.full(1 << 17, i, dtype=np.float64)   # 1 MiB: shm path
+
+    refs = [make.remote(i) for i in range(4)]
+    # materialize one to prove the pull path works pre-cut
+    assert float(ray_tpu.get(refs[0], timeout=60)[0]) == 0.0
+    _wait(lambda: all(  # the rest are sealed remotely before the cut
+        side.raylet.store.contains(bytes.fromhex(r.id.hex()))
+        for r in refs), 60, "side-node results to seal")
+
+    _open_partition(c, src="raylet", dst_name="side",
+                    dst_addrs=[side.raylet.address], version=1)
+    # cross-node pull must FAIL while the wire is down (the partition is
+    # real): refs[1] lives only on the side node
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(refs[1], timeout=PARTITION_S)
+    _heal(c, version=2)
+
+    # ...and succeed after heal, no object lost
+    for i, r in enumerate(refs):
+        assert float(ray_tpu.get(r, timeout=90)[0]) == float(i)
+    del refs, r    # the loop variable is a live ref too
+    _assert_no_leaks(c)
+
+
+# ----------------------------------------------------------------------
+# slow tier: owner <-> worker push-plane partition
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.nightly
+def test_worker_owner_partition_tasks_fall_back_and_recover(chaos_cluster):
+    c = chaos_cluster
+    actor = Ordered.remote()
+    pre = [actor.add.remote(i) for i in range(5)]
+    assert ray_tpu.get(pre, timeout=60) == list(range(5))
+
+    # sever EVERY owner->worker channel (lease pushes + direct actor
+    # submission). Partition = reset + connect-refuse, never a silent
+    # black hole: in-flight pushes fail synchronously and the owner
+    # falls back to the raylet-mediated path.
+    fi.put_plan(c.gcs_address, {
+        "version": 2, "seed": 7,
+        "rules": [{"id": "cut-owner", "fault": "partition",
+                   "src": "owner", "direction": "both"}]})
+    mid_tasks = [double.remote(i) for i in range(10)]
+    mid_actor = [actor.add.remote(i) for i in range(5, 10)]
+    time.sleep(PARTITION_S)
+    _heal(c, version=3)
+
+    assert ray_tpu.get(mid_tasks, timeout=90) == [i * 2 for i in range(10)]
+    assert ray_tpu.get(mid_actor, timeout=90) == list(range(5, 10))
+    post = [actor.add.remote(i) for i in range(10, 15)]
+    assert ray_tpu.get(post, timeout=90) == list(range(10, 15))
+    # exactly-once, in-order actor delivery across the severed channel
+    log = ray_tpu.get(actor.snapshot.remote(), timeout=60)
+    assert log == list(range(15))
+    del pre, mid_tasks, mid_actor, post, log
+    ray_tpu.kill(actor)
+    _assert_no_leaks(c)
+
+
+# ----------------------------------------------------------------------
+# idempotency: injected duplicates applied exactly once
+# ----------------------------------------------------------------------
+
+def _head_raylet(cluster):
+    return cluster.nodes[cluster._head_id].raylet
+
+
+def test_duplicate_lease_grant_applied_once(chaos_cluster):
+    c = chaos_cluster
+    ray_tpu.get(double.remote(1), timeout=60)   # warm a worker
+    raylet = _head_raylet(c)
+    token = "lease-tok-1"
+    r1 = raylet.rpc_request_lease(None, None, demand={"CPU": 1},
+                                  timeout_s=10, token=token)
+    assert r1.get("ok"), r1
+    # the retry (same token: the reply was lost, the owner redialled)
+    r2 = raylet.rpc_request_lease(None, None, demand={"CPU": 1},
+                                  timeout_s=10, token=token)
+    assert r2 == r1, "duplicate lease request granted a second worker"
+    leased = [w for w in raylet.workers.workers.values()
+              if w.state == "leased"]
+    assert len(leased) == 1, \
+        f"{len(leased)} workers leased for one logical acquisition"
+    # replay is NOT blind: once the worker leaves 'leased', the token
+    # must re-grant instead of handing out a stale address
+    with raylet.workers.lock:
+        leased[0].state = "idle"
+        leased[0].acquired = None
+    raylet.scheduler.release({"CPU": 1})
+    r3 = raylet.rpc_request_lease(None, None, demand={"CPU": 1},
+                                  timeout_s=10, token=token)
+    assert r3.get("ok")
+    with raylet.workers.lock:   # hand it back for teardown
+        w = raylet.workers.workers.get(r3["worker_id"])
+        if w is not None and w.state == "leased":
+            w.state = "idle"
+            w.acquired = None
+    raylet.scheduler.release({"CPU": 1})
+
+
+def test_duplicate_put_report_applied_once(chaos_cluster):
+    raylet = _head_raylet(chaos_cluster)
+    applied = []
+    orig = raylet.objects.report_object
+
+    def counting(oid, size):
+        applied.append(oid)
+        return orig(oid, size)
+
+    raylet.objects.report_object = counting
+    try:
+        entries = [("cd" * 16, 64), ("ef" * 16, 64)]
+        r1 = raylet.rpc_report_objects(None, None, entries=entries,
+                                       token="put-tok-1")
+        # injected duplicate delivery of the SAME batch
+        r2 = raylet.rpc_report_objects(None, None, entries=entries,
+                                       token="put-tok-1")
+        assert r2 == r1
+        assert len(applied) == 2, \
+            f"duplicate report re-applied pins: {applied}"
+        # a different token is a different batch: applies normally
+        raylet.rpc_report_objects(None, None, entries=entries,
+                                  token="put-tok-2")
+        assert len(applied) == 4
+    finally:
+        raylet.objects.report_object = orig
+
+
+def test_duplicate_task_push_replays_full_reply(chaos_cluster):
+    from ray_tpu.runtime.worker_main import TaskPushServer
+
+    class _StubWorker:
+        def __init__(self):
+            self._push_conn_lock = threading.Lock()
+            self.lease_conns = set()
+            self.cancelled_push_ids = set()
+            self.push_task_thread = None
+            self.current_push_task_id = None
+            self.runs = []
+
+        def _execute(self, task):
+            self.runs.append(task["task_id"])
+            sink = task.get("_direct_sink")
+            if sink is not None:
+                sink["oid-" + task["task_id"]] = b"direct-result"
+
+    worker = _StubWorker()
+    server = TaskPushServer(worker)
+    try:
+        r1 = server.rpc_push_task(None, None,
+                                  task={"task_id": "t1", "name": "t"})
+        assert r1["results"] == {"oid-t1": b"direct-result"}
+        # duplicate delivery (injected, or owner re-push after a lost
+        # reply): must NOT re-execute, must return the SAME results —
+        # they ride the reply and exist nowhere else
+        r2 = server.rpc_push_task(None, None,
+                                  task={"task_id": "t1", "name": "t"})
+        assert r2 == r1
+        assert worker.runs == ["t1"], f"task re-executed: {worker.runs}"
+
+        b1 = server.rpc_push_tasks(None, None, tasks=[
+            {"task_id": "t2"}, {"task_id": "t3"}])
+        b2 = server.rpc_push_tasks(None, None, tasks=[
+            {"task_id": "t2"}, {"task_id": "t3"}])
+        assert b2 == b1
+        assert worker.runs == ["t1", "t2", "t3"]
+    finally:
+        server.stop()
+
+
+def test_push_reply_cache_is_bounded(chaos_cluster):
+    from ray_tpu.runtime.worker_main import TaskPushServer
+
+    class _StubWorker:
+        _push_conn_lock = threading.Lock()
+        lease_conns = set()
+        cancelled_push_ids = set()
+        push_task_thread = None
+        current_push_task_id = None
+
+        def _execute(self, task):
+            sink = task.get("_direct_sink")
+            sink["oid-" + task["task_id"]] = b"x" * 1024
+
+    server = TaskPushServer(_StubWorker())
+    try:
+        for i in range(TaskPushServer.REPLY_CACHE_ENTRIES + 64):
+            server.rpc_push_task(None, None, task={"task_id": f"t{i}"})
+        assert len(server._push_replies) <= \
+            TaskPushServer.REPLY_CACHE_ENTRIES
+        assert server._push_reply_bytes <= TaskPushServer.REPLY_CACHE_BYTES
+        # evicted oldest, kept newest
+        assert server._cached_push_reply("t0") is None
+        last = f"t{TaskPushServer.REPLY_CACHE_ENTRIES + 63}"
+        assert server._cached_push_reply(last) is not None
+    finally:
+        server.stop()
+
+
+def test_duplicate_actor_registration_is_idempotent(chaos_cluster):
+    gcs = chaos_cluster.gcs
+    # infeasible resources keep the actor PENDING: an empty creation
+    # spec would be scheduled, die instantly, and (correctly) free the
+    # name — which is not the conflict path under test
+    kwargs = dict(actor_id="idem-actor-1", name="idem-name",
+                  creation_spec=b"", resources={"__never__": 1},
+                  max_restarts=0, namespace="chaos", owner_id=None)
+    r1 = gcs.rpc_register_actor(None, None, **kwargs)
+    assert r1["ok"]
+    # duplicate delivery of the registration: same actor_id acks (it
+    # must not reject its OWN name as taken)
+    r2 = gcs.rpc_register_actor(None, None, **kwargs)
+    assert r2["ok"]
+    assert len([a for a in gcs._actors
+                if a == "idem-actor-1"]) == 1
+    # a DIFFERENT actor wanting the same name still conflicts
+    with pytest.raises(ValueError, match="already taken"):
+        gcs.rpc_register_actor(None, None, **{
+            **kwargs, "actor_id": "idem-actor-2"})
+
+
+def test_injected_duplicate_lease_rpc_end_to_end(chaos_cluster):
+    """Full wire-level check: a duplicate-delivery rule on the raylet's
+    request_lease recv path runs the handler twice, and the token keeps
+    the second application a replay."""
+    from ray_tpu.runtime.rpc import RpcClient
+
+    c = chaos_cluster
+    ray_tpu.get(double.remote(1), timeout=60)   # warm a worker
+    raylet = _head_raylet(c)
+    fi.put_plan(c.gcs_address, {
+        "version": 1, "seed": 7,
+        "rules": [{"id": "dup-lease", "fault": "duplicate",
+                   "src": "raylet", "direction": "recv",
+                   "method": "request_lease", "max_hits": 1}]})
+    client = RpcClient(raylet.address, label="driver")
+    try:
+        before = sum(1 for w in raylet.workers.workers.values()
+                     if w.state == "leased")
+        reply = client.call("request_lease", demand={"CPU": 1},
+                            timeout_s=10, token="dup-tok-1", timeout=30)
+        assert reply.get("ok"), reply
+        assert fi.plane.stats.get("dup-lease") == 1
+        after = sum(1 for w in raylet.workers.workers.values()
+                    if w.state == "leased")
+        assert after - before == 1, \
+            "injected duplicate granted a second worker"
+    finally:
+        client.close()
+        _heal(c, version=2)
+        with raylet.workers.lock:
+            w = raylet.workers.workers.get(reply["worker_id"])
+            if w is not None and w.state == "leased":
+                w.state = "idle"
+                w.acquired = None
+        raylet.scheduler.release({"CPU": 1})

@@ -16,7 +16,10 @@ import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 from ray_tpu.utils.config import get_config
 
-pytestmark = pytest.mark.nightly
+# slow as well: an explicit `-m 'not slow'` on the command line REPLACES
+# the addopts default (`-m 'not nightly'`), and a minutes-long envelope
+# tier must never ride into a bounded default/tier-1 run that way
+pytestmark = [pytest.mark.nightly, pytest.mark.slow]
 
 # tier sizes are flags (RAY_TPU_ENVELOPE_NIGHTLY_* env overrides):
 # defaults 2,000 actors / 1,000,000 queued / 5,000 args

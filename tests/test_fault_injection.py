@@ -1,0 +1,376 @@
+"""Fault-injection plane: rule semantics, RPC-layer consult points, the
+GCS KV switch, and the hardened ReconnectingRpcClient redial policy.
+
+Reference analog: the reference's chaos utilities
+(``python/ray/tests/chaos``) — here the plane itself is under test
+before ``test_chaos_partitions.py`` uses it against full clusters.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.runtime import fault_injection as fi
+from ray_tpu.runtime.rpc import (ConnectionLost, ReconnectingRpcClient,
+                                 RpcClient, RpcServer)
+
+
+@pytest.fixture(autouse=True)
+def clean_plane():
+    fi.plane.clear()
+    yield
+    fi.stop_kv_watcher()
+    fi.plane.clear()
+
+
+def _plan(*rules, seed=7, version=1, endpoints=None):
+    return {"version": version, "seed": seed,
+            "endpoints": endpoints or {}, "rules": list(rules)}
+
+
+# ----------------------------------------------------------------------
+# rule semantics (no sockets)
+# ----------------------------------------------------------------------
+
+class TestRules:
+    def test_inactive_plane_passes_everything(self):
+        assert not fi.plane.active
+        assert fi.plane.consult("driver", "send", ("h", 1), "m") == fi.PASS
+
+    def test_src_label_scoping(self):
+        fi.plane.load_plan(_plan({"fault": "drop", "src": "driver"}))
+        assert fi.plane.consult("driver", "send", ("h", 1), "m") == fi.DROP
+        assert fi.plane.consult("raylet", "send", ("h", 1), "m") == fi.PASS
+        assert fi.plane.consult(None, "send", ("h", 1), "m") == fi.PASS
+
+    def test_dst_address_and_endpoint_name(self):
+        fi.plane.load_plan(_plan(
+            {"fault": "drop", "dst": "gcs"},
+            endpoints={"gcs": ["10.0.0.1:6379"]}))
+        assert fi.plane.consult("x", "send", ("10.0.0.1", 6379),
+                                "m") == fi.DROP
+        assert fi.plane.consult("x", "send", ("10.0.0.2", 6379),
+                                "m") == fi.PASS
+        # literal host:port dst needs no endpoints entry
+        fi.plane.load_plan(_plan({"fault": "drop", "dst": "10.9.9.9:1"},
+                                 version=2))
+        assert fi.plane.consult("x", "send", ("10.9.9.9", 1),
+                                "m") == fi.DROP
+
+    def test_direction_and_method_scoping(self):
+        fi.plane.load_plan(_plan(
+            {"fault": "drop", "direction": "recv", "method": "put"}))
+        assert fi.plane.consult("x", "recv", ("h", 1), "put") == fi.DROP
+        assert fi.plane.consult("x", "send", ("h", 1), "put") == fi.PASS
+        assert fi.plane.consult("x", "recv", ("h", 1), "get") == fi.PASS
+
+    def test_nth_every_max_hits(self):
+        fi.plane.load_plan(_plan({"fault": "drop", "nth": 3}))
+        got = [fi.plane.consult("x", "send", ("h", 1), "m")
+               for _ in range(5)]
+        assert got == [fi.PASS, fi.PASS, fi.DROP, fi.PASS, fi.PASS]
+
+        fi.plane.load_plan(_plan({"fault": "drop", "every": 2}, version=2))
+        got = [fi.plane.consult("x", "send", ("h", 1), "m")
+               for _ in range(4)]
+        assert got == [fi.PASS, fi.DROP, fi.PASS, fi.DROP]
+
+        fi.plane.load_plan(_plan({"fault": "drop", "max_hits": 2},
+                                 version=3))
+        got = [fi.plane.consult("x", "send", ("h", 1), "m")
+               for _ in range(4)]
+        assert got == [fi.DROP, fi.DROP, fi.PASS, fi.PASS]
+
+    def test_probabilistic_rules_are_seed_deterministic(self):
+        def run(seed):
+            fi.plane.load_plan(_plan({"fault": "drop", "p": 0.5},
+                                     seed=seed))
+            return [fi.plane.consult("x", "send", ("h", 1), "m")
+                    for _ in range(64)]
+
+        a, b, c = run(42), run(42), run(43)
+        assert a == b                      # same seed -> same trace
+        assert a != c                      # different seed -> different
+        assert fi.DROP in a and fi.PASS in a
+
+    def test_partition_maps_to_reset_and_blocks_connect(self):
+        fi.plane.load_plan(_plan(
+            {"fault": "partition", "src": "driver", "dst": "h:1"}))
+        assert fi.plane.consult("driver", "send", ("h", 1),
+                                "m") == fi.RESET
+        with pytest.raises(fi.InjectedConnectionReset):
+            fi.plane.check_connect("driver", ("h", 1))
+        # other labels still connect
+        fi.plane.check_connect("raylet", ("h", 1))
+        # heal: empty plan deactivates
+        fi.plane.load_plan(_plan(version=2))
+        assert not fi.plane.active
+        fi.plane.check_connect("driver", ("h", 1))
+
+    def test_recv_only_partition_does_not_block_connect(self):
+        fi.plane.load_plan(_plan(
+            {"fault": "partition", "src": "driver", "direction": "recv"}))
+        fi.plane.check_connect("driver", ("h", 1))
+        assert fi.plane.consult("driver", "recv", ("h", 1),
+                                "m") == fi.RESET
+        assert fi.plane.consult("driver", "send", ("h", 1), "m") == fi.PASS
+
+    def test_control_label_is_exempt(self):
+        fi.plane.load_plan(_plan({"fault": "partition"}))
+        fi.plane.check_connect(fi.FAULT_CONTROL_LABEL, ("h", 1))
+        assert fi.plane.consult(fi.FAULT_CONTROL_LABEL, "send", ("h", 1),
+                                "kv_put") == fi.PASS
+
+    def test_delay_sleeps_inline(self):
+        fi.plane.load_plan(_plan({"fault": "delay", "delay_s": 0.15}))
+        t0 = time.monotonic()
+        assert fi.plane.consult("x", "send", ("h", 1), "m") == fi.PASS
+        assert time.monotonic() - t0 >= 0.14
+
+    def test_bad_fault_rejected(self):
+        with pytest.raises(ValueError):
+            fi.plane.load_plan(_plan({"fault": "explode"}))
+
+    def test_decode_plan_forms(self):
+        assert fi.decode_plan(None) is None
+        assert fi.decode_plan('{"version": 1}') == {"version": 1}
+        assert fi.decode_plan(b'{"version": 2}') == {"version": 2}
+        assert fi.decode_plan({"version": 3}) == {"version": 3}
+        with pytest.raises(ValueError):
+            fi.decode_plan("[1, 2]")
+
+
+# ----------------------------------------------------------------------
+# consult points in the real RPC layer
+# ----------------------------------------------------------------------
+
+class _Echo(RpcServer):
+    def __init__(self):
+        super().__init__("127.0.0.1", 0)
+        self.fault_label = "server"
+        self.calls = 0
+        self._calls_lock = threading.Lock()
+
+    def rpc_echo(self, conn, send_lock, *, value):
+        with self._calls_lock:
+            self.calls += 1
+        return {"value": value}
+
+
+@pytest.fixture
+def echo():
+    server = _Echo().start()
+    yield server
+    server.stop()
+
+
+class TestRpcConsults:
+    def test_client_send_drop_times_out(self, echo):
+        client = RpcClient(echo.address, label="driver")
+        try:
+            fi.plane.load_plan(_plan(
+                {"fault": "drop", "src": "driver", "direction": "send",
+                 "max_hits": 1}))
+            with pytest.raises(TimeoutError):
+                client.call("echo", value=1, timeout=0.3)
+            assert echo.calls == 0          # never reached the server
+            assert client.call("echo", value=2,
+                               timeout=5)["value"] == 2
+        finally:
+            client.close()
+
+    def test_server_recv_duplicate_runs_handler_twice(self, echo):
+        client = RpcClient(echo.address, label="driver")
+        try:
+            fi.plane.load_plan(_plan(
+                {"fault": "duplicate", "src": "server",
+                 "direction": "recv", "method": "echo", "max_hits": 1}))
+            assert client.call("echo", value=3, timeout=5)["value"] == 3
+            deadline = time.monotonic() + 5
+            while echo.calls < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert echo.calls == 2
+        finally:
+            client.close()
+
+    def test_client_send_reset_raises_connection_lost(self, echo):
+        client = RpcClient(echo.address, label="driver")
+        try:
+            fi.plane.load_plan(_plan(
+                {"fault": "reset", "src": "driver", "direction": "send",
+                 "max_hits": 1}))
+            with pytest.raises(ConnectionLost):
+                client.call("echo", value=4, timeout=5)
+            assert client._closed
+        finally:
+            client.close()
+
+    def test_partition_blocks_dial_until_healed(self, echo):
+        addr = f"{echo.address[0]}:{echo.address[1]}"
+        fi.plane.load_plan(_plan(
+            {"fault": "partition", "src": "driver", "dst": "srv"},
+            endpoints={"srv": [addr]}))
+        with pytest.raises(fi.InjectedConnectionReset):
+            RpcClient(echo.address, label="driver")
+        # unlabeled / other-labeled channels unaffected
+        other = RpcClient(echo.address, label="raylet")
+        try:
+            assert other.call("echo", value=5, timeout=5)["value"] == 5
+        finally:
+            other.close()
+        fi.plane.load_plan(_plan(version=2))
+        healed = RpcClient(echo.address, label="driver")
+        try:
+            assert healed.call("echo", value=6, timeout=5)["value"] == 6
+        finally:
+            healed.close()
+
+    def test_reconnecting_client_rides_through_reset(self, echo):
+        client = ReconnectingRpcClient(echo.address, label="driver")
+        try:
+            fi.plane.load_plan(_plan(
+                {"fault": "reset", "src": "driver", "direction": "send",
+                 "max_hits": 1}))
+            # one transparent redial+retry, inside the call deadline
+            assert client.call("echo", value=7,
+                               timeout=10)["value"] == 7
+        finally:
+            client.close()
+
+
+# ----------------------------------------------------------------------
+# redial policy
+# ----------------------------------------------------------------------
+
+class TestRedialPolicy:
+    def test_backoff_schedule_and_jitter_bounds(self, echo):
+        client = ReconnectingRpcClient(echo.address, label="driver")
+        try:
+            client._backoff_init = 0.1
+            client._backoff_mult = 2.0
+            client._backoff_max = 0.5
+            client._jitter = 0.0
+            assert client._backoff(1) == pytest.approx(0.1)
+            assert client._backoff(2) == pytest.approx(0.2)
+            assert client._backoff(3) == pytest.approx(0.4)
+            assert client._backoff(4) == pytest.approx(0.5)   # capped
+            client._jitter = 0.2
+            for attempt in (1, 2, 5):
+                base = min(0.5, 0.1 * 2.0 ** (attempt - 1))
+                for _ in range(32):
+                    d = client._backoff(attempt)
+                    assert base * 0.8 <= d <= base * 1.2
+        finally:
+            client.close()
+
+    def test_redial_budget_bounds_attempts(self, echo):
+        dead_addr = echo.address
+        client = ReconnectingRpcClient(dead_addr, label="driver",
+                                       redial_window_s=30.0)
+        try:
+            client._max_redials = 2
+            client._backoff_init = 0.01
+            client._jitter = 0.0
+            echo.stop()
+            t0 = time.monotonic()
+            with pytest.raises((ConnectionLost, OSError)):
+                client.call("echo", value=8, timeout=20)
+            # 2 attempts at ~10ms backoff — nowhere near the 30s window
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            client.close()
+
+    def test_call_timeout_caps_redial_window(self):
+        # dial an unroutable-but-fast-failing port: server never existed
+        probe = RpcServer("127.0.0.1", 0).start()
+        addr = probe.address
+        client = ReconnectingRpcClient(addr, label="driver",
+                                       redial_window_s=60.0)
+        probe.stop()
+        try:
+            client._backoff_init = 0.05
+            client._jitter = 0.0
+            t0 = time.monotonic()
+            with pytest.raises((ConnectionLost, OSError, TimeoutError)):
+                client.call("echo", value=9, timeout=1.0)
+            # the UNIFORM deadline (1s) bounds the whole call including
+            # redials — not a fresh 60s window per attempt
+            assert time.monotonic() - t0 < 8.0
+        finally:
+            client.close()
+
+
+# ----------------------------------------------------------------------
+# the GCS KV switch
+# ----------------------------------------------------------------------
+
+class TestKvSwitch:
+    def test_put_plan_applies_on_gcs_and_watchers(self):
+        from ray_tpu.runtime.gcs import GcsServer
+
+        gcs = GcsServer().start()
+        try:
+            fi.start_kv_watcher(gcs.address, poll_s=0.05)
+            fi.put_plan(gcs.address, _plan(
+                {"fault": "drop", "src": "nobody"}, version=11))
+            # the GCS applied it to its own (shared, in-process) plane
+            # synchronously at kv_put time; the watcher converges too
+            deadline = time.monotonic() + 5
+            while fi.plane.version != 11 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert fi.plane.version == 11
+            assert fi.plane.active
+            # heal through the same key
+            fi.put_plan(gcs.address, _plan(version=12))
+            deadline = time.monotonic() + 5
+            while fi.plane.active and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert not fi.plane.active
+        finally:
+            fi.stop_kv_watcher()
+            gcs.stop()
+
+    def test_put_plan_channel_is_exempt_while_partitioned(self):
+        from ray_tpu.runtime.gcs import GcsServer
+
+        gcs = GcsServer().start()
+        try:
+            addr = f"{gcs.address[0]}:{gcs.address[1]}"
+            # partition EVERY labeled channel to the GCS...
+            fi.put_plan(gcs.address, _plan(
+                {"fault": "partition", "dst": "gcs"}, version=21,
+                endpoints={"gcs": [addr]}))
+            assert fi.plane.active
+            with pytest.raises(fi.InjectedConnectionReset):
+                RpcClient(gcs.address, label="driver")
+            # ...and heal it over the exempt control channel
+            fi.put_plan(gcs.address, _plan(version=22))
+            assert not fi.plane.active
+        finally:
+            gcs.stop()
+
+    def test_maybe_init_is_noop_when_disabled(self):
+        from ray_tpu.utils.config import get_config
+
+        assert not get_config().fault_injection_enabled
+        fi.maybe_init_from_config()
+        assert not fi.plane.active
+
+    def test_maybe_init_loads_inline_plan(self, monkeypatch):
+        import json
+
+        monkeypatch.setenv("RAY_TPU_FAULT_INJECTION_ENABLED", "1")
+        monkeypatch.setenv("RAY_TPU_FAULT_INJECTION_SEED", "9")
+        monkeypatch.setenv("RAY_TPU_FAULT_INJECTION_PLAN", json.dumps(
+            _plan({"fault": "drop", "src": "nobody"}, version=31)))
+        from ray_tpu.utils import config as config_mod
+
+        config_mod.reset_config()
+        try:
+            fi.maybe_init_from_config()
+            assert fi.plane.active
+            assert fi.plane.version == 31
+        finally:
+            monkeypatch.undo()
+            config_mod.reset_config()

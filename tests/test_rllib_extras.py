@@ -210,6 +210,7 @@ def test_pixel_cartpole_env():
 
 
 @pytest.mark.nightly
+@pytest.mark.slow
 def test_rl_throughput_pixel_env(rt):
     """RL plane throughput leg (reference: release_tests.yaml rllib
     suites): vectorized rollouts + LearnerGroup on pixel obs must
@@ -239,6 +240,7 @@ def test_rl_throughput_pixel_env(rt):
 
 
 @pytest.mark.nightly
+@pytest.mark.slow
 def test_ppo_learns_from_pixels(rt):
     """Pixel-obs LEARNING at nightly tier (beyond-CartPole-scale check:
     the policy must read an 84x84 frame, not a 4-float state). Measured:

@@ -1,0 +1,246 @@
+"""Regression tests for the round-5 robustness findings.
+
+Each test pins a specific fixed defect and fails on the pre-fix code:
+
+1. ``Runtime.get()`` slow path: a sustained direct-result stream woke
+   the memstore cv every cycle and each wake skipped ``ensure_local``
+   entirely — a remote shm object never got its pull issued (starvation).
+2. ``Runtime._accept_direct_results``: a result arriving after its last
+   local ref died was kept in the memory store forever (the release hook
+   had already fired; no death notice would ever come again).
+3. ``ProxyManager._spawn_child``: the announce-line read had no real
+   timeout (``readline()`` blocks between deadline checks), and the
+   spawn ran UNDER the manager lock — one wedged child start blocked
+   every other session's hello.
+4. ``AccelerateTrainer``: structured YAML configs were mangled by the
+   line-splitting fallback even when the ``yaml`` package was available.
+"""
+
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.runtime.task_spec import SchedulingStrategy
+
+
+@pytest.fixture
+def two_node_cluster():
+    ray_tpu.shutdown()
+    c = Cluster(heartbeat_timeout_s=1.0)
+    c.add_node(num_cpus=2)
+    c.add_node(num_cpus=2, resources={"side": 4})
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+# ----------------------------------------------------------------------
+# 1. get() slow path vs a direct-result arrival storm
+# ----------------------------------------------------------------------
+
+def test_get_survives_direct_arrival_storm(two_node_cluster):
+    """A remote (other-node, shm-sized) object must resolve even while
+    direct results arrive continuously: the memstore-cv wake may defer
+    the ensure_local window only a bounded number of times (~100 ms),
+    not indefinitely. Pre-fix, every wake skipped ensure_local and this
+    get() starved to GetTimeoutError."""
+    from ray_tpu import api
+
+    side = next(h for h in two_node_cluster.nodes.values()
+                if h.raylet is not None
+                and "side" in h.raylet.total_resources)
+
+    @ray_tpu.remote(scheduling_strategy=SchedulingStrategy(
+        kind="NODE_AFFINITY", node_id=side.node_id))
+    def big():
+        time.sleep(0.5)     # land AFTER the storm is underway
+        return np.ones(1 << 18, dtype=np.float64)   # 2 MiB: shm path
+
+    ref = big.remote()
+    rt = api._runtime()
+    stop = threading.Event()
+
+    def storm():
+        # perpetual direct-arrival wakeups: exactly the signal a stream
+        # of small task returns produces
+        while not stop.is_set():
+            with rt._mem_cv:
+                rt._mem_arrivals += 1
+                rt._mem_cv.notify_all()
+            time.sleep(0.001)
+
+    t = threading.Thread(target=storm, daemon=True)
+    t.start()
+    try:
+        got = ray_tpu.get(ref, timeout=20)
+        assert float(got[0]) == 1.0
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+# ----------------------------------------------------------------------
+# 2. direct results for already-dead refs must not leak the memstore
+# ----------------------------------------------------------------------
+
+def test_direct_result_for_dead_ref_is_evicted(two_node_cluster):
+    from ray_tpu import api
+
+    rt = api._runtime()
+    if not rt._use_memstore:
+        pytest.skip("memory store disabled (ref counting off)")
+
+    live = ray_tpu.put(123)            # holds a local ref
+    live_hex = live.id.hex()
+    dead_hex = "ab" * 16               # no ref anywhere: died in flight
+    assert rt._refs.count(dead_hex) == 0
+
+    rt._accept_direct_results({dead_hex: b"payload-of-a-dead-ref",
+                               live_hex: b"payload-of-a-live-ref"})
+    assert dead_hex not in rt._memstore, \
+        "dead-ref direct result leaked into the memory store"
+    # the live oid stays resident (normal direct-return behavior)
+    assert live_hex in rt._memstore
+    del live
+
+
+# ----------------------------------------------------------------------
+# 3. proxier: announce timeout + spawn outside the manager lock
+# ----------------------------------------------------------------------
+
+@pytest.fixture
+def proxy_manager():
+    from ray_tpu.client.proxier import ProxyManager
+
+    manager = ProxyManager(port=0, child_spawn_timeout_s=1.0)
+    yield manager
+    manager.stop()
+
+
+def test_proxier_spawn_timeout_is_real(proxy_manager):
+    """A child that starts but never announces must fail the hello at
+    the spawn timeout. Pre-fix, readline() blocked forever: the 60 s
+    deadline was only checked between lines that never came."""
+    proxy_manager._spawn_cmd = [sys.executable, "-c",
+                                "import time; time.sleep(60)"]
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="did not announce"):
+        proxy_manager.rpc_client_hello(None, None)
+    assert time.monotonic() - t0 < 10.0
+    # the failed spawn must not poison the token table
+    assert proxy_manager._children == {}
+
+
+def test_proxier_dead_child_reported(proxy_manager):
+    proxy_manager._spawn_cmd = [sys.executable, "-c", "raise SystemExit(3)"]
+    with pytest.raises(RuntimeError, match="died at startup"):
+        proxy_manager.rpc_client_hello(None, None)
+    assert proxy_manager._children == {}
+
+
+def test_proxier_spawns_do_not_serialize_across_tokens(proxy_manager):
+    """Two different sessions' hellos must spawn their children
+    CONCURRENTLY. Pre-fix, the spawn ran under the manager lock: a slow
+    child start serialized every hello behind it."""
+    proxy_manager._spawn_timeout = 30.0
+    proxy_manager._spawn_cmd = [
+        sys.executable, "-c",
+        "import time; time.sleep(1.2); "
+        "print('client server on 127.0.0.1:1', flush=True); "
+        "time.sleep(30)"]
+    results = {}
+
+    def hello(token):
+        results[token] = proxy_manager.rpc_client_hello(
+            None, None, session_token=token)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=hello, args=(tok,))
+               for tok in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    elapsed = time.monotonic() - t0
+    assert set(results) == {"a", "b"}
+    # concurrent: ~1.2 s. Serialized (pre-fix): >= 2.4 s.
+    assert elapsed < 2.3, f"hellos serialized: {elapsed:.2f}s"
+
+
+def test_proxier_same_token_waits_for_inflight_spawn(proxy_manager):
+    proxy_manager._spawn_timeout = 30.0
+    proxy_manager._spawn_cmd = [
+        sys.executable, "-c",
+        "import time; time.sleep(0.8); "
+        "print('client server on 127.0.0.1:2', flush=True); "
+        "time.sleep(30)"]
+    results = []
+
+    def hello():
+        results.append(proxy_manager.rpc_client_hello(
+            None, None, session_token="tok"))
+
+    threads = [threading.Thread(target=hello) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    assert len(results) == 3
+    addrs = {tuple(r["redirect"]) for r in results}
+    assert addrs == {("127.0.0.1", 2)}   # ONE child served all three
+    assert len(proxy_manager._children) == 1
+
+
+# ----------------------------------------------------------------------
+# 4. accelerate config parsing
+# ----------------------------------------------------------------------
+
+NESTED_YAML = """\
+compute_environment: LOCAL_MACHINE
+deepspeed_config:
+  zero_stage: 3
+  offload_optimizer_device: cpu
+mixed_precision: bf16
+"""
+
+
+def test_accelerate_nested_yaml_parses_with_yaml_package():
+    pytest.importorskip("yaml")
+    from ray_tpu.train.accelerate import _parse_accelerate_config
+
+    cfg = _parse_accelerate_config(NESTED_YAML)
+    # pre-fix the fallback splitter produced garbage like
+    # {"deepspeed_config": "", "zero_stage": "3", ...}
+    assert cfg["deepspeed_config"] == {"zero_stage": 3,
+                                       "offload_optimizer_device": "cpu"}
+    assert cfg["mixed_precision"] == "bf16"
+    assert "zero_stage" not in cfg
+
+
+def test_accelerate_fallback_rejects_nested_yaml(monkeypatch):
+    from ray_tpu.train import accelerate
+
+    monkeypatch.setitem(sys.modules, "yaml", None)   # import -> ImportError
+    with pytest.raises(ValueError, match="nested"):
+        accelerate._parse_accelerate_config(NESTED_YAML)
+
+
+def test_accelerate_fallback_parses_flat_config(monkeypatch):
+    from ray_tpu.train import accelerate
+
+    monkeypatch.setitem(sys.modules, "yaml", None)
+    cfg = accelerate._parse_accelerate_config(
+        "---\n# a comment\nmixed_precision: bf16\ncpu: true  # inline\n")
+    assert cfg == {"mixed_precision": "bf16", "cpu": "true"}
+
+
+def test_accelerate_json_config_still_works():
+    from ray_tpu.train.accelerate import _parse_accelerate_config
+
+    assert _parse_accelerate_config('{"cpu": true}') == {"cpu": True}

@@ -17,7 +17,10 @@ import jax
 from ray_tpu.models import llama
 from ray_tpu.serve.paged_llm import PagedLLMEngine
 
-pytestmark = pytest.mark.nightly
+# slow as well: an explicit `-m 'not slow'` on the command line REPLACES
+# the addopts default (`-m 'not nightly'`) — keep the soak out of
+# bounded default/tier-1 runs either way
+pytestmark = [pytest.mark.nightly, pytest.mark.slow]
 
 
 def _soak(eng, vocab, *, rounds, concurrency, rng, shared_prefix=None):
