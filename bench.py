@@ -18,7 +18,12 @@ Env knobs:
 
 ``core`` is the microbenchmark suite analog
 (``python/ray/_private/ray_perf.py:93``): task/actor/put/get op
-throughput on the cluster runtime.
+throughput on the cluster runtime. ``envelope`` is the bounded
+scalability probe (``release/benchmarks/README.md`` analog): queued-task
+drain rate, actor-creation rate through the fork-server worker pool,
+and steady-state calls/s across the created actors — sized by
+``RAY_TPU_BENCH_ENVELOPE_TASKS`` / ``RAY_TPU_BENCH_ENVELOPE_ACTORS``
+(defaults 100k tasks / 500 actors).
 """
 
 from __future__ import annotations
@@ -481,17 +486,101 @@ def bench_core() -> dict:
     }
 
 
-def bench_core_subprocess() -> dict:
-    """Core microbenchmarks in a FRESH interpreter, for parity with a
-    standalone ``BENCH_MODE=core`` run (ray_perf runs standalone too).
-    bench_all also orders this leg FIRST so the parent hasn't imported
-    jax yet — on the 1-cpu host even an idle parent's dispatch/tunnel
-    threads would steal timeslices from the child's cluster."""
+def bench_envelope() -> dict:
+    """Bounded scalability-envelope probe: how far the cluster runtime
+    stretches in ONE artifact-visible leg (the full nightly tier runs
+    10x+ these axes; this keeps a driver-captured record every round).
+
+    Three axes on an external-process GCS + raylet:
+      * drain rate of ``bench_envelope_tasks`` queued no-op tasks
+        (submitted in windows so the host never holds every ref),
+      * creation rate of ``bench_envelope_actors`` trivial actors —
+        the fork-server worker pool (``runtime/prestart.py``) is what
+        moves this axis: each actor is an ``os.fork()`` of the warm
+        zygote template, not a cold interpreter boot,
+      * steady-state actor calls/s round-robined over all of them.
+    """
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.utils.config import get_config
+
+    cfg = get_config()
+    n_tasks = cfg.bench_envelope_tasks
+    n_actors = cfg.bench_envelope_actors
+    c = Cluster(external_gcs=True)
+    c.add_node(num_cpus=4, external=True)
+    ray_tpu.init(address=c.gcs_address)
+    detail: dict = {"tasks": n_tasks, "actors": n_actors}
+
+    @ray_tpu.remote
+    def nop(i):
+        return i
+
+    # warm the pool + zygote template so the probe measures the runtime,
+    # not first-boot imports
+    ray_tpu.get([nop.remote(i) for i in range(8)])
+
+    window = min(25_000, n_tasks)
+    t0 = time.perf_counter()
+    done = 0
+    while done < n_tasks:
+        take = min(window, n_tasks - done)
+        out = ray_tpu.get([nop.remote(done + i) for i in range(take)])
+        assert out[0] == done and out[-1] == done + take - 1
+        done += take
+    detail["envelope_tasks_per_sec"] = round(
+        n_tasks / (time.perf_counter() - t0), 1)
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    # creation clock stops when every actor has ANSWERED a call (alive
+    # and schedulable, not merely submitted)
+    t0 = time.perf_counter()
+    actors = [A.remote(i) for i in range(n_actors)]
+    got = ray_tpu.get([a.who.remote() for a in actors])
+    create_s = time.perf_counter() - t0
+    assert got == list(range(n_actors))
+    detail["actors_created_per_sec"] = round(n_actors / create_s, 1)
+    detail["actor_create_elapsed_s"] = round(create_s, 1)
+
+    # steady state: every live actor answers again, round-robin
+    calls = 4 * n_actors
+    t0 = time.perf_counter()
+    refs = [actors[i % n_actors].who.remote() for i in range(calls)]
+    ray_tpu.get(refs)
+    detail["steady_actor_calls_per_sec"] = round(
+        calls / (time.perf_counter() - t0), 1)
+
+    for a in actors:
+        ray_tpu.kill(a)
+    ray_tpu.shutdown()
+    c.shutdown()
+    return {
+        "metric": "envelope_actors_created_per_sec",
+        "value": detail["actors_created_per_sec"],
+        "unit": "actors/s",
+        "vs_baseline": None,  # reference envelope publishes no rates
+        "detail": detail,
+    }
+
+
+def _bench_subprocess(mode: str, timeout: float = 900.0) -> dict:
+    """Run one bench mode in a FRESH interpreter (parity with a
+    standalone ``BENCH_MODE=<mode>`` run; ray_perf runs standalone too).
+    bench_all orders these legs FIRST so the parent hasn't imported jax
+    yet — on a 1-cpu host even an idle parent's dispatch/tunnel threads
+    would steal timeslices from the child's cluster."""
     import signal
     import subprocess
 
     env = dict(os.environ)
-    env["BENCH_MODE"] = "core"
+    env["BENCH_MODE"] = mode
     # own process group: a timeout kill must take the child's external
     # raylet/GCS processes down with it, not orphan them on the host
     proc = subprocess.Popen(
@@ -499,16 +588,20 @@ def bench_core_subprocess() -> dict:
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         start_new_session=True)
     try:
-        stdout, stderr = proc.communicate(timeout=900)
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         os.killpg(proc.pid, signal.SIGKILL)
         proc.wait()
-        raise RuntimeError("core bench subprocess timed out") from None
+        raise RuntimeError(f"{mode} bench subprocess timed out") from None
     if proc.returncode != 0 or not stdout.strip():
         raise RuntimeError(
-            f"core bench subprocess failed (rc={proc.returncode}): "
+            f"{mode} bench subprocess failed (rc={proc.returncode}): "
             f"{(stderr or '')[-2000:]}")
     return json.loads(stdout.strip().splitlines()[-1])
+
+
+def bench_core_subprocess() -> dict:
+    return _bench_subprocess("core")
 
 
 def bench_all() -> dict:
@@ -522,7 +615,9 @@ def bench_all() -> dict:
     the core subprocess's cluster processes to depress a pure-Python
     RPC benchmark ~25%. Before jax is ever imported, the parent is an
     idle wait and the child's numbers match a standalone run."""
-    subs = [("core", bench_core_subprocess), ("serve", bench_serve)]
+    subs = [("core", bench_core_subprocess),
+            ("envelope", lambda: _bench_subprocess("envelope", 1800.0)),
+            ("serve", bench_serve)]
     if os.environ.get("BENCH_PRESET", "base") != "small":
         # the ~1B entry is a real-chip measurement; a CPU smoke run
         # (BENCH_PRESET=small) must not train a 1B model on host
@@ -553,6 +648,7 @@ def bench_all() -> dict:
 if __name__ == "__main__":
     mode = os.environ.get("BENCH_MODE", "all")
     fn = {"serve": bench_serve, "core": bench_core,
+          "envelope": bench_envelope,
           "train": bench_train}.get(mode, bench_all)
     print(json.dumps(fn()))
     sys.exit(0)

@@ -39,6 +39,17 @@ METRICS = {
                               ("detail", "puts_1kb_per_sec")],
     "core_gets_1kb_per_sec": [("detail", "core", "gets_1kb_per_sec"),
                               ("detail", "gets_1kb_per_sec")],
+    # envelope probe (fork-server worker pool axes); key names are
+    # envelope-unique so a mode-only doc can't collide with core paths
+    "envelope_tasks_per_sec": [
+        ("detail", "envelope", "envelope_tasks_per_sec"),
+        ("detail", "envelope_tasks_per_sec")],
+    "envelope_actors_created_per_sec": [
+        ("detail", "envelope", "actors_created_per_sec"),
+        ("detail", "actors_created_per_sec")],
+    "envelope_actor_calls_per_sec": [
+        ("detail", "envelope", "steady_actor_calls_per_sec"),
+        ("detail", "steady_actor_calls_per_sec")],
 }
 
 # train metric paths only exist in full-run docs; the train bench value

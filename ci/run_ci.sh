@@ -14,6 +14,9 @@ stage() { echo; echo "=== CI stage: $1 ==="; }
 if [ "${1:-}" = "--nightly" ]; then
   stage "nightly scalability envelope (2k actors / 1M tasks / 5k args / 4 nodes)"
   python -m pytest tests/test_envelope_nightly.py -m nightly -q -s
+  stage "nightly fork-server envelope (10k actors via preforked zygotes)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_fork_envelope_nightly.py \
+    -m nightly -q -s
   stage "nightly serve soak (paged engine page/refcount flatness)"
   python -m pytest tests/test_serve_soak_nightly.py -m nightly -q -s
   stage "nightly RL plane (pixel-obs throughput + learning)"

@@ -977,6 +977,15 @@ class ClusterRuntime:
         else:
             spec.return_ids = [ObjectID.from_random()
                                for _ in range(spec.num_returns)]
+        # The caller's ObjectRefs MUST exist before the task can reach a
+        # pusher thread: _accept_direct_results reads count==0 as "every
+        # ref died while the result was in flight" and drops the arriving
+        # copy. A worker fast enough to reply before this thread got back
+        # to construct the refs (routinely ~0.03% of a 10k-task drain on
+        # a loaded host) would lose the only copy of the result, and the
+        # later get() waits forever.
+        out_refs = ([] if streaming
+                    else [ObjectRef(oid) for oid in spec.return_ids])
         if spec.task_type == TaskType.ACTOR_TASK:
             self._submit_actor_task(spec)
         else:
@@ -1025,7 +1034,7 @@ class ClusterRuntime:
         if streaming:
             from ray_tpu.runtime.streaming import ObjectRefGenerator
             return [ObjectRefGenerator(spec.task_id.binary())]
-        return [ObjectRef(oid) for oid in spec.return_ids]
+        return out_refs
 
     def _legacy_submit(self, task: dict):
         """Raylet-queue submission (placement-constrained tasks, lease

@@ -71,8 +71,15 @@ DELAY = "delay"
 DUPLICATE = "duplicate"
 RESET = "reset"
 PARTITION = "partition"
+# Consumed by runtime/prestart.py only (consulted with method
+# "fork_worker"): SIGKILL the zygote template right before a fork is
+# requested from it, proving the cold-spawn fallback. The RPC layer
+# treats it as PASS (it only acts on DROP/DUPLICATE/RESET), so rules
+# should pin ``method: "fork_worker"`` to avoid burning hit budgets on
+# unrelated messages.
+KILL_TEMPLATE = "kill_template"
 
-_FAULTS = (DROP, DELAY, DUPLICATE, RESET, PARTITION)
+_FAULTS = (DROP, DELAY, DUPLICATE, RESET, PARTITION, KILL_TEMPLATE)
 
 
 class InjectedConnectionReset(OSError):
@@ -326,6 +333,19 @@ def stop_kv_watcher():
         if _watcher_stop is not None:
             _watcher_stop.set()
             _watcher_stop = None
+
+
+def reset_after_fork():
+    """Called in a zygote-forked child before any worker code runs: the
+    child must start with a FRESH plane (no rules, version -1) and no
+    watcher bookkeeping — a template never loads a plan or starts the
+    watcher, but the child enforces the invariant rather than assuming
+    it. The worker's own ``maybe_init_from_config`` then rebuilds state
+    from ITS environment, exactly like a cold-spawned worker."""
+    global plane, _watcher_stop
+    with _watcher_lock:
+        _watcher_stop = None   # watcher threads do not survive fork
+    plane = FaultPlane()
 
 
 def maybe_init_from_config(gcs_address=None):

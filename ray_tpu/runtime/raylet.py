@@ -172,7 +172,8 @@ class Raylet(RpcServer):
         loops = [self.scheduler.dispatch_loop, self._heartbeat_loop,
                  self.workers.monitor_loop, self.scheduler.infeasible_loop,
                  self.objects.location_flush_loop,
-                 self._log_monitor_loop]
+                 self._log_monitor_loop,
+                 self.workers.prestart_policy_loop]
         if self.objects.spill_enabled:
             loops.append(self.objects.spill_loop)
         if self._mem_threshold > 0:
@@ -237,6 +238,9 @@ class Raylet(RpcServer):
             with self.workers.lock:
                 live = {h.worker_id[:12]: (h.proc.pid if h.proc else 0)
                         for h in self.workers.workers.values()}
+            # zygote templates log here too; without this their capture
+            # files read as dead-worker leftovers and get deleted
+            live.update(self.workers.prestart.log_stems())
             pid_of.update(live)
             entries = []
             try:
@@ -622,8 +626,14 @@ class Raylet(RpcServer):
         if not self.scheduler.try_acquire(demand):
             raise RuntimeError(
                 f"node {self.node_id} cannot host actor: {demand} unavailable")
-        handle = self.workers.spawn(spec.get("runtime_env"))
-        handle.state = "actor"
+        # prestart fast path: dedicate a warm already-registered idle
+        # worker (its conn is live, so _deliver sends create_actor
+        # immediately — no interpreter boot on the actor-creation path);
+        # otherwise spawn, which itself prefers a zygote fork
+        handle = self.workers.take_idle_for_actor(spec.get("runtime_env"))
+        if handle is None:
+            handle = self.workers.spawn(spec.get("runtime_env"))
+            handle.state = "actor"
         handle.actor_id = actor_id
         handle.incarnation = incarnation
         handle.acquired = dict(demand)
@@ -979,6 +989,7 @@ class Raylet(RpcServer):
             if w is None or w.state != "leased":
                 return {"ok": False}
             acquired, w.acquired = w.acquired, {}
+            w.idle_since = time.monotonic()
             w.state = "idle"
         self._release(acquired)
         self._kick_dispatch()
@@ -1056,7 +1067,8 @@ class Raylet(RpcServer):
                 "address": self.address, "resources": self.total_resources,
                 "available": self._avail_snapshot(),
                 "num_workers": len(self.workers.workers),
-                "spill_stats": dict(self.objects.spill_stats)}
+                "spill_stats": dict(self.objects.spill_stats),
+                "prestart": self.workers.prestart.snapshot()}
 
     # ------------------------------------------------------------------
     # heartbeat

@@ -1074,6 +1074,18 @@ def _iter_markers(args, kwargs):
 
 
 def main():
+    import sys
+
+    if "--zygote" in sys.argv[1:]:
+        # fork-server template mode (runtime/prestart.py): preload the
+        # worker import set once, then serve os.fork() requests over the
+        # control pipe — each forked child re-enters Worker().run() with
+        # a fresh identity. The template itself NEVER constructs a
+        # Worker and never initializes a device backend (fork-after-
+        # XLA-init is unsafe; devices attach post-fork in the child).
+        from ray_tpu.runtime.prestart import zygote_main
+
+        raise SystemExit(zygote_main())
     Worker().run()
 
 

@@ -52,6 +52,12 @@ class WorkerHandle:
     # worker with a matching key (reference: (language, runtime_env)-
     # keyed worker caching in worker_pool.cc)
     env_key: str = ""
+    # monotonic time of the last busy→idle transition; the prestart
+    # policy evicts idle workers beyond the demand target older than
+    # prestart_idle_timeout_s
+    idle_since: float = 0.0
+    # spawned via the zygote fork path (runtime/prestart.py)
+    forked: bool = False
 
 
 class WorkerPool:
@@ -63,10 +69,19 @@ class WorkerPool:
     BAD_ENV_TTL_S = 60.0
 
     def __init__(self, node, *, max_workers: int):
+        from ray_tpu.runtime.prestart import PrestartManager
+
         self._node = node
         self.max_workers = max_workers
         self.workers: dict[str, WorkerHandle] = {}
         self.lock = threading.Lock()
+        # fork-server templates (runtime/prestart.py): lazy — no process
+        # is spawned until the first fork attempt
+        self.prestart = PrestartManager(self)
+        # actor-creation misses since the last policy tick: actors do
+        # not flow through the lease queue, so take_idle_for_actor
+        # misses are their demand signal to the prestart policy
+        self._actor_demand = 0
         # why recent workers died, queried by lease owners on break
         # (bounded FIFO; reference: worker exit detail in death reports)
         self._death_info: dict[str, dict] = {}
@@ -102,6 +117,28 @@ class WorkerPool:
             # the worker's block buffer instead of reaching the driver
             "PYTHONUNBUFFERED": "1",
         })
+        # Capture paths first: both spawn paths share them (the cold
+        # path opens+dups them into Popen; a forked child opens them
+        # itself post-fork)
+        log_dir = getattr(node, "log_dir", None)
+        log_out = log_err = None
+        if log_dir:
+            base = os.path.join(log_dir, f"worker-{worker_id[:12]}")
+            log_out, log_err = base + ".out", base + ".err"
+        # fork fast path: an os.fork() of the preloaded env-keyed
+        # template instead of a cold interpreter start; any miss
+        # (disabled, template warming/dead, container env) returns None
+        # and the cold path below runs unchanged
+        fork_proc = self.prestart.fork_worker(runtime_env, worker_id,
+                                              log_out, log_err)
+        if fork_proc is not None:
+            handle = WorkerHandle(worker_id=worker_id, proc=fork_proc,
+                                  env_key=_env_key(runtime_env),
+                                  forked=True)
+            handle.log_out, handle.log_err = log_out, log_err
+            with self.lock:
+                self.workers[worker_id] = handle
+            return handle
         cmd = [sys.executable, "-m", "ray_tpu.runtime.worker_main"]
         container = (runtime_env or {}).get("container")
         if container:
@@ -133,21 +170,18 @@ class WorkerPool:
         # Capture worker stdout/stderr into the raylet's log dir; the
         # raylet's log monitor tails these and forwards lines to the
         # driver (reference: worker logs -> session dir -> log_monitor)
-        log_dir = getattr(node, "log_dir", None)
-        log_out = log_err = None
         stdout = stderr = None
-        if log_dir:
-            base = os.path.join(log_dir, f"worker-{worker_id[:12]}")
+        if log_out:
             try:
-                stdout = open(base + ".out", "ab", buffering=0)
-                stderr = open(base + ".err", "ab", buffering=0)
-                log_out, log_err = base + ".out", base + ".err"
+                stdout = open(log_out, "ab", buffering=0)
+                stderr = open(log_err, "ab", buffering=0)
             except OSError:
                 # disk-full/permission: run uncaptured, don't leak the
                 # half-opened fd
                 if stdout is not None:
                     stdout.close()
                 stdout = stderr = None
+                log_out = log_err = None
         try:
             proc = subprocess.Popen(cmd, env=env, cwd=os.getcwd(),
                                     stdout=stdout, stderr=stderr)
@@ -187,6 +221,7 @@ class WorkerPool:
                 # actor-designated workers keep their "actor" state — the
                 # dispatcher must never hand them normal tasks
                 handle.state = "idle"
+                handle.idle_since = time.monotonic()
         node._kick_dispatch()
         try:
             while not node._stopping:
@@ -225,6 +260,7 @@ class WorkerPool:
             # (released on death/kill); only per-task resources return here
             node._release(w.acquired)
             w.acquired = {}
+            w.idle_since = time.monotonic()
             w.state = "idle"
         node._kick_dispatch()
 
@@ -343,28 +379,131 @@ class WorkerPool:
                         spawn = True
                         break
         if evict is not None:
-            # off the dispatch thread: a worker slow to honor SIGTERM
-            # must not stall dispatch for every other queued task
-            def _reap(w=evict):
-                try:
-                    if w.proc is not None:
-                        w.proc.terminate()
-                    if w.conn is not None:
-                        w.conn.close()
-                except OSError:
-                    pass
-                self.on_worker_gone(w)
-                if w.proc is not None:
-                    try:
-                        w.proc.wait(timeout=5)
-                    except subprocess.TimeoutExpired:
-                        w.proc.kill()
-
-            threading.Thread(target=_reap, name="ray_tpu-evict",
-                             daemon=True).start()
+            self._evict_async(evict)
         if spawn:
             self.spawn(runtime_env)
         return None
+
+    def _evict_async(self, w: WorkerHandle):
+        """Terminate an idle worker off the calling thread: a worker
+        slow to honor SIGTERM must not stall dispatch (or the prestart
+        policy tick) for every other queued task."""
+        def _reap():
+            try:
+                if w.proc is not None:
+                    w.proc.terminate()
+                if w.conn is not None:
+                    w.conn.close()
+            except OSError:
+                pass
+            self.on_worker_gone(w)
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+
+        threading.Thread(target=_reap, name="ray_tpu-evict",
+                         daemon=True).start()
+
+    def take_idle_for_actor(self, runtime_env: dict | None = None
+                            ) -> WorkerHandle | None:
+        """Dedicate an already-registered idle worker (matching env key)
+        to an actor instead of spawning a fresh process — with the fork
+        pool keeping idle workers warm this makes actor creation an RPC
+        away (reference: PopWorker serving actor-creation leases from
+        the started-worker pool). Gated on prestart_enabled so the
+        legacy fresh-process-per-actor behavior is preserved when the
+        subsystem is off."""
+        if not self.prestart.enabled:
+            return None
+        from ray_tpu.runtime_env import env_key as _env_key
+
+        key = _env_key(runtime_env)
+        with self.lock:
+            for w in self.workers.values():
+                if (w.state == "idle" and w.conn is not None
+                        and w.env_key == key):
+                    w.state = "actor"
+                    return w
+            self._actor_demand += 1
+        return None
+
+    # ------------------------------------------------------------------
+    # prestart policy (reference: worker_pool.h:354 PrestartWorkers —
+    # lease-demand-driven warm pool + idle eviction beyond the target)
+    # ------------------------------------------------------------------
+
+    def prestart_policy_loop(self):
+        from ray_tpu.utils.config import get_config
+
+        node = self._node
+        cfg = get_config()
+        if not cfg.prestart_enabled:
+            return
+        while not node._stopping:
+            node._interruptible_sleep(cfg.prestart_policy_interval_s)
+            if node._stopping:
+                return
+            try:
+                self._prestart_tick(cfg)
+            except Exception:  # noqa: BLE001 - policy must never die
+                pass
+
+    def _prestart_tick(self, cfg):
+        """One policy decision: predict demand from lease-queue + ready-
+        queue depth, fork up to the deficit, evict idle workers beyond
+        the target that outlived the idle timeout."""
+        # The policy only acts where fork-server demand exists: the
+        # default env key crossed the spawn threshold, or an explicit
+        # warm floor is configured. Ungated, every transient queue blip
+        # in a small short-lived pool would speculatively spawn workers
+        # the scheduler's own demand spawning already covers.
+        if (cfg.prestart_min_workers <= 0
+                and not self.prestart.justified("")):
+            return
+        sched = self._node.scheduler
+        with sched.cv:
+            depth = len(sched.ready) + len(sched.lease_waiters)
+        now = time.monotonic()
+        with self.lock:
+            # an actor-creation burst shows up as take_idle misses, not
+            # queue depth — fold it in so the next wave of creations is
+            # served by warm takeovers instead of per-actor forks
+            depth += self._actor_demand
+            self._actor_demand = 0
+            idle = [w for w in self.workers.values()
+                    if w.state == "idle" and w.conn is not None
+                    and w.env_key == ""]
+            n_starting = sum(1 for w in self.workers.values()
+                             if w.state == "starting")
+            n_alive = sum(1 for w in self.workers.values()
+                          if w.state in ("idle", "busy", "starting",
+                                         "leased"))
+        want = min(max(depth, cfg.prestart_min_workers), self.max_workers)
+        deficit = min(want - (len(idle) + n_starting),
+                      self.max_workers - n_alive,
+                      cfg.prestart_max_forks_per_tick)
+        for _ in range(max(0, deficit)):
+            self.spawn(None)
+        if cfg.prestart_idle_timeout_s <= 0:
+            return
+        floor = max(want, cfg.prestart_min_workers)
+        excess = len(idle) - floor
+        if excess <= 0:
+            return
+        victims = []
+        with self.lock:
+            for w in sorted(idle, key=lambda w: w.idle_since):
+                if len(victims) >= excess:
+                    break
+                if (w.state == "idle"
+                        and now - w.idle_since
+                        > cfg.prestart_idle_timeout_s):
+                    w.state = "evicting"
+                    victims.append(w)
+        for w in victims:
+            self._evict_async(w)
 
     # ------------------------------------------------------------------
     # observability targets (worker push ports serve stack dumps/profiles)
@@ -471,6 +610,7 @@ class WorkerPool:
     def stop(self):
         """Terminate every worker process (called from Raylet.stop after
         background loops have been joined)."""
+        self.prestart.stop()
         with self.lock:
             workers = list(self.workers.values())
         for w in workers:

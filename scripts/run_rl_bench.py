@@ -46,14 +46,29 @@ def main():
         t0 = time.monotonic()
         algo.train()
         warm_s = time.monotonic() - t0
-        iters = 8
+        # run until BOTH floors are met: a minimum wall-clock (default
+        # 30s — a 2s single-shot measurement is one scheduler draw, not
+        # a throughput number) and a minimum iteration count (variance
+        # needs samples). Per-iteration rates are recorded so the
+        # artifact itself shows spread, not just the mean.
+        min_elapsed = float(os.environ.get("RL_BENCH_MIN_ELAPSED_S", "30"))
+        min_iters = int(os.environ.get("RL_BENCH_MIN_ITERS", "8"))
+        steps_per_iter = 2 * 8 * 32     # workers * envs * unroll
+        iter_rates = []
         t0 = time.monotonic()
         steps = 0
-        for _ in range(iters):
+        while len(iter_rates) < min_iters or \
+                time.monotonic() - t0 < min_elapsed:
+            it0 = time.monotonic()
             algo.train()
-            steps += 2 * 8 * 32     # workers * envs * unroll
+            iter_rates.append(
+                round(steps_per_iter / (time.monotonic() - it0), 1))
+            steps += steps_per_iter
         el = time.monotonic() - t0
         algo.stop()
+        mean = sum(iter_rates) / len(iter_rates)
+        std = (sum((r - mean) ** 2 for r in iter_rates)
+               / len(iter_rates)) ** 0.5
         out = {
             "metric": "rl_env_steps_per_sec",
             "value": round(steps / el, 1),
@@ -66,9 +81,14 @@ def main():
                 "unroll_length": 32,
                 "learners": 2,
                 "learner_mode": "mesh",
-                "iters": iters,
+                "iters": len(iter_rates),
                 "elapsed_s": round(el, 1),
                 "first_iter_s": round(warm_s, 1),
+                "iter_rates": iter_rates,
+                "iter_rate_mean": round(mean, 1),
+                "iter_rate_std": round(std, 1),
+                "iter_rate_min": min(iter_rates),
+                "iter_rate_max": max(iter_rates),
             },
         }
     finally:

@@ -1,0 +1,93 @@
+"""Fork-server actor envelope — the NIGHTLY 10k-actor axis.
+
+Reference analog: ``release/benchmarks/README.md:9`` (40k actors on 64
+hosts ≈ 600/host, proven nightly). The fork-server worker pool
+(``runtime/prestart.py``) is what makes this axis reachable on few
+hosts: every actor worker is an ``os.fork()`` of a preloaded zygote
+template, so creation cost is fork + registration, not interpreter boot
++ imports, and forked siblings share their preloaded pages copy-on-write
+(10k cold interpreters would not fit host memory).
+
+Sized by ``RAY_TPU_ENVELOPE_NIGHTLY_FORK_ACTORS`` (default 10,000).
+Selected only by ``ci/run_ci.sh --nightly`` (``pytest -m nightly``).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.utils.config import get_config
+
+pytestmark = [pytest.mark.nightly, pytest.mark.slow]
+
+_N_ACTORS = get_config().envelope_nightly_fork_actors
+
+
+@pytest.fixture(scope="module")
+def fork_cluster():
+    ray_tpu.shutdown()
+    # same shape as the main nightly envelope: generous heartbeat (a
+    # raylet starved of cpu during a 10k-process ramp must not be
+    # declared dead), 3 external raylets + an IN-PROCESS head whose
+    # prestart counters the test reads at the end
+    c = Cluster(external_gcs=True, heartbeat_timeout_s=90.0)
+    head = c.add_node(num_cpus=4)
+    for _ in range(3):
+        c.add_node(num_cpus=4, external=True)
+    c.wait_for_nodes(4)
+    ray_tpu.init(address=c.gcs_address)
+    yield c, head
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_10k_actor_fork_envelope(fork_cluster):
+    """10,000 concurrent trivial actors created through the fork path;
+    creation rate and steady-state calls/s are the recorded envelope
+    numbers (printed with ``-s``; the driver's nightly log keeps them)."""
+    c, head = fork_cluster
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    n = _N_ACTORS
+    window = 500
+    actors = []
+    t0 = time.monotonic()
+    try:
+        # windowed ramp: each window is confirmed ALIVE (answered a
+        # call) before the next, so a stall is visible at its window,
+        # and the host never queues 10k unconfirmed creations
+        while len(actors) < n:
+            take = min(window, n - len(actors))
+            base = len(actors)
+            batch = [A.remote(base + i) for i in range(take)]
+            got = ray_tpu.get([a.who.remote() for a in batch],
+                              timeout=1800)
+            assert got == list(range(base, base + take))
+            actors.extend(batch)
+        create_s = time.monotonic() - t0
+        # steady state: every one of the 10k actors answers again
+        t0 = time.monotonic()
+        got = ray_tpu.get([a.who.remote() for a in actors], timeout=1800)
+        steady_s = time.monotonic() - t0
+        assert got == list(range(n))
+        stats = head.raylet.workers.prestart.snapshot()
+        print(f"\n{n} actors: created+confirmed in {create_s:.1f}s "
+              f"({n / create_s:.1f} actors/s), steady-state "
+              f"{n / steady_s:.0f} calls/s; head prestart: "
+              f"forked={stats['forked']} "
+              f"cold_fallback={stats['cold_fallback']} "
+              f"template_spawns={stats['template_spawns']}")
+        # the axis is only proven if the fork plane actually carried it
+        assert stats["forked"] > 0
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)

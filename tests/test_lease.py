@@ -127,3 +127,49 @@ def test_worker_death_no_retries_fails_fast(two_node_cluster):
     with pytest.raises(ray_tpu.exceptions.RayTpuError):
         ray_tpu.get(die.remote(), timeout=30)
     assert time.monotonic() - start < 10
+
+
+def test_return_refs_registered_before_task_reaches_pusher():
+    """Direct returns ride the push reply, and _accept_direct_results
+    drops any arriving result whose return refs count 0 live instances
+    ("every ref died while the result was in flight"). A worker fast
+    enough to reply before submit_task's caller resumed used to hit that
+    guard — the refs were constructed only on return from submit_task —
+    deleting the only copy of a live result and wedging the later get()
+    forever (~3 per 10k tasks in the envelope drain on a loaded host).
+    The return ObjectRefs must be registered with the refcounter BEFORE
+    the task is visible to any lease pusher."""
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.gcs_address)
+    try:
+        from ray_tpu.runtime import core as _core
+
+        rt = _core.get_runtime()
+        lm = rt._leases
+        counts = []
+        orig = lm.submit
+
+        def spy(task):
+            counts.extend(rt._refs.count(o)
+                          for o in task.get("return_oids", ()))
+            orig(task)
+
+        lm.submit = spy
+
+        @ray_tpu.remote
+        def echo(i):
+            return i
+
+        try:
+            refs = [echo.remote(i) for i in range(20)]
+            assert ray_tpu.get(refs, timeout=30) == list(range(20))
+        finally:
+            lm.submit = orig
+        assert counts, "no leasable task went through the lease manager"
+        assert min(counts) >= 1, (
+            f"return refs not registered before push: counts={counts}")
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()

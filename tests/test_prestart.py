@@ -1,0 +1,246 @@
+"""Fork-server worker pool (``runtime/prestart.py``) edge cases.
+
+Reference analog: ``src/ray/raylet/worker_pool_test.cc`` (PopWorker /
+PrestartWorkers paths) — here against the zygote fork plane: forked
+workers must be indistinguishable from cold spawns (fresh fault plane,
+no inherited control fd), template death at any moment must degrade to
+cold spawn without losing work, and env-keyed templates must never
+serve a fork for the wrong runtime env.
+
+The cluster fixture is IN-PROCESS (``Cluster()`` + ``add_node``), so the
+raylet's ``WorkerPool``/``PrestartManager`` are directly inspectable
+while real template/worker processes run underneath.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.runtime import fault_injection as fi
+from ray_tpu.runtime.prestart import ZYGOTE_FD_ENV
+from ray_tpu.runtime_env import env_key
+
+
+@pytest.fixture(scope="module")
+def _shared_cluster():
+    """One in-process cluster for the whole module: templates respawn
+    after every kill these tests inflict, so sharing is safe and saves
+    a cluster boot/teardown per test."""
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=4)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+@pytest.fixture
+def cluster(_shared_cluster):
+    yield _shared_cluster
+    fi.plane.load_plan(None)   # heal any plan a test installed
+
+
+def _pool(cluster):
+    return next(iter(cluster.nodes.values())).raylet.workers
+
+
+def _warm_template(mgr, key: str = "", runtime_env=None, timeout=90.0):
+    """Explicitly spawn the env-keyed template (warm() bypasses the
+    spawn-request threshold) and wait until it answers the ready frame."""
+    mgr.warm(runtime_env)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with mgr.lock:
+            t = mgr.templates.get(key)
+        if t is not None and t.poll_ready(timeout=0.2):
+            return t
+        time.sleep(0.1)
+    raise AssertionError(f"template for key {key!r} never became ready")
+
+
+@ray_tpu.remote
+def _probe():
+    """Runs in a worker: report fork provenance + inherited-state audit."""
+    from ray_tpu.runtime import fault_injection as wfi
+    from ray_tpu.runtime import prestart
+
+    return {
+        "pid": os.getpid(),
+        "child_info": prestart.CHILD_INFO,
+        "zygote_fd_env": ZYGOTE_FD_ENV in os.environ,
+        "plane_active": wfi.plane.active,
+        "plane_rules": len(wfi.plane._rules),
+    }
+
+
+def test_forked_worker_serves_tasks_with_fresh_state(cluster):
+    """Once the template is warm every spawn forks; the forked worker
+    runs tasks AND carries no template leftovers: the zygote control fd
+    env var is gone and the fault plane is empty even while the RAYLET's
+    plane has live rules (forked children must not inherit chaos state
+    the template never loaded)."""
+    pool = _pool(cluster)
+    _warm_template(pool.prestart)
+    # raylet-side plan that no worker should ever see (method pinned to
+    # an RPC name no worker calls, so it never fires — it only needs to
+    # make the raylet's plane ACTIVE while children boot)
+    fi.plane.load_plan({"rules": [{"fault": "drop",
+                                   "method": "never_called"}]})
+    forked_before = pool.prestart.stats["forked"]
+    handle = pool.spawn(None)
+    assert handle.forked, "warm template did not serve the fork path"
+    assert pool.prestart.stats["forked"] == forked_before + 1
+    # occupy every worker so at least one probe lands on the forked one
+    out = ray_tpu.get([_probe.remote() for _ in range(16)], timeout=120)
+    by_pid = {r["pid"]: r for r in out}
+    forked = [r for r in by_pid.values() if r["child_info"] is not None]
+    assert forked, "no probe task ran on a forked worker"
+    for r in forked:
+        assert r["child_info"]["template_pid"] > 0
+        assert not r["zygote_fd_env"]
+        assert not r["plane_active"]
+        assert r["plane_rules"] == 0
+
+
+def test_template_spawn_gated_on_demand_threshold(cluster):
+    """Below ``prestart_spawn_threshold`` cumulative spawn requests for
+    an env key no template exists — every request is a plain cold spawn
+    with zero added cost. The Nth request justifies the template."""
+    mgr = _pool(cluster).prestart
+    renv = {"env_vars": {"PRESTART_MARKER": "gate"}}
+    key = env_key(renv)
+    from ray_tpu.utils.config import get_config
+    thresh = get_config().prestart_spawn_threshold
+    assert thresh > 1
+    below = mgr.stats["below_threshold"]
+    for i in range(thresh - 1):
+        assert mgr.fork_worker(renv, f"gate-{i}", None, None) is None
+        with mgr.lock:
+            assert key not in mgr.templates
+    assert mgr.stats["below_threshold"] == below + thresh - 1
+    # the Nth request crosses the gate: the template spawns (this
+    # request is still a cold-spawn miss while it preloads)
+    assert mgr.fork_worker(renv, "gate-n", None, None) is None
+    with mgr.lock:
+        assert key in mgr.templates
+
+
+def test_template_crash_falls_back_to_cold_spawn(cluster):
+    """SIGKILL the template, then demand workers: everything completes
+    via cold spawn and the manager respawns a fresh template."""
+    pool = _pool(cluster)
+    mgr = pool.prestart
+    t = _warm_template(mgr)
+    spawns_before = mgr.stats["template_spawns"]
+    cold_before = mgr.stats["cold_fallback"]
+    os.kill(t.proc.pid, signal.SIGKILL)
+    t.proc.wait(timeout=10)
+    # every spawn while the replacement preloads is a cold fallback;
+    # tasks still complete (the fallback contract)
+    handle = pool.spawn(None)
+    assert not handle.forked
+    assert ray_tpu.get([_probe.remote() for _ in range(8)],
+                       timeout=120)
+    assert mgr.stats["cold_fallback"] > cold_before
+    assert mgr.stats["template_deaths"] >= 1
+    assert mgr.stats["template_spawns"] == spawns_before + 1
+    with mgr.lock:
+        t2 = mgr.templates.get("")
+    assert t2 is not None and t2 is not t and t2.alive()
+
+
+def test_kill_template_fault_burst_loses_no_leases(cluster):
+    """Chaos-tier criterion: a ``kill_template`` fault fired mid-burst
+    (the 3rd fork acquisition) must not lose a single actor creation —
+    the pool cold-spawns through the gap and respawns the template."""
+    pool = _pool(cluster)
+    _warm_template(pool.prestart)
+    fi.plane.load_plan({"rules": [{"fault": "kill_template",
+                                   "method": "fork_worker",
+                                   "nth": 3, "max_hits": 1}]})
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    n = int(os.environ.get("RAY_TPU_TEST_BURST_ACTORS", "64"))
+    actors = [A.remote(i) for i in range(n)]
+    try:
+        got = ray_tpu.get([a.who.remote() for a in actors], timeout=600)
+        assert got == list(range(n))
+        assert pool.prestart.stats["fault_template_kills"] >= 1
+        assert pool.prestart.stats["template_spawns"] >= 2
+    finally:
+        fi.plane.load_plan(None)
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_env_key_mismatch_never_crosses_templates(cluster):
+    """Two runtime envs get two templates, and a worker for env A is
+    forked from template A (its own env var set, provenance pid = the
+    A template) — never from B's."""
+    pool = _pool(cluster)
+    mgr = pool.prestart
+    env_a = {"env_vars": {"PRESTART_MARKER": "a"}}
+    env_b = {"env_vars": {"PRESTART_MARKER": "b"}}
+    key_a, key_b = env_key(env_a), env_key(env_b)
+    assert key_a != key_b
+    ta = _warm_template(mgr, key_a, env_a)
+    tb = _warm_template(mgr, key_b, env_b)
+    assert ta.proc.pid != tb.proc.pid
+    with mgr.lock:
+        assert mgr.templates[key_a].runtime_env == env_a
+        assert mgr.templates[key_b].runtime_env == env_b
+
+    @ray_tpu.remote
+    def probe_env():
+        from ray_tpu.runtime import prestart
+
+        return {"marker": os.environ.get("PRESTART_MARKER"),
+                "child_info": prestart.CHILD_INFO}
+
+    for renv, marker, template in ((env_a, "a", ta), (env_b, "b", tb)):
+        out = ray_tpu.get(
+            probe_env.options(runtime_env=renv).remote(), timeout=120)
+        assert out["marker"] == marker
+        if out["child_info"] is not None:
+            assert out["child_info"]["template_pid"] == template.proc.pid
+
+
+def test_template_honors_jax_fork_safety(cluster):
+    """The template preloads the worker import closure but must hold no
+    live XLA backend and no extra threads (fork from a threaded process
+    inherits locked locks)."""
+    mgr = _pool(cluster).prestart
+    t = _warm_template(mgr)
+    st = t.status()
+    assert st["ok"]
+    assert st["jax_backends_initialized"] is False
+    assert st["threads"] == 1
+    assert "numpy" in st["preloaded"]
+    assert "ray_tpu.runtime.rpc" in st["preloaded"]
+
+
+def test_reset_after_fork_clears_plane():
+    """Unit: the child-side reset installs a fresh, inactive plane even
+    if (impossibly) the template had loaded rules."""
+    fi.plane.load_plan({"rules": [{"fault": "drop"}]})
+    assert fi.plane.active
+    old = fi.plane
+    fi.reset_after_fork()
+    try:
+        assert fi.plane is not old
+        assert not fi.plane.active
+        assert fi.plane._rules == ()
+    finally:
+        old.load_plan(None)
