@@ -540,22 +540,60 @@ def bench_envelope() -> dict:
             return self.i
 
     # creation clock stops when every actor has ANSWERED a call (alive
-    # and schedulable, not merely submitted)
+    # and schedulable, not merely submitted); per-phase decomposition
+    # (register / place / ready / resolve) comes from the driver's
+    # registration coalescer + the GCS actor-plane counters
+    from ray_tpu.runtime import core as _core
+    from ray_tpu.runtime.rpc import RpcClient
+
+    rt = _core.get_runtime()
+    gcs_probe = RpcClient(tuple(c.gcs_address), label="driver")
+    gcs_probe.call("actor_plane_stats", reset=True)
+    polls_before = getattr(rt, "_actor_get_polls", 0)
     t0 = time.perf_counter()
     actors = [A.remote(i) for i in range(n_actors)]
+    submit_s = time.perf_counter() - t0
+    if hasattr(rt, "_reg_drain"):
+        for a in actors:   # registration acks (cheap: set lookups)
+            rt._reg_drain(a._actor_id.hex())
+    register_s = time.perf_counter() - t0
     got = ray_tpu.get([a.who.remote() for a in actors])
     create_s = time.perf_counter() - t0
     assert got == list(range(n_actors))
+    plane = gcs_probe.call("actor_plane_stats")
+    gcs_probe.close()
     detail["actors_created_per_sec"] = round(n_actors / create_s, 1)
     detail["actor_create_elapsed_s"] = round(create_s, 1)
+    detail["creation_phases"] = {
+        "submit_s": round(submit_s, 3),
+        "register_s": round(register_s, 3),
+        "place_mean_ms": round(1e3 * plane["place_s"]
+                               / max(plane["placed"], 1), 2),
+        "ready_mean_ms": round(1e3 * plane["ready_s"]
+                               / max(plane["ready"], 1), 2),
+        "resolve_and_first_call_s": round(create_s - register_s, 3),
+        "register_batches": plane["register_batches"],
+        "register_batch_max": plane["register_batch_max"],
+        "host_batches": plane["host_batches"],
+        "host_batch_max": plane["host_batch_max"],
+        "ready_batches": plane["ready_batches"],
+    }
 
-    # steady state: every live actor answers again, round-robin
+    # steady state: every live actor answers again, round-robin; the
+    # location-resolve rate rides the warm pushed table (zero polls)
     calls = 4 * n_actors
     t0 = time.perf_counter()
     refs = [actors[i % n_actors].who.remote() for i in range(calls)]
     ray_tpu.get(refs)
-    detail["steady_actor_calls_per_sec"] = round(
-        calls / (time.perf_counter() - t0), 1)
+    steady_s = time.perf_counter() - t0
+    detail["steady_actor_calls_per_sec"] = round(calls / steady_s, 1)
+    t0 = time.perf_counter()
+    for a in actors:
+        rt._actor_location(a._actor_id.hex())
+    detail["actor_resolves_per_sec"] = round(
+        n_actors / max(time.perf_counter() - t0, 1e-9), 1)
+    detail["resolve_fallback_polls"] = (
+        getattr(rt, "_actor_get_polls", 0) - polls_before)
 
     for a in actors:
         ray_tpu.kill(a)

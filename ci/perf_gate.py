@@ -50,6 +50,12 @@ METRICS = {
     "envelope_actor_calls_per_sec": [
         ("detail", "envelope", "steady_actor_calls_per_sec"),
         ("detail", "steady_actor_calls_per_sec")],
+    # batched actor control plane (round 6): warm location-resolve rate
+    # off the pushed CH_ACTOR table (absent in pre-round-6 baselines:
+    # the gate skips keys either side lacks)
+    "envelope_actor_resolves_per_sec": [
+        ("detail", "envelope", "actor_resolves_per_sec"),
+        ("detail", "actor_resolves_per_sec")],
 }
 
 # train metric paths only exist in full-run docs; the train bench value

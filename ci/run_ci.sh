@@ -17,6 +17,9 @@ if [ "${1:-}" = "--nightly" ]; then
   stage "nightly fork-server envelope (10k actors via preforked zygotes)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_fork_envelope_nightly.py \
     -m nightly -q -s
+  stage "nightly actor control plane (40k actors through the batched plane)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_actor_plane_nightly.py \
+    -m nightly -q -s
   stage "nightly serve soak (paged engine page/refcount flatness)"
   python -m pytest tests/test_serve_soak_nightly.py -m nightly -q -s
   stage "nightly RL plane (pixel-obs throughput + learning)"

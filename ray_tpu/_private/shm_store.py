@@ -159,17 +159,26 @@ class _SegmentHandle:
     refers back), so plain refcounting — no cyclic GC — munmaps exactly
     when the last of {store object, escaped view} drops."""
 
-    __slots__ = ("_lib", "_h", "_closed")
+    __slots__ = ("_lib", "_h", "_closed", "cleanup_lock")
 
     def __init__(self, lib, h):
         self._lib = lib
         self._h = h
         self._closed = False
+        # Serializes munmap against the raylet's worker-death cleanup
+        # calls (release_pid/evict_orphans): those run on RPC threads
+        # and may still be inside the C store when teardown closes it —
+        # without this, close() unmaps the segment under a thread
+        # blocked on the in-segment mutex (observed SIGSEGV under
+        # actor kill-flood churn). Hot-path ops stay lock-free: views
+        # escaping past close are already the caller's contract.
+        self.cleanup_lock = threading.Lock()
 
     def close(self):
-        if not self._closed:
-            self._closed = True
-            self._lib.store_close(self._h)
+        with self.cleanup_lock:
+            if not self._closed:
+                self._closed = True
+                self._lib.store_close(self._h)
 
     def __del__(self):
         try:
@@ -330,11 +339,17 @@ class ShmObjectStore:
 
     def evict_orphans(self, pid: int = 0) -> int:
         """Reclaim unsealed entries of a dead writer pid (0 = any writer)."""
-        return self._lib.store_evict_orphans(self._h, pid)
+        with self._handle.cleanup_lock:
+            if self._handle._closed:
+                return 0
+            return self._lib.store_evict_orphans(self._h, pid)
 
     def release_pid(self, pid: int) -> int:
         """Drop all read refs held by a dead process (crash cleanup)."""
-        return self._lib.store_release_pid(self._h, pid)
+        with self._handle.cleanup_lock:
+            if self._handle._closed:
+                return 0
+            return self._lib.store_release_pid(self._h, pid)
 
     def spill_candidates(self, target_bytes: int, max_out: int = 512,
                          pin_pid: int = 0) -> list[bytes]:

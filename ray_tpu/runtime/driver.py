@@ -105,6 +105,38 @@ class ClusterRuntime:
         self._actor_outbox: dict[str, list] = {}
         self._actor_unacked: dict[str, int] = {}   # flow control (tasks)
         self._outbox_cv = threading.Condition()
+        # Registration coalescer (same shape as the ref flusher): N
+        # create_actor calls become one register_actors frame. Anonymous
+        # creations return as soon as the entry is enqueued; named ones
+        # wait for their per-entry ack (name-conflict stays a
+        # synchronous ValueError).
+        from ray_tpu.utils.config import get_config as _gcfg0
+        _pcfg = _gcfg0()
+        self._reg_outbox: list[dict] = []
+        self._reg_pending: set[str] = set()        # enqueued, unacked ids
+        self._reg_failed: dict[str, str] = {}      # async failures by id
+        self._reg_cv = threading.Condition()
+        self._reg_flusher_started = False
+        self._reg_linger_s = _pcfg.actor_register_linger_s
+        self._reg_batch_cap = max(1, _pcfg.actor_register_batch_size)
+        self._reg_window = max(1, _pcfg.actor_register_window)
+        # CH_ACTOR pushed location table (reference: the core worker's
+        # ActorInfoAccessor subscription — resolution is an event wait,
+        # not a get_actor poll storm against the locked GCS). Only
+        # top-level drivers subscribe: a pool of in-worker runtimes each
+        # drinking the full actor event firehose would multiply every
+        # creation flood by the worker count; workers resolve few actors
+        # and keep the cached-poll path.
+        self._actor_pubsub = (_pcfg.actor_pubsub_enabled
+                              and "RAY_TPU_WORKER_ID" not in _os.environ)
+        self._resolve_fallback_s = max(0.05, _pcfg.actor_resolve_fallback_s)
+        self._resolve_timeout_s = max(1.0, _pcfg.actor_resolve_timeout_s)
+        self._actor_table: dict[str, dict] = {}
+        self._actor_table_cv = threading.Condition()
+        self._actor_sub = None
+        self._actor_sub_lock = threading.Lock()
+        self._actor_get_polls = 0   # get_actor fallback polls (tested: 0
+                                    # once the pushed table is warm)
         self._named_cache: dict[str, str] = {}
         # cached per-address actor-call clients (see _actor_client)
         self._actor_clients: dict[tuple, RpcClient] = {}
@@ -1131,45 +1163,234 @@ class ClusterRuntime:
             # creation task can finish and release it
             self._ref_flush_now()
         strategy = _wire_strategy(spec)
-        self._gcs.call(
-            "register_actor", actor_id=actor_id.hex(), name=name,
-            creation_spec=creation,
-            resources=dict(spec.resources.resources),
-            max_restarts=spec.max_restarts,
-            pg_id=strategy.get("pg_id"),
-            namespace=ns,
-            owner_id=self.client_id if self._ref_enabled else None,
-            lifetime=lifetime)
+        entry = {
+            "kwargs": {
+                "actor_id": actor_id.hex(), "name": name,
+                "creation_spec": creation,
+                "resources": dict(spec.resources.resources),
+                "max_restarts": spec.max_restarts,
+                "pg_id": strategy.get("pg_id"),
+                "namespace": ns,
+                "owner_id": self.client_id if self._ref_enabled else None,
+                "lifetime": lifetime,
+            },
+            # named registrations stay synchronous: the name-conflict
+            # ValueError must surface from THIS call, not a later one
+            "ev": threading.Event() if name is not None else None,
+            "error": None,
+        }
+        # subscribe BEFORE the registration can produce events, so the
+        # alive push is never lost to the subscribe race
+        self._ensure_actor_sub()
+        with self._reg_cv:
+            while (len(self._reg_pending) >= self._reg_window
+                   and not self._closed):
+                self._reg_cv.wait(timeout=0.1)
+            self._reg_outbox.append(entry)
+            self._reg_pending.add(actor_id.hex())
+            self._reg_cv.notify_all()
+        self._ensure_reg_flusher()
+        if entry["ev"] is not None:
+            entry["ev"].wait(timeout=60.0)
+            if entry["error"] is not None:
+                raise ValueError(entry["error"])
         return actor_id
 
-    def _actor_location(self, actor_id_hex: str, timeout: float = 30.0):
+    # -- registration coalescer ----------------------------------------
+
+    def _ensure_reg_flusher(self):
+        if self._reg_flusher_started:
+            return
+        with self._reg_cv:
+            if self._reg_flusher_started:
+                return
+            self._reg_flusher_started = True
+        threading.Thread(target=self._reg_flush_loop, daemon=True,
+                         name="actor-register-flusher").start()
+
+    def _reg_flush_loop(self):
+        while not self._closed:
+            with self._reg_cv:
+                while not self._reg_outbox and not self._closed:
+                    self._reg_cv.wait(timeout=0.2)
+                if self._closed:
+                    batch = self._reg_outbox
+                    self._reg_outbox = []
+                else:
+                    batch = None
+            if batch is not None:   # shutdown: fail the stragglers
+                self._reg_fail_batch(batch, "runtime shut down")
+                return
+            if self._reg_linger_s > 0:
+                time.sleep(self._reg_linger_s)   # coalesce the burst
+            with self._reg_cv:
+                batch = self._reg_outbox[:self._reg_batch_cap]
+                self._reg_outbox = self._reg_outbox[self._reg_batch_cap:]
+            if not batch:
+                continue
+            try:
+                reply = self._gcs.call(
+                    "register_actors",
+                    actors=[e["kwargs"] for e in batch])
+                results = reply["results"]
+            except Exception as e:  # noqa: BLE001 - redial window burned
+                self._reg_fail_batch(batch, repr(e))
+                continue
+            with self._reg_cv:
+                for entry, res in zip(batch, results):
+                    aid = entry["kwargs"]["actor_id"]
+                    self._reg_pending.discard(aid)
+                    if not res.get("ok"):
+                        err = res.get("error", "registration failed")
+                        entry["error"] = err
+                        self._reg_failed[aid] = err
+                    if entry["ev"] is not None:
+                        entry["ev"].set()
+                self._reg_cv.notify_all()
+
+    def _reg_fail_batch(self, batch: list, err: str):
+        with self._reg_cv:
+            for entry in batch:
+                aid = entry["kwargs"]["actor_id"]
+                self._reg_pending.discard(aid)
+                entry["error"] = err
+                self._reg_failed[aid] = err
+                if entry["ev"] is not None:
+                    entry["ev"].set()
+            self._reg_cv.notify_all()
+
+    def _reg_drain(self, actor_id_hex: str, timeout: float = 10.0):
+        """Block until this actor's registration frame has been acked
+        (ordering guard for kill/lookup racing the coalescer)."""
+        deadline = time.monotonic() + timeout
+        with self._reg_cv:
+            while (actor_id_hex in self._reg_pending
+                   and time.monotonic() < deadline and not self._closed):
+                self._reg_cv.wait(timeout=0.1)
+
+    # -- pushed actor-location table (CH_ACTOR subscription) -----------
+
+    def _ensure_actor_sub(self) -> bool:
+        if not self._actor_pubsub or self._closed:
+            return False
+        if self._actor_sub is not None:
+            return True
+        with self._actor_sub_lock:
+            if self._actor_sub is None and not self._closed:
+                from ray_tpu.runtime.rpc import PushSubscriber
+
+                self._actor_sub = PushSubscriber(
+                    self.gcs_address,
+                    {"method": "subscribe", "channels": ["actor"]},
+                    self._on_actor_event,
+                    reconnect=True,   # survive a GCS restart
+                    label="driver")
+        return True
+
+    def _on_actor_event(self, msg: dict):
+        events = msg.get("batch") or (msg,)
+        with self._actor_table_cv:
+            for ev in events:
+                aid = ev.get("actor_id")
+                kind = ev.get("event")
+                if aid is None or kind is None:
+                    continue
+                if kind == "alive":
+                    self._actor_table[aid] = {
+                        "state": "ALIVE",
+                        "address": ev.get("address"),
+                        "push_addr": ev.get("push_addr"),
+                        "num_restarts": ev.get("num_restarts", 0)}
+                elif kind == "restarting":
+                    self._actor_table[aid] = {"state": "RESTARTING"}
+                    self._actor_locations.pop(aid, None)
+                elif kind == "dead":
+                    self._actor_table[aid] = {
+                        "state": "DEAD",
+                        "death_reason": ev.get("reason", "dead")}
+                    self._actor_locations.pop(aid, None)
+            self._actor_table_cv.notify_all()
+
+    def _install_location(self, actor_id_hex: str, addr, num_restarts):
+        entry = (tuple(addr), num_restarts)
+        with self._seq_lock:
+            if self._actor_seq_inc.get(actor_id_hex) != entry[1]:
+                self._actor_seq[actor_id_hex] = 0
+                self._actor_seq_inc[actor_id_hex] = entry[1]
+            self._actor_locations[actor_id_hex] = entry
+        return entry
+
+    def _actor_location(self, actor_id_hex: str,
+                        timeout: float | None = None):
         """(address, incarnation) of an ALIVE actor — the DIRECT worker
         push port when the actor has one (reference:
         DirectActorTaskSubmitter dials the actor process, no raylet hop),
         else its raylet. Caches, and resets the caller-side sequence
         numbering when a new incarnation is observed (restarted actors
-        start their ordering from 0)."""
+        start their ordering from 0).
+
+        Steady state is pubsub-driven: waits on the CH_ACTOR pushed
+        table; a counted get_actor poll fires only after a quiet
+        ``actor_resolve_fallback_s`` window (events published before the
+        subscription landed, or lost across a redial)."""
         cached = self._actor_locations.get(actor_id_hex)
         if cached is not None:
             return cached
+        if timeout is None:
+            timeout = self._resolve_timeout_s
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            info = self._gcs.call("get_actor", actor_id=actor_id_hex)
-            if info is None:
-                raise exc.ActorDiedError(actor_id_hex, "unknown actor")
-            if info["state"] == "ALIVE":
-                addr = info.get("push_addr") or info["address"]
-                entry = (tuple(addr), info.get("num_restarts", 0))
-                with self._seq_lock:
-                    if self._actor_seq_inc.get(actor_id_hex) != entry[1]:
-                        self._actor_seq[actor_id_hex] = 0
-                        self._actor_seq_inc[actor_id_hex] = entry[1]
-                    self._actor_locations[actor_id_hex] = entry
-                return entry
-            if info["state"] == "DEAD":
-                raise exc.ActorDiedError(actor_id_hex,
-                                         info.get("death_reason", "dead"))
-            time.sleep(0.02)
+        use_push = self._ensure_actor_sub()
+        poll_at = (time.monotonic() + self._resolve_fallback_s
+                   if use_push else time.monotonic())
+        while True:
+            if use_push:
+                with self._actor_table_cv:
+                    ent = self._actor_table.get(actor_id_hex)
+                if ent is not None:
+                    if ent["state"] == "ALIVE":
+                        addr = ent.get("push_addr") or ent.get("address")
+                        if addr is not None:
+                            return self._install_location(
+                                actor_id_hex, addr,
+                                ent.get("num_restarts", 0))
+                    elif ent["state"] == "DEAD":
+                        raise exc.ActorDiedError(
+                            actor_id_hex,
+                            ent.get("death_reason", "dead"))
+                err = self._reg_failed.get(actor_id_hex)
+                if err is not None:
+                    raise exc.ActorDiedError(actor_id_hex, err)
+            now = time.monotonic()
+            if now >= deadline:
+                break
+            if now >= poll_at:
+                # fallback poll — the regression test asserts this
+                # counter stays flat once the pushed table is warm
+                self._actor_get_polls += 1
+                info = self._gcs.call("get_actor", actor_id=actor_id_hex)
+                if info is None:
+                    if actor_id_hex in self._reg_pending:
+                        # still queued in the coalescer: not an error
+                        poll_at = now + self._resolve_fallback_s
+                        continue
+                    raise exc.ActorDiedError(actor_id_hex,
+                                             "unknown actor")
+                if info["state"] == "ALIVE":
+                    addr = info.get("push_addr") or info["address"]
+                    return self._install_location(
+                        actor_id_hex, addr, info.get("num_restarts", 0))
+                if info["state"] == "DEAD":
+                    raise exc.ActorDiedError(
+                        actor_id_hex, info.get("death_reason", "dead"))
+                poll_at = now + (self._resolve_fallback_s if use_push
+                                 else 0.02)
+                if not use_push:
+                    time.sleep(0.02)
+                continue
+            if use_push:
+                with self._actor_table_cv:
+                    self._actor_table_cv.wait(
+                        timeout=min(0.2, deadline - now, poll_at - now))
         raise exc.ActorUnavailableError(
             f"actor {actor_id_hex[:8]} not ALIVE within {timeout}s")
 
@@ -1535,6 +1756,9 @@ class ClusterRuntime:
                          name="actor-submit-flusher").start()
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        # a kill racing the registration coalescer would find no actor
+        # at the GCS and silently no-op — drain this id's frame first
+        self._reg_drain(actor_id.hex())
         self._gcs.call("kill_actor", actor_id=actor_id.hex(),
                        no_restart=no_restart)
         entry = self._actor_locations.pop(actor_id.hex(), None)
@@ -1582,8 +1806,15 @@ class ClusterRuntime:
             self._refs.remove_serialize_hook(self._memstore_serialize_hook)
             self._memstore.clear()
         self._closed = True
+        with self._reg_cv:
+            self._reg_cv.notify_all()   # reg flusher drains + exits
         if self._log_sub is not None:
             self._log_sub.close()
+        if self._actor_sub is not None:
+            try:
+                self._actor_sub.close()
+            except Exception:  # noqa: BLE001
+                pass
         self._leases.stop()
         # grace for pusher threads already past their _closed checks to
         # finish touching the store before it unmaps

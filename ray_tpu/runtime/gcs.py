@@ -18,6 +18,7 @@ from __future__ import annotations
 import socket
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -221,11 +222,37 @@ class GcsServer(RpcServer):
         self._max_lost_objects = 100_000
         self._pgs: dict[str, PlacementGroupInfo] = {}
         self._jobs: dict[str, dict] = {}
-        # cached host_actor channels, one per raylet (see _schedule_actor)
+        # cached host_actors channels, one per raylet (see _place_batch)
         self._placement_clients: dict[tuple, Any] = {}
         self._placement_lock = threading.Lock()
+        # Bounded placement executor (reference: GcsActorScheduler's
+        # shared io_context — NOT thread-per-actor): host_actors batches
+        # queue here; at most gcs_placement_pool_size workers drain it.
+        from ray_tpu.utils.config import get_config as _gcfg
+        _pcfg = _gcfg()
+        self._place_pool_size = max(1, _pcfg.gcs_placement_pool_size)
+        self._place_batch_cap = max(1, _pcfg.gcs_placement_batch_size)
+        self._place_queue: deque = deque()
+        self._place_cv = threading.Condition()
+        self._place_threads: list[threading.Thread] = []
         # pubsub: channel -> list of (conn, send_lock)
         self._subs: dict[str, list] = {}
+        # CH_ACTOR per-subscriber coalescing: actor events buffer per
+        # held conn and a flusher ships ONE framed batch per subscriber
+        # per window — rpc_actor_ready no longer pays an inline send_msg
+        # per actor per subscriber under a creation flood.
+        self._pub_flush_s = _pcfg.actor_pubsub_flush_s
+        self._pub_buf: dict[int, tuple] = {}   # id(conn) -> (conn, lock, [msgs])
+        self._pub_cv = threading.Condition()
+        # creation-phase decomposition (register -> place -> ready),
+        # cumulative; actor_id -> (t_register, t_placed) while in flight
+        self._plane = {
+            "register_batches": 0, "register_actors": 0,
+            "register_batch_max": 0, "host_batches": 0, "host_actors": 0,
+            "host_batch_max": 0, "ready_batches": 0, "ready_actors": 0,
+            "place_s": 0.0, "placed": 0, "ready_s": 0.0, "ready": 0,
+        }
+        self._plane_t: dict[str, list] = {}
         self._hb_timeout = heartbeat_timeout_s
         # --- distributed refcounting (reference: reference_count.h:61;
         # centralized here to match the centralized object directory).
@@ -313,6 +340,13 @@ class GcsServer(RpcServer):
                 self._actors.pop(key, None)
             else:
                 self._actors[key] = ActorInfo(**payload)
+        elif kind == "actors":
+            # one record per registration/ready BATCH (the batched plane
+            # appends one WAL record for N actors, not N records)
+            for actor in payload.get("actors", ()):
+                self._actors[actor["actor_id"]] = ActorInfo(**actor)
+            for nkey, aid in payload.get("named", {}).items():
+                self._named_actors[nkey] = aid
         elif kind == "named":
             if payload is None:
                 self._named_actors.pop(key, None)
@@ -407,6 +441,21 @@ class GcsServer(RpcServer):
 
         self._log("actor", actor.actor_id, asdict(actor))
 
+    def _log_actors(self, actors: list, named: dict | None = None):
+        """One WAL record per BATCH of actor upserts (the batched
+        registration/ready paths must not pay one append+flush per
+        actor)."""
+        from dataclasses import asdict
+
+        if not actors and not named:
+            return
+        if len(actors) == 1 and not named:
+            self._log_actor(actors[0])
+            return
+        self._log("actors", None, {
+            "actors": [asdict(a) for a in actors],
+            "named": dict(named or {})})
+
     def _restore_reconcile(self):
         """Post-restart reconciliation (reference: GcsInitData load then
         reconcile against re-registering raylets): give live raylets one
@@ -439,6 +488,8 @@ class GcsServer(RpcServer):
     def start(self):
         super().start()
         self._health_thread.start()
+        threading.Thread(target=self._pub_flush_loop, daemon=True,
+                         name="gcs-pub-flusher").start()
         if self._persist is not None:
             threading.Thread(target=self._snapshot_loop,
                              daemon=True).start()
@@ -451,6 +502,10 @@ class GcsServer(RpcServer):
 
     def stop(self):
         super().stop()
+        with self._place_cv:
+            self._place_cv.notify_all()   # placement workers exit
+        with self._pub_cv:
+            self._pub_cv.notify_all()     # pub flusher exits
         with self._placement_lock:
             clients, self._placement_clients = \
                 dict(self._placement_clients), {}
@@ -473,7 +528,13 @@ class GcsServer(RpcServer):
     def rpc_subscribe(self, conn, send_lock, *, channels: list):
         with self._lock:
             for ch in channels:
-                self._subs.setdefault(ch, []).append((conn, send_lock))
+                subs = self._subs.setdefault(ch, [])
+                # dedupe per (conn, channel): a re-subscribe after a
+                # redial races the old entry's cleanup on the SAME held
+                # conn — appending unconditionally double-delivered
+                # every message to that subscriber
+                if not any(c is conn for c, _ in subs):
+                    subs.append((conn, send_lock))
         send_msg(conn, {"subscribed": channels}, send_lock)
         return RpcServer.HELD
 
@@ -489,8 +550,48 @@ class GcsServer(RpcServer):
         message = {"channel": channel, **message}
         with self._lock:
             subs = list(self._subs.get(channel, []))
+        if not subs:
+            return
+        if channel == CH_ACTOR and self._pub_flush_s > 0:
+            # coalesce: buffer per subscriber, flusher ships one framed
+            # batch per window — the publisher (often rpc_actor_ready
+            # under the creation flood) never blocks on N sockets
+            with self._pub_cv:
+                for conn, send_lock in subs:
+                    ent = self._pub_buf.get(id(conn))
+                    if ent is None:
+                        self._pub_buf[id(conn)] = (conn, send_lock,
+                                                   [message])
+                    else:
+                        ent[2].append(message)
+                self._pub_cv.notify_all()
+            return
+        self._send_to_subs([(conn, lk, message) for conn, lk in subs])
+
+    def _pub_flush_loop(self):
+        while not self._stopping:
+            with self._pub_cv:
+                while not self._pub_buf and not self._stopping:
+                    self._pub_cv.wait(0.5)
+                if self._stopping:
+                    return
+            time.sleep(self._pub_flush_s)   # coalesce the burst
+            with self._pub_cv:
+                buf, self._pub_buf = self._pub_buf, {}
+            sends = []
+            for conn, send_lock, msgs in buf.values():
+                if len(msgs) == 1:
+                    sends.append((conn, send_lock, msgs[0]))
+                else:
+                    sends.append((conn, send_lock,
+                                  {"channel": CH_ACTOR, "batch": msgs}))
+            self._send_to_subs(sends)
+
+    def _send_to_subs(self, sends: list):
+        """Deliver one message per (conn, send_lock, message) triple;
+        dead conns are stripped from every channel and released."""
         dead = []
-        for conn, send_lock in subs:
+        for conn, send_lock, message in sends:
             try:
                 send_msg(conn, message, send_lock)
             except OSError:
@@ -667,116 +768,252 @@ class GcsServer(RpcServer):
     # actors (reference: GcsActorManager + GcsActorScheduler)
     # ------------------------------------------------------------------
 
-    def rpc_register_actor(self, conn, send_lock, *, actor_id, name,
-                           creation_spec, resources, max_restarts,
-                           pg_id=None, namespace=None, owner_id=None,
-                           lifetime=None):
+    def _register_one_locked(self, *, actor_id, name, creation_spec,
+                             resources, max_restarts, pg_id=None,
+                             namespace=None, owner_id=None,
+                             lifetime=None):
+        """Per-actor registration core (caller holds self._lock; caller
+        logs). Returns (result_dict, created: ActorInfo | None,
+        named_key: str | None)."""
         namespace = namespace or "default"
         # owner-scoped lifetime (reference: actor.py:524 + gcs_actor_
         # manager.cc:632): default actors die with their owner client;
         # lifetime="detached" (or an ownerless registration) opts out
         detached = (lifetime == "detached") or owner_id is None
+        # idempotent by actor_id: a retried registration (the reply
+        # was lost to a partition, or the delivery was duplicated)
+        # acks the registration that already exists instead of
+        # rejecting its own name as taken
+        existing = self._actors.get(actor_id)
+        if existing is not None and existing.state != "DEAD":
+            return ({"ok": True, "node_id": existing.node_id},
+                    None, None)
+        named_key = None
+        if name is not None:
+            key = _ns_key(namespace, name)
+            if self._named_actors.get(key, actor_id) != actor_id:
+                return ({"ok": False,
+                         "error": f"Actor name {name!r} already taken "
+                                  f"in namespace {namespace!r}"},
+                        None, None)
+            self._named_actors[key] = actor_id
+            named_key = key
+        actor = ActorInfo(
+            actor_id=actor_id, name=name, namespace=namespace,
+            state="PENDING",
+            creation_spec=creation_spec, resources=dict(resources),
+            max_restarts=max_restarts, pg_id=pg_id,
+            owner_id=owner_id, detached=detached,
+        )
+        self._actors[actor_id] = actor
+        self._plane_t[actor_id] = [time.monotonic(), 0.0]
+        return ({"ok": True}, actor, named_key)
+
+    def rpc_register_actor(self, conn, send_lock, *, actor_id, name,
+                           creation_spec, resources, max_restarts,
+                           pg_id=None, namespace=None, owner_id=None,
+                           lifetime=None):
         with self._lock:
-            # idempotent by actor_id: a retried registration (the reply
-            # was lost to a partition, or the delivery was duplicated)
-            # acks the registration that already exists instead of
-            # rejecting its own name as taken
-            existing = self._actors.get(actor_id)
-            if existing is not None and existing.state != "DEAD":
-                return {"ok": True, "node_id": existing.node_id}
-            if name is not None:
-                key = _ns_key(namespace, name)
-                if self._named_actors.get(key, actor_id) != actor_id:
-                    raise ValueError(
-                        f"Actor name {name!r} already taken in namespace "
-                        f"{namespace!r}")
-                self._named_actors[key] = actor_id
-            self._actors[actor_id] = ActorInfo(
-                actor_id=actor_id, name=name, namespace=namespace,
-                state="PENDING",
-                creation_spec=creation_spec, resources=dict(resources),
+            result, created, named_key = self._register_one_locked(
+                actor_id=actor_id, name=name,
+                creation_spec=creation_spec, resources=resources,
                 max_restarts=max_restarts, pg_id=pg_id,
-                owner_id=owner_id, detached=detached,
-            )
-            self._log_actor(self._actors[actor_id])
-            if name is not None:
-                self._log("named", _ns_key(namespace, name), actor_id)
+                namespace=namespace, owner_id=owner_id,
+                lifetime=lifetime)
+            if created is not None:
+                self._log_actor(created)
+            if named_key is not None:
+                self._log("named", named_key, actor_id)
+        if not result["ok"]:
+            raise ValueError(result["error"])
+        if created is None:
+            return result
         node_id = self._schedule_actor(actor_id)
         return {"ok": True, "node_id": node_id}
 
-    def _schedule_actor(self, actor_id: str) -> str | None:
-        """Pick a node for the actor and ask its raylet to host it
-        (reference: GcsActorScheduler::Schedule, ScheduleByGcs)."""
+    def rpc_register_actors(self, conn, send_lock, *, actors: list):
+        """Batched registration (the driver-side coalescer's frame): ONE
+        lock hold and ONE WAL record for the whole batch, per-actor
+        idempotency/name-conflict results so one bad entry cannot fail
+        its neighbors, then batch scheduling."""
+        results = []
+        to_schedule = []
         with self._lock:
-            actor = self._actors.get(actor_id)
-            if actor is None or actor.state == "DEAD":
-                return None
-            pg = self._pgs.get(actor.pg_id) if actor.pg_id else None
-            node_id = self._pick_node(actor.resources, pg=pg)
-            if node_id is None:
-                actor.state = "DEAD"
-                actor.death_reason = (
-                    f"no node can host actor resources {actor.resources}"
-                )
-                name = actor.name
-                spec = None
-            else:
-                actor.node_id = node_id
-                node = self._nodes[node_id]
-                spec = actor.creation_spec
-            self._log_actor(actor)
-        if node_id is None:
+            created_infos, named = [], {}
+            for ent in actors:
+                result, created, named_key = \
+                    self._register_one_locked(**ent)
+                results.append(result)
+                if created is not None:
+                    created_infos.append(created)
+                    to_schedule.append(created.actor_id)
+                if named_key is not None:
+                    named[named_key] = ent["actor_id"]
+            self._log_actors(created_infos, named)
+            self._plane["register_batches"] += 1
+            self._plane["register_actors"] += len(actors)
+            self._plane["register_batch_max"] = max(
+                self._plane["register_batch_max"], len(actors))
+        node_ids = self._schedule_actors(to_schedule)
+        for result, ent in zip(results, actors):
+            if result["ok"] and "node_id" not in result:
+                result["node_id"] = node_ids.get(ent["actor_id"])
+        return {"results": results}
+
+    def _schedule_actor(self, actor_id: str) -> str | None:
+        return self._schedule_actors([actor_id]).get(actor_id)
+
+    def _schedule_actors(self, actor_ids: list) -> dict:
+        """Pick nodes for a batch of actors under ONE lock hold, group
+        host requests per target raylet, and hand the batches to the
+        bounded placement executor (reference: GcsActorScheduler::
+        Schedule, ScheduleByGcs — no thread-per-actor)."""
+        if not actor_ids:
+            return {}
+        results: dict[str, str | None] = {}
+        assigned: dict[tuple, list] = {}   # raylet addr -> [(id, spec, inc)]
+        unschedulable: list[str] = []
+        with self._lock:
+            occupancy: dict[str, int] = {}
+            for a in self._actors.values():
+                if a.node_id and a.state in ("PENDING", "ALIVE",
+                                             "RESTARTING"):
+                    occupancy[a.node_id] = occupancy.get(a.node_id, 0) + 1
+            dirty = []
+            for actor_id in actor_ids:
+                actor = self._actors.get(actor_id)
+                if actor is None or actor.state == "DEAD":
+                    results[actor_id] = None
+                    continue
+                pg = self._pgs.get(actor.pg_id) if actor.pg_id else None
+                node_id = self._pick_node(actor.resources, pg=pg,
+                                          occupancy=occupancy)
+                if node_id is None:
+                    actor.state = "DEAD"
+                    actor.death_reason = (
+                        f"no node can host actor resources "
+                        f"{actor.resources}")
+                    self._plane_t.pop(actor_id, None)
+                    unschedulable.append(actor_id)
+                    results[actor_id] = None
+                else:
+                    actor.node_id = node_id
+                    occupancy[node_id] = occupancy.get(node_id, 0) + 1
+                    addr = tuple(self._nodes[node_id].address)
+                    assigned.setdefault(addr, []).append(
+                        (actor_id, actor.creation_spec,
+                         actor.num_restarts))
+                    results[actor_id] = node_id
+                dirty.append(actor)
+            self._log_actors(dirty)
+        for actor_id in unschedulable:
             self.publish(CH_ACTOR, {"event": "dead", "actor_id": actor_id,
                                     "reason": "unschedulable"})
-            return None
-        # Ask the raylet to host the actor (fire on a thread: raylet may
-        # itself call back into GCS during creation). The client is
-        # CACHED per raylet address — a 2k-actor flood through fresh
-        # sockets (connect + reader thread each) made placement the GCS
-        # bottleneck at the envelope tier.
-        incarnation = actor.num_restarts
+        if assigned:
+            with self._place_cv:
+                for addr, batch in assigned.items():
+                    for i in range(0, len(batch), self._place_batch_cap):
+                        self._place_queue.append(
+                            (addr, batch[i:i + self._place_batch_cap]))
+                self._ensure_placement_workers_locked()
+                self._place_cv.notify_all()
+        return results
 
-        def _place():
-            from ray_tpu.runtime.rpc import ConnectionLost
-            addr = tuple(node.address)
-            last_err: Exception | None = None
-            for attempt in (0, 1):
-                client = None
-                try:
-                    client = self._placement_client(addr)
-                    client.call("host_actor", actor_id=actor_id, spec=spec,
-                                incarnation=incarnation)
+    def _ensure_placement_workers_locked(self):
+        """Lazily grow the placement pool up to its cap (caller holds
+        _place_cv). The pool is the ONLY source of host_actors RPCs —
+        bounded by flag, asserted by test."""
+        self._place_threads = [t for t in self._place_threads
+                               if t.is_alive()]
+        want = min(self._place_pool_size, len(self._place_queue))
+        while len(self._place_threads) < want:
+            t = threading.Thread(
+                target=self._placement_worker, daemon=True,
+                name=f"gcs-place-{len(self._place_threads)}")
+            self._place_threads.append(t)
+            t.start()
+
+    def _placement_worker(self):
+        while True:
+            with self._place_cv:
+                while not self._place_queue and not self._stopping:
+                    self._place_cv.wait(0.5)
+                if self._stopping:
                     return
-                except (OSError, ConnectionLost) as e:
-                    # transport death only: an APPLICATION error (e.g. a
-                    # lost resource race re-raised by the handler) must
-                    # not close the SHARED channel under other in-flight
-                    # placements pipelined on it. One RST drains EVERY
-                    # call pipelined on the cached channel with
-                    # ConnectionLost — retry once on a fresh dial so a
-                    # transient break doesn't permanently kill all
-                    # concurrent placements (safe: host_actor dedups on
-                    # (actor_id, incarnation) raylet-side).
-                    last_err = e
-                    if client is not None:
-                        # evict only OUR dead client: a concurrent retry
-                        # may already have installed a healthy fresh
-                        # channel at this address — popping that would
-                        # kill its pipelined in-flight placements
-                        with self._placement_lock:
-                            if self._placement_clients.get(addr) is client:
-                                self._placement_clients.pop(addr, None)
-                        try:
-                            client.close()
-                        except OSError:
-                            pass
-                except Exception as e:  # noqa: BLE001
-                    last_err = e
-                    break
-            self._on_actor_failure_id(
-                actor_id, f"placement failed: {last_err!r}")
-        threading.Thread(target=_place, daemon=True).start()
-        return node_id
+                addr, batch = self._place_queue.popleft()
+            try:
+                self._place_batch(addr, batch)
+            except Exception:  # noqa: BLE001 - worker must survive
+                pass
+
+    def _place_batch(self, addr: tuple, batch: list):
+        """Ship one host_actors frame to one raylet over the cached
+        placement channel; per-actor results feed the failure path. The
+        client is CACHED per raylet address — a 2k-actor flood through
+        fresh sockets (connect + reader thread each) made placement the
+        GCS bottleneck at the envelope tier."""
+        from ray_tpu.runtime.rpc import ConnectionLost
+        wire = [{"actor_id": a, "spec": s, "incarnation": i}
+                for a, s, i in batch]
+        last_err: Exception | None = None
+        reply = None
+        for _attempt in (0, 1):
+            client = None
+            try:
+                client = self._placement_client(addr)
+                reply = client.call("host_actors", actors=wire)
+                break
+            except (OSError, ConnectionLost) as e:
+                # transport death only: an APPLICATION error must not
+                # close the SHARED channel under other in-flight
+                # placements pipelined on it. One RST drains EVERY call
+                # pipelined on the cached channel with ConnectionLost —
+                # retry once on a fresh dial so a transient break
+                # doesn't permanently kill all concurrent placements
+                # (safe: host_actor dedups on (actor_id, incarnation)
+                # raylet-side).
+                last_err = e
+                if client is not None:
+                    # evict only OUR dead client: a concurrent retry
+                    # may already have installed a healthy fresh
+                    # channel at this address — popping that would
+                    # kill its pipelined in-flight placements
+                    with self._placement_lock:
+                        if self._placement_clients.get(addr) is client:
+                            self._placement_clients.pop(addr, None)
+                    try:
+                        client.close()
+                    except OSError:
+                        pass
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+                break
+        if reply is None:
+            for actor_id, _spec, _inc in batch:
+                self._on_actor_failure_id(
+                    actor_id, f"placement failed: {last_err!r}")
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._plane["host_batches"] += 1
+            self._plane["host_actors"] += len(batch)
+            self._plane["host_batch_max"] = max(
+                self._plane["host_batch_max"], len(batch))
+            for actor_id, _spec, _inc in batch:
+                t = self._plane_t.get(actor_id)
+                if t is not None:
+                    self._plane["place_s"] += now - t[0]
+                    self._plane["placed"] += 1
+                    t[1] = now
+        failed = []
+        for (actor_id, _spec, _inc), res in zip(batch,
+                                                reply.get("results", ())):
+            if not res.get("ok"):
+                failed.append((actor_id,
+                               res.get("error", "host_actor failed")))
+        for actor_id, err in failed:
+            self._on_actor_failure_id(actor_id,
+                                      f"placement failed: {err}")
 
     def _placement_client(self, addr: tuple):
         from ray_tpu.runtime.rpc import RpcClient
@@ -795,17 +1032,62 @@ class GcsServer(RpcServer):
 
     def rpc_actor_ready(self, conn, send_lock, *, actor_id, node_id,
                         push_addr=None):
+        reply = self.rpc_actors_ready(
+            conn, send_lock, node_id=node_id,
+            actors=[{"actor_id": actor_id, "push_addr": push_addr}])
+        return reply["results"][0]
+
+    def rpc_actors_ready(self, conn, send_lock, *, node_id, actors: list):
+        """Batched ready acks from one raylet: one lock hold + one WAL
+        record per batch; the alive events carry the full location
+        (address/push_addr/incarnation) so a pubsub-driven driver never
+        needs a get_actor round trip to resolve."""
+        results = []
+        events = []
+        now = time.monotonic()
         with self._lock:
-            actor = self._actors.get(actor_id)
-            if actor is None:
-                return {"ok": False}
-            actor.state = "ALIVE"
-            actor.node_id = node_id
-            actor.push_addr = tuple(push_addr) if push_addr else None
-            self._log_actor(actor)
-        self.publish(CH_ACTOR, {"event": "alive", "actor_id": actor_id,
-                                "node_id": node_id})
-        return {"ok": True}
+            node = self._nodes.get(node_id)
+            node_addr = tuple(node.address) if node else None
+            dirty = []
+            for ent in actors:
+                actor_id = ent["actor_id"]
+                push_addr = ent.get("push_addr")
+                actor = self._actors.get(actor_id)
+                if actor is None:
+                    results.append({"ok": False})
+                    continue
+                actor.state = "ALIVE"
+                actor.node_id = node_id
+                actor.push_addr = tuple(push_addr) if push_addr else None
+                dirty.append(actor)
+                results.append({"ok": True})
+                t = self._plane_t.pop(actor_id, None)
+                if t is not None:
+                    self._plane["ready_s"] += now - (t[1] or t[0])
+                    self._plane["ready"] += 1
+                events.append({"event": "alive", "actor_id": actor_id,
+                               "node_id": node_id, "address": node_addr,
+                               "push_addr": actor.push_addr,
+                               "num_restarts": actor.num_restarts})
+            self._log_actors(dirty)
+            self._plane["ready_batches"] += 1
+            self._plane["ready_actors"] += len(actors)
+        for ev in events:
+            self.publish(CH_ACTOR, ev)
+        return {"results": results}
+
+    def rpc_actor_plane_stats(self, conn, send_lock, *, reset=False):
+        """Creation-plane counters + phase decomposition (cumulative
+        seconds and counts for register->place and place->ready; the
+        envelope probe divides for per-phase means)."""
+        with self._lock:
+            stats = dict(self._plane)
+            stats["in_flight"] = len(self._plane_t)
+            if reset:
+                for k in self._plane:
+                    self._plane[k] = 0.0 if isinstance(
+                        self._plane[k], float) else 0
+            return stats
 
     def rpc_actor_failed(self, conn, send_lock, *, actor_id, reason):
         with self._lock:
@@ -835,6 +1117,7 @@ class GcsServer(RpcServer):
             else:
                 actor.state = "DEAD"
                 actor.death_reason = reason
+                self._plane_t.pop(actor.actor_id, None)
                 if actor.name:
                     key = _ns_key(actor.namespace, actor.name)
                     self._named_actors.pop(key, None)
@@ -907,7 +1190,8 @@ class GcsServer(RpcServer):
     # ------------------------------------------------------------------
 
     def _pick_node(self, demand: dict, pg: PlacementGroupInfo | None = None,
-                   exclude: set | None = None) -> str | None:
+                   exclude: set | None = None,
+                   occupancy: dict | None = None) -> str | None:
         # zero-valued entries (num_cpus=0 actors arrive as {"CPU": 0.0})
         # are not demand: they must take the occupancy-spread path below,
         # not ride the resource-driven policy to node[0] forever
@@ -940,18 +1224,23 @@ class GcsServer(RpcServer):
                 [n.alive for n in nodes],
                 exclude or set(), demand,
                 spread_threshold=0.0, top_k=1)
-        occupancy: dict[str, int] = {}
-        if not demand:
-            # zero-resource demands tie on utilization everywhere, so
-            # live-actor occupancy is the spread signal (reference:
-            # GcsActorScheduler spreads; without it an envelope flood
-            # stacks all 2,000 actors on node[0]). Recomputed per pick —
-            # drift-free vs incremental counts across the many death
-            # paths, and only empty-demand picks pay the O(actors) scan.
-            for a in self._actors.values():
-                if a.node_id and a.state in ("PENDING", "ALIVE",
-                                             "RESTARTING"):
-                    occupancy[a.node_id] = occupancy.get(a.node_id, 0) + 1
+        if occupancy is None:
+            occupancy = {}
+            if not demand:
+                # zero-resource demands tie on utilization everywhere, so
+                # live-actor occupancy is the spread signal (reference:
+                # GcsActorScheduler spreads; without it an envelope flood
+                # stacks all 2,000 actors on node[0]). Recomputed per pick
+                # — drift-free vs incremental counts across the many death
+                # paths, and only empty-demand picks pay the O(actors)
+                # scan. Batch scheduling passes a precomputed dict it
+                # maintains incrementally (one scan per BATCH, not per
+                # actor — per-pick rescans are O(n^2) at the 40k tier).
+                for a in self._actors.values():
+                    if a.node_id and a.state in ("PENDING", "ALIVE",
+                                                 "RESTARTING"):
+                        occupancy[a.node_id] = \
+                            occupancy.get(a.node_id, 0) + 1
         best, best_score = None, None
         feasible_busy, busy_load = None, None
         for n in self._nodes.values():

@@ -120,6 +120,13 @@ class Raylet(RpcServer):
             self.resource_syncer.mark_changed
         self._mem_threshold = _cfg.memory_usage_threshold
         self._mem_refresh_s = max(_cfg.memory_monitor_refresh_ms, 50) / 1e3
+        # actor_ready acks coalesce here: worker ready messages buffer
+        # and a flusher ships ONE actors_ready batch to the GCS per
+        # linger window (was one GCS call per worker message — an actor
+        # flood paid a full control-plane RTT per actor)
+        self._ready_buf: list[dict] = []
+        self._ready_cv = threading.Condition()
+        self._ready_linger_s = _cfg.actor_ready_linger_s
         self.objects = LocalObjectManager(
             self, store=self.store, store_capacity=store_capacity, cfg=_cfg)
 
@@ -173,7 +180,8 @@ class Raylet(RpcServer):
                  self.workers.monitor_loop, self.scheduler.infeasible_loop,
                  self.objects.location_flush_loop,
                  self._log_monitor_loop,
-                 self.workers.prestart_policy_loop]
+                 self.workers.prestart_policy_loop,
+                 self._ready_flush_loop]
         if self.objects.spill_enabled:
             loops.append(self.objects.spill_loop)
         if self._mem_threshold > 0:
@@ -315,6 +323,8 @@ class Raylet(RpcServer):
         super().stop()
         self.objects.stop()
         self.scheduler.stop()
+        with self._ready_cv:
+            self._ready_cv.notify_all()   # ready flusher exits
         # join background loops BEFORE closing the store: a mid-tick spill
         # loop dereferencing the munmapped segment is a segfault, not an
         # exception
@@ -676,6 +686,55 @@ class Raylet(RpcServer):
                                reason=reason)
         threading.Thread(target=_deliver, daemon=True).start()
         return {"ok": True}
+
+    def rpc_host_actors(self, conn, send_lock, *, actors: list):
+        """Batched placement frame from the GCS executor: host each
+        actor through the idempotent single-actor path, replying
+        per-actor outcomes so one infeasible entry cannot fail its
+        batch-mates (the GCS feeds failures to the restart/death path
+        individually)."""
+        results = []
+        for ent in actors:
+            try:
+                res = self.rpc_host_actor(
+                    None, None, actor_id=ent["actor_id"],
+                    spec=ent["spec"],
+                    incarnation=ent.get("incarnation", 0))
+                results.append(res)
+            except Exception as e:  # noqa: BLE001 - per-actor outcome
+                results.append({"ok": False, "error": repr(e)})
+        return {"results": results}
+
+    def queue_actor_ready(self, actor_id: str, push_addr):
+        """Buffer one worker's actor_ready for the batched GCS ack."""
+        with self._ready_cv:
+            self._ready_buf.append({"actor_id": actor_id,
+                                    "push_addr": push_addr})
+            self._ready_cv.notify_all()
+
+    def _ready_flush_loop(self):
+        while not self._stopping:
+            with self._ready_cv:
+                while not self._ready_buf and not self._stopping:
+                    self._ready_cv.wait(0.5)
+                if self._stopping:
+                    return
+            if self._ready_linger_s > 0:
+                time.sleep(self._ready_linger_s)   # coalesce the burst
+            with self._ready_cv:
+                batch, self._ready_buf = self._ready_buf, []
+            if not batch:
+                continue
+            try:
+                with self._gcs_lock:
+                    self._gcs.call("actors_ready", node_id=self.node_id,
+                                   actors=batch)
+            except Exception:  # noqa: BLE001 - requeue; reconnecting
+                # client already burned its redial window, so an ack
+                # lost here would strand the actors PENDING forever
+                with self._ready_cv:
+                    self._ready_buf = batch + self._ready_buf
+                self._interruptible_sleep(0.2)
 
     def rpc_submit_actor_task(self, conn, send_lock, *, task: dict):
         actor_id = task["actor_id"]

@@ -241,11 +241,11 @@ class WorkerPool:
         if kind == "task_done":
             self._finish_task(w)
         elif kind == "actor_ready":
-            with node._gcs_lock:
-                node._gcs.call(
-                    "actor_ready", actor_id=msg["actor_id"],
-                    node_id=node.node_id,
-                    push_addr=(list(w.push_addr) if w.push_addr else None))
+            # batched ack: the node's flusher coalesces a creation
+            # flood's readies into one actors_ready frame per linger
+            node.queue_actor_ready(
+                msg["actor_id"],
+                list(w.push_addr) if w.push_addr else None)
         elif kind == "actor_creation_failed":
             with node._gcs_lock:
                 node._gcs.call("actor_failed", actor_id=msg["actor_id"],

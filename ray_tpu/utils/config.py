@@ -242,6 +242,47 @@ class Config:
     rpc_backoff_max_s: float = 2.0
     rpc_backoff_jitter: float = 0.2
 
+    # --- actor control plane (batched, pipelined creation/resolution;
+    # reference analog: GcsActorManager batch scheduling + the GCS
+    # pubsub-driven actor table in core_worker's ActorInfoAccessor) ---
+    # Driver-side registration coalescer: linger before a burst of
+    # create_actor calls is flushed as ONE register_actors RPC, and the
+    # max actors packed per frame.
+    actor_register_linger_s: float = 0.002
+    actor_register_batch_size: int = 512
+    # Unacked registrations in flight before create_actor blocks
+    # (memory backstop: each entry carries the pickled creation spec).
+    actor_register_window: int = 8192
+    # GCS placement executor: bounded worker threads fanning host_actors
+    # batches out per raylet (was: one daemon thread per actor), and the
+    # max placements packed per host_actors RPC.
+    gcs_placement_pool_size: int = 8
+    gcs_placement_batch_size: int = 256
+    # Driver subscribes to CH_ACTOR and resolves locations from the
+    # pushed table (get_actor polling survives only as a gap fallback).
+    actor_pubsub_enabled: bool = True
+    # GCS-side per-subscriber coalesce window for CH_ACTOR events: an
+    # actor_ready burst becomes one framed batch per subscriber instead
+    # of one inline send_msg per actor per subscriber. 0 = inline.
+    actor_pubsub_flush_s: float = 0.002
+    # How long the driver waits on the pushed table before falling back
+    # to one counted get_actor poll (covers events published before the
+    # subscription landed or lost across a redial).
+    actor_resolve_fallback_s: float = 1.0
+    # Hard deadline on resolving an actor's location (pushed table wait
+    # + fallback polls) before the call errors ActorUnavailableError.
+    # Envelope floods raise this (RAY_TPU_ACTOR_RESOLVE_TIMEOUT_S): on
+    # a saturated host the tail of a 500-actor wave can legitimately
+    # take minutes to come ALIVE.
+    actor_resolve_timeout_s: float = 60.0
+    # Raylet-side linger coalescing worker actor_ready messages into one
+    # actors_ready GCS ack batch.
+    actor_ready_linger_s: float = 0.002
+    # Nightly 40k control-plane axis (tests/test_actor_plane_nightly.py):
+    # cumulative actors driven through the batched plane in windows.
+    envelope_nightly_plane_actors: int = 40_000
+    envelope_plane_window: int = 500
+
     # --- fault injection (runtime/fault_injection.py; env overrides
     # RAY_TPU_FAULT_INJECTION_* — the chaos tier's knobs) ---
     # Master switch: off = the plane is never consulted beyond one

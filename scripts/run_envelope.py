@@ -23,6 +23,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # minutes on a small host — a reaped LIVE driver loses its actors mid-
 # flood (same reason the node heartbeat_timeout is 90s below)
 os.environ.setdefault("RAY_TPU_CLIENT_TIMEOUT_S", "600")
+# tail actors of a 500-wide creation wave can take minutes to come
+# ALIVE on a saturated host — the default 60s resolve deadline is sized
+# for interactive use, not envelope floods
+os.environ.setdefault("RAY_TPU_ACTOR_RESOLVE_TIMEOUT_S", "1800")
 
 import ray_tpu
 from ray_tpu.cluster_utils import Cluster
@@ -30,16 +34,18 @@ from ray_tpu.utils.config import get_config
 
 
 def main():
-    rnd = sys.argv[1] if len(sys.argv) > 1 else "05"
+    rnd = sys.argv[1] if len(sys.argv) > 1 else "06"
     cfg = get_config()
     n_actors = cfg.envelope_nightly_actors
     n_queued = cfg.envelope_nightly_queued_tasks
     n_args = cfg.envelope_nightly_task_args
+    n_plane = cfg.envelope_nightly_plane_actors
+    plane_window = cfg.envelope_plane_window
     # ENVELOPE_AXES=queued_tasks,actors reruns a subset, merging into an
     # existing artifact (axes are independent; a 25-minute all-axes run
     # must not be repeated to redo one)
     axes = set((os.environ.get("ENVELOPE_AXES")
-                or "queued_tasks,task_args,actors").split(","))
+                or "queued_tasks,task_args,actors,plane").split(","))
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), f"ENVELOPE_r{rnd}.json")
     out: dict = {"axes": {}, "nodes": 4,
@@ -120,8 +126,22 @@ def main():
             def who(self):
                 return self.i
 
+        from ray_tpu.runtime import core as _core
+        from ray_tpu.runtime.rpc import RpcClient
+
+        rt = _core.get_runtime()
+        gcs_probe = RpcClient(tuple(c.gcs_address), label="driver")
+        gcs_probe.call("actor_plane_stats", reset=True)
+        polls0 = getattr(rt, "_actor_get_polls", 0)
         t0 = time.monotonic()
         actors = [A.remote(i) for i in range(n_actors)]
+        submit_s = time.monotonic() - t0
+        # drain the registration coalescer so register_s isolates the
+        # batched GCS ingest from placement + worker spawn
+        if actors and hasattr(rt, "_reg_drain"):
+            for a in actors:
+                rt._reg_drain(a._actor_id.hex())
+        register_s = time.monotonic() - t0
         try:
             got = ray_tpu.get([a.who.remote() for a in actors],
                               timeout=3600) if actors else []
@@ -133,12 +153,31 @@ def main():
                                    timeout=600)
                 steady_s = time.monotonic() - t1
                 assert got2 == got
+                plane = gcs_probe.call("actor_plane_stats")
                 out["axes"]["actors"] = {
                     "n": n_actors,
                     "create_and_first_call_s": round(create_s, 1),
                     "steady_round_trip_s": round(steady_s, 1),
                     "steady_calls_per_sec": round(n_actors / steady_s,
-                                                  1)}
+                                                  1),
+                    "phases": {
+                        "submit_s": round(submit_s, 2),
+                        "register_s": round(register_s, 2),
+                        "register_batches": plane["register_batches"],
+                        "register_batch_max":
+                            plane["register_batch_max"],
+                        "host_batches": plane["host_batches"],
+                        "host_batch_max": plane["host_batch_max"],
+                        "ready_batches": plane["ready_batches"],
+                        "place_mean_ms": round(
+                            1e3 * plane["place_s"]
+                            / max(1, plane["placed"]), 2),
+                        "ready_mean_ms": round(
+                            1e3 * plane["ready_s"]
+                            / max(1, plane["ready"]), 2),
+                    },
+                    "resolve_fallback_polls":
+                        getattr(rt, "_actor_get_polls", 0) - polls0}
                 print(f"actors: {n_actors} created+called in "
                       f"{create_s:.1f}s; steady round {steady_s:.1f}s",
                       flush=True)
@@ -149,6 +188,66 @@ def main():
                     ray_tpu.kill(a)
                 except Exception:  # noqa: BLE001
                     pass
+
+        # --- batched control plane at reference scale (40k actors) ---
+        # windowed ramp (same shape as the fork-envelope nightly): each
+        # window of actors is created, called once, and killed before
+        # the next, so 40k actors flow through the registration /
+        # placement / ready plane while at most `plane_window` are live
+        if "plane" in axes and n_plane:
+            gcs_probe.call("actor_plane_stats", reset=True)
+            polls0 = getattr(rt, "_actor_get_polls", 0)
+            t0 = time.monotonic()
+            done = 0
+            steady_s = 0.0
+            while done < n_plane:
+                take = min(plane_window, n_plane - done)
+                wave = [A.remote(done + i) for i in range(take)]
+                got = ray_tpu.get([a.who.remote() for a in wave],
+                                  timeout=1800)
+                assert got == list(range(done, done + take))
+                # warm second round: every actor answers again off the
+                # pushed location table — summed across all waves this
+                # is the 40k steady-state calls/s
+                t1 = time.monotonic()
+                got2 = ray_tpu.get([a.who.remote() for a in wave],
+                                   timeout=600)
+                steady_s += time.monotonic() - t1
+                assert got2 == got
+                for a in wave:
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:  # noqa: BLE001
+                        pass
+                done += take
+                if done % 5000 == 0 or done == n_plane:
+                    el = time.monotonic() - t0
+                    print(f"  plane {done}/{n_plane} "
+                          f"({done/el:.0f} actors/s)", flush=True)
+            el = time.monotonic() - t0
+            plane = gcs_probe.call("actor_plane_stats")
+            out["axes"]["plane"] = {
+                "n": n_plane, "window": plane_window,
+                "elapsed_s": round(el, 1),
+                "actors_per_sec": round(n_plane / el, 1),
+                "create_and_first_call_s": round(el - steady_s, 1),
+                "created_per_sec": round(n_plane / (el - steady_s), 1),
+                "steady_round_trip_s": round(steady_s, 1),
+                "steady_calls_per_sec": round(n_plane / steady_s, 1),
+                "register_batches": plane["register_batches"],
+                "register_batch_max": plane["register_batch_max"],
+                "host_batches": plane["host_batches"],
+                "host_batch_max": plane["host_batch_max"],
+                "place_mean_ms": round(
+                    1e3 * plane["place_s"] / max(1, plane["placed"]),
+                    2),
+                "ready_mean_ms": round(
+                    1e3 * plane["ready_s"] / max(1, plane["ready"]), 2),
+                "resolve_fallback_polls":
+                    getattr(rt, "_actor_get_polls", 0) - polls0}
+            print(f"plane: {n_plane} actors through the batched plane "
+                  f"in {el:.1f}s ({n_plane/el:.0f}/s)", flush=True)
+            save()
     finally:
         ray_tpu.shutdown()
         c.shutdown()

@@ -1,0 +1,118 @@
+"""Batched actor control plane — the NIGHTLY 40k-actor axis.
+
+Reference analog: ``release/benchmarks/README.md:9`` — 40k actors is
+the reference's published envelope, proven nightly on 64 hosts. On few
+hosts the binding constraint is not memory (the fork-server pool covers
+that at 10k concurrent, ``test_fork_envelope_nightly.py``) but the
+CONTROL PLANE: per-actor registration RPCs, thread-per-actor placement
+and location polling collapse long before 40k. This axis drives 40k
+actors THROUGH that plane — windowed like the reference's long-running
+many-actor release test (create → call → kill per window) so at most
+``envelope_plane_window`` are alive at once — and asserts the batched
+machinery actually carried them.
+
+Sized by ``RAY_TPU_ENVELOPE_NIGHTLY_PLANE_ACTORS`` (default 40,000) and
+``RAY_TPU_ENVELOPE_PLANE_WINDOW`` (default 500). Selected only by
+``ci/run_ci.sh --nightly`` (``pytest -m nightly``).
+"""
+
+import os
+import time
+
+import pytest
+
+# wave-tail actors can take minutes to come ALIVE on a saturated host;
+# the interactive-sized resolve deadline would error the whole wave
+os.environ.setdefault("RAY_TPU_ACTOR_RESOLVE_TIMEOUT_S", "1800")
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.runtime import core as _core
+from ray_tpu.runtime.rpc import RpcClient
+from ray_tpu.utils.config import get_config
+
+pytestmark = [pytest.mark.nightly, pytest.mark.slow]
+
+_N_ACTORS = get_config().envelope_nightly_plane_actors
+_WINDOW = get_config().envelope_plane_window
+
+
+@pytest.fixture(scope="module")
+def plane_cluster():
+    ray_tpu.shutdown()
+    # same shape as the fork-envelope nightly: generous heartbeat (a
+    # raylet starved of cpu during the ramp must not be declared dead),
+    # 3 external raylets + an in-process head
+    c = Cluster(external_gcs=True, heartbeat_timeout_s=90.0)
+    c.add_node(num_cpus=4)
+    for _ in range(3):
+        c.add_node(num_cpus=4, external=True)
+    c.wait_for_nodes(4)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_40k_actors_through_batched_plane(plane_cluster):
+    """40,000 actors flow through registration/placement/ready in
+    batches; creation rate and the plane decomposition are the recorded
+    envelope numbers (printed with ``-s``)."""
+    c = plane_cluster
+    rt = _core.get_runtime()
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    probe = RpcClient(tuple(c.gcs_address), label="driver")
+    probe.call("actor_plane_stats", reset=True)
+    polls0 = rt._actor_get_polls
+    n, window = _N_ACTORS, _WINDOW
+    done = 0
+    t0 = time.monotonic()
+    try:
+        while done < n:
+            take = min(window, n - done)
+            wave = [A.remote(done + i) for i in range(take)]
+            got = ray_tpu.get([a.who.remote() for a in wave],
+                              timeout=1800)
+            assert got == list(range(done, done + take))
+            for a in wave:
+                ray_tpu.kill(a)
+            done += take
+            if done % 5000 == 0:
+                el = time.monotonic() - t0
+                print(f"  {done}/{n} ({done / el:.0f} actors/s)",
+                      flush=True)
+        el = time.monotonic() - t0
+        plane = probe.call("actor_plane_stats")
+        polls = rt._actor_get_polls - polls0
+        print(f"\n{n} actors through the batched plane in {el:.1f}s "
+              f"({n / el:.1f} actors/s); register_batches="
+              f"{plane['register_batches']} (max "
+              f"{plane['register_batch_max']}), host_batches="
+              f"{plane['host_batches']} (max {plane['host_batch_max']}),"
+              f" place_mean="
+              f"{1e3 * plane['place_s'] / max(1, plane['placed']):.1f}ms"
+              f", ready_mean="
+              f"{1e3 * plane['ready_s'] / max(1, plane['ready']):.1f}ms"
+              f", fallback_polls={polls}")
+        # the axis is only proven if the BATCHED plane carried it:
+        # coalesced registration, batched placement, and (near-)zero
+        # fallback polling against the pushed location table
+        assert plane["register_actors"] == n
+        assert plane["register_batch_max"] > 1
+        assert plane["register_batches"] < n
+        assert plane["host_batch_max"] > 1
+        # resolution rode CH_ACTOR pushes; a handful of quiet-window
+        # fallbacks under CPU starvation are tolerated, per-actor
+        # polling (>= 1 poll/actor) is the regression this guards
+        assert polls < n / 10, \
+            f"{polls} fallback polls for {n} actors — pushed table idle"
+    finally:
+        probe.close()

@@ -462,3 +462,98 @@ def test_injected_duplicate_lease_rpc_end_to_end(chaos_cluster):
                 w.state = "idle"
                 w.acquired = None
         raylet.scheduler.release({"CPU": 1})
+
+
+def test_duplicate_register_actors_batch_applied_once(chaos_cluster):
+    """Round-6 plane: duplicate delivery of a register_actors BATCH
+    (the driver's coalescer retries the whole frame after a lost reply)
+    acks every entry again without double-creating, and an intra-batch
+    name conflict is a per-entry error, not a batch failure."""
+    gcs = chaos_cluster.gcs
+    batch = [dict(actor_id=f"batch-idem-{i}",
+                  name="batch-name" if i == 0 else None,
+                  creation_spec=b"", resources={"__never__": 1},
+                  max_restarts=0, namespace="chaos", owner_id=None)
+             for i in range(4)]
+    r1 = gcs.rpc_register_actors(None, None, actors=batch)
+    assert all(res["ok"] for res in r1["results"]), r1
+    # duplicate delivery of the SAME batch: every entry re-acks
+    r2 = gcs.rpc_register_actors(None, None, actors=batch)
+    assert all(res["ok"] for res in r2["results"]), r2
+    for i in range(4):
+        assert len([a for a in gcs._actors
+                    if a == f"batch-idem-{i}"]) == 1
+    # a DIFFERENT actor claiming a batch-mate's name fails ITS entry
+    # only — its batch-mates still register
+    r3 = gcs.rpc_register_actors(None, None, actors=[
+        {**batch[0], "actor_id": "batch-idem-thief"},
+        {**batch[1], "actor_id": "batch-idem-new"}])
+    assert not r3["results"][0]["ok"]
+    assert "taken" in r3["results"][0]["error"]
+    assert r3["results"][1]["ok"]
+
+
+def test_duplicate_host_actors_batch_is_noop(chaos_cluster):
+    """Round-6 plane: the GCS retries a host_actors batch once when the
+    shared placement channel dies mid-call — a duplicate for an actor
+    already hosted must dedup per entry, never run a second copy."""
+    c = chaos_cluster
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def who(self):
+            return 42
+
+    a = A.remote()
+    assert ray_tpu.get(a.who.remote(), timeout=60) == 42
+    aid = a._actor_id.hex()
+    info = c.gcs._actors[aid]
+    raylet = c.nodes[info.node_id].raylet
+    try:
+        reply = raylet.rpc_host_actors(None, None, actors=[
+            {"actor_id": aid, "spec": info.creation_spec,
+             "incarnation": info.num_restarts}])
+        assert reply["results"][0].get("dedup"), reply
+        hosts = [w for w in raylet.workers.workers.values()
+                 if w.state == "actor" and w.actor_id == aid]
+        assert len(hosts) == 1, \
+            f"duplicate host_actors ran {len(hosts)} copies"
+        # and the actor still answers (the dup didn't disturb it)
+        assert ray_tpu.get(a.who.remote(), timeout=60) == 42
+    finally:
+        ray_tpu.kill(a)
+
+
+def test_dropped_register_actors_retried_without_orphan(chaos_cluster):
+    """Round-6 plane: a register_actors frame dropped on the GCS recv
+    path leaves NO partial state (no orphan registration), and the
+    caller's retry registers exactly once."""
+    from ray_tpu.runtime.rpc import RpcClient
+
+    c = chaos_cluster
+    fi.put_plan(c.gcs_address, {
+        "version": 1, "seed": 7,
+        "rules": [{"id": "drop-reg", "fault": "drop",
+                   "src": "gcs", "direction": "recv",
+                   "method": "register_actors", "max_hits": 1}]})
+    batch = [dict(actor_id="dropped-actor-1", name=None,
+                  creation_spec=b"", resources={"__never__": 1},
+                  max_restarts=0, namespace="chaos", owner_id=None)]
+    client = RpcClient(c.gcs_address, label="driver")
+    try:
+        with pytest.raises(TimeoutError):
+            client.call("register_actors", actors=batch, timeout=2)
+    finally:
+        client.close()   # pipelined stream is desynced after a timeout
+    assert fi.plane.stats.get("drop-reg") == 1
+    assert "dropped-actor-1" not in c.gcs._actors, \
+        "dropped frame left an orphan registration"
+    _heal(c, version=2)
+    retry = RpcClient(c.gcs_address, label="driver")
+    try:
+        reply = retry.call("register_actors", actors=batch, timeout=30)
+        assert reply["results"][0]["ok"], reply
+    finally:
+        retry.close()
+    assert len([a for a in c.gcs._actors
+                if a == "dropped-actor-1"]) == 1
