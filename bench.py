@@ -287,7 +287,11 @@ def bench_serve() -> dict:
         with lock:
             done_counts.append(len(toks))
             if req.ttft is not None:
-                sus_ttfts.append((req.submit_t - t0, req.ttft))
+                # breakdown: the MEASURED per-request TTFT decomposition
+                # (queue wait / prefill / pipeline stall / first-token
+                # ship) stamped by the engine; stages sum to the TTFT
+                sus_ttfts.append((req.submit_t - t0, req.ttft,
+                                  req.breakdown))
             go = remaining[0] > 0
             if go:
                 remaining[0] -= 1
@@ -309,8 +313,23 @@ def bench_serve() -> dict:
         time.sleep(0.05)
     sus_elapsed = time.monotonic() - t0
     sus_tps = sum(done_counts) / sus_elapsed
-    steady = [t for (ts, t) in sus_ttfts if ts > 0.5] or \
-        [t for _, t in sus_ttfts]
+    steady_rows = [r for r in sus_ttfts if r[0] > 0.5] or sus_ttfts
+    steady = [t for _, t, _ in steady_rows]
+    # measured TTFT decomposition over the steady requests: per-stage
+    # means, plus the sum-vs-observed check that proves the stages
+    # account for the whole latency (not a model — stamped timestamps)
+    steady_bds = [bd for _, _, bd in steady_rows if bd is not None]
+    ttft_breakdown = None
+    if steady_bds:
+        ttft_breakdown = {
+            k: round(float(np.mean([bd[k] for bd in steady_bds])), 4)
+            for k in ("queue_wait_s", "prefill_s", "pipeline_stall_s",
+                      "ship_s")}
+        ttft_breakdown["sum_s"] = round(
+            sum(ttft_breakdown.values()), 4)
+        ttft_breakdown["mean_observed_ttft_s"] = round(
+            float(np.mean([t for _, t, bd in steady_rows
+                           if bd is not None])), 4)
 
     # -- prefix-cache phase: shared system prompt + unique tails --
     # (the chat/agent-serving shape; random-prompt phases above never
@@ -365,6 +384,7 @@ def bench_serve() -> dict:
                 "tokens_per_sec": round(sus_tps, 1),
                 "p50_ttft_s": round(float(np.median(steady)), 4),
                 "p95_ttft_s": round(float(np.percentile(steady, 95)), 4),
+                "ttft_breakdown": ttft_breakdown,
             },
             # fixed per-dispatch sync latency of the device transport —
             # the floor under every TTFT above (tunneled chips pay ~2 of
@@ -580,13 +600,47 @@ def bench_envelope() -> dict:
     }
 
     # steady state: every live actor answers again, round-robin; the
-    # location-resolve rate rides the warm pushed table (zero polls)
+    # location-resolve rate rides the warm pushed table (zero polls).
+    # bench_profile_enabled samples the DRIVER's threads across exactly
+    # this window (the submit/await path is driver-side — the axis that
+    # dipped when the actor count grew) and writes the collapsed-stack
+    # artifact any flamegraph renderer consumes.
+    profiler = None
+    if cfg.bench_profile_enabled:
+        import threading as _threading
+
+        from ray_tpu.util.profiling import sample_profile
+
+        prof_out: list = []
+        prof_stop = _threading.Event()
+        profiler = _threading.Thread(
+            target=lambda: prof_out.append(
+                sample_profile(duration_s=600.0, hz=200, stop=prof_stop)),
+            daemon=True, name="bench-profiler")
+        profiler.start()
     calls = 4 * n_actors
     t0 = time.perf_counter()
     refs = [actors[i % n_actors].who.remote() for i in range(calls)]
     ray_tpu.get(refs)
     steady_s = time.perf_counter() - t0
     detail["steady_actor_calls_per_sec"] = round(calls / steady_s, 1)
+    if profiler is not None:
+        prof_stop.set()
+        profiler.join(timeout=10)
+        if prof_out:
+            prof = prof_out[0]
+            path = os.environ.get("BENCH_PROFILE_OUT",
+                                  "PROFILE_envelope.folded")
+            with open(path, "w") as f:
+                f.write(prof["folded"] + "\n")
+            detail["profile"] = {
+                "artifact": path,
+                "samples": prof["samples"],
+                "duration_s": prof["duration_s"],
+                # top frames inline so the artifact JSON alone shows
+                # where the steady-call window went
+                "top_stacks": prof["folded"].splitlines()[:5],
+            }
     t0 = time.perf_counter()
     for a in actors:
         rt._actor_location(a._actor_id.hex())

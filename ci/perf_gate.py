@@ -58,6 +58,29 @@ METRICS = {
         ("detail", "actor_resolves_per_sec")],
 }
 
+# LOWER-is-better latency keys (round 7: measured serve TTFT
+# decomposition from the metrics plane) — a regression is an INCREASE
+# past the fence. Absent in pre-round-7 baselines: skipped until both
+# sides carry them.
+METRICS_LOWER = {
+    "serve_sustained_p50_ttft_s": [
+        ("detail", "serve", "sustained", "p50_ttft_s"),
+        ("detail", "sustained", "p50_ttft_s")],
+    "serve_ttft_queue_wait_s": [
+        ("detail", "serve", "sustained", "ttft_breakdown", "queue_wait_s"),
+        ("detail", "sustained", "ttft_breakdown", "queue_wait_s")],
+    "serve_ttft_prefill_s": [
+        ("detail", "serve", "sustained", "ttft_breakdown", "prefill_s"),
+        ("detail", "sustained", "ttft_breakdown", "prefill_s")],
+    "serve_ttft_pipeline_stall_s": [
+        ("detail", "serve", "sustained", "ttft_breakdown",
+         "pipeline_stall_s"),
+        ("detail", "sustained", "ttft_breakdown", "pipeline_stall_s")],
+    "serve_ttft_ship_s": [
+        ("detail", "serve", "sustained", "ttft_breakdown", "ship_s"),
+        ("detail", "sustained", "ttft_breakdown", "ship_s")],
+}
+
 # train metric paths only exist in full-run docs; the train bench value
 # doubles as core_tasks in core-only docs — guard that collision
 _TRAIN_ONLY = {"train_tokens_per_sec_per_chip"}
@@ -76,7 +99,7 @@ def _dig(doc: dict, name: str):
     if name in _TRAIN_ONLY and doc.get("metric") != \
             "llama_train_tokens_per_sec_per_chip":
         return None
-    for path in METRICS[name]:
+    for path in METRICS.get(name) or METRICS_LOWER[name]:
         v = _dig_one(doc, path)
         if v is not None:
             return v
@@ -86,8 +109,10 @@ def _dig(doc: dict, name: str):
 def _load(path: str) -> dict:
     with open(path) as f:
         doc = json.load(f)
-    # driver-recorded rounds wrap the bench line under "parsed"
-    return doc.get("parsed", doc)
+    # driver-recorded rounds wrap the bench line under "parsed" (null
+    # when the driver could not parse the bench tail — fall back to the
+    # wrapper so the gate skips its metrics instead of crashing)
+    return doc.get("parsed") or doc
 
 
 def main(argv: list[str]) -> int:
@@ -114,6 +139,16 @@ def main(argv: list[str]) -> int:
         print(f"  {name:34s} {b:>12.1f} -> {a:>12.1f}  "
               f"{delta:+7.1%}  {flag}")
         if delta < -fence:
+            failures.append((name, b, a, delta))
+    for name in METRICS_LOWER:
+        a, b = _dig(new, name), _dig(old, name)
+        if a is None or b is None or b <= 0:
+            continue
+        delta = a / b - 1.0
+        flag = "REGRESSION" if delta > fence else "ok"
+        print(f"  {name:34s} {b:>12.4f} -> {a:>12.4f}  "
+              f"{delta:+7.1%}  {flag} (lower=better)")
+        if delta > fence:
             failures.append((name, b, a, delta))
     if failures:
         print(f"perf gate: {len(failures)} metric(s) regressed past "

@@ -516,7 +516,13 @@ def remote(*args, **kwargs):
 
 def timeline(filename: str | None = None) -> list:
     """Task timeline in chrome://tracing format (reference:
-    ``ray.timeline()`` from ``_private/profiling.py:84``)."""
+    ``ray.timeline()`` from ``_private/profiling.py:84``).
+
+    Events carry wall-clock timestamps (``wall_start``/``wall_end``,
+    anchored at record time in each worker) so they share a clock domain
+    with ``ray_tpu.util.tracing`` spans — see
+    ``tracing.export_chrome_trace`` for the merged view. pid is the OS
+    pid of the executing process; tid is the executing thread."""
     rt = _runtime()
     if hasattr(rt, "task_events"):
         events = rt.task_events()
@@ -532,9 +538,11 @@ def timeline(filename: str | None = None) -> list:
             "name": e["name"],
             "cat": "task",
             "ph": "X",
-            "ts": e["start"] * 1e6,
+            # wall stamps when present (events recorded before the
+            # anchor existed fall back to raw monotonic values)
+            "ts": e.get("wall_start", e["start"]) * 1e6,
             "dur": (e["end"] - e["start"]) * 1e6,
-            "pid": 0,
+            "pid": e.get("pid", 0),
             "tid": e.get("thread", "worker"),
             "args": {"task_id": e["task_id"], "state": e["state"]},
         }

@@ -160,6 +160,27 @@ class _Handler(BaseHTTPRequestHandler):
                 job_id = path[len("/api/jobs/"):]
                 self._send_json(
                     JobSubmissionClient().get_job_info(job_id))
+            elif path == "/api/metrics/query":
+                # cluster metrics plane range/instant query:
+                # ?name=...&last_s=60&group_by=src,stage&per_window=1
+                # (no name -> the metric-name listing)
+                qs = parse_qs(self.path.partition("?")[2])
+                name = qs.get("name", [None])[0]
+                gb = [g for g in
+                      qs.get("group_by", [""])[0].split(",") if g]
+                last_s = qs.get("last_s", [None])[0]
+                tags = {k[4:]: v[0] for k, v in qs.items()
+                        if k.startswith("tag.")}
+                self._send_json(_state.cluster_metrics(
+                    name, tags=tags or None,
+                    last_s=float(last_s) if last_s else None,
+                    group_by=gb,
+                    per_window=qs.get("per_window", ["0"])[0] == "1"))
+            elif path == "/api/latencies":
+                # per-stage latency digest (live dashboard view)
+                qs = parse_qs(self.path.partition("?")[2])
+                last_s = float(qs.get("last_s", ["300"])[0])
+                self._send_json(_state.summarize_latencies(last_s=last_s))
             elif path == "/api/version":
                 self._send_json({"version": ray_tpu.__version__})
             elif path == "/metrics":

@@ -238,11 +238,19 @@ class Runtime:
 
     def record_task_event(self, spec: TaskSpec, start: float, end: float,
                           ok: bool):
+        # start/end are monotonic (caller's clock); wall_* anchors them
+        # to the wall clock HERE, while the monotonic domain is still
+        # ours — wall stamps are what makes events comparable across
+        # processes and with tracing spans (one trace file, one clock)
+        offset = time.time() - time.monotonic()
         self._task_events.append({
             "task_id": spec.task_id.hex(),
             "name": spec.function_name,
             "start": start,
             "end": end,
+            "wall_start": start + offset,
+            "wall_end": end + offset,
+            "pid": os.getpid(),
             "state": "FINISHED" if ok else "FAILED",
             "thread": threading.current_thread().name,
         })

@@ -246,6 +246,17 @@ class ClusterRuntime:
                 pass
             threading.Thread(target=self._ref_flush_loop, daemon=True,
                              name="ref-flusher").start()
+        # metrics plane: this process's registry pushes delta frames to
+        # the GCS (claim machinery keeps it to ONE pusher per process —
+        # a nested in-worker runtime loses the claim to the first one)
+        from ray_tpu.runtime.metrics_plane import MetricsPusher
+        self._metrics_pusher = MetricsPusher(
+            self.gcs_address, src=self.client_id[:12],
+            kind="worker" if in_worker else "driver").start()
+        from ray_tpu.util import metrics as _metrics
+        self._h_actor_resolve = _metrics.histogram(
+            "ray_tpu_actor_resolve_s",
+            "actor location resolve latency (cache misses only)").handle()
 
     @staticmethod
     def _print_worker_logs(msg: dict):
@@ -1336,6 +1347,10 @@ class ClusterRuntime:
         cached = self._actor_locations.get(actor_id_hex)
         if cached is not None:
             return cached
+        # only the MISS path is timed: the cache hit above runs at
+        # >10k calls/s on the direct-call path and must stay bare
+        from ray_tpu.util import metrics as _metrics
+        t_resolve = time.perf_counter() if _metrics.enabled() else 0.0
         if timeout is None:
             timeout = self._resolve_timeout_s
         deadline = time.monotonic() + timeout
@@ -1350,6 +1365,9 @@ class ClusterRuntime:
                     if ent["state"] == "ALIVE":
                         addr = ent.get("push_addr") or ent.get("address")
                         if addr is not None:
+                            if t_resolve:
+                                self._h_actor_resolve.observe(
+                                    time.perf_counter() - t_resolve)
                             return self._install_location(
                                 actor_id_hex, addr,
                                 ent.get("num_restarts", 0))
@@ -1377,6 +1395,9 @@ class ClusterRuntime:
                                              "unknown actor")
                 if info["state"] == "ALIVE":
                     addr = info.get("push_addr") or info["address"]
+                    if t_resolve:
+                        self._h_actor_resolve.observe(
+                            time.perf_counter() - t_resolve)
                     return self._install_location(
                         actor_id_hex, addr, info.get("num_restarts", 0))
                 if info["state"] == "DEAD":
@@ -1806,6 +1827,10 @@ class ClusterRuntime:
             self._refs.remove_serialize_hook(self._memstore_serialize_hook)
             self._memstore.clear()
         self._closed = True
+        try:
+            self._metrics_pusher.stop()
+        except Exception:  # noqa: BLE001 - best-effort plane teardown
+            pass
         with self._reg_cv:
             self._reg_cv.notify_all()   # reg flusher drains + exits
         if self._log_sub is not None:

@@ -31,6 +31,7 @@ CH_ACTOR = "actor"          # actor state transitions
 CH_OBJECT = "object"        # object location added (get() wakeups)
 CH_ERROR = "error"          # error broadcast to drivers
 CH_LOG = "log"              # worker log forwarding
+CH_METRICS = "metrics"      # rolled metric-window summaries (dashboards)
 
 
 @dataclass
@@ -253,6 +254,22 @@ class GcsServer(RpcServer):
             "place_s": 0.0, "placed": 0, "ready_s": 0.0, "ready": 0,
         }
         self._plane_t: dict[str, list] = {}
+        # actor-plane stage durations ALSO land in plane histograms so
+        # the metrics plane can answer p99 place/ready queries (the
+        # counters above stay — bench decomposition reads them)
+        from ray_tpu.util import metrics as _metrics
+        self._plane_hist = _metrics.histogram(
+            "ray_tpu_actor_stage_s",
+            "actor control-plane stage latency", tag_keys=("stage",))
+        # --- cluster metrics plane: ring-buffer time-series store fed
+        # by rpc_push_metrics; rolled windows fan out on CH_METRICS ---
+        from ray_tpu.runtime.metrics_plane import MetricsStore
+        self._metrics_store = MetricsStore(
+            window_s=_pcfg.metrics_window_s,
+            windows=_pcfg.metrics_windows,
+            on_roll=self._publish_metrics_window)
+        self._metrics_push_interval = _pcfg.metrics_push_interval_s
+        self._metrics_stop = threading.Event()
         self._hb_timeout = heartbeat_timeout_s
         # --- distributed refcounting (reference: reference_count.h:61;
         # centralized here to match the centralized object directory).
@@ -490,6 +507,10 @@ class GcsServer(RpcServer):
         self._health_thread.start()
         threading.Thread(target=self._pub_flush_loop, daemon=True,
                          name="gcs-pub-flusher").start()
+        from ray_tpu.util import metrics as _metrics
+        if _metrics.enabled():
+            threading.Thread(target=self._metrics_self_loop, daemon=True,
+                             name="gcs-metrics-self").start()
         if self._persist is not None:
             threading.Thread(target=self._snapshot_loop,
                              daemon=True).start()
@@ -502,6 +523,7 @@ class GcsServer(RpcServer):
 
     def stop(self):
         super().stop()
+        self._metrics_stop.set()
         with self._place_cv:
             self._place_cv.notify_all()   # placement workers exit
         with self._pub_cv:
@@ -552,18 +574,19 @@ class GcsServer(RpcServer):
             subs = list(self._subs.get(channel, []))
         if not subs:
             return
-        if channel == CH_ACTOR and self._pub_flush_s > 0:
-            # coalesce: buffer per subscriber, flusher ships one framed
-            # batch per window — the publisher (often rpc_actor_ready
-            # under the creation flood) never blocks on N sockets
+        if channel in (CH_ACTOR, CH_METRICS) and self._pub_flush_s > 0:
+            # coalesce: buffer per (subscriber, channel), flusher ships
+            # one framed batch per window — the publisher (often
+            # rpc_actor_ready under the creation flood, or a metrics
+            # window roll) never blocks on N sockets
             with self._pub_cv:
                 for conn, send_lock in subs:
-                    ent = self._pub_buf.get(id(conn))
+                    ent = self._pub_buf.get((id(conn), channel))
                     if ent is None:
-                        self._pub_buf[id(conn)] = (conn, send_lock,
-                                                   [message])
+                        self._pub_buf[(id(conn), channel)] = (
+                            conn, send_lock, channel, [message])
                     else:
-                        ent[2].append(message)
+                        ent[3].append(message)
                 self._pub_cv.notify_all()
             return
         self._send_to_subs([(conn, lk, message) for conn, lk in subs])
@@ -579,12 +602,12 @@ class GcsServer(RpcServer):
             with self._pub_cv:
                 buf, self._pub_buf = self._pub_buf, {}
             sends = []
-            for conn, send_lock, msgs in buf.values():
+            for conn, send_lock, channel, msgs in buf.values():
                 if len(msgs) == 1:
                     sends.append((conn, send_lock, msgs[0]))
                 else:
                     sends.append((conn, send_lock,
-                                  {"channel": CH_ACTOR, "batch": msgs}))
+                                  {"channel": channel, "batch": msgs}))
             self._send_to_subs(sends)
 
     def _send_to_subs(self, sends: list):
@@ -1004,6 +1027,8 @@ class GcsServer(RpcServer):
                 if t is not None:
                     self._plane["place_s"] += now - t[0]
                     self._plane["placed"] += 1
+                    self._plane_hist.observe(now - t[0],
+                                             tags={"stage": "place"})
                     t[1] = now
         failed = []
         for (actor_id, _spec, _inc), res in zip(batch,
@@ -1065,6 +1090,8 @@ class GcsServer(RpcServer):
                 if t is not None:
                     self._plane["ready_s"] += now - (t[1] or t[0])
                     self._plane["ready"] += 1
+                    self._plane_hist.observe(now - (t[1] or t[0]),
+                                             tags={"stage": "ready"})
                 events.append({"event": "alive", "actor_id": actor_id,
                                "node_id": node_id, "address": node_addr,
                                "push_addr": actor.push_addr,
@@ -1722,6 +1749,63 @@ class GcsServer(RpcServer):
     def rpc_get_task_events(self, conn, send_lock, *, limit=1000):
         with self._lock:
             return self._task_events[-limit:]
+
+    # ------------------------------------------------------------------
+    # cluster metrics plane (runtime/metrics_plane.py: delta frames in,
+    # windowed time series out; reference analog: the node metrics
+    # agents + Prometheus, centralized here like the object directory)
+    # ------------------------------------------------------------------
+
+    def _publish_metrics_window(self, window: dict):
+        """A rolled aggregation window fans out to CH_METRICS
+        subscribers (live dashboard views) through the same coalesced
+        pushed-channel path CH_ACTOR uses. Best-effort by construction:
+        publish() drops dead subscribers and never blocks ingest."""
+        self.publish(CH_METRICS, {"event": "window",
+                                  "start": window["start"],
+                                  "end": window["end"],
+                                  "data": window["data"]})
+
+    def rpc_push_metrics(self, conn, send_lock, *, src, frame,
+                         kind="worker", ts=None):
+        """Ingest one delta frame from a process's MetricsPusher.
+        Duplicate delivery over-counts a window slightly (at-most-once
+        is traded for never-blocking); the store is additive so the
+        damage is bounded to the duplicated frame."""
+        self._metrics_store.ingest(src, frame, ts)
+        return {"ok": True}
+
+    def rpc_query_metrics(self, conn, send_lock, *, name=None,
+                          tags=None, last_s=None, group_by=(),
+                          per_window=False):
+        if name is None:
+            return {"names": self._metrics_store.names()}
+        return self._metrics_store.query(
+            name, tags=tags, last_s=last_s, group_by=group_by,
+            per_window=per_window)
+
+    def _metrics_self_loop(self):
+        """The GCS ingests its OWN registry (rpc handler timers, actor
+        plane stage histograms) on the same delta protocol workers use —
+        unless another runtime in this process already claimed the
+        process-wide pusher (in-process GCS under a driver: the driver's
+        pusher ships the shared registry)."""
+        from ray_tpu.runtime import metrics_plane as _mp
+        from ray_tpu.util import metrics as _metrics
+
+        prev = None
+        claimed = False
+        while not self._metrics_stop.wait(self._metrics_push_interval):
+            if not claimed:
+                claimed = _mp.claim_pusher(f"gcs:{self.address[1]}")
+                if not claimed:
+                    continue
+            try:
+                frame, prev = _metrics.snapshot_delta(prev)
+                if frame:
+                    self._metrics_store.ingest("gcs", frame)
+            except Exception:  # noqa: BLE001 - observability only
+                pass
 
     # ------------------------------------------------------------------
     # cluster summary

@@ -29,6 +29,16 @@ from collections import deque
 from typing import Callable
 
 from ray_tpu.runtime.rpc import ConnectionLost, RpcClient
+from ray_tpu.util import metrics as _metrics
+
+# owner-side lease stage timers (metrics plane): "acquire" is the full
+# grant latency seen by a pusher (parking + spillback hops included);
+# "push_rtt" is one pushed task group's round trip over the held lease
+_lease_hist = _metrics.histogram(
+    "ray_tpu_lease_owner_s", "owner-side lease stage latency",
+    tag_keys=("stage",))
+_h_acquire = _lease_hist.handle({"stage": "acquire"})
+_h_push_rtt = _lease_hist.handle({"stage": "push_rtt"})
 
 
 class Lease:
@@ -239,7 +249,7 @@ class LeaseManager:
             except Exception:  # noqa: BLE001
                 pass
             while window:
-                tasks, _ = window.popleft()
+                tasks, _, _ = window.popleft()
                 _drop_in_flight(tasks)
                 for t in tasks:
                     self._handle_break(t, error, info)
@@ -248,6 +258,7 @@ class LeaseManager:
             with self._lock:
                 for t in tasks:
                     self._in_flight[t.get("task_id", "")] = (t, lease)
+            t_send = time.perf_counter()
             try:
                 if len(tasks) == 1:
                     pending = lease.client.call_async("push_task",
@@ -256,10 +267,10 @@ class LeaseManager:
                     pending = lease.client.call_async("push_tasks",
                                                       tasks=tasks)
             except (ConnectionLost, OSError) as e:
-                window.append((tasks, None))
+                window.append((tasks, None, t_send))
                 _break_all(e)
                 return False
-            window.append((tasks, pending))
+            window.append((tasks, pending, t_send))
             return True
 
         try:
@@ -277,11 +288,14 @@ class LeaseManager:
                     if not _send_group(tasks):
                         break
                 if window:
-                    tasks, pending = window.popleft()
+                    tasks, pending, t_send = window.popleft()
                     try:
                         if pending is None:
                             raise ConnectionLost("lease lost before send")
                         reply = pending.result(timeout=None)
+                        if _metrics.enabled():
+                            _h_push_rtt.observe(
+                                time.perf_counter() - t_send)
                         results = (reply or {}).get("results")
                         if results and self._on_direct_results:
                             # small returns came back IN the reply:
@@ -314,8 +328,11 @@ class LeaseManager:
                 with self._lock:
                     self._in_flight[tid] = (task, None)
                 if lease is None:
+                    t_acq = time.perf_counter()
                     lease = self._acquire_lease(task)
                     if lease is not None:
+                        if _metrics.enabled():
+                            _h_acquire.observe(time.perf_counter() - t_acq)
                         self._note_acquired(key)
                 if lease is None:
                     # unplaceable via lease (infeasible / exhausted

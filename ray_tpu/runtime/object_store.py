@@ -18,8 +18,24 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable
 
+from ray_tpu.util import metrics as _metrics
 from ray_tpu.utils.exceptions import GetTimeoutError, ObjectLostError
 from ray_tpu.utils.ids import ObjectID
+
+# Hot-path stage timers, SAMPLED 1-in-64: the in-process store sees
+# >100k ops/s, so timing every op would blow the <3% overhead budget
+# (tests/test_metrics_plane.py). The mask test runs BEFORE the
+# enabled() probe so 63/64 ops pay one int add + one branch; the
+# latency distribution stays representative, series counts are ~1/64
+# of actual op counts.
+_SAMPLE_MASK = 63
+_sample = 0
+_store_hist = _metrics.histogram(
+    "ray_tpu_object_store_s",
+    "in-process object store op latency (sampled 1/64)",
+    tag_keys=("op",))
+_h_put = _store_hist.handle({"op": "put"})
+_h_get = _store_hist.handle({"op": "get"})
 
 
 @dataclass
@@ -53,6 +69,10 @@ class ObjectStore:
 
     def put(self, object_id: ObjectID, value: Any, is_error: bool = False,
             size_bytes: int = 0) -> None:
+        global _sample
+        _sample += 1
+        t0 = time.perf_counter() \
+            if not (_sample & _SAMPLE_MASK) and _metrics.enabled() else 0.0
         with self._cv:
             if object_id in self._objects:
                 return  # objects are immutable; first write wins
@@ -64,6 +84,8 @@ class ObjectStore:
             callbacks = list(self._on_put)
         for cb in callbacks:
             cb(object_id)
+        if t0:
+            _h_put.observe(time.perf_counter() - t0)
 
     # --- reads ---
 
@@ -73,6 +95,10 @@ class ObjectStore:
 
     def get(self, object_ids: list[ObjectID], timeout: float | None = None) -> list[Any]:
         """Block until all ids are present; raise stored errors."""
+        global _sample
+        _sample += 1
+        t0 = time.perf_counter() \
+            if not (_sample & _SAMPLE_MASK) and _metrics.enabled() else 0.0
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             for oid in object_ids:
@@ -89,7 +115,9 @@ class ObjectStore:
                 if entry.is_error:
                     raise entry.value
                 results.append(entry.value)
-            return results
+        if t0:
+            _h_get.observe(time.perf_counter() - t0)
+        return results
 
     def get_entry(self, object_id: ObjectID):
         """Non-blocking raw fetch: (found, value, is_error)."""

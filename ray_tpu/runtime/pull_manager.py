@@ -20,9 +20,21 @@ waiters block on its event rather than issuing duplicate transfers.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable
 
 from ray_tpu.runtime.rpc import RpcClient
+from ray_tpu.util import metrics as _metrics
+
+# transfers are rare and expensive relative to a histogram observe, so
+# the leader of every successful pull is timed end to end (meta probe +
+# chunk reads + seal); bytes feed the data-plane GiB/s dashboard view
+_h_pull = _metrics.histogram(
+    "ray_tpu_object_transfer_s",
+    "leader-side object pull latency (spill restore or peer transfer)"
+).handle()
+_pull_bytes = _metrics.counter(
+    "ray_tpu_object_transfer_bytes", "object bytes pulled from peers")
 
 
 class _Pull:
@@ -142,7 +154,10 @@ class PullManager:
             pull.event.wait(timeout=timeout_s)
             return pull.ok or self._store.contains(oid)
         try:
+            t0 = time.perf_counter()
             pull.ok = self._do_pull(oid_hex, oid, known_sources)
+            if pull.ok and _metrics.enabled():
+                _h_pull.observe(time.perf_counter() - t0)
             return pull.ok
         finally:
             with self._pulls_lock:
@@ -185,8 +200,12 @@ class PullManager:
         if not sources:
             return False
         if size <= self.chunk_size:
-            return self._pull_small(oid_hex, oid, sources[0], size, crc)
-        return self._pull_chunked(oid_hex, oid, sources, size, crc)
+            ok = self._pull_small(oid_hex, oid, sources[0], size, crc)
+        else:
+            ok = self._pull_chunked(oid_hex, oid, sources, size, crc)
+        if ok and _metrics.enabled():
+            _pull_bytes.inc(size)
+        return ok
 
     def _pull_small(self, oid_hex: str, oid: bytes, addr: tuple,
                     size: int, crc) -> bool:

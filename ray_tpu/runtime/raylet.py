@@ -129,6 +129,16 @@ class Raylet(RpcServer):
         self._ready_linger_s = _cfg.actor_ready_linger_s
         self.objects = LocalObjectManager(
             self, store=self.store, store_capacity=store_capacity, cfg=_cfg)
+        # metrics plane: this raylet's registry pushes to the GCS under
+        # its node id; grant latency is the raylet-side lease stage
+        from ray_tpu.runtime.metrics_plane import MetricsPusher
+        from ray_tpu.util import metrics as _metrics
+        self._metrics_pusher = MetricsPusher(
+            self.gcs_address, src=self.node_id[:12], kind="raylet")
+        self._h_lease_grant = _metrics.histogram(
+            "ray_tpu_lease_grant_s",
+            "raylet-side lease grant latency (request to grant, parking "
+            "included)").handle()
 
     # component-facing compatibility views (tests, the dashboard, and the
     # worker pool read these under their historical names)
@@ -191,6 +201,7 @@ class Raylet(RpcServer):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._threads.append(t)
+        self._metrics_pusher.start()
         self._spawn_dashboard_agent()
         return self
 
@@ -321,6 +332,7 @@ class Raylet(RpcServer):
 
     def stop(self):
         super().stop()
+        self._metrics_pusher.stop()
         self.objects.stop()
         self.scheduler.stop()
         with self._ready_cv:
@@ -1004,8 +1016,14 @@ class Raylet(RpcServer):
                           runtime_env: dict | None = None,
                           timeout_s: float = 10.0, spill_count: int = 0,
                           token: str | None = None):
-        return self.scheduler.request_lease(demand, runtime_env, timeout_s,
+        from ray_tpu.util import metrics as _metrics
+
+        t0 = time.perf_counter()
+        resp = self.scheduler.request_lease(demand, runtime_env, timeout_s,
                                             spill_count, token=token)
+        if resp.get("ok") and _metrics.enabled():
+            self._h_lease_grant.observe(time.perf_counter() - t0)
+        return resp
 
     def rpc_cancel_leased(self, conn, send_lock, *, worker_id: str,
                           task: dict, force: bool = False):

@@ -25,8 +25,28 @@ import time
 from typing import Any, Callable
 
 from ray_tpu.runtime import fault_injection as _fi
+from ray_tpu.util import metrics as _metrics
 
 _LEN = struct.Struct(">Q")
+
+# RPC-boundary stage timer (metrics plane): server-side handler latency
+# per method. Handles are cached per method name so the hot dispatch
+# path pays one dict hit + one bisect, never a tag merge.
+_rpc_hist: _metrics.Histogram | None = None
+_rpc_handles: dict[str, _metrics._HistHandle] = {}
+
+
+def _rpc_handle(method: str) -> _metrics._HistHandle:
+    global _rpc_hist
+    h = _rpc_handles.get(method)
+    if h is None:
+        if _rpc_hist is None:
+            _rpc_hist = _metrics.histogram(
+                "ray_tpu_rpc_server_s",
+                "server-side RPC handler latency by method",
+                tag_keys=("method",))
+        h = _rpc_handles[method] = _rpc_hist.handle({"method": method})
+    return h
 
 
 class ConnectionLost(Exception):
@@ -242,7 +262,12 @@ class RpcServer:
         try:
             if handler is None:
                 raise AttributeError(f"no rpc method {method!r}")
-            result = handler(conn, send_lock, **payload)
+            if _metrics.enabled():
+                t0 = time.perf_counter()
+                result = handler(conn, send_lock, **payload)
+                _rpc_handle(method).observe(time.perf_counter() - t0)
+            else:
+                result = handler(conn, send_lock, **payload)
         except BaseException as e:  # noqa: BLE001 - ship to caller
             try:
                 self._send_reply(conn, {"_id": req_id, "error": e},

@@ -698,14 +698,24 @@ class Worker:
         task_event_buffer.cc -> gcs_task_manager.cc). Flushes every few
         events so the state API / dashboard / timeline see cluster tasks
         without a per-task RPC."""
+        import os as _os
         import time as _time
 
+        # start is this process's monotonic clock; wall_* re-anchors the
+        # pair to wall time before the event leaves the process, so the
+        # GCS sink holds cross-worker-comparable stamps (and the unified
+        # chrome trace can overlay them with wall-clock tracing spans)
+        end = _time.monotonic()
+        wall_end = _time.time()
         with self._event_lock:
             self._event_buf.append({
                 "task_id": task.get("task_id", ""),
                 "name": task.get("name", "?"),
                 "start": start,
-                "end": _time.monotonic(),
+                "end": end,
+                "wall_start": wall_end - (end - start),
+                "wall_end": wall_end,
+                "pid": _os.getpid(),
                 "state": "FINISHED" if ok else "FAILED",
                 "thread": f"worker-{self.worker_id[:8]}",
             })

@@ -21,6 +21,15 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
+
+from ray_tpu.util import metrics as _metrics
+
+# per-request ingress timer (metrics plane): full replica-side handling
+# latency of one proxied HTTP request through the ASGI app
+_h_ingress = _metrics.histogram(
+    "ray_tpu_serve_ingress_s",
+    "replica-side ASGI ingress request handling latency").handle()
 
 
 class _ASGIDriver:
@@ -107,21 +116,29 @@ class _ASGIDriver:
                 "headers": status["headers"], "body": b"".join(chunks)}
 
     def handle(self, request: dict) -> dict:
+        t0 = time.perf_counter()
         fut = asyncio.run_coroutine_threadsafe(self._run(request),
                                                self._loop)
-        return fut.result(timeout=request.get("timeout_s", 60))
+        out = fut.result(timeout=request.get("timeout_s", 60))
+        if _metrics.enabled():
+            _h_ingress.observe(time.perf_counter() - t0)
+        return out
 
     async def ahandle(self, request: dict) -> dict:
         """Await the app (on its dedicated loop) from ANOTHER loop,
         with the same per-request timeout the sync path enforces — a
         hung app must surface an error, not hold a concurrency slot
         forever."""
+        t0 = time.perf_counter()
         fut = asyncio.run_coroutine_threadsafe(self._run(request),
                                                self._loop)
         try:
-            return await asyncio.wait_for(
+            out = await asyncio.wait_for(
                 asyncio.wrap_future(fut),
                 timeout=request.get("timeout_s", 60))
+            if _metrics.enabled():
+                _h_ingress.observe(time.perf_counter() - t0)
+            return out
         except asyncio.TimeoutError:
             fut.cancel()
             raise TimeoutError(

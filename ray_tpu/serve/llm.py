@@ -37,6 +37,19 @@ import numpy as np
 from ray_tpu.models import decoding
 from ray_tpu.models.decoding import (KVCache, SamplingParams, lax_slice_row,
                                      lax_update_row)
+from ray_tpu.util import metrics as _metrics
+
+# Per-request TTFT decomposition (metrics plane): every request's time to
+# first token splits into queue_wait (submit -> prefill dispatch),
+# prefill (dispatch -> device completion, stamped by the ready watcher),
+# pipeline_stall (device completion -> the loop draining the firsts) and
+# ship (the host copy of the first-token batch). The four stages sum to
+# the observed TTFT exactly (see Request.breakdown).
+_STAGES = ("queue_wait", "prefill", "pipeline_stall", "ship")
+_serve_hist = _metrics.histogram(
+    "ray_tpu_serve_stage_s", "per-request serve TTFT stage latency",
+    tag_keys=("stage",))
+_h_stage = {s: _serve_hist.handle({"stage": s}) for s in _STAGES}
 
 
 def _bucket(n: int, minimum: int = 16) -> int:
@@ -57,6 +70,12 @@ class Request:
     out: "queue.Queue[int | None]" = field(default_factory=queue.Queue)
     submit_t: float = field(default_factory=time.monotonic)
     first_token_t: float | None = None
+    # TTFT decomposition stamps (see Request.breakdown): prefill batch
+    # dispatched / device results ready (watcher thread) / loop drained
+    # the first-token batch to the host
+    dispatch_t: float | None = None
+    ready_t: float | None = None
+    drain_t: float | None = None
     generated: int = 0
     slot: int = -1
     # set before the None sentinel when the request itself failed
@@ -69,6 +88,24 @@ class Request:
         if self.first_token_t is None:
             return None
         return self.first_token_t - self.submit_t
+
+    @property
+    def breakdown(self) -> dict | None:
+        """Measured TTFT decomposition. ``ready_t`` (stamped by the
+        watcher thread off the device stream) is clamped into
+        [dispatch_t, drain_t] so the four stages ALWAYS sum to the
+        observed TTFT exactly."""
+        if (self.first_token_t is None or self.dispatch_t is None
+                or self.drain_t is None):
+            return None
+        ready = self.ready_t if self.ready_t is not None else self.drain_t
+        ready = min(max(ready, self.dispatch_t), self.drain_t)
+        return {
+            "queue_wait_s": self.dispatch_t - self.submit_t,
+            "prefill_s": ready - self.dispatch_t,
+            "pipeline_stall_s": self.drain_t - ready,
+            "ship_s": self.first_token_t - self.drain_t,
+        }
 
     engine: "LLMEngine | None" = None
 
@@ -135,6 +172,16 @@ class LLMEngine:
         self.total_generated = 0
         self.total_finished = 0
         self.ttfts: "deque[float]" = deque(maxlen=1024)
+        # per-request TTFT stage breakdowns (same bounded window)
+        self.breakdowns: "deque[dict]" = deque(maxlen=1024)
+        # ready watcher: stamps Request.ready_t when a prefill batch's
+        # device results complete — block_until_ready OFF the loop
+        # thread, so the measurement never stalls the decode pipeline
+        self._ready_q: "queue.Queue | None" = None
+        if _metrics.enabled():
+            self._ready_q = queue.Queue()
+            threading.Thread(target=self._ready_watcher, daemon=True,
+                             name="llm-ready-watcher").start()
         # device-resident loop inputs (see _device_inputs)
         self._dev_inputs: dict | None = None
         self._dev_dirty = True
@@ -314,6 +361,25 @@ class LLMEngine:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
+        if self._ready_q is not None:
+            self._ready_q.put(None)
+
+    def _ready_watcher(self):
+        """Stamp ready_t per prefill batch in dispatch order (device
+        stream order, so sequential blocking gives correct stamps)."""
+        while True:
+            item = self._ready_q.get()
+            if item is None:
+                return
+            firsts, reqs = item
+            try:
+                firsts.block_until_ready()
+            except Exception:  # noqa: BLE001 - backend quirk: skip stamp
+                continue
+            now = time.monotonic()
+            for r in reqs:
+                if r.ready_t is None:
+                    r.ready_t = now
 
     def submit(self, prompt, *, max_new_tokens: int = 128,
                temperature: float = 0.0, eos_id: int | None = None) -> Request:
@@ -428,8 +494,13 @@ class LLMEngine:
                     m *= 2
                 part = items[i:i + m]
                 i += m
-                batches.append((part, self._dispatch_prefill(part,
-                                                             bucket)))
+                firsts = self._dispatch_prefill(part, bucket)
+                now = time.monotonic()
+                for it in part:
+                    it[0].dispatch_t = now
+                if self._ready_q is not None:
+                    self._ready_q.put((firsts, [it[0] for it in part]))
+                batches.append((part, firsts))
         # ASYNC first tokens: scatter each batch's firsts into the
         # device last-token vector (so the very next decode chunk
         # covers the new slots with no host round trip) and activate
@@ -479,11 +550,19 @@ class LLMEngine:
             if completed_seq is None or seq_at > completed_seq:
                 keep.append((seq_at, part, firsts))
                 continue
+            t_drain = time.monotonic()
             vals = np.asarray(firsts)
             now = time.monotonic()
             for (req, slot, plen, _), first in zip(part, vals):
+                req.drain_t = t_drain
                 req.first_token_t = now
                 self.ttfts.append(req.ttft)
+                bd = req.breakdown
+                if bd is not None:
+                    self.breakdowns.append(bd)
+                    if _metrics.enabled():
+                        for stage in _STAGES:
+                            _h_stage[stage].observe(bd[f"{stage}_s"])
                 self._emit(req, int(first))
         self._pending_firsts = keep
 
@@ -688,13 +767,20 @@ class LLMEngine:
 
     def stats(self) -> dict:
         live = sum(r is not None for r in self._active)
-        return {
+        out = {
             "active_slots": live,
             "waiting": self._waiting.qsize(),
             "total_generated": self.total_generated,
             "total_finished": self.total_finished,
             "mean_ttft_s": float(np.mean(self.ttfts)) if self.ttfts else None,
         }
+        if self.breakdowns:
+            bs = list(self.breakdowns)
+            out["ttft_breakdown_s"] = {
+                k: float(np.mean([b[k] for b in bs]))
+                for k in ("queue_wait_s", "prefill_s",
+                          "pipeline_stall_s", "ship_s")}
+        return out
 
 
 class LLMDeployment:

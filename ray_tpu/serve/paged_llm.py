@@ -589,6 +589,15 @@ class PagedLLMEngine(LLMEngine):
         out = super().stats()
         out["kv_pages_total"] = self.num_pages
         out["kv_pages_free"] = len(self._alloc.free)
+        # feed the metrics plane: pool occupancy + prefix-cache hit
+        # counters ride the process's next pushed delta frame
+        from ray_tpu.util import metrics as _m
+        if _m.enabled():
+            g = _m.gauge("ray_tpu_serve_kv_pages",
+                         "paged-KV pool size by state",
+                         tag_keys=("state",))
+            g.set(out["kv_pages_free"], tags={"state": "free"})
+            g.set(self.num_pages, tags={"state": "total"})
         out["prefix_cache"] = {
             "enabled": self._prefix_enabled,
             "hit_pages": self._prefix.hit_pages,

@@ -1,8 +1,11 @@
-"""ray_tpu.util: ecosystem utilities (reference: ray.util, SURVEY P22)."""
+"""ray_tpu.util: ecosystem utilities (reference: ray.util, SURVEY P22).
 
-from ray_tpu.util.actor_pool import ActorPool
-from ray_tpu.util.iter import ParallelIterator, from_items, from_range
-from ray_tpu.util.queue import Queue
+Lazy re-exports (PEP 562): the ecosystem helpers here decorate with
+``@ray_tpu.remote`` at import time, so importing them eagerly from this
+package ``__init__`` would make ``ray_tpu.util.metrics`` — which low-level
+runtime modules import for hot-path instrumentation — circular with the
+top-level ``ray_tpu`` package init.
+"""
 
 __all__ = [
     "ActorPool",
@@ -11,3 +14,20 @@ __all__ = [
     "from_items",
     "from_range",
 ]
+
+_HOMES = {
+    "ActorPool": "ray_tpu.util.actor_pool",
+    "ParallelIterator": "ray_tpu.util.iter",
+    "from_items": "ray_tpu.util.iter",
+    "from_range": "ray_tpu.util.iter",
+    "Queue": "ray_tpu.util.queue",
+}
+
+
+def __getattr__(name):
+    home = _HOMES.get(name)
+    if home is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(home), name)

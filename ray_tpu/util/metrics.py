@@ -4,7 +4,15 @@
 Counter/Gauge/Histogram with tag support; the process-local registry
 exports Prometheus text format (the reference pushes to a per-node metrics
 agent scraped by Prometheus — here ``export_prometheus()`` serves the same
-wire format for any scraper)."""
+wire format for any scraper).
+
+The registry also feeds the CLUSTER metrics plane
+(``runtime/metrics_plane.py``): each process periodically snapshots the
+registry as a DELTA frame (``snapshot_delta``) and pushes it to the GCS
+time-series store. Hot-path call sites guard on :func:`enabled` (one
+cached boolean read) and observe through pre-resolved series handles
+(:meth:`Histogram.handle`) so the instrumented cost stays within the
+<3% overhead budget (``tests/test_metrics_plane.py``)."""
 
 from __future__ import annotations
 
@@ -14,6 +22,34 @@ from collections import defaultdict
 
 _registry_lock = threading.Lock()
 _registry: dict[str, "Metric"] = {}
+
+# ---------------------------------------------------------------------------
+# master switch — resolved once from config (RAY_TPU_METRICS_ENABLED);
+# hot paths read the cached module global, never the environment
+# ---------------------------------------------------------------------------
+
+_enabled: bool | None = None
+
+
+def enabled() -> bool:
+    """Whether hot-path instrumentation + the push plane are on.
+    Resolved from config on first call, then a plain module-global read."""
+    global _enabled
+    if _enabled is None:
+        try:
+            from ray_tpu.utils.config import get_config
+
+            _enabled = bool(get_config().metrics_enabled)
+        except Exception:  # noqa: BLE001 - config import cycle during boot
+            return True
+    return _enabled
+
+
+def set_enabled(flag: bool | None):
+    """Override (tests / explicit opt-out). ``None`` re-resolves from
+    config on the next :func:`enabled` call."""
+    global _enabled
+    _enabled = flag
 
 
 class Metric:
@@ -67,6 +103,26 @@ class Gauge(Metric):
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
 
 
+class _HistHandle:
+    """Pre-resolved (histogram, tag-key) pair for hot paths: observe()
+    skips the per-call dict merge + sorted() of :meth:`Metric._key` —
+    the tag tuple is computed once at handle creation."""
+
+    __slots__ = ("_hist", "_key_t")
+
+    def __init__(self, hist: "Histogram", key_t: tuple):
+        self._hist = hist
+        self._key_t = key_t
+
+    def observe(self, value: float):
+        h = self._hist
+        idx = bisect.bisect_left(h.boundaries, value)
+        with h._lock:
+            h._counts[self._key_t][idx] += 1
+            h._sums[self._key_t] += value
+            h._totals[self._key_t] += 1
+
+
 class Histogram(Metric):
     def __init__(self, name, description="", boundaries=DEFAULT_BUCKETS,
                  tag_keys=()):
@@ -85,11 +141,148 @@ class Histogram(Metric):
             self._sums[key] += value
             self._totals[key] += 1
 
+    def handle(self, tags: dict | None = None) -> _HistHandle:
+        return _HistHandle(self, self._key(tags))
+
     def series(self):
         with self._lock:
             return {k: {"buckets": list(v), "sum": self._sums[k],
                         "count": self._totals[k]}
                     for k, v in self._counts.items()}
+
+
+# ---------------------------------------------------------------------------
+# get-or-create constructors (instrumented modules resolve their metric
+# once at module/first-use time; re-registration must return the live
+# instance, not shadow its accumulated series)
+# ---------------------------------------------------------------------------
+
+
+def _get_or_create(cls, name, description, **kwargs):
+    with _registry_lock:
+        m = _registry.get(name)
+    if m is not None and type(m) is cls:
+        return m
+    return cls(name, description, **kwargs)
+
+
+def counter(name: str, description: str = "", tag_keys=()) -> Counter:
+    return _get_or_create(Counter, name, description, tag_keys=tag_keys)
+
+
+def gauge(name: str, description: str = "", tag_keys=()) -> Gauge:
+    return _get_or_create(Gauge, name, description, tag_keys=tag_keys)
+
+
+def histogram(name: str, description: str = "",
+              boundaries=DEFAULT_BUCKETS, tag_keys=()) -> Histogram:
+    return _get_or_create(Histogram, name, description,
+                          boundaries=boundaries, tag_keys=tag_keys)
+
+
+def clear_registry():
+    """Tests only: drop every registered metric (a fresh process-local
+    registry; live Metric objects keep working but stop being exported)."""
+    with _registry_lock:
+        _registry.clear()
+
+
+# ---------------------------------------------------------------------------
+# snapshots + delta frames (the push plane's wire unit)
+# ---------------------------------------------------------------------------
+
+
+def snapshot() -> dict:
+    """Cumulative snapshot of every registered metric:
+    ``{name: {"kind", "boundaries"?, "series": {tag_key_tuple: payload}}}``
+    where payload is a float (counter/gauge) or
+    ``{"buckets": [...], "sum": s, "count": n}`` (histogram)."""
+    with _registry_lock:
+        metrics = list(_registry.values())
+    out = {}
+    for m in metrics:
+        entry = {"kind": type(m).__name__.lower(), "series": m.series()}
+        if isinstance(m, Histogram):
+            entry["boundaries"] = list(m.boundaries)
+        out[m.name] = entry
+    return out
+
+
+def snapshot_delta(prev: dict | None) -> tuple[dict, dict]:
+    """One push frame: counters/histograms as DELTAS against ``prev``
+    (the last snapshot this process successfully framed), gauges as
+    current values. Returns ``(frame, new_prev)``; empty series are
+    dropped so an idle process frames nothing. A counter/bucket that
+    went BACKWARDS (registry cleared) resends its current value."""
+    cur = snapshot()
+    prev = prev or {}
+    frame: dict = {}
+    for name, entry in cur.items():
+        kind = entry["kind"]
+        prev_series = (prev.get(name) or {}).get("series", {})
+        out_series: dict = {}
+        if kind == "gauge":
+            out_series = dict(entry["series"])
+        elif kind == "counter":
+            for key, val in entry["series"].items():
+                base = prev_series.get(key, 0.0)
+                d = val - base if val >= base else val
+                if d != 0.0:
+                    out_series[key] = d
+        else:  # histogram
+            for key, data in entry["series"].items():
+                base = prev_series.get(key)
+                if base is None or base["count"] > data["count"]:
+                    d = data
+                else:
+                    d = {"buckets": [a - b for a, b in
+                                     zip(data["buckets"], base["buckets"])],
+                         "sum": data["sum"] - base["sum"],
+                         "count": data["count"] - base["count"]}
+                if d["count"] > 0:
+                    out_series[key] = d
+        if out_series:
+            ent = {"kind": kind, "series": out_series}
+            if "boundaries" in entry:
+                ent["boundaries"] = entry["boundaries"]
+            frame[name] = ent
+    return frame, cur
+
+
+def merge_hist(into: dict | None, data: dict) -> dict:
+    """Accumulate one histogram payload into another (additive across
+    processes/windows — the cluster-aggregation primitive)."""
+    if into is None:
+        return {"buckets": list(data["buckets"]), "sum": data["sum"],
+                "count": data["count"]}
+    into["buckets"] = [a + b for a, b in zip(into["buckets"],
+                                             data["buckets"])]
+    into["sum"] += data["sum"]
+    into["count"] += data["count"]
+    return into
+
+
+def quantile_from_buckets(boundaries, buckets, q: float) -> float | None:
+    """Quantile estimate from cumulative-able bucket counts (linear
+    interpolation inside the winning bucket; the +Inf bucket returns its
+    lower bound — same convention as Prometheus ``histogram_quantile``)."""
+    total = sum(buckets)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, cnt in enumerate(buckets):
+        if cnt <= 0:
+            continue
+        if cum + cnt >= rank:
+            if i >= len(boundaries):         # +Inf bucket
+                return float(boundaries[-1]) if boundaries else None
+            lo = float(boundaries[i - 1]) if i > 0 else 0.0
+            hi = float(boundaries[i])
+            frac = (rank - cum) / cnt
+            return lo + (hi - lo) * frac
+        cum += cnt
+    return float(boundaries[-1]) if boundaries else None
 
 
 def _fmt_tags(key: tuple) -> str:

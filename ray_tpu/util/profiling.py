@@ -40,17 +40,21 @@ def _folded_stack(frame) -> str:
 
 
 def sample_profile(duration_s: float = 2.0, hz: int = 100,
-                   exclude_thread: int | None = None) -> dict:
+                   exclude_thread: int | None = None,
+                   stop: "threading.Event | None" = None) -> dict:
     """Sample all threads for ``duration_s`` and aggregate folded stacks
     (py-spy ``record`` analog). Returns {"folded": "stack count" lines,
     "samples": N, "duration_s": d} — feed ``folded`` to any flamegraph
-    renderer."""
+    renderer. ``stop`` ends the run early — callers profiling a
+    workload of unknown length pass a generous duration plus the event."""
     interval = 1.0 / max(hz, 1)
     counts: Counter = Counter()
     samples = 0
     me = threading.get_ident()
-    deadline = time.monotonic() + duration_s
-    while time.monotonic() < deadline:
+    start = time.monotonic()
+    deadline = start + duration_s
+    while time.monotonic() < deadline and \
+            not (stop is not None and stop.is_set()):
         for ident, frame in sys._current_frames().items():
             if ident == me or ident == exclude_thread:
                 continue
@@ -59,7 +63,7 @@ def sample_profile(duration_s: float = 2.0, hz: int = 100,
         time.sleep(interval)
     folded = "\n".join(f"{stack} {n}" for stack, n in counts.most_common())
     return {"folded": folded, "samples": samples,
-            "duration_s": duration_s}
+            "duration_s": round(time.monotonic() - start, 3)}
 
 
 def host_stats(spill_dir: str | None = None) -> dict:

@@ -112,6 +112,58 @@ def cluster_summary() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# cluster metrics plane (runtime/metrics_plane.py): push-aggregated
+# time series in the GCS, queried here — reference analog: the
+# Prometheus endpoint the dashboard's Metrics tab queries
+# ---------------------------------------------------------------------------
+
+
+def cluster_metrics(name: str | None = None, *, tags: dict | None = None,
+                    last_s: float | None = None, group_by=(),
+                    per_window: bool = False) -> dict:
+    """Query the GCS time-series store. ``name=None`` lists metric
+    names; otherwise returns the merged aggregate over every window in
+    range (``per_window=True`` for the raw range query). ``group_by``
+    names tag keys to split on — ``["src"]`` gives per-process/per-node
+    series. In local mode the process registry answers directly (one
+    window, no ring buffer)."""
+    mode, rt = _mode()
+    if mode == "cluster":
+        return rt._gcs.call("query_metrics", name=name, tags=tags,
+                            last_s=last_s, group_by=tuple(group_by or ()),
+                            per_window=per_window)
+    from ray_tpu.runtime.metrics_plane import MetricsStore
+    from ray_tpu.util import metrics as _metrics
+
+    store = MetricsStore(window_s=3600.0)
+    frame, _ = _metrics.snapshot_delta(None)
+    store.ingest("local", frame)
+    if name is None:
+        return {"names": store.names()}
+    return store.query(name, tags=tags, last_s=last_s,
+                       group_by=group_by, per_window=per_window)
+
+
+def summarize_latencies(last_s: float | None = 300.0,
+                        quantiles=(0.5, 0.95, 0.99)) -> dict:
+    """Digest of every cluster latency histogram: count / mean / p50 /
+    p95 / p99 per metric over the window — the one-call answer to
+    "where is the time going right now"."""
+    from ray_tpu.runtime.metrics_plane import summarize_histogram
+
+    names = cluster_metrics().get("names", {})
+    out = {}
+    for name, kind in sorted(names.items()):
+        if kind != "histogram":
+            continue
+        res = cluster_metrics(name, last_s=last_s)
+        digest = summarize_histogram(res, quantiles=quantiles)
+        if digest.get("count"):
+            out[name] = digest
+    return out
+
+
+# ---------------------------------------------------------------------------
 # profiling / stack introspection (reference: py-spy dump/record through
 # the dashboard reporter agent, profile_manager.py:11-51 — here every
 # raylet proxies its workers' in-process samplers)

@@ -212,3 +212,49 @@ def to_chrome_trace(spans: list[dict]) -> list[dict]:
         }
         for s in spans
     ]
+
+
+def export_chrome_trace(trace_dir: str | None = None,
+                        filename: str | None = None) -> list[dict]:
+    """One chrome://tracing file for the whole story: tracing spans AND
+    ``ray_tpu.timeline()`` task lifecycle events, merged on a shared
+    wall-clock domain.
+
+    Spans are recorded with ``time.time()``; task events are recorded
+    monotonic but wall-anchored at record time inside each producing
+    process (``wall_start``/``wall_end``), so both series line up in one
+    viewer without post-hoc clock matching.
+
+    pid/tid mapping (one row group per OS process):
+
+    - pid — the OS pid of the producing process for BOTH kinds, so a
+      worker's spans and its task executions share a process group.
+    - tid — for spans, the ``trace_id`` (one lane per distributed call
+      tree: submit + run spans of a call nest on one line); for task
+      events, the executing thread name (one lane per executor thread).
+
+    ``trace_dir`` defaults to the active trace dir (``enable_tracing``);
+    with tracing off, the export is the timeline alone. Task events need
+    an initialized runtime — without one the export is the spans alone.
+    Returns the merged event list; ``filename`` additionally dumps it as
+    JSON.
+    """
+    if trace_dir is None:
+        trace_dir = os.environ.get(_ENV_DIR)
+    events: list[dict] = []
+    if trace_dir:
+        events.extend(to_chrome_trace(read_spans(trace_dir)))
+    try:
+        import ray_tpu
+
+        events.extend(ray_tpu.timeline())
+    except Exception:  # noqa: BLE001 - no runtime: spans-only export
+        pass
+    # process_name metadata so the viewer labels each pid row group
+    for pid in sorted({e["pid"] for e in events if "pid" in e}):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"ray_tpu pid {pid}"}})
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

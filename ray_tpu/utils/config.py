@@ -334,6 +334,30 @@ class Config:
     event_buffer_size: int = 10000
     log_level: str = "INFO"
 
+    # --- cluster metrics plane (util/metrics.py + runtime/metrics_plane.py;
+    # reference analog: the opencensus stats registry pushed to the node
+    # metrics agent and scraped by Prometheus — here each process pushes
+    # delta frames straight to the GCS time-series store) ---
+    # Master switch for hot-path instrumentation AND the push loop.
+    # RAY_TPU_METRICS_ENABLED=0 turns every timer into one cached
+    # boolean read (the <3% overhead gate measures against this).
+    metrics_enabled: bool = True
+    # Delta-frame push period per process (driver / worker / raylet /
+    # GCS self-ingest). Coarse by design: at 2k workers/host this is
+    # idle control-plane load next to the ref heartbeat.
+    metrics_push_interval_s: float = 2.0
+    # Ring-buffer time-series store on the GCS: window width and how
+    # many windows are kept per (metric, tags) series.
+    metrics_window_s: float = 5.0
+    metrics_windows: int = 60
+    # Bounded pusher buffer: frames queued past this are DROPPED (the
+    # plane is strictly best-effort — a slow/partitioned GCS must never
+    # block or backpressure a hot path).
+    metrics_push_buffer: int = 8
+    # Sampling profiler riding BENCH_MODE=envelope's steady-call phase
+    # (satellite of ROADMAP #2): writes a collapsed-stack artifact.
+    bench_profile_enabled: bool = False
+
     def __post_init__(self):
         for f in fields(self):
             setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))

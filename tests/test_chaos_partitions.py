@@ -524,6 +524,153 @@ def test_duplicate_host_actors_batch_is_noop(chaos_cluster):
         ray_tpu.kill(a)
 
 
+# ----------------------------------------------------------------------
+# round 7: metrics-plane chaos — CH_METRICS faults cost observability
+# fidelity only, never task submission / lease grants / serve handling
+# ----------------------------------------------------------------------
+
+@pytest.fixture
+def metrics_chaos_cluster(monkeypatch):
+    import ray_tpu.runtime.metrics_plane as mp
+    from ray_tpu.utils.config import reset_config
+
+    monkeypatch.setenv("RAY_TPU_METRICS_PUSH_INTERVAL_S", "0.1")
+    reset_config()
+    ray_tpu.shutdown()
+    fi.plane.clear()
+    c = Cluster(heartbeat_timeout_s=HEARTBEAT_S)
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.gcs_address)
+    # Deterministic RPC-path pusher: in an in-process cluster the GCS
+    # self-loop (direct ingest, no wire) races the raylet/driver pushers
+    # for the process-wide claim. Hand the role to a test-owned pusher
+    # so the injected CH_METRICS faults provably cross the RPC boundary.
+    mp._claimed = None
+    pusher = mp.MetricsPusher(c.gcs_address, src="chaos-test",
+                              kind="driver", interval_s=0.1).start()
+    assert pusher._thread is not None, "test pusher failed to claim"
+    yield c, pusher
+    pusher.stop()
+    fi.plane.clear()
+    ray_tpu.shutdown()
+    fi.stop_kv_watcher()
+    c.shutdown()
+    fi.plane.clear()
+    reset_config()
+
+
+async def _ok_app(scope, receive, send):
+    await send({"type": "http.response.start", "status": 200,
+                "headers": []})
+    await send({"type": "http.response.body", "body": b"ok"})
+
+
+def test_metrics_frame_chaos_never_blocks_work(metrics_chaos_cluster):
+    """Dropped, duplicated, AND delayed push_metrics frames while tasks,
+    lease grants, and serve ingress handling run at full speed."""
+    from ray_tpu.serve.ingress import _ASGIDriver
+
+    c, pusher = metrics_chaos_cluster
+    assert ray_tpu.get(double.remote(1), timeout=60) == 2
+    asgi = _ASGIDriver(_ok_app)
+    assert asgi.handle({"method": "GET", "path": "/"})["status"] == 200
+
+    fi.put_plan(c.gcs_address, {
+        "version": 1, "seed": 7,
+        "rules": [
+            {"id": "delay-metrics", "fault": "delay", "src": "gcs",
+             "direction": "recv", "method": "push_metrics",
+             "delay_s": 0.2, "max_hits": 4},
+            {"id": "dup-metrics", "fault": "duplicate", "src": "gcs",
+             "direction": "recv", "method": "push_metrics",
+             "every": 3, "max_hits": 2},
+            {"id": "drop-metrics", "fault": "drop", "src": "gcs",
+             "direction": "recv", "method": "push_metrics",
+             "every": 2, "max_hits": 2},
+        ]})
+
+    # keep the workload flowing until every fault class has fired; each
+    # leg stays fast THROUGHOUT (instrumentation is registry-local — a
+    # faulted push frame can only stall the pusher thread)
+    rule_ids = ("delay-metrics", "dup-metrics", "drop-metrics")
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        assert ray_tpu.get([double.remote(i) for i in range(10)],
+                           timeout=60) == [i * 2 for i in range(10)]
+        t0 = time.monotonic()
+        assert asgi.handle({"method": "GET", "path": "/"})["status"] == 200
+        # < the 2s metrics RPC timeout: serve handling provably never
+        # waited on the faulted metrics wire
+        assert time.monotonic() - t0 < 1.0, \
+            "serve ingress handling slowed by metrics faults"
+        if all(fi.plane.stats.get(r) for r in rule_ids):
+            break
+        time.sleep(0.1)
+    assert all(fi.plane.stats.get(r) for r in rule_ids), \
+        f"metrics faults never fired: {fi.plane.stats}"
+
+    # a direct lease grant under the (possibly mid-drop-timeout) plane
+    raylet = _head_raylet(c)
+    t0 = time.monotonic()
+    r = raylet.rpc_request_lease(None, None, demand={"CPU": 1},
+                                 timeout_s=5, token="metrics-chaos-lease")
+    assert r.get("ok"), r
+    assert time.monotonic() - t0 < 2.0, \
+        "lease grant slowed by metrics faults"
+    with raylet.workers.lock:
+        w = raylet.workers.workers.get(r["worker_id"])
+        if w is not None and w.state == "leased":
+            w.state = "idle"
+            w.acquired = None
+    raylet.scheduler.release({"CPU": 1})
+
+    _heal(c, version=2)
+    # the plane keeps flowing after the chaos (drops cost fidelity only)
+    pushed = pusher.pushed
+    _wait(lambda: pusher.pushed > pushed, 30,
+          "metrics pushes to resume after frame chaos")
+
+
+def test_metrics_partitioned_gcs_work_unaffected_then_resumes(
+        metrics_chaos_cluster):
+    """A full partition of the metrics channel to the GCS: submission,
+    actor calls, and queries stay up; pushes stall silently and resume
+    on heal."""
+    from ray_tpu.util import state as state_api
+
+    c, pusher = metrics_chaos_cluster
+    assert ray_tpu.get(double.remote(1), timeout=60) == 2
+    _wait(lambda: pusher.pushed > 0, 30, "first metrics frames")
+
+    fi.put_plan(c.gcs_address, {
+        "version": 1, "seed": 7,
+        "endpoints": {"gcs": [_addr(c.gcs_address)]},
+        "rules": [{"id": "cut-metrics-gcs", "fault": "partition",
+                   "src": "metrics", "dst": "gcs", "direction": "both"}]})
+    t_cut = time.monotonic()
+
+    # the whole work surface rides THROUGH the severed metrics channel
+    actor = Ordered.remote()
+    assert ray_tpu.get([double.remote(i) for i in range(30)],
+                       timeout=60) == [i * 2 for i in range(30)]
+    assert ray_tpu.get([actor.add.remote(i) for i in range(10)],
+                       timeout=60) == list(range(10))
+    # ...and the query path (driver-labeled, not partitioned) answers
+    assert isinstance(state_api.cluster_metrics().get("names"), dict)
+
+    # the partition is real: the pusher's channel was actually cut
+    _wait(lambda: fi.plane.stats.get("cut-metrics-gcs"), 30,
+          "metrics partition to fire")
+    time.sleep(max(0.0, PARTITION_S - (time.monotonic() - t_cut)))
+
+    pushed_during = pusher.pushed
+    _heal(c, version=2)
+    # pushes resume (heartbeat handler timers keep generating deltas)
+    _wait(lambda: pusher.pushed > pushed_during, 30,
+          "metrics pushes to resume after heal")
+    ray_tpu.kill(actor)
+
+
 def test_dropped_register_actors_retried_without_orphan(chaos_cluster):
     """Round-6 plane: a register_actors frame dropped on the GCS recv
     path leaves NO partial state (no orphan registration), and the
