@@ -330,6 +330,12 @@ def bench_serve() -> dict:
         ttft_breakdown["mean_observed_ttft_s"] = round(
             float(np.mean([t for _, t, bd in steady_rows
                            if bd is not None])), 4)
+        # queue wait as a share of the whole TTFT: the continuous-
+        # admission acceptance number (ci/perf_gate.py fences it)
+        if ttft_breakdown["sum_s"] > 0:
+            ttft_breakdown["queue_wait_share"] = round(
+                ttft_breakdown["queue_wait_s"] / ttft_breakdown["sum_s"],
+                4)
 
     # -- prefix-cache phase: shared system prompt + unique tails --
     # (the chat/agent-serving shape; random-prompt phases above never
@@ -407,6 +413,206 @@ def bench_serve() -> dict:
         },
     }
     return result
+
+
+def bench_serve_scaleout() -> dict:
+    """Multi-replica serve leg: cluster tokens/s and per-replica TTFT
+    decomposition at 1/2/4 replicas under REPEAT-PREFIX traffic, routed
+    through the prefix-affinity DeploymentHandle (serve/prefix_router.py
+    digests pushed over the metrics plane from real worker processes).
+
+    The scaling mechanism on a 1-cpu host is redundant-prefill
+    ELIMINATION, not extra compute: 8 session prefixes of 24 pages each
+    (192 pages working set) round-robin against a 128-page per-replica
+    pool, so one replica LRU-thrashes and re-prefills ~768 tokens per
+    request, while 2+ replicas with affinity routing each keep their
+    session subset cached and prefill only the 32-token tail. Efficiency
+    at 2x = cluster tokens/s ratio vs the single-replica leg."""
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.utils.config import reset_config
+
+    # digests must reach the router fast enough to settle affinity
+    # within a couple of rounds (default 2s push would dominate a leg)
+    os.environ.setdefault("RAY_TPU_METRICS_PUSH_INTERVAL_S", "0.25")
+    reset_config()
+
+    PAGE, PREFIX, TAIL, NEW = 32, 768, 32, 8
+    MAX_LEN, MAX_BATCH, POOL = 1024, 4, 128
+    SESSIONS, CONC = 8, 4
+    SETTLE_ROUNDS = int(os.environ.get("BENCH_SCALEOUT_SETTLE", "3"))
+    MEASURE_ROUNDS = int(os.environ.get("BENCH_SCALEOUT_ROUNDS", "4"))
+    REPLICA_LEGS = (1, 2, 4)
+
+    from ray_tpu.models import llama
+    vocab = llama.llama_tiny().vocab_size
+
+    c = Cluster()
+    c.add_node(num_cpus=max(REPLICA_LEGS) + 1)
+    ray_tpu.init(address=c.gcs_address)
+
+    rng = np.random.default_rng(0)
+    session_prefixes = [rng.integers(1, vocab, PREFIX)
+                        for _ in range(SESSIONS)]
+
+    @serve.deployment(max_concurrent_queries=8)
+    class ScaleLLM:
+        def __init__(self):
+            import jax
+            from ray_tpu.models import llama as _llama
+            from ray_tpu.serve.paged_llm import PagedLLMEngine
+
+            cfg = _llama.llama_tiny()
+            params = _llama.init_params(cfg, jax.random.key(0))
+            self.eng = PagedLLMEngine(
+                params=params, cfg=cfg, max_batch=MAX_BATCH,
+                max_len=MAX_LEN, page_size=PAGE, num_pages=POOL,
+                decode_chunk=8)
+            # cold-miss prefill + decode buckets, then the suffix
+            # programs prefix-cache hits dispatch — no XLA compile may
+            # land inside a measured round
+            self.eng.warmup(PREFIX + TAIL)
+            self.eng.warmup_prefix(PREFIX, TAIL)
+            self.eng.start()
+
+        def __call__(self, tokens, max_new):
+            import numpy as _np
+
+            w = self.eng.submit(_np.asarray(tokens, _np.int32),
+                                max_new_tokens=max_new)
+            toks = list(w.tokens())
+            st = self.eng.stats()     # also force-publishes the digest
+            pc = st["prefix_cache"]
+            return {"n": len(toks), "ttft": w.ttft,
+                    "breakdown": w.breakdown,
+                    "tag": self.eng.replica_tag,
+                    "hit_pages": pc["hit_pages"],
+                    "miss_pages": pc["miss_pages"]}
+
+    def _run_leg(n_replicas: int) -> dict:
+        name = f"scale{n_replicas}"
+        handle = serve.run(
+            ScaleLLM.options(name=name, num_replicas=n_replicas).bind(),
+            name=name)
+
+        def _call(req_tokens):
+            toks = [int(t) for t in req_tokens]
+            return ray_tpu.get(
+                handle.remote(toks, NEW, _prefix_tokens=toks),
+                timeout=600)
+
+        def _run_rounds(rounds: int):
+            seq = [np.concatenate([session_prefixes[s],
+                                   rng.integers(1, vocab, TAIL)])
+                   for _ in range(rounds) for s in range(SESSIONS)]
+            out: list = []
+            lock = threading.Lock()
+            idx = [0]
+
+            def worker():
+                while True:
+                    with lock:
+                        i = idx[0]
+                        if i >= len(seq):
+                            return
+                        idx[0] += 1
+                    r = _call(seq[i])
+                    with lock:
+                        out.append(r)
+
+            ths = [threading.Thread(target=worker) for _ in range(CONC)]
+            t0 = time.perf_counter()
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            return out, time.perf_counter() - t0
+
+        # settle: absorbs replica construction, prime misses, and the
+        # digest-driven session->replica migration; hit/miss counters at
+        # the end of settle are the measured rounds' baselines
+        settle, _ = _run_rounds(SETTLE_ROUNDS)
+        base: dict = {}
+        for r in settle:
+            b = base.setdefault(r["tag"], {"hit": 0, "miss": 0})
+            b["hit"] = max(b["hit"], r["hit_pages"])
+            b["miss"] = max(b["miss"], r["miss_pages"])
+
+        measured, elapsed = _run_rounds(MEASURE_ROUNDS)
+        tokens = sum(r["n"] for r in measured)
+        ttfts = [r["ttft"] for r in measured if r["ttft"] is not None]
+        per_tag: dict = {}
+        for r in measured:
+            d = per_tag.setdefault(r["tag"], {
+                "requests": 0, "ttfts": [], "bds": [],
+                "hit": 0, "miss": 0})
+            d["requests"] += 1
+            if r["ttft"] is not None:
+                d["ttfts"].append(r["ttft"])
+            if r["breakdown"]:
+                d["bds"].append(r["breakdown"])
+            d["hit"] = max(d["hit"], r["hit_pages"])
+            d["miss"] = max(d["miss"], r["miss_pages"])
+        per_replica = {}
+        for tag, d in sorted(per_tag.items()):
+            b = base.get(tag, {"hit": 0, "miss": 0})
+            bd = None
+            if d["bds"]:
+                bd = {k: round(float(np.mean([x[k] for x in d["bds"]])), 4)
+                      for k in ("queue_wait_s", "prefill_s",
+                                "pipeline_stall_s", "ship_s")}
+            per_replica[tag] = {
+                "requests": d["requests"],
+                "p50_ttft_s": (round(float(np.median(d["ttfts"])), 4)
+                               if d["ttfts"] else None),
+                "ttft_breakdown": bd,
+                "prefix_hit_pages": d["hit"] - b["hit"],
+                "prefix_miss_pages": d["miss"] - b["miss"],
+            }
+        leg = {
+            "replicas": n_replicas,
+            "requests": len(measured),
+            "elapsed_s": round(elapsed, 3),
+            "cluster_tokens_per_sec": round(tokens / elapsed, 1),
+            "p50_ttft_s": (round(float(np.median(ttfts)), 4)
+                           if ttfts else None),
+            "per_replica": per_replica,
+        }
+        serve.delete(name)
+        return leg
+
+    legs = {str(n): _run_leg(n) for n in REPLICA_LEGS}
+    tps1 = legs["1"]["cluster_tokens_per_sec"]
+    eff2 = round(legs["2"]["cluster_tokens_per_sec"] / tps1, 3)
+    eff4 = round(legs["4"]["cluster_tokens_per_sec"] / tps1, 3)
+    serve.shutdown()
+    ray_tpu.shutdown()
+    c.shutdown()
+    return {
+        "metric": "serve_scaleout_efficiency_2x",
+        "value": eff2,
+        "unit": "x",
+        "vs_baseline": None,  # reference publishes no serving numbers
+        "detail": {
+            "traffic": {
+                "sessions": SESSIONS, "prefix_len": PREFIX,
+                "tail_len": TAIL, "new_tokens": NEW,
+                "page_size": PAGE, "pool_pages": POOL,
+                "working_set_pages": SESSIONS * (PREFIX // PAGE),
+                "concurrency": CONC,
+                "measured_requests": MEASURE_ROUNDS * SESSIONS,
+            },
+            "prefix_affinity_routing": True,
+            "efficiency_2x": eff2,
+            "efficiency_4x": eff4,
+            "legs": legs,
+        },
+    }
 
 
 def bench_core() -> dict:
@@ -709,6 +915,11 @@ def bench_all() -> dict:
     idle wait and the child's numbers match a standalone run."""
     subs = [("core", bench_core_subprocess),
             ("envelope", lambda: _bench_subprocess("envelope", 1800.0)),
+            # multi-replica scale-out leg: own subprocess (it builds a
+            # worker-process cluster) BEFORE the in-parent serve leg
+            # imports jax
+            ("serve_scaleout",
+             lambda: _bench_subprocess("serve_scaleout", 1800.0)),
             ("serve", bench_serve)]
     if os.environ.get("BENCH_PRESET", "base") != "small":
         # the ~1B entry is a real-chip measurement; a CPU smoke run
@@ -741,6 +952,7 @@ if __name__ == "__main__":
     mode = os.environ.get("BENCH_MODE", "all")
     fn = {"serve": bench_serve, "core": bench_core,
           "envelope": bench_envelope,
+          "serve_scaleout": bench_serve_scaleout,
           "train": bench_train}.get(mode, bench_all)
     print(json.dumps(fn()))
     sys.exit(0)

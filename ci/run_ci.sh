@@ -22,6 +22,9 @@ if [ "${1:-}" = "--nightly" ]; then
     -m nightly -q -s
   stage "nightly serve soak (paged engine page/refcount flatness)"
   python -m pytest tests/test_serve_soak_nightly.py -m nightly -q -s
+  stage "nightly serve autoscaling swing (square wave, pushed metrics)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_serve_autoscale_nightly.py \
+    -m nightly -q -s
   stage "nightly RL plane (pixel-obs throughput + learning)"
   # conftest forces the 8-device virtual CPU platform the mesh
   # learners need

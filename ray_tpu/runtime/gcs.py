@@ -1767,12 +1767,17 @@ class GcsServer(RpcServer):
                                   "data": window["data"]})
 
     def rpc_push_metrics(self, conn, send_lock, *, src, frame,
-                         kind="worker", ts=None):
+                         kind="worker", ts=None, annex=None):
         """Ingest one delta frame from a process's MetricsPusher.
         Duplicate delivery over-counts a window slightly (at-most-once
         is traded for never-blocking); the store is additive so the
-        damage is bounded to the duplicated frame."""
-        self._metrics_store.ingest(src, frame, ts)
+        damage is bounded to the duplicated frame. ``annex`` is the
+        pusher's piggybacked annex set (e.g. serve prefix-cache
+        digests): latest-wins per (src, key), no windowing."""
+        if frame:
+            self._metrics_store.ingest(src, frame, ts)
+        if annex is not None:
+            self._metrics_store.put_annexes(src, annex)
         return {"ok": True}
 
     def rpc_query_metrics(self, conn, send_lock, *, name=None,
@@ -1783,6 +1788,11 @@ class GcsServer(RpcServer):
         return self._metrics_store.query(
             name, tags=tags, last_s=last_s, group_by=group_by,
             per_window=per_window)
+
+    def rpc_query_metric_annexes(self, conn, send_lock, *, prefix="",
+                                 max_age_s=None):
+        return {"annexes": self._metrics_store.annexes(
+            prefix, max_age_s=max_age_s)}
 
     def _metrics_self_loop(self):
         """The GCS ingests its OWN registry (rpc handler timers, actor
@@ -1804,6 +1814,10 @@ class GcsServer(RpcServer):
                 frame, prev = _metrics.snapshot_delta(prev)
                 if frame:
                     self._metrics_store.ingest("gcs", frame)
+                ann = _mp.local_annexes()
+                if ann:
+                    self._metrics_store.put_annexes(
+                        "gcs", {k: v[1] for k, v in ann.items()})
             except Exception:  # noqa: BLE001 - observability only
                 pass
 

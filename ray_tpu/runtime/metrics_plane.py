@@ -40,6 +40,41 @@ FAULT_LABEL = "metrics"
 _claim_lock = threading.Lock()
 _claimed: str | None = None
 
+# -- metric annexes ------------------------------------------------------
+#
+# Small opaque payloads piggybacked on metrics frames: a publisher
+# (e.g. a serve replica's prefix-cache digest) registers them in the
+# process-local annex registry; the process's pusher attaches the
+# current annex set to its next push, and the GCS-side MetricsStore
+# keeps the latest payload per (src, key) stamped with its push time.
+# Same best-effort contract as the series: a lost annex costs routing
+# fidelity, never correctness.
+_annex_lock = threading.Lock()
+_annexes: dict[str, tuple[float, object]] = {}
+_annex_version = 0
+
+
+def set_annex(key: str, payload) -> None:
+    """Publish (payload) or retract (None) one annex under ``key``."""
+    global _annex_version
+    with _annex_lock:
+        if payload is None:
+            _annexes.pop(key, None)
+        else:
+            _annexes[key] = (time.time(), payload)
+        _annex_version += 1
+
+
+def local_annexes() -> dict[str, tuple[float, object]]:
+    """{key: (ts, payload)} snapshot of this process's annexes."""
+    with _annex_lock:
+        return dict(_annexes)
+
+
+def _annex_snapshot():
+    with _annex_lock:
+        return _annex_version, {k: v[1] for k, v in _annexes.items()}
+
 
 def claim_pusher(owner: str) -> bool:
     global _claimed
@@ -74,6 +109,8 @@ class MetricsPusher:
         self._buf: deque = deque()
         self._buf_cap = max(1, cfg.metrics_push_buffer)
         self._prev: dict | None = None
+        self._annex_ver = -1
+        self._annex_sent_t = 0.0
         self._client = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -122,12 +159,24 @@ class MetricsPusher:
                 self._buf.popleft()      # bounded: oldest frame drops
                 self.dropped += 1
             self._buf.append((time.time(), frame))
+        # annexes ride the first push of the tick; when nothing else is
+        # queued but the annex set changed (or needs a freshness
+        # re-stamp so GCS-side max_age filters don't expire a live
+        # publisher), an empty frame carries them
+        annex_ver, annex = _annex_snapshot()
+        now = time.time()
+        want_annex = bool(annex) and (
+            annex_ver != self._annex_ver
+            or now - self._annex_sent_t >= max(1.0, 2 * self._interval))
+        if want_annex and not self._buf:
+            self._buf.append((now, {}))
         while self._buf and not self._stop.is_set():
             ts, fr = self._buf[0]
             try:
                 self._ensure_client().call(
                     "push_metrics", src=self._src, kind=self._kind,
-                    ts=ts, frame=fr, timeout=2.0)
+                    ts=ts, frame=fr, timeout=2.0,
+                    annex=(annex if want_annex else None))
             except Exception:  # noqa: BLE001 - best-effort: retry next tick
                 client, self._client = self._client, None
                 if client is not None:
@@ -138,6 +187,10 @@ class MetricsPusher:
                 return
             self._buf.popleft()
             self.pushed += 1
+            if want_annex:
+                self._annex_ver = annex_ver
+                self._annex_sent_t = now
+                want_annex = False
 
     def _loop(self):
         while not self._stop.wait(self._interval):
@@ -169,6 +222,8 @@ class MetricsStore:
         self._cur_start = time.time()
         self._on_roll = on_roll
         self.frames = 0
+        # latest annex payload per (src, key), stamped with ingest time
+        self._annex: dict = {}
 
     # -- ingest --------------------------------------------------------
 
@@ -211,6 +266,34 @@ class MetricsStore:
         self._cur_start = now
         return win
 
+    def put_annexes(self, src: str, annexes: dict,
+                    ts: float | None = None):
+        """Latest-wins upsert of one pusher's annex set. The push
+        replaces the pusher's whole set: keys it no longer publishes
+        are dropped, so a retracted digest disappears on the next
+        frame rather than lingering until max_age expiry."""
+        now = ts if ts is not None else time.time()
+        with self._lock:
+            for k in [k for k in self._annex if k[0] == src]:
+                if k[1] not in annexes:
+                    del self._annex[k]
+            for key, payload in annexes.items():
+                self._annex[(src, key)] = (now, payload)
+
+    def annexes(self, prefix: str = "",
+                max_age_s: float | None = None) -> list:
+        """[{src, key, ts, payload}] for keys under ``prefix``, newest
+        first, dropping entries older than ``max_age_s``."""
+        now = time.time()
+        with self._lock:
+            items = [(src, key, ts, payload)
+                     for (src, key), (ts, payload) in self._annex.items()
+                     if key.startswith(prefix)
+                     and (max_age_s is None or now - ts <= max_age_s)]
+        items.sort(key=lambda it: -it[2])
+        return [{"src": src, "key": key, "ts": ts, "payload": payload}
+                for src, key, ts, payload in items]
+
     # -- queries -------------------------------------------------------
 
     def names(self) -> dict:
@@ -237,10 +320,22 @@ class MetricsStore:
         tags = tags or {}
         group_by = tuple(group_by or ())
         with self._lock:
+            # windows are TIME-based, so they must advance on queries
+            # too: during a full metrics-plane partition nothing
+            # ingests, and without this roll the pre-partition current
+            # window would read as eternally fresh — consumers keying
+            # freshness off the query horizon (the serve autoscaler's
+            # degradation policy) would never see the data go stale
+            rolled = self._maybe_roll_locked(now)
             windows = [dict(w) for w in self._ring]
             if self._cur:
                 windows.append({"start": self._cur_start, "end": now,
                                 "data": self._cur})
+        if rolled is not None and self._on_roll is not None:
+            try:
+                self._on_roll(rolled)
+            except Exception:  # noqa: BLE001 - publish is best-effort
+                pass
         windows = [w for w in windows
                    if cutoff is None or w["end"] >= cutoff]
         kind = None

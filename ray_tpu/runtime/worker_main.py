@@ -357,6 +357,15 @@ class Worker:
                 pass
             threading.Thread(target=self._ref_flush_loop, daemon=True,
                              name="ref-flusher").start()
+        # metrics plane: this worker process's registry (serve replica
+        # gauges, engine histograms, prefix-digest annexes) pushes delta
+        # frames to the GCS. The process-wide claim keeps it to ONE
+        # pusher even when a nested in-worker runtime starts later.
+        from ray_tpu.runtime.metrics_plane import MetricsPusher
+        self._metrics_pusher = MetricsPusher(
+            (os.environ["RAY_TPU_GCS_HOST"],
+             int(os.environ["RAY_TPU_GCS_PORT"])),
+            src=self.worker_id[:12], kind="worker").start()
         self._install_sigint_router()
         # Owner-facing push port, then registration — ALL execution state
         # above must exist first: the instant registration lands, the

@@ -13,6 +13,23 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 0.5
     downscale_delay_s: float = 2.0
+    # -- metrics-driven policy (serve/controller.py) ------------------
+    # "metrics" consumes pushed queue_wait / ongoing / KV-occupancy
+    # windows from the cluster metrics plane and degrades to the
+    # original polled per-replica loop whenever those windows are
+    # missing or stale (partitioned metrics plane, cold deployment);
+    # "polled" pins the original behavior.
+    policy: str = "metrics"
+    # how far back pushed windows are read; also the staleness horizon
+    # past which the policy declares the plane partitioned
+    metrics_window_s: float = 3.0
+    # upscale when the windowed queue_wait p50 exceeds this (seconds),
+    # even if per-replica ongoing still looks healthy — queue growth is
+    # the leading indicator the polled loop cannot see
+    upscale_queue_wait_s: float = 0.25
+    # upscale when cluster KV-page occupancy exceeds this fraction
+    # (paged LLM replicas: admission backpressure is imminent)
+    kv_upscale_occupancy: float = 0.9
 
 
 @dataclass

@@ -23,9 +23,18 @@ class _Replica:
     Requests run on the actor's concurrency pool; ``num_ongoing`` feeds
     both the router's p2c choice and controller autoscaling."""
 
-    def __init__(self, cls_blob, init_args, init_kwargs, user_config):
+    def __init__(self, cls_blob, init_args, init_kwargs, user_config,
+                 deployment=None, replica_tag=None):
         import cloudpickle
 
+        from ray_tpu.serve.context import set_replica_context
+
+        self._deployment = deployment or "-"
+        self._tag = replica_tag or f"replica-{id(self) & 0xffffff:06x}"
+        # context must be installed on THIS thread before the user class
+        # constructs: engines read it in __init__ to tag their metrics
+        # series and prefix digests
+        set_replica_context(self._deployment, self._tag)
         cls = cloudpickle.loads(cls_blob)
         init_args = [self._resolve_refs(a) for a in init_args]
         init_kwargs = {k: self._resolve_refs(v)
@@ -38,6 +47,19 @@ class _Replica:
         self._total = 0
         self._streams: dict = {}
         self._stream_errors: dict = {}
+        # pushed ongoing gauge: the metrics-driven autoscaler consumes
+        # this instead of polling each replica's metrics() every tick
+        from ray_tpu.util import metrics as _metrics
+        self._g_ongoing = (_metrics.gauge(
+            "ray_tpu_serve_ongoing", "in-flight requests per replica",
+            tag_keys=("deployment", "replica"))
+            if _metrics.enabled() else None)
+        self._set_ongoing_gauge()
+
+    def _set_ongoing_gauge(self):
+        if self._g_ongoing is not None:
+            self._g_ongoing.set(self._ongoing, tags={
+                "deployment": self._deployment, "replica": self._tag})
 
     @staticmethod
     def _resolve_refs(value):
@@ -76,6 +98,7 @@ class _Replica:
         with self._lock:
             self._ongoing += 1
             self._total += 1
+            self._set_ongoing_gauge()
         token = set_request_model_id(model_id)
         try:
             target = (self._instance if method_name == "__call__"
@@ -110,6 +133,7 @@ class _Replica:
             _request_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
+                self._set_ongoing_gauge()
 
     # -- streaming (reference: replica.py handle_request_streaming:323) --
 
@@ -131,6 +155,7 @@ class _Replica:
             self._streams[stream_id] = q
             self._ongoing += 1
             self._total += 1
+            self._set_ongoing_gauge()
 
         def pump():
             token = set_request_model_id(model_id)
@@ -155,6 +180,7 @@ class _Replica:
                 _request_model_id.reset(token)
                 with self._lock:
                     self._ongoing -= 1
+                    self._set_ongoing_gauge()
 
         threading.Thread(target=pump, daemon=True).start()
         return stream_id
@@ -238,6 +264,9 @@ class _Replica:
         with self._lock:
             return {"ongoing": self._ongoing, "total": self._total}
 
+    def replica_tag(self) -> str:
+        return self._tag
+
     def multiplexed_model_ids(self) -> list:
         from ray_tpu.serve.multiplex import loaded_model_ids
 
@@ -256,6 +285,10 @@ class ServeController:
         self._lock = threading.RLock()
         self._stop = False
         self._version = 0
+        # multiplexed model-id sets are POLLED here (throttled, off the
+        # request path) and PUSHED to handles inside the routing table,
+        # replacing each handle's own per-request 1s-TTL replica sweep
+        self._models_polled_at = 0.0
         self._loop = threading.Thread(target=self._control_loop, daemon=True)
         self._loop.start()
 
@@ -280,6 +313,10 @@ class ServeController:
                 "init_kwargs": init_kwargs,
                 "config": config,
                 "replicas": prev["replicas"] if prev else [],
+                "tags": prev["tags"] if prev else [],
+                "models": prev["models"] if prev else {},
+                "next_idx": prev["next_idx"] if prev else 0,
+                "autoscale_mode": None,
                 "target": (config.get("autoscaling") or {}).get(
                     "min_replicas", config.get("num_replicas", 1))
                 if config.get("autoscaling")
@@ -307,6 +344,21 @@ class ServeController:
                 return self._version, None
             return self._version, list(dep["replicas"])
 
+    def get_routing_table(self, name: str):
+        """(version, [{replica, tag, models}]) — the handle-facing route
+        set: actor handles plus stable replica tags (prefix-affinity
+        routing keys into these) and each replica's multiplexed
+        model-id set (pushed model map — handles no longer sweep
+        replicas themselves; the table invalidates on version bumps)."""
+        with self._lock:
+            dep = self._deployments.get(name)
+            if dep is None:
+                return self._version, None
+            models = dep["models"]
+            return self._version, [
+                {"replica": r, "tag": t, "models": models.get(t, [])}
+                for r, t in zip(dep["replicas"], dep["tags"])]
+
     def version(self) -> int:
         return self._version
 
@@ -315,6 +367,7 @@ class ServeController:
             return {
                 name: {"target": dep["target"],
                        "running": len(dep["replicas"]),
+                       "autoscale_mode": dep.get("autoscale_mode"),
                        "config": dep["config"]}
                 for name, dep in self._deployments.items()
             }
@@ -334,6 +387,7 @@ class ServeController:
         while not self._stop:
             try:
                 self._reconcile_once()
+                self._poll_models_once()
                 self._autoscale_once()
             except Exception:  # noqa: BLE001 - keep the loop alive
                 pass
@@ -348,6 +402,8 @@ class ServeController:
                 # rolling update; v1 does stop-then-start)
                 old = dep["replicas"]
                 dep["replicas"] = []
+                dep["tags"] = []
+                dep["models"] = {}
                 dep["redeploy"] = False
                 for r in old:
                     _kill_quietly(r)
@@ -364,15 +420,45 @@ class ServeController:
                     opts["num_cpus"] = res["CPU"]
                 if res.get("TPU"):
                     opts["num_tpus"] = res["TPU"]
+                tag = f"{name}#r{dep['next_idx']}"
+                dep["next_idx"] += 1
                 handle = replica_cls.options(**opts).remote(
                     dep["cls_blob"], dep["init_args"], dep["init_kwargs"],
-                    dep["config"].get("user_config") or {})
+                    dep["config"].get("user_config") or {},
+                    deployment=name, replica_tag=tag)
                 replicas.append(handle)
+                dep["tags"].append(tag)
                 with self._lock:
                     self._version += 1
             while len(replicas) > target:
                 victim = replicas.pop()
+                tag = dep["tags"].pop() if dep["tags"] else None
+                dep["models"].pop(tag, None)
                 _kill_quietly(victim)
+                with self._lock:
+                    self._version += 1
+
+    def _poll_models_once(self, interval_s: float = 0.25):
+        """Refresh each replica's multiplexed model-id set (throttled).
+        Changes bump the routing-table version, so handles re-pull the
+        pushed model map instead of sweeping replicas per request."""
+        now = time.monotonic()
+        if now - self._models_polled_at < interval_s:
+            return
+        self._models_polled_at = now
+        with self._lock:
+            items = list(self._deployments.items())
+        for name, dep in items:
+            pairs = list(zip(dep["replicas"], dep["tags"]))
+            models = {}
+            for r, t in pairs:
+                try:
+                    models[t] = sorted(ray_tpu.get(
+                        r.multiplexed_model_ids.remote(), timeout=2))
+                except Exception:  # noqa: BLE001 - dead replica: keep last
+                    models[t] = dep["models"].get(t, [])
+            if models != dep["models"]:
+                dep["models"] = models
                 with self._lock:
                     self._version += 1
 
@@ -384,27 +470,88 @@ class ServeController:
             auto = dep["config"].get("autoscaling")
             if not auto or not dep["replicas"]:
                 continue
-            try:
-                metrics = ray_tpu.get(
-                    [r.metrics.remote() for r in dep["replicas"]],
-                    timeout=5)
-            except Exception:  # noqa: BLE001
-                continue
-            ongoing = sum(m["ongoing"] for m in metrics)
-            per_replica = ongoing / max(1, len(dep["replicas"]))
+            n = len(dep["replicas"])
+            signals = None
+            if auto.get("policy", "metrics") == "metrics":
+                signals = self._pushed_signals(name, auto)
+            queue_p50 = kv_occ = None
+            if signals is not None:
+                dep["autoscale_mode"] = "metrics"
+                per_replica = signals["ongoing"] / n
+                queue_p50 = signals.get("queue_wait_p50")
+                kv_occ = signals.get("kv_occupancy")
+            else:
+                # pushed windows missing or stale (metrics plane
+                # partitioned, or nothing flowing yet): degrade to the
+                # original polled per-replica loop — scaling must not
+                # stop because observability did
+                dep["autoscale_mode"] = "polled"
+                try:
+                    metrics = ray_tpu.get(
+                        [r.metrics.remote() for r in dep["replicas"]],
+                        timeout=5)
+                except Exception:  # noqa: BLE001
+                    continue
+                per_replica = sum(m["ongoing"] for m in metrics) / n
             target_per = auto.get("target_ongoing_requests", 2.0)
-            if (per_replica > target_per
+            hot_queue = (queue_p50 is not None and queue_p50
+                         > auto.get("upscale_queue_wait_s", 0.25))
+            hot_kv = (kv_occ is not None and kv_occ
+                      > auto.get("kv_upscale_occupancy", 0.9))
+            want_up = per_replica > target_per or hot_queue or hot_kv
+            want_down = (per_replica < target_per / 2
+                         and not hot_queue and not hot_kv)
+            if (want_up
                     and dep["target"] < auto.get("max_replicas", 4)
                     and now - dep["last_scale"] > auto.get(
                         "upscale_delay_s", 0.5)):
                 dep["target"] += 1
                 dep["last_scale"] = now
-            elif (per_replica < target_per / 2
+            elif (want_down
                     and dep["target"] > auto.get("min_replicas", 1)
                     and now - dep["last_scale"] > auto.get(
                         "downscale_delay_s", 2.0)):
                 dep["target"] -= 1
                 dep["last_scale"] = now
+
+    def _pushed_signals(self, name: str, auto: dict) -> dict | None:
+        """Windowed autoscaling signals from the cluster metrics plane,
+        or None when the plane has nothing fresh for this deployment —
+        the caller then degrades to the polled loop. The GCS keeps its
+        own windows rolling during a metrics-plane partition (its self
+        loop ingests locally), so partitioned replicas' series age out
+        of the query horizon within ~one window and this returns None
+        without any explicit partition detector."""
+        horizon = auto.get("metrics_window_s", 3.0)
+        try:
+            from ray_tpu.util.state import cluster_metrics
+            res = cluster_metrics("ray_tpu_serve_ongoing",
+                                  tags={"deployment": name},
+                                  last_s=horizon)
+            if res.get("kind") is None or not res.get("groups"):
+                return None
+            out = {"ongoing": float(sum(
+                g["value"] for g in res["groups"]))}
+            qres = cluster_metrics("ray_tpu_serve_stage_s",
+                                   tags={"stage": "queue_wait",
+                                         "deployment": name},
+                                   last_s=horizon)
+            from ray_tpu.runtime.metrics_plane import summarize_histogram
+            digest = summarize_histogram(qres, quantiles=(0.5,))
+            if digest.get("count"):
+                out["queue_wait_p50"] = digest["p50"]
+            kres = cluster_metrics("ray_tpu_serve_kv_pages",
+                                   tags={"deployment": name},
+                                   group_by=("state",),
+                                   last_s=horizon)
+            kv = {g["tags"].get("state"): g["value"]
+                  for g in kres.get("groups", ())}
+            if kv.get("total"):
+                out["kv_occupancy"] = max(
+                    0.0, 1.0 - kv.get("free", 0.0) / kv["total"])
+            return out
+        except Exception:  # noqa: BLE001 - plane unreachable: degrade
+            return None
 
 
 def _kill_quietly(handle):

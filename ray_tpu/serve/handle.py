@@ -28,7 +28,11 @@ class DeploymentHandle:
         self._version = -1
         self._checked_at = 0.0
         self._lock = threading.Lock()
-        self._inflight: dict = {}   # replica -> count
+        self._inflight: dict = {}    # replica -> outstanding refs
+        self._tags: dict = {}        # replica -> controller replica tag
+        self._model_map: dict = {}   # model id -> [replicas] (pushed)
+        self._router = None          # lazy PrefixRouter
+        self._router_at = 0.0
 
     def options(self, *, method_name: str | None = None,
                 multiplexed_model_id: str | None = None
@@ -38,6 +42,8 @@ class DeploymentHandle:
                              multiplexed_model_id or self._model_id)
         h._replicas, h._version = self._replicas, self._version
         h._inflight = self._inflight
+        h._tags, h._model_map = self._tags, self._model_map
+        h._router = self._router
         return h
 
     # -- replica set refresh (long-poll analog) -------------------------
@@ -51,16 +57,42 @@ class DeploymentHandle:
             if version == self._version and self._replicas:
                 self._checked_at = now
                 return
-        version, replicas = ray_tpu.get(
-            self._controller.get_replicas.remote(self.deployment_name))
-        if replicas is None:
+        version, table = ray_tpu.get(
+            self._controller.get_routing_table.remote(
+                self.deployment_name))
+        if table is None:
             raise KeyError(
                 f"deployment {self.deployment_name!r} does not exist")
+        replicas = [e["replica"] for e in table]
+        model_map: dict = {}
+        for e in table:
+            for mid in e["models"]:
+                model_map.setdefault(mid, []).append(e["replica"])
         with self._lock:
             self._replicas = replicas
+            self._tags = {e["replica"]: e["tag"] for e in table}
+            self._model_map = model_map
             self._version = version
             self._checked_at = now
             self._inflight = {r: self._inflight.get(r, []) for r in replicas}
+
+    def _evict(self, replica):
+        """Drop a failed replica from every routing structure NOW: the
+        controller's reconciler takes a beat to notice the death, and
+        until it bumps the version this handle's maps would happily
+        re-pick the corpse (the stale-map window). The next refresh
+        re-adds the replica if it was actually alive."""
+        with self._lock:
+            self._replicas = [r for r in self._replicas if r != replica]
+            tag = self._tags.pop(replica, None)
+            self._inflight.pop(replica, None)
+            for mid, lst in list(self._model_map.items()):
+                if replica in lst:
+                    self._model_map[mid] = [
+                        r for r in lst if r != replica]
+            self._version = -1
+        if tag is not None and self._router is not None:
+            self._router.forget(tag)
 
     def _prune(self, replica):
         """Drop completed refs from a replica's outstanding list (non-
@@ -73,12 +105,20 @@ class DeploymentHandle:
             return len(not_ready)
         return 0
 
-    def _pick(self):
+    def _pick(self, prefix_tokens=None):
         """Power-of-two-choices on client-side outstanding-request counts
         (pruned at pick time — no background bookkeeping threads). With a
         multiplexed model id, cache-affinity comes first: prefer replicas
         that already hold the model (reference:
-        multiplexed_replica_info routing in the replica scheduler)."""
+        multiplexed_replica_info routing in the replica scheduler). With
+        ``prefix_tokens``, prefix-cache affinity comes first: route to
+        the replica whose published digest already holds the longest
+        leading page run (serve/prefix_router.py), falling back to p2c
+        when no digest matches."""
+        if prefix_tokens is not None:
+            replica = self._affinity_pick(prefix_tokens)
+            if replica is not None:
+                return replica
         if self._model_id is not None:
             warm = self._replicas_with_model(self._model_id)
             if warm:
@@ -98,32 +138,53 @@ class DeploymentHandle:
             return a if self._prune(a) <= self._prune(b) else b
 
     def _replicas_with_model(self, model_id: str) -> list:
-        """Replicas that currently hold model_id loaded. Cached with a
-        short TTL: polling every replica per request would put N
-        round-trips on the hot path (reference pushes model-id sets to
-        the router; a TTL cache is the pull-model equivalent)."""
+        """Replicas that currently hold model_id loaded — a LOCAL
+        lookup into the controller-pushed model map (refreshed with the
+        routing table on version bumps; the controller polls replicas
+        off the request path, so the per-request N-round-trip sweep the
+        old TTL cache amortized is gone entirely)."""
+        with self._lock:
+            return list(self._model_map.get(model_id, []))
+
+    def _affinity_pick(self, tokens):
+        """Prefix-affinity choice, or None for the p2c fallback. Digest
+        pulls are throttled to the publish interval and best-effort: a
+        partitioned metrics plane just means stale digests expire and
+        every pick falls back."""
+        from ray_tpu.utils.config import get_config
+
+        cfg = get_config()
+        if not cfg.serve_prefix_routing_enabled:
+            return None
+        if self._router is None:
+            from ray_tpu.serve.prefix_router import PrefixRouter
+
+            self._router = PrefixRouter()
         now = time.monotonic()
-        with self._lock:
-            cache = getattr(self, "_model_map", None)
-            if cache is not None and now - self._model_map_at < 1.0:
-                return cache.get(model_id, [])
-            replicas = list(self._replicas)
-        model_map: dict = {}
-        for r in replicas:
+        if now - self._router_at >= cfg.serve_digest_publish_interval_s:
+            self._router_at = now
             try:
-                for mid in ray_tpu.get(r.multiplexed_model_ids.remote(),
-                                       timeout=2):
-                    model_map.setdefault(mid, []).append(r)
-            except Exception:  # noqa: BLE001 - dead replica: skip
-                continue
+                from ray_tpu.serve.prefix_router import DIGEST_PREFIX
+                from ray_tpu.util.state import cluster_metric_annexes
+
+                self._router.ingest(cluster_metric_annexes(
+                    DIGEST_PREFIX, max_age_s=cfg.serve_digest_ttl_s))
+            except Exception:  # noqa: BLE001 - best-effort: TTL expires stale
+                pass
         with self._lock:
-            self._model_map = model_map
-            self._model_map_at = now
-        return model_map.get(model_id, [])
+            by_tag = {t: r for r, t in self._tags.items()}
+            candidates = {t: len(self._inflight.get(r, ()))
+                          for t, r in by_tag.items()}
+        tag = self._router.pick(tokens, candidates)
+        return by_tag.get(tag) if tag is not None else None
 
     # -- request path ----------------------------------------------------
     def remote(self, *args, **kwargs):
-        """Async call → ObjectRef (resolve with ray_tpu.get)."""
+        """Async call → ObjectRef (resolve with ray_tpu.get). The
+        reserved ``_prefix_tokens`` kwarg (the request's prompt token
+        list) opts the call into prefix-affinity routing; it is stripped
+        before the replica sees the arguments."""
+        prefix_tokens = kwargs.pop("_prefix_tokens", None)
         if self._model_id is not None:
             from ray_tpu.serve.multiplex import MODEL_ID_KWARG
 
@@ -131,8 +192,9 @@ class DeploymentHandle:
         self._refresh()
         last = None
         for attempt in range(5):
+            replica = None
             try:
-                replica = self._pick()  # raises during redeploy gap
+                replica = self._pick(prefix_tokens)  # raises in redeploy gap
                 ref = replica.handle_request.remote(self._method, args,
                                                     kwargs)
                 with self._lock:
@@ -140,6 +202,8 @@ class DeploymentHandle:
                 return ref
             except Exception as e:  # noqa: BLE001 - dead replica / empty set
                 last = e
+                if replica is not None:
+                    self._evict(replica)
                 with self._lock:
                     self._version = -1
                 time.sleep(0.05 * attempt)
@@ -154,6 +218,7 @@ class DeploymentHandle:
         (next_chunks) so per-chunk overhead amortizes. Stream START
         retries against a refreshed replica set like remote(); once
         streaming, a replica death surfaces to the consumer."""
+        prefix_tokens = kwargs.pop("_prefix_tokens", None)
         if self._model_id is not None:
             from ray_tpu.serve.multiplex import MODEL_ID_KWARG
 
@@ -161,14 +226,17 @@ class DeploymentHandle:
         self._refresh()
         last = None
         for attempt in range(5):
+            replica = None
             try:
-                replica = self._pick()
+                replica = self._pick(prefix_tokens)
                 stream_id = ray_tpu.get(
                     replica.start_stream.remote(self._method, args,
                                                 kwargs))
                 break
             except Exception as e:  # noqa: BLE001 - stale/dead replica
                 last = e
+                if replica is not None:
+                    self._evict(replica)
                 with self._lock:
                     self._version = -1
                 time.sleep(0.05 * attempt)
@@ -196,11 +264,23 @@ class DeploymentHandle:
 
         last = None
         for attempt in range(3):
+            ref = None
             try:
-                return ray_tpu.get(self.remote(*args, **kwargs))
+                ref = self.remote(*args, **kwargs)
+                return ray_tpu.get(ref)
             except ActorError as e:
                 last = e
+                owner = self._owner_of(ref) if ref is not None else None
+                if owner is not None:
+                    self._evict(owner)
                 with self._lock:
                     self._version = -1
                 time.sleep(0.05 * (attempt + 1))
         raise last
+
+    def _owner_of(self, ref):
+        with self._lock:
+            for r, refs in self._inflight.items():
+                if ref in refs:
+                    return r
+        return None

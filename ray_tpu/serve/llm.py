@@ -44,12 +44,14 @@ from ray_tpu.util import metrics as _metrics
 # prefill (dispatch -> device completion, stamped by the ready watcher),
 # pipeline_stall (device completion -> the loop draining the firsts) and
 # ship (the host copy of the first-token batch). The four stages sum to
-# the observed TTFT exactly (see Request.breakdown).
+# the observed TTFT exactly (see Request.breakdown). Series carry the
+# hosting deployment + replica tags (from the serve replica context) so
+# the controller's autoscaler and the dashboard can split per
+# deployment/replica; engines outside serve tag deployment="-".
 _STAGES = ("queue_wait", "prefill", "pipeline_stall", "ship")
 _serve_hist = _metrics.histogram(
     "ray_tpu_serve_stage_s", "per-request serve TTFT stage latency",
-    tag_keys=("stage",))
-_h_stage = {s: _serve_hist.handle({"stage": s}) for s in _STAGES}
+    tag_keys=("stage", "deployment", "replica"))
 
 
 def _bucket(n: int, minimum: int = 16) -> int:
@@ -151,6 +153,24 @@ class LLMEngine:
         self.decode_chunk = max(1, decode_chunk)
         self._drain_chunk_flag = (drain_chunk if drain_chunk is not None
                                   else _cfg.serve_drain_chunk)
+        # serve replica identity: set by the hosting _Replica before it
+        # constructs the deployment body; engines built outside serve
+        # get a private tag (bench / direct use)
+        from ray_tpu.serve.context import get_replica_context
+        ctx = get_replica_context()
+        self.deployment_name = ctx.deployment if ctx else "-"
+        self.replica_tag = (ctx.replica_tag if ctx
+                            else f"engine-{id(self) & 0xffffff:06x}")
+        # continuous admission (flag serve_continuous_admission): the
+        # loop opens a timed window between chunk dispatches so a
+        # request arriving mid-chunk prefills behind ONE in-flight
+        # chunk instead of waiting out the full double-buffered
+        # pipeline (the dominant queue_wait term in BENCH_r07)
+        self._continuous_admission = bool(_cfg.serve_continuous_admission)
+        self._window_frac = min(0.95, max(
+            0.0, float(_cfg.serve_admission_window_frac)))
+        self._sync_t: float | None = None       # last chunk-sync finish
+        self._chunk_period: float | None = None  # EMA between syncs
         # host-side slot state (mirrors cache.lengths but trusted copy)
         self._lengths = np.zeros((max_batch,), np.int32)
         self._last_tok = np.zeros((max_batch,), np.int32)
@@ -174,6 +194,10 @@ class LLMEngine:
         self.ttfts: "deque[float]" = deque(maxlen=1024)
         # per-request TTFT stage breakdowns (same bounded window)
         self.breakdowns: "deque[dict]" = deque(maxlen=1024)
+        # pre-resolved per-(deployment, replica) stage-histogram handles
+        self._h_stage = {s: _serve_hist.handle(
+            {"stage": s, "deployment": self.deployment_name,
+             "replica": self.replica_tag}) for s in _STAGES}
         # ready watcher: stamps Request.ready_t when a prefill batch's
         # device results complete — block_until_ready OFF the loop
         # thread, so the measurement never stalls the decode pipeline
@@ -444,19 +468,25 @@ class LLMEngine:
         )
         return firsts
 
-    def _admit(self):
+    def _admit(self, first: "Request | None" = None):
         """Prefill waiting requests into free slots. All prefills of the
         round are DISPATCHED first and their first tokens extracted in
         one host pass — through a network tunnel the per-sync RTT is the
         dominant prefill cost, so a burst of admissions pays ~one RTT,
-        not one per request."""
+        not one per request. ``first``: a request already pulled off the
+        queue (the admission window's timed get) — admitted ahead of the
+        queue, requeued on backpressure like any other."""
         admits = []   # (req, slot, plen, padded)
         self._admission_blocked = False
+        pulled = first
         for slot in self._free_slots():
-            try:
-                req = self._waiting.get_nowait()
-            except queue.Empty:
-                break
+            if pulled is not None:
+                req, pulled = pulled, None
+            else:
+                try:
+                    req = self._waiting.get_nowait()
+                except queue.Empty:
+                    break
             plen = len(req.prompt)
             if plen >= self.max_len:
                 req.error = ValueError(
@@ -475,6 +505,8 @@ class LLMEngine:
                 self._admission_blocked = True
                 break
             admits.append(self._pack_admit(req, slot, plen))
+        if pulled is not None:
+            self._waiting.put(pulled)   # no free slot took it
         if not admits:
             return
         # Group by bucket, then split each group into POWER-OF-TWO
@@ -562,9 +594,42 @@ class LLMEngine:
                     self.breakdowns.append(bd)
                     if _metrics.enabled():
                         for stage in _STAGES:
-                            _h_stage[stage].observe(bd[f"{stage}_s"])
+                            self._h_stage[stage].observe(bd[f"{stage}_s"])
                 self._emit(req, int(first))
         self._pending_firsts = keep
+
+    def _admission_window(self) -> bool:
+        """Continuous admission: between the previous chunk's sync and
+        the NEXT chunk's dispatch, block on the waiting queue for up to
+        a fraction of the EMA chunk period and prefill arrivals
+        immediately. A prefill dispatched here queues behind only the
+        ONE in-flight chunk — without the window, a request arriving
+        just after an emit waits out the whole double-buffered pipeline
+        (~2.5 chunks of queue_wait, the dominant TTFT term in
+        BENCH_r07). The wait costs no device time: the in-flight chunk
+        computes while this thread sleeps, and the remaining period
+        fraction covers the next dispatch. Skipped until the loop has a
+        period estimate, when no slot is free, or under page
+        backpressure (a request the pool can't place would spin)."""
+        if (not self._continuous_admission or self._chunk_period is None
+                or self._sync_t is None):
+            return False
+        deadline = self._sync_t + self._window_frac * self._chunk_period
+        admitted = False
+        while not self._stop.is_set():
+            if self._admission_blocked or \
+                    not any(r is None for r in self._active):
+                break
+            timeout = deadline - time.monotonic()
+            if timeout <= 0:
+                break
+            try:
+                req = self._waiting.get(timeout=timeout)
+            except queue.Empty:
+                break
+            self._admit(first=req)
+            admitted = True
+        return admitted
 
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
@@ -731,6 +796,7 @@ class LLMEngine:
             active_idx = [i for i, r in enumerate(self._active)
                           if r is not None]
             if not active_idx:
+                self._sync_t = None   # pipeline drains: period resets
                 if pending is not None:
                     toks, idxs, gens, _, seq = pending
                     pending = None
@@ -750,6 +816,12 @@ class LLMEngine:
             if pending is None:
                 pending = self._dispatch_decode(active_idx)
                 continue
+            # continuous admission: requests arriving while `pending`
+            # computes are prefilled NOW, before the next chunk is
+            # dispatched behind them
+            if self._admission_window():
+                active_idx = [i for i, r in enumerate(self._active)
+                              if r is not None]
             nxt = self._dispatch_decode(active_idx)
             toks_prev, idx_prev, gens_prev, _, seq_prev = pending
             # EVERY pending prefill was dispatched before nxt: block for
@@ -760,6 +832,13 @@ class LLMEngine:
             # first-token latency.
             self._drain_firsts(completed_seq=self._dispatch_seq)
             toks_np = np.asarray(toks_prev)     # chunk N host sync
+            now = time.monotonic()
+            if self._sync_t is not None:
+                period = now - self._sync_t
+                self._chunk_period = (
+                    period if self._chunk_period is None
+                    else 0.5 * self._chunk_period + 0.5 * period)
+            self._sync_t = now
             self._emit_chunk(toks_np, idx_prev, gens_prev)
             pending = nxt
 
@@ -780,6 +859,10 @@ class LLMEngine:
                 k: float(np.mean([b[k] for b in bs]))
                 for k in ("queue_wait_s", "prefill_s",
                           "pipeline_stall_s", "ship_s")}
+            total = sum(out["ttft_breakdown_s"].values())
+            if total > 0:
+                out["queue_wait_share"] = (
+                    out["ttft_breakdown_s"]["queue_wait_s"] / total)
         return out
 
 

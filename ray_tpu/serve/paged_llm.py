@@ -104,12 +104,29 @@ class PagedLLMEngine(LLMEngine):
         self.page_size = page_size
         self.max_pages_per_seq = -(-max_len // page_size)
         # default pool: half the dense equivalent — the paged layout's
-        # raison d'être is NOT reserving worst-case length per slot
-        self.num_pages = (num_pages if num_pages is not None
-                          else max_batch * self.max_pages_per_seq // 2)
+        # raison d'être is NOT reserving worst-case length per slot —
+        # floored so every slot can hold a minimal reservation (prompt
+        # page + 1 overshoot page); without the floor, short-sequence
+        # configs (max_pages_per_seq == 2) starve half of max_batch and
+        # admission waits a full generation for pages, not slots
+        if num_pages is not None:
+            self.num_pages = num_pages
+        else:
+            half_dense = max_batch * self.max_pages_per_seq // 2
+            floor = max_batch * min(2, self.max_pages_per_seq)
+            self.num_pages = max(half_dense, floor)
         self._prefix_enabled = prefix_cache
         super().__init__(cfg, params, max_batch=max_batch,
                          max_len=max_len, decode_chunk=decode_chunk)
+        # prefix-cache digest publishing (serve/prefix_router.py): the
+        # engine periodically drops a compact digest — chained full-page
+        # hashes + pool occupancy — into the process annex registry;
+        # the metrics pusher piggybacks it to the GCS and handles route
+        # repeat-prefix traffic to the replica already holding the pages
+        self._digest_enabled = (self._prefix_enabled
+                                and _cfg.serve_prefix_routing_enabled)
+        self._digest_interval = float(_cfg.serve_digest_publish_interval_s)
+        self._digest_t = 0.0
 
     # -- device state ------------------------------------------------------
 
@@ -389,6 +406,17 @@ class PagedLLMEngine(LLMEngine):
             max_reuse = (plen - 1) // self.page_size
             hits = self._prefix.acquire(hashes[:max_reuse])
         n_fresh = pages - len(hits)
+        if n_fresh > len(self._alloc.free) and self._deferred_free:
+            # Deferred frees are reclaimable for a NEW admission: the
+            # prefill it dispatches is ordered AFTER every in-flight
+            # chunk on the device stream, and prefill + decode write
+            # each page position before the causal mask exposes it, so
+            # a stale in-flight write to a reclaimed page is always
+            # overwritten before any read. The sync-count deferral only
+            # protects the no-reuse window; claiming under pressure
+            # saves up to two chunk periods of admission latency — the
+            # dominant queue_wait term when the pool runs tight.
+            self._age_deferred_frees(drain_all=True)
         if n_fresh > len(self._alloc.free) + self._prefix.evictable():
             self._prefix.release(hits)   # nothing dispatched yet
             return False
@@ -463,6 +491,29 @@ class PagedLLMEngine(LLMEngine):
             shared.append(page)
             self._prefix.ref(page)
 
+    def _publish_digest(self, force: bool = False):
+        """Drop this replica's prefix-cache digest into the process
+        annex registry (throttled; the pusher ships it). Engine-thread
+        only — ``_by_hash`` has a single mutator."""
+        if not self._digest_enabled:
+            return
+        import time as _time
+        now = _time.monotonic()
+        if not force and now - self._digest_t < self._digest_interval:
+            return
+        self._digest_t = now
+        from ray_tpu.runtime import metrics_plane as _mp
+        hashes = [int.from_bytes(h[:8], "little")
+                  for h in list(self._prefix._by_hash)]
+        _mp.set_annex(f"serve/prefix_digest/{self.replica_tag}", {
+            "tag": self.replica_tag,
+            "deployment": self.deployment_name,
+            "page_size": self.page_size,
+            "hashes": hashes,
+            "kv_free": len(self._alloc.free),
+            "kv_total": self.num_pages,
+        })
+
     def _on_slot_retired(self, slot: int):
         super()._on_slot_retired(slot)   # marks device inputs dirty
         # a chunk dispatched before this retirement was observed may
@@ -496,6 +547,7 @@ class PagedLLMEngine(LLMEngine):
         super()._emit_chunk(toks_np, active_idx, gens)
         # one chunk sync elapsed: age the deferred frees
         self._age_deferred_frees()
+        self._publish_digest()
 
     def _on_idle(self):
         # no active slots and nothing in flight: every dispatched chunk
@@ -595,9 +647,12 @@ class PagedLLMEngine(LLMEngine):
         if _m.enabled():
             g = _m.gauge("ray_tpu_serve_kv_pages",
                          "paged-KV pool size by state",
-                         tag_keys=("state",))
-            g.set(out["kv_pages_free"], tags={"state": "free"})
-            g.set(self.num_pages, tags={"state": "total"})
+                         tag_keys=("state", "deployment", "replica"))
+            base = {"deployment": self.deployment_name,
+                    "replica": self.replica_tag}
+            g.set(out["kv_pages_free"], tags={"state": "free", **base})
+            g.set(self.num_pages, tags={"state": "total", **base})
+        self._publish_digest(force=True)
         out["prefix_cache"] = {
             "enabled": self._prefix_enabled,
             "hit_pages": self._prefix.hit_pages,

@@ -144,6 +144,29 @@ def cluster_metrics(name: str | None = None, *, tags: dict | None = None,
                        group_by=group_by, per_window=per_window)
 
 
+def cluster_metric_annexes(prefix: str = "",
+                           max_age_s: float | None = None) -> list[dict]:
+    """[{src, key, ts, payload}] annexes piggybacked on metrics frames
+    (e.g. serve prefix-cache digests under ``serve/prefix_digest/``).
+    Cluster mode queries the GCS store; local mode reads the process
+    annex registry directly (every local-mode replica shares it)."""
+    mode, rt = _mode()
+    if mode == "cluster":
+        return rt._gcs.call("query_metric_annexes", prefix=prefix,
+                            max_age_s=max_age_s)["annexes"]
+    from ray_tpu.runtime import metrics_plane as _mp
+    import time as _time
+
+    now = _time.time()
+    items = [(k, ts, payload)
+             for k, (ts, payload) in _mp.local_annexes().items()
+             if k.startswith(prefix)
+             and (max_age_s is None or now - ts <= max_age_s)]
+    items.sort(key=lambda it: -it[1])
+    return [{"src": "local", "key": k, "ts": ts, "payload": payload}
+            for k, ts, payload in items]
+
+
 def summarize_latencies(last_s: float | None = 300.0,
                         quantiles=(0.5, 0.95, 0.99)) -> dict:
     """Digest of every cluster latency histogram: count / mean / p50 /

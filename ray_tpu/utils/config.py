@@ -314,6 +314,25 @@ class Config:
     serve_kv_page_size: int = 128
     # Prefix cache on shared prompt prefixes (chat/system prompts).
     serve_prefix_cache_enabled: bool = True
+    # Continuous admission: the engine loop opens a timed admission
+    # window between decode-chunk dispatches, so a request arriving
+    # mid-chunk prefills behind ONE in-flight chunk instead of waiting
+    # out the whole double-buffered pipeline (~2.5 chunks of
+    # queue_wait measured in BENCH_r07).
+    serve_continuous_admission: bool = True
+    # Fraction of the EMA chunk period the admission window may wait
+    # before dispatching the next chunk (the remainder covers dispatch
+    # overhead so the device never idles between chunks).
+    serve_admission_window_frac: float = 0.75
+    # Prefix-affinity routing: handles score replicas by the longest
+    # cached prefix advertised in their pushed page-hash digests and
+    # fall back to power-of-two-choices when nothing matches.
+    serve_prefix_routing_enabled: bool = True
+    # Min interval between a replica's prefix-digest annex publishes.
+    serve_digest_publish_interval_s: float = 0.2
+    # A digest older than this is ignored by the router (replica dead
+    # or metrics plane partitioned — fall back to p2c).
+    serve_digest_ttl_s: float = 5.0
 
     # --- envelope / benchmark tiers (tests/test_envelope*.py) ---
     envelope_actors: int = 200

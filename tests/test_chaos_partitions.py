@@ -704,3 +704,128 @@ def test_dropped_register_actors_retried_without_orphan(chaos_cluster):
         retry.close()
     assert len([a for a in c.gcs._actors
                 if a == "dropped-actor-1"]) == 1
+
+
+# ----------------------------------------------------------------------
+# round 8: serve autoscaler vs a partitioned metrics plane — the
+# metrics-driven policy must degrade to the polled loop (scaling and
+# serving continue) and return to pushed metrics on heal
+# ----------------------------------------------------------------------
+
+@pytest.fixture
+def serve_chaos_cluster(monkeypatch):
+    import ray_tpu.runtime.metrics_plane as mp
+    from ray_tpu import serve
+    from ray_tpu.utils.config import reset_config
+
+    monkeypatch.setenv("RAY_TPU_METRICS_PUSH_INTERVAL_S", "0.1")
+    # small aggregation windows: pre-partition gauge data must age out
+    # of the autoscaler's query horizon within a couple of seconds
+    monkeypatch.setenv("RAY_TPU_METRICS_WINDOW_S", "0.5")
+    # replica gauges push from WORKER subprocesses: those processes must
+    # watch the KV plan key themselves or the partition never reaches
+    # their pusher connections (the in-process plane only covers the
+    # driver/GCS/raylet threads)
+    monkeypatch.setenv("RAY_TPU_FAULT_INJECTION_ENABLED", "1")
+    reset_config()
+    ray_tpu.shutdown()
+    fi.plane.clear()
+    c = Cluster(heartbeat_timeout_s=HEARTBEAT_S)
+    c.add_node(num_cpus=4)
+    ray_tpu.init(address=c.gcs_address)
+    # deterministic RPC-path pusher (see metrics_chaos_cluster): the
+    # injected partition must provably cross the RPC boundary
+    mp._claimed = None
+    pusher = mp.MetricsPusher(c.gcs_address, src="serve-chaos",
+                              kind="driver", interval_s=0.1).start()
+    assert pusher._thread is not None, "test pusher failed to claim"
+    yield c, pusher
+    serve.shutdown()
+    pusher.stop()
+    fi.plane.clear()
+    ray_tpu.shutdown()
+    fi.stop_kv_watcher()
+    c.shutdown()
+    fi.plane.clear()
+    reset_config()
+
+
+def test_metrics_partition_degrades_autoscaler_then_heals(
+        serve_chaos_cluster):
+    """Partition the metrics plane from the GCS mid-load: the
+    autoscaler flips from the pushed-metrics policy to the polled
+    per-replica loop (scale is held, no request is dropped), and flips
+    back once the plane heals and frames flow again."""
+    from ray_tpu import serve
+
+    c, pusher = serve_chaos_cluster
+
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0, "upscale_delay_s": 0.2,
+        "downscale_delay_s": 120.0, "metrics_window_s": 1.5})
+    class Slow:
+        def __call__(self, delay):
+            time.sleep(delay)
+            return "ok"
+
+    handle = serve.run(Slow.bind(), name="chaos_auto")
+
+    stop = threading.Event()
+    failures: list = []
+    rounds = [0]
+
+    def load():
+        while not stop.is_set():
+            try:
+                refs = [handle.remote(0.3) for _ in range(4)]
+                for r in refs:
+                    ray_tpu.get(r, timeout=30)
+            except Exception as e:  # noqa: BLE001 - any drop fails the test
+                failures.append(repr(e))
+                return
+            rounds[0] += 1
+
+    th = threading.Thread(target=load, daemon=True)
+    th.start()
+    try:
+        def dep():
+            return serve.status()["deployments"].get("chaos_auto", {})
+
+        _wait(lambda: dep().get("running", 0) >= 2
+              and dep().get("autoscale_mode") == "metrics",
+              30, "metrics-mode upscale under load")
+
+        fi.put_plan(c.gcs_address, {
+            "version": 1, "seed": 7,
+            "endpoints": {"gcs": [_addr(c.gcs_address)]},
+            "rules": [{"id": "cut-metrics-gcs", "fault": "partition",
+                       "src": "metrics", "dst": "gcs",
+                       "direction": "both"}]})
+        _wait(lambda: fi.plane.stats.get("cut-metrics-gcs"), 30,
+              "metrics partition to fire")
+
+        # pushed windows go stale -> the policy degrades to polled;
+        # replicas stay up and serving never blocks
+        _wait(lambda: dep().get("autoscale_mode") == "polled", 30,
+              "autoscaler degradation to polled")
+        assert not failures, failures
+        assert dep().get("running", 0) >= 2, \
+            "polled policy should hold the scale-up under load"
+        assert handle.call(0.05) == "ok", \
+            "serving must not block during the metrics partition"
+        before = rounds[0]
+        _wait(lambda: rounds[0] > before, 30,
+              "load to keep flowing under the partition")
+
+        pushed_during = pusher.pushed
+        _heal(c, version=2)
+        _wait(lambda: pusher.pushed > pushed_during, 30,
+              "metrics pushes to resume after heal")
+        _wait(lambda: dep().get("autoscale_mode") == "metrics", 30,
+              "autoscaler back on pushed metrics after heal")
+        assert not failures, failures
+    finally:
+        stop.set()
+        th.join(timeout=60)
+    assert not failures, failures
