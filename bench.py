@@ -678,6 +678,43 @@ def bench_core() -> dict:
         lambda: ray_tpu.get([a.m.remote() for _ in range(n)]),
         name="actor_calls_per_sec")
 
+    # tracing hot-path fence input (round 9): the amortized-delta
+    # methodology from round 4's probe gates — time the per-call tracing
+    # probe (wire_context with tracing ON minus OFF, min-of-k over a
+    # large loop) and divide by the measured per-op cost, instead of
+    # diffing two noisy end-to-end rates. ci/perf_gate.py holds the
+    # ratio under an ABSOLUTE 3% ceiling (a cross-round relative fence
+    # is meaningless for a ratio that sits near zero). A traced steady
+    # actor round rides along as the loose end-to-end tripwire.
+    from ray_tpu.util import tracing as _tracing
+
+    def _probe_cost(iters: int = 200_000, k: int = 5) -> float:
+        best = float("inf")
+        for _ in range(k):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                _tracing.wire_context()
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best
+
+    cold = _probe_cost()                   # tracing disabled
+    _tracing.enable_tracing()
+    try:
+        with _tracing.span("bench-overhead"):
+            hot = _probe_cost()            # enabled + ambient context
+            t0 = time.perf_counter()
+            ray_tpu.get([a.m.remote() for _ in range(n)])
+            traced_rate = round(n / (time.perf_counter() - t0), 1)
+    finally:
+        _tracing.disable_tracing()
+    per_op_s = 1.0 / results["actor_calls_per_sec"]
+    results["tracing_overhead"] = {
+        "probe_delta_ns": round((hot - cold) * 1e9, 1),
+        "per_op_us": round(per_op_s * 1e6, 1),
+        "ratio": round(max(hot - cold, 0.0) / per_op_s, 5),
+        "traced_actor_calls_per_sec": traced_rate,
+    }
+
     small = b"x" * 1024
     put_refs: list = []
 

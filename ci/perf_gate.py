@@ -98,6 +98,21 @@ METRICS_LOWER = {
         ("detail", "sustained", "ttft_breakdown", "ship_s")],
 }
 
+# ABSOLUTE ceilings checked on the NEW doc alone (no baseline diff):
+# ratios that must sit near zero regardless of history, where a
+# relative fence would let the value creep up 10% per round forever.
+# Round 9: tracing-enabled hot-path overhead — the per-call tracing
+# probe delta amortized over the measured per-op cost (the round-4
+# probe-gate methodology; bench_core produces it, and
+# tests/test_tracing_plane.py gates the same ratio in-test) must stay
+# under 3%. Key absent (pre-round-9 doc): skipped.
+METRICS_CEILING = {
+    "tracing_hot_path_overhead_ratio": (
+        [("detail", "core", "tracing_overhead", "ratio"),
+         ("detail", "tracing_overhead", "ratio")],
+        0.03),
+}
+
 # train metric paths only exist in full-run docs; the train bench value
 # doubles as core_tasks in core-only docs — guard that collision
 _TRAIN_ONLY = {"train_tokens_per_sec_per_chip"}
@@ -167,6 +182,19 @@ def main(argv: list[str]) -> int:
               f"{delta:+7.1%}  {flag} (lower=better)")
         if delta > fence:
             failures.append((name, b, a, delta))
+    for name, (paths, ceiling) in METRICS_CEILING.items():
+        a = None
+        for path in paths:
+            a = _dig_one(new, path)
+            if a is not None:
+                break
+        if a is None:
+            continue
+        flag = "REGRESSION" if a > ceiling else "ok"
+        print(f"  {name:34s} {'(ceiling)':>12s} -> {a:>12.5f}  "
+              f"< {ceiling:.2f}  {flag}")
+        if a > ceiling:
+            failures.append((name, ceiling, a, a - ceiling))
     if failures:
         print(f"perf gate: {len(failures)} metric(s) regressed past "
               f"the {fence:.0%} fence")

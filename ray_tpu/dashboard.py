@@ -11,6 +11,12 @@ same information surface:
 - ``GET /api/cluster_status``     cluster summary (nodes/actors/resources)
 - ``GET /api/nodes|actors|tasks|jobs|placement_groups|objects``
 - ``GET /api/timeline``           chrome://tracing JSON of task events
+- ``GET /api/traces``             collected distributed traces (GCS
+                                  TraceStore); ``/api/trace/<trace_id>``
+                                  returns spans + waterfall rows
+- ``GET /api/stuck_calls``        cluster-wide in-flight calls past a
+                                  threshold; ``/api/flight_record``
+                                  dumps a process's recent span window
 - ``GET /metrics``                Prometheus text (``ray.util.metrics``
                                   analog + runtime counters)
 - ``GET /api/version``
@@ -176,6 +182,34 @@ class _Handler(BaseHTTPRequestHandler):
                     last_s=float(last_s) if last_s else None,
                     group_by=gb,
                     per_window=qs.get("per_window", ["0"])[0] == "1"))
+            elif path == "/api/traces":
+                qs = parse_qs(self.path.partition("?")[2])
+                self._send_json(_state.list_traces(
+                    limit=int(qs.get("limit", ["50"])[0])))
+            elif path.startswith("/api/trace/"):
+                from ray_tpu.util import tracing as _tracing
+
+                trace_id = path[len("/api/trace/"):]
+                trace = _state.get_trace(trace_id)
+                if trace is None:
+                    self._send_json(
+                        {"error": f"unknown trace {trace_id!r}"}, 404)
+                else:
+                    self._send_json({
+                        "trace": trace,
+                        "waterfall": _tracing.build_waterfall(
+                            trace.get("spans") or [])})
+            elif path == "/api/stuck_calls":
+                qs = parse_qs(self.path.partition("?")[2])
+                t = qs.get("threshold_s", [None])[0]
+                self._send_json(_state.stuck_calls(
+                    threshold_s=float(t) if t else None))
+            elif path == "/api/flight_record":
+                qs = parse_qs(self.path.partition("?")[2])
+                last_s = qs.get("last_s", [None])[0]
+                self._send_json(_state.flight_record(
+                    proc=qs.get("proc", [None])[0],
+                    last_s=float(last_s) if last_s else None))
             elif path == "/api/latencies":
                 # per-stage latency digest (live dashboard view)
                 qs = parse_qs(self.path.partition("?")[2])

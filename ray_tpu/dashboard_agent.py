@@ -91,6 +91,18 @@ class DashboardAgent(RpcServer):
             t.join(timeout=8)
         return out
 
+    def rpc_stuck_calls(self, conn, send_lock, *, threshold_s=None):
+        # proxied THROUGH the raylet (not dialed per worker here): the
+        # node answer must include the raylet's own in-flight registry,
+        # which only the raylet process can read
+        return self._raylet.call("stuck_calls", threshold_s=threshold_s,
+                                 timeout=12)
+
+    def rpc_flight_record(self, conn, send_lock, *,
+                          worker_id: str | None = None, last_s=None):
+        return self._raylet.call("flight_record", worker_id=worker_id,
+                                 last_s=last_s, timeout=12)
+
     def rpc_profile_worker(self, conn, send_lock, *, worker_id: str,
                            duration_s: float = 2.0, hz: int = 100):
         targets = self._targets(worker_id)

@@ -650,9 +650,11 @@ class Runtime:
                 self._store_error(spec, e)
                 return
             try:
-                from ray_tpu.util.tracing import execution_span
+                from ray_tpu.util import tracing as _tracing
 
-                with execution_span(spec.function_name, spec.trace_ctx):
+                with _tracing.execution_span(spec.function_name,
+                                             spec.trace_ctx), \
+                        _tracing.inflight("task", spec.function_name):
                     result = self._call_in_runtime_env(
                         spec.runtime_env, spec.function, args, kwargs)
                     if _isawaitable(result):
@@ -808,9 +810,11 @@ class Runtime:
             method = getattr(state.instance, spec.actor_method_name)
             renv = (state.creation_spec.runtime_env
                     if state.creation_spec is not None else None)
-            from ray_tpu.util.tracing import execution_span
+            from ray_tpu.util import tracing as _tracing
 
-            with execution_span(spec.function_name, spec.trace_ctx):
+            with _tracing.execution_span(spec.function_name,
+                                         spec.trace_ctx), \
+                    _tracing.inflight("actor_task", spec.function_name):
                 result = self._call_in_runtime_env(renv, method, args,
                                                    kwargs)
                 if _isawaitable(result):

@@ -30,6 +30,7 @@ from ray_tpu.runtime.rpc import (
     RpcClient,
 )
 from ray_tpu.runtime.task_spec import TaskSpec, TaskType
+from ray_tpu.util import tracing as _tracing
 from ray_tpu.utils import exceptions as exc
 from ray_tpu.utils.ids import ActorID, ObjectID, WorkerID
 
@@ -104,6 +105,10 @@ class ClusterRuntime:
         # DirectActorTaskSubmitter batches sends on its io thread)
         self._actor_outbox: dict[str, list] = {}
         self._actor_unacked: dict[str, int] = {}   # flow control (tasks)
+        # stuck-call watchdog tokens per actor, FIFO like the unacked
+        # window (acks are in submission order, so finishing the oldest
+        # n tokens on an n-task ack matches 1:1)
+        self._wd_tokens: dict[str, deque] = {}
         self._outbox_cv = threading.Condition()
         # Registration coalescer (same shape as the ref flusher): N
         # create_actor calls become one register_actors frame. Anonymous
@@ -1340,6 +1345,25 @@ class ClusterRuntime:
         numbering when a new incarnation is observed (restarted actors
         start their ordering from 0).
 
+        The cache-HIT path stays bare (>10k calls/s on the direct-call
+        path); a MISS is traced + registered with the stuck-call
+        watchdog, so a resolve wedged on a dead pushed table shows up
+        in ``util.state.stuck_calls`` with its parent span."""
+        cached = self._actor_locations.get(actor_id_hex)
+        if cached is not None:
+            return cached
+        token = _tracing.call_started("actor_resolve", actor_id_hex[:16])
+        try:
+            with _tracing.span(f"resolve:{actor_id_hex[:8]}",
+                               kind="control"):
+                return self._actor_location_miss(actor_id_hex, timeout)
+        finally:
+            _tracing.call_finished(token)
+
+    def _actor_location_miss(self, actor_id_hex: str,
+                             timeout: float | None = None):
+        """Slow path of :meth:`_actor_location`.
+
         Steady state is pubsub-driven: waits on the CH_ACTOR pushed
         table; a counted get_actor poll fires only after a quiet
         ``actor_resolve_fallback_s`` window (events published before the
@@ -1465,6 +1489,12 @@ class ClusterRuntime:
                 (task, tuple(addr)))
             self._actor_unacked[actor_hex] = \
                 self._actor_unacked.get(actor_hex, 0) + 1
+            # watchdog: one entry per unacked actor call, finished by
+            # _ack_actor_tasks in the same FIFO order acks arrive
+            self._wd_tokens.setdefault(actor_hex, deque()).append(
+                _tracing.call_started(
+                    "actor_call",
+                    f"{spec.actor_method_name} ({actor_hex[:8]})"))
             self._outbox_cv.notify_all()
         self._ensure_actor_reaper()
 
@@ -1563,7 +1593,16 @@ class ClusterRuntime:
                 self._actor_unacked[actor_hex] = left
             else:
                 self._actor_unacked.pop(actor_hex, None)
+            tokens = self._wd_tokens.get(actor_hex)
+            done = []
+            if tokens:
+                for _ in range(min(n, len(tokens))):
+                    done.append(tokens.popleft())
+                if not tokens:
+                    self._wd_tokens.pop(actor_hex, None)
             self._outbox_cv.notify_all()
+        for t in done:
+            _tracing.call_finished(t)
 
     def _drain_actor_window(self, actor_hex: str):
         """Flusher duty: pop completed batch frames off the window head;

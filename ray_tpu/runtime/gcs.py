@@ -270,6 +270,14 @@ class GcsServer(RpcServer):
             on_roll=self._publish_metrics_window)
         self._metrics_push_interval = _pcfg.metrics_push_interval_s
         self._metrics_stop = threading.Event()
+        # --- distributed tracing plane: cluster span ring fed by
+        # rpc_push_spans (spans ride the metrics pusher ticks) ---
+        from ray_tpu.util.tracing import TraceStore
+        self._trace_store = TraceStore(
+            max_traces=_pcfg.trace_store_traces,
+            max_spans=_pcfg.trace_store_spans,
+            sample_n=_pcfg.trace_sample_n,
+            slow_s=_pcfg.trace_slow_s)
         self._hb_timeout = heartbeat_timeout_s
         # --- distributed refcounting (reference: reference_count.h:61;
         # centralized here to match the centralized object directory).
@@ -1794,6 +1802,34 @@ class GcsServer(RpcServer):
         return {"annexes": self._metrics_store.annexes(
             prefix, max_age_s=max_age_s)}
 
+    # ------------------------------------------------------------------
+    # distributed tracing plane
+    # ------------------------------------------------------------------
+
+    def rpc_push_spans(self, conn, send_lock, *, src, spans):
+        """Ingest finished spans from a process's pusher tick. Same
+        at-most-once trade as metric frames: a duplicated batch stores
+        duplicate spans in the affected traces, never blocks."""
+        accepted = self._trace_store.ingest(src, spans or [])
+        return {"ok": True, "accepted": accepted}
+
+    def rpc_get_trace(self, conn, send_lock, *, trace_id):
+        return {"trace": self._trace_store.get(trace_id)}
+
+    def rpc_list_traces(self, conn, send_lock, *, limit=50):
+        return {"traces": self._trace_store.list(limit),
+                "stats": self._trace_store.stats()}
+
+    def rpc_stuck_calls(self, conn, send_lock, *, threshold_s=None):
+        """The GCS's OWN in-flight registry (outbound RPCs it makes);
+        per-node registries are collected by util.state.stuck_calls."""
+        from ray_tpu.util import tracing as _tracing
+        return {"calls": _tracing.local_stuck_calls(threshold_s)}
+
+    def rpc_flight_record(self, conn, send_lock, *, last_s=None):
+        from ray_tpu.util import tracing as _tracing
+        return {"flight": _tracing.flight_snapshot(last_s)}
+
     def _metrics_self_loop(self):
         """The GCS ingests its OWN registry (rpc handler timers, actor
         plane stage histograms) on the same delta protocol workers use —
@@ -1804,12 +1840,13 @@ class GcsServer(RpcServer):
         from ray_tpu.util import metrics as _metrics
 
         prev = None
-        claimed = False
         while not self._metrics_stop.wait(self._metrics_push_interval):
-            if not claimed:
-                claimed = _mp.claim_pusher(f"gcs:{self.address[1]}")
-                if not claimed:
-                    continue
+            # re-checked EVERY tick (claim_pusher is idempotent for the
+            # holder): the span-ring drain below is destructive, so the
+            # moment another pusher in this process takes the claim over
+            # (forced hand-off) this loop must stop consuming the ring
+            if not _mp.claim_pusher(f"gcs:{self.address[1]}"):
+                continue
             try:
                 frame, prev = _metrics.snapshot_delta(prev)
                 if frame:
@@ -1818,6 +1855,14 @@ class GcsServer(RpcServer):
                 if ann:
                     self._metrics_store.put_annexes(
                         "gcs", {k: v[1] for k, v in ann.items()})
+                # the GCS's own spans (rpc: server spans of handlers it
+                # runs while traced) land in its store directly — no
+                # network round trip to itself
+                from ray_tpu.util import tracing as _tracing
+                if _tracing.is_enabled():
+                    spans = _tracing.drain_spans()
+                    if spans:
+                        self._trace_store.ingest("gcs", spans)
             except Exception:  # noqa: BLE001 - observability only
                 pass
 
@@ -1857,6 +1902,10 @@ def main():
     stop_ev = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop_ev.set())
     signal.signal(signal.SIGINT, lambda *_: stop_ev.set())
+    # flight recorder: dump recent spans/events before a SIGTERM death
+    # (chains to the stop handler installed above)
+    from ray_tpu.util import tracing as _tracing
+    _tracing.install_crash_dump()
     print(json.dumps({"address": server.address}), flush=True)
     try:
         stop_ev.wait()

@@ -116,6 +116,7 @@ class MetricsPusher:
         self._thread: threading.Thread | None = None
         self.pushed = 0
         self.dropped = 0
+        self.pushed_spans = 0
 
     def start(self) -> "MetricsPusher":
         if not _metrics.enabled() or not claim_pusher(self._src):
@@ -191,6 +192,32 @@ class MetricsPusher:
                 self._annex_ver = annex_ver
                 self._annex_sent_t = now
                 want_annex = False
+        # trace spans ride the same tick AFTER the frame loop drained
+        # cleanly (a failed frame push already spent this tick's one
+        # timeout — don't spend a second on a dead GCS). Same contract:
+        # drop-not-block, bounded requeue on failure.
+        self._push_spans()
+
+    def _push_spans(self):
+        from ray_tpu.util import tracing as _tracing
+
+        if self._stop.is_set() or not _tracing.is_enabled():
+            return
+        spans = _tracing.drain_spans()
+        if not spans:
+            return
+        try:
+            self._ensure_client().call("push_spans", src=self._src,
+                                       spans=spans, timeout=2.0)
+            self.pushed_spans += len(spans)
+        except Exception:  # noqa: BLE001 - best-effort: retry next tick
+            _tracing.requeue_spans(spans)
+            client, self._client = self._client, None
+            if client is not None:
+                try:
+                    client.close()
+                except OSError:
+                    pass
 
     def _loop(self):
         while not self._stop.wait(self._interval):

@@ -25,6 +25,7 @@ from typing import Callable
 
 from ray_tpu.runtime.rpc import RpcClient
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 
 # transfers are rare and expensive relative to a histogram observe, so
 # the leader of every successful pull is timed end to end (meta probe +
@@ -153,13 +154,19 @@ class PullManager:
         if not leader:
             pull.event.wait(timeout=timeout_s)
             return pull.ok or self._store.contains(oid)
+        # watchdog + span cover the LEADER only (followers ride its
+        # transfer); chunk worker threads re-bind this span's context so
+        # their fetch RPCs parent into it across the peer hop
+        token = _tracing.call_started("pull", oid_hex[:16])
         try:
-            t0 = time.perf_counter()
-            pull.ok = self._do_pull(oid_hex, oid, known_sources)
-            if pull.ok and _metrics.enabled():
-                _h_pull.observe(time.perf_counter() - t0)
-            return pull.ok
+            with _tracing.span(f"pull:{oid_hex[:8]}", kind="transfer"):
+                t0 = time.perf_counter()
+                pull.ok = self._do_pull(oid_hex, oid, known_sources)
+                if pull.ok and _metrics.enabled():
+                    _h_pull.observe(time.perf_counter() - t0)
+                return pull.ok
         finally:
+            _tracing.call_finished(token)
             with self._pulls_lock:
                 self._pulls.pop(oid_hex, None)
             pull.event.set()
@@ -274,6 +281,10 @@ class PullManager:
         done_chunks = [0]
         retries: list[int] = []   # chunks dropped by a dying source
         state_lock = threading.Lock()
+        # contextvars do not cross threads: capture the pull span's
+        # context here so chunk workers can re-bind it (their fetch
+        # RPCs then carry the _trace header to the source node)
+        trace_ctx = _tracing.current_context()
         known: list = list(sources)       # all holders seen so far
         failed = threading.Event()
         done_workers = threading.Semaphore(0)
@@ -319,6 +330,8 @@ class PullManager:
             return True
 
         def run_worker(addr):
+            if trace_ctx is not None:
+                _tracing.bind(trace_ctx)
             try:
                 try:
                     client = self._checkout(addr)

@@ -139,6 +139,15 @@ class Raylet(RpcServer):
             "ray_tpu_lease_grant_s",
             "raylet-side lease grant latency (request to grant, parking "
             "included)").handle()
+        # per-node live resource gauges (dashboard per-resource panels):
+        # sampled on the heartbeat cadence, pushed with src=node_id so
+        # /api/metrics/query?group_by=src yields one series per node
+        self._g_cpu = _metrics.gauge(
+            "ray_tpu_node_cpu_load",
+            "1-min load average / cpu count, per node")
+        self._g_mem = _metrics.gauge(
+            "ray_tpu_node_mem_used_frac",
+            "used host memory fraction, per node")
 
     # component-facing compatibility views (tests, the dashboard, and the
     # worker pool read these under their historical names)
@@ -1147,9 +1156,102 @@ class Raylet(RpcServer):
                 "spill_stats": dict(self.objects.spill_stats),
                 "prestart": self.workers.prestart.snapshot()}
 
+    def rpc_stuck_calls(self, conn, send_lock, *, threshold_s=None):
+        """In-flight calls older than the threshold on this NODE: the
+        raylet's own registry plus every local worker's, collected in
+        parallel over the worker push ports (same shape as
+        rpc_worker_stacks: one wedged worker costs 5s, not 5s x N)."""
+        from ray_tpu.util import tracing as _tracing
+        out = {"raylet": _tracing.local_stuck_calls(threshold_s)}
+        out_lock = threading.Lock()
+
+        def query(wid, addr):
+            client = None
+            try:
+                client = RpcClient(addr, timeout=5, label="raylet")
+                calls = client.call("stuck_calls",
+                                    threshold_s=threshold_s)["calls"]
+            except Exception as e:  # noqa: BLE001 - worker busy/gone
+                calls = {"error": repr(e)}
+            finally:
+                if client is not None:
+                    client.close()
+            with out_lock:
+                out[wid] = calls
+
+        threads = [threading.Thread(target=query, args=t, daemon=True)
+                   for t in self.workers.push_targets(None)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=8)
+        return out
+
+    def rpc_flight_record(self, conn, send_lock, *,
+                          worker_id: str | None = None, last_s=None):
+        """Flight-recorder snapshots for this node: the raylet's own
+        ring plus (one or all) local workers'. Local memory only — a
+        partitioned GCS cannot make this fail."""
+        from ray_tpu.util import tracing as _tracing
+        out = {}
+        if worker_id is None:
+            out["raylet"] = _tracing.flight_snapshot(last_s)
+        out_lock = threading.Lock()
+
+        def query(wid, addr):
+            client = None
+            try:
+                client = RpcClient(addr, timeout=5, label="raylet")
+                snap = client.call("flight_record", last_s=last_s)
+            except Exception as e:  # noqa: BLE001 - worker busy/gone
+                snap = {"error": repr(e)}
+            finally:
+                if client is not None:
+                    client.close()
+            with out_lock:
+                out[wid] = snap
+
+        threads = [threading.Thread(target=query, args=t, daemon=True)
+                   for t in self.workers.push_targets(worker_id)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=8)
+        return out
+
     # ------------------------------------------------------------------
     # heartbeat
     # ------------------------------------------------------------------
+
+    def _sample_node_gauges(self, stats: dict):
+        """Feed the per-node dashboard panels. Prefers the host_stats
+        sample (psutil); falls back to load average + /proc/meminfo so
+        the panels work without psutil (Linux) and degrade to silence
+        elsewhere."""
+        try:
+            if stats and "cpu_percent" in stats:
+                self._g_cpu.set(stats["cpu_percent"] / 100.0)
+            else:
+                self._g_cpu.set(
+                    os.getloadavg()[0] / max(1, os.cpu_count() or 1))
+        except OSError:
+            pass
+        try:
+            if stats and stats.get("mem_total"):
+                self._g_mem.set(
+                    1.0 - stats["mem_available"] / stats["mem_total"])
+            else:
+                meminfo = {}
+                with open("/proc/meminfo") as f:
+                    for line in f:
+                        k, _, rest = line.partition(":")
+                        meminfo[k] = float(rest.split()[0])
+                total = meminfo.get("MemTotal", 0.0)
+                avail = meminfo.get("MemAvailable", 0.0)
+                if total > 0:
+                    self._g_mem.set(1.0 - avail / total)
+        except (OSError, IndexError, ValueError):
+            pass
 
     def _heartbeat_loop(self):
         ticks = 0
@@ -1172,6 +1274,7 @@ class Raylet(RpcServer):
                     stats = host_stats(
                         self.objects.spill_dir
                         if self.objects.spill_is_local else None)
+                    self._sample_node_gauges(stats)
                 acks = sorted(freed_acks) if freed_acks else None
                 with self._gcs_beat_lock:
                     # liveness only, on the DEDICATED beat channel: the
@@ -1227,6 +1330,10 @@ def main():  # runs a raylet as a standalone process (cluster_utils spawns it)
     # terminate()); otherwise the shm segment leaks in /dev/shm
     signal.signal(signal.SIGTERM, lambda *_: stop_ev.set())
     signal.signal(signal.SIGINT, lambda *_: stop_ev.set())
+    # flight recorder: dump before a SIGTERM death (chains to the stop
+    # handler above)
+    from ray_tpu.util import tracing as _tracing
+    _tracing.install_crash_dump()
     raylet.start()
     # signal readiness to the parent via stdout
     print(json.dumps({"address": raylet.address,

@@ -26,6 +26,7 @@ from typing import Any, Callable
 
 from ray_tpu.runtime import fault_injection as _fi
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 
 _LEN = struct.Struct(">Q")
 
@@ -221,6 +222,10 @@ class RpcServer:
                     return
                 req_id = req.pop("_id", None)
                 method = req.pop("method")
+                # trace header: present only when the caller was inside
+                # a span — untraced traffic (heartbeats, metric pushes)
+                # carries no header and produces no server spans
+                wire_trace = req.pop("_trace", None)
                 deliveries = 1
                 if _fi.plane.active:
                     try:
@@ -241,7 +246,8 @@ class RpcServer:
                     payload = (req if delivery == deliveries - 1
                                else pickle.loads(pickle.dumps(req)))
                     outcome = self._dispatch_one(conn, send_lock, fmt,
-                                                 method, req_id, payload)
+                                                 method, req_id, payload,
+                                                 wire_trace)
                     if outcome == "held":
                         held = True
                         return
@@ -254,20 +260,32 @@ class RpcServer:
             if not self._stopping:
                 self.on_disconnect(conn)
 
+    def _invoke(self, handler, method, conn, send_lock, payload):
+        if _metrics.enabled():
+            t0 = time.perf_counter()
+            result = handler(conn, send_lock, **payload)
+            _rpc_handle(method).observe(time.perf_counter() - t0)
+            return result
+        return handler(conn, send_lock, **payload)
+
     def _dispatch_one(self, conn, send_lock, fmt, method, req_id,
-                      payload) -> str:
+                      payload, wire_trace=None) -> str:
         """Dispatch one request and send its reply. Returns "ok", "held"
         (handler took the connection), or "gone" (peer unreachable)."""
         handler = getattr(self, f"rpc_{method}", None)
         try:
             if handler is None:
                 raise AttributeError(f"no rpc method {method!r}")
-            if _metrics.enabled():
-                t0 = time.perf_counter()
-                result = handler(conn, send_lock, **payload)
-                _rpc_handle(method).observe(time.perf_counter() - t0)
+            if wire_trace is not None:
+                # restore the caller's ambient context so handler-side
+                # spans (and any RPCs the handler makes) parent across
+                # the hop — the server half of context propagation
+                with _tracing.server_span(method, wire_trace):
+                    result = self._invoke(handler, method, conn,
+                                          send_lock, payload)
             else:
-                result = handler(conn, send_lock, **payload)
+                result = self._invoke(handler, method, conn, send_lock,
+                                      payload)
         except BaseException as e:  # noqa: BLE001 - ship to caller
             try:
                 self._send_reply(conn, {"_id": req_id, "error": e},
@@ -381,6 +399,7 @@ class RpcClient:
             with self._pending_lock:
                 ev_reply = self._pending.pop(msg_id, None)
             if ev_reply is not None:
+                _tracing.call_finished(ev_reply[3])
                 ev_reply[1] = msg
                 ev_reply[0].set()
 
@@ -390,6 +409,7 @@ class RpcClient:
             self._pending.clear()
             self._closed = True
         for ev_reply in pending:
+            _tracing.call_finished(ev_reply[3])
             ev_reply[1] = {"error": ConnectionLost(
                 f"connection to {self.address} lost")}
             ev_reply[0].set()
@@ -412,10 +432,17 @@ class RpcClient:
                 raise ConnectionLost(f"client to {self.address} closed")
             msg_id = self._next_id
             self._next_id += 1
-            ev_reply = [threading.Event(), None, method]
+            # 4th slot: stuck-call watchdog token, released wherever the
+            # pending entry is popped (reply, failure, or caller timeout)
+            ev_reply = [threading.Event(), None, method,
+                        _tracing.call_started("rpc", method,
+                                              target=self.address)]
             self._pending[msg_id] = ev_reply
         kwargs["method"] = method
         kwargs["_id"] = msg_id
+        wire = _tracing.wire_context()
+        if wire is not None:
+            kwargs["_trace"] = wire
         if _fi.plane.active:
             action = _fi.plane.consult(self._label, "send", self.address,
                                        method)
@@ -569,7 +596,9 @@ class PendingCall:
     def result(self, timeout: float | None = None):
         if not self._ev_reply[0].wait(timeout=timeout):
             with self._client._pending_lock:
-                self._client._pending.pop(self._msg_id, None)
+                popped = self._client._pending.pop(self._msg_id, None)
+            if popped is not None:
+                _tracing.call_finished(popped[3])
             raise TimeoutError(
                 f"rpc {self._method} timed out after {timeout}s")
         reply = self._ev_reply[1]

@@ -225,6 +225,20 @@ class TaskPushServer(RpcServer):
         return sample_profile(duration_s=min(duration_s, 30.0), hz=hz,
                               exclude_thread=threading.get_ident())
 
+    def rpc_stuck_calls(self, conn, send_lock, *, threshold_s=None):
+        """This worker's in-flight call registry (the raylet fans these
+        out node-wide for util.state.stuck_calls)."""
+        from ray_tpu.util import tracing as _tracing
+
+        return {"calls": _tracing.local_stuck_calls(threshold_s)}
+
+    def rpc_flight_record(self, conn, send_lock, *, last_s=None):
+        """This worker's flight-recorder snapshot (recent spans + RPC
+        events + in-flight calls), straight from local memory."""
+        from ray_tpu.util import tracing as _tracing
+
+        return {"flight": _tracing.flight_snapshot(last_s)}
+
     def on_disconnect(self, conn):
         # Release the lease only when the LAST lease-tagged connection
         # drops. A profiler or direct actor caller disconnecting from a
@@ -839,18 +853,23 @@ class Worker:
             return result
 
         try:
+            from ray_tpu.util import tracing as _tracing
+
             trace_ctx = task.get("trace_ctx")
             if trace_ctx is None:
                 # tracing off (the default): no generator-contextmanager
-                # frame on the per-task hot path
-                result = _call()
+                # frame on the per-task hot path (the in-flight entry is
+                # always on — a hung task must be visible in stuck_calls
+                # even when nobody enabled tracing beforehand)
+                with _tracing.inflight("task", task.get("name", "?")):
+                    result = _call()
             else:
-                from ray_tpu.util.tracing import execution_span
-
                 # the coroutine drive stays INSIDE the span: an async
                 # task's real execution happens in asyncio.run, not at
                 # the call that returns the coroutine
-                with execution_span(task.get("name", "?"), trace_ctx):
+                with _tracing.execution_span(task.get("name", "?"),
+                                             trace_ctx), \
+                        _tracing.inflight("task", task.get("name", "?")):
                     result = _call()
         except BaseException as e:  # noqa: BLE001
             self._store_error(
@@ -1002,11 +1021,13 @@ class Worker:
                 self._release_task_pin(task)
                 _done()
             try:
-                from ray_tpu.util.tracing import execution_span
+                from ray_tpu.util import tracing as _tracing
 
                 method = getattr(self.actor_instance, task["method_name"])
-                with execution_span(task.get("name", "?"),
-                                    task.get("trace_ctx")):
+                with _tracing.execution_span(task.get("name", "?"),
+                                             task.get("trace_ctx")), \
+                        _tracing.inflight("actor_task",
+                                          task.get("name", "?")):
                     result = method(*args, **kwargs)
                     if inspect.isawaitable(result):
                         result = await result
@@ -1048,12 +1069,14 @@ class Worker:
             return
         started = _time.monotonic()
         try:
-            from ray_tpu.util.tracing import execution_span
+            from ray_tpu.util import tracing as _tracing
 
             args, kwargs = self._resolve_args(task)
             method = getattr(self.actor_instance, task["method_name"])
-            with execution_span(task.get("name", "?"),
-                                task.get("trace_ctx")):
+            with _tracing.execution_span(task.get("name", "?"),
+                                         task.get("trace_ctx")), \
+                    _tracing.inflight("actor_task",
+                                      task.get("name", "?")):
                 result = method(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             self._store_error(
@@ -1105,6 +1128,10 @@ def main():
         from ray_tpu.runtime.prestart import zygote_main
 
         raise SystemExit(zygote_main())
+    # flight recorder: dump recent spans/events before a SIGTERM death
+    from ray_tpu.util import tracing as _tracing
+
+    _tracing.install_crash_dump()
     Worker().run()
 
 

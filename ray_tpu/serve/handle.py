@@ -15,6 +15,7 @@ import threading
 import time
 
 import ray_tpu
+from ray_tpu.util import tracing as _tracing
 
 
 class DeploymentHandle:
@@ -191,23 +192,30 @@ class DeploymentHandle:
             kwargs = {**kwargs, MODEL_ID_KWARG: self._model_id}
         self._refresh()
         last = None
-        for attempt in range(5):
-            replica = None
-            try:
-                replica = self._pick(prefix_tokens)  # raises in redeploy gap
-                ref = replica.handle_request.remote(self._method, args,
-                                                    kwargs)
-                with self._lock:
-                    self._inflight.setdefault(replica, []).append(ref)
-                return ref
-            except Exception as e:  # noqa: BLE001 - dead replica / empty set
-                last = e
-                if replica is not None:
-                    self._evict(replica)
-                with self._lock:
-                    self._version = -1
-                time.sleep(0.05 * attempt)
-                self._refresh(ttl=0)
+        # trace root of the serve path: the actor call below captures
+        # this ambient span into its task spec, so router, replica run
+        # span, and engine stage spans all land in ONE trace
+        with _tracing.span(f"serve.request:{self.deployment_name}",
+                           kind="serve"):
+            for attempt in range(5):
+                replica = None
+                try:
+                    with _tracing.span("serve.route", kind="serve"):
+                        # raises in redeploy gap
+                        replica = self._pick(prefix_tokens)
+                    ref = replica.handle_request.remote(self._method, args,
+                                                        kwargs)
+                    with self._lock:
+                        self._inflight.setdefault(replica, []).append(ref)
+                    return ref
+                except Exception as e:  # noqa: BLE001 - dead/empty set
+                    last = e
+                    if replica is not None:
+                        self._evict(replica)
+                    with self._lock:
+                        self._version = -1
+                    time.sleep(0.05 * attempt)
+                    self._refresh(ttl=0)
         raise RuntimeError(
             f"could not route request to {self.deployment_name!r}: {last!r}")
 
@@ -228,10 +236,14 @@ class DeploymentHandle:
         for attempt in range(5):
             replica = None
             try:
-                replica = self._pick(prefix_tokens)
-                stream_id = ray_tpu.get(
-                    replica.start_stream.remote(self._method, args,
-                                                kwargs))
+                with _tracing.span(
+                        f"serve.request:{self.deployment_name}",
+                        kind="serve"):
+                    with _tracing.span("serve.route", kind="serve"):
+                        replica = self._pick(prefix_tokens)
+                    stream_id = ray_tpu.get(
+                        replica.start_stream.remote(self._method, args,
+                                                    kwargs))
                 break
             except Exception as e:  # noqa: BLE001 - stale/dead replica
                 last = e

@@ -38,6 +38,7 @@ from ray_tpu.models import decoding
 from ray_tpu.models.decoding import (KVCache, SamplingParams, lax_slice_row,
                                      lax_update_row)
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 
 # Per-request TTFT decomposition (metrics plane): every request's time to
 # first token splits into queue_wait (submit -> prefill dispatch),
@@ -84,6 +85,12 @@ class Request:
     # (e.g. prompt longer than the cache) — distinguishes rejection from
     # a legitimate empty/EOS completion
     error: BaseException | None = None
+    # tracing: the ambient span context at submit() (the replica's run
+    # span when the request came through serve) plus a wall-clock submit
+    # stamp — the engine emits its TTFT stage spans against these after
+    # the first token drains
+    trace_ctx: object | None = None
+    submit_wall: float | None = None
 
     @property
     def ttft(self) -> float | None:
@@ -415,6 +422,9 @@ class LLMEngine:
             eos_id=eos_id,
         )
         req.engine = self
+        if _tracing.is_enabled():
+            req.trace_ctx = _tracing.current_context()
+            req.submit_wall = time.time()
         # Lock pairs with the drain in _loop's finally: a request either
         # lands in _waiting before the drain (and gets its sentinel
         # there) or observes the dead/stopped engine here — never neither.
@@ -595,8 +605,35 @@ class LLMEngine:
                     if _metrics.enabled():
                         for stage in _STAGES:
                             self._h_stage[stage].observe(bd[f"{stage}_s"])
+                    if req.trace_ctx is not None \
+                            and req.submit_wall is not None:
+                        self._emit_trace_spans(req, bd)
                 self._emit(req, int(first))
         self._pending_firsts = keep
+
+    def _emit_trace_spans(self, req: Request, bd: dict):
+        """The engine's span subtree for one traced request: an
+        ``engine.request`` parent spanning submit -> first token
+        (wall-anchored at the submit stamp, parented to the replica's
+        run span), with the four TTFT stages as SEQUENTIAL children.
+        ``breakdown`` clamps the stamps, so the children tile the parent
+        exactly — the waterfall shows queue_wait/prefill/pipeline_stall/
+        ship summing to the traced TTFT."""
+        ttft = req.ttft
+        if ttft is None:
+            return
+        parent = _tracing.emit(
+            "engine.request", start=req.submit_wall, duration=ttft,
+            parent=req.trace_ctx, kind="serve",
+            attrs={"request_id": req.request_id,
+                   "deployment": self.deployment_name,
+                   "replica": self.replica_tag})
+        t = req.submit_wall
+        for stage in _STAGES:
+            d = bd[f"{stage}_s"]
+            _tracing.emit(f"engine.{stage}", start=t, duration=d,
+                          parent=parent, kind="serve")
+            t += d
 
     def _admission_window(self) -> bool:
         """Continuous admission: between the previous chunk's sync and

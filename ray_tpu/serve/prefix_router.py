@@ -139,4 +139,12 @@ class PrefixRouter:
             self.fallbacks += 1
         else:
             self.hits += 1
+        # flight-recorder breadcrumb: routing decisions are the first
+        # thing to read when a serve trace shows a cold-cache prefill
+        from ray_tpu.util import tracing as _tracing
+        _tracing.record_event(
+            "prefix_router.pick",
+            hit=best_tag is not None,
+            tag=best_tag,
+            score_pages=best[0] if best_tag is not None else 0)
         return best_tag

@@ -187,6 +187,127 @@ def summarize_latencies(last_s: float | None = 300.0,
 
 
 # ---------------------------------------------------------------------------
+# distributed tracing plane (util/tracing.py): collected traces, the
+# stuck-call watchdog, and per-process flight recorders
+# ---------------------------------------------------------------------------
+
+
+def get_trace(trace_id: str) -> dict | None:
+    """One collected trace by id: ``{"trace_id", "spans", ...}`` with
+    spans sorted by start, or None if the store no longer holds it.
+    Cluster mode asks the GCS TraceStore; local mode reads the process
+    flight ring (local-mode spans never leave the process)."""
+    mode, rt = _mode()
+    if mode == "cluster":
+        return rt._gcs.call("get_trace", trace_id=trace_id)["trace"]
+    from ray_tpu.util import tracing as _tracing
+
+    spans = _tracing.local_trace(trace_id)
+    if not spans:
+        return None
+    return {"trace_id": trace_id, "spans": spans,
+            "first": spans[0]["start"],
+            "last": max(s["start"] + s.get("duration", 0.0)
+                        for s in spans),
+            "error": any(s.get("error") for s in spans),
+            "slow": False, "srcs": ["local"]}
+
+
+def list_traces(limit: int = 50) -> list[dict]:
+    """Newest-first summaries of collected traces (cluster mode), or
+    summaries reconstructed from the local flight ring."""
+    mode, rt = _mode()
+    if mode == "cluster":
+        return rt._gcs.call("list_traces", limit=limit)["traces"]
+    from ray_tpu.util import tracing as _tracing
+
+    snap = _tracing.flight_snapshot()
+    by_tid: dict[str, list] = {}
+    for s in snap["spans"]:
+        by_tid.setdefault(s["trace_id"], []).append(s)
+    items = []
+    for tid, spans in by_tid.items():
+        first = min(s["start"] for s in spans)
+        last = max(s["start"] + s.get("duration", 0.0) for s in spans)
+        items.append({
+            "trace_id": tid, "spans": len(spans), "first": first,
+            "last": last, "duration_s": last - first,
+            "error": any(s.get("error") for s in spans),
+            "slow": False, "srcs": ["local"],
+            "root": next((s["name"] for s in spans
+                          if not s.get("parent_id")), spans[0]["name"]),
+        })
+    items.sort(key=lambda i: -i["last"])
+    return items[:max(0, int(limit))]
+
+
+def stuck_calls(threshold_s: float | None = None) -> dict:
+    """In-flight calls (RPCs, pulls, leases, actor calls) older than
+    ``threshold_s`` (default config ``trace_stuck_threshold_s``),
+    cluster-wide: this process's registry, the GCS's, and every node's
+    (raylet + its workers, fanned out by each raylet). Entries carry
+    start stamps and — when the call was made inside a span — the
+    trace/span ids of their parent chain."""
+    from ray_tpu.util import tracing as _tracing
+
+    out: dict[str, Any] = {"driver": _tracing.local_stuck_calls(threshold_s)}
+    mode, rt = _mode()
+    if mode != "cluster":
+        return out
+    try:
+        out["gcs"] = rt._gcs.call("stuck_calls",
+                                  threshold_s=threshold_s)["calls"]
+    except Exception as e:  # noqa: BLE001 - partial result beats none
+        out["gcs"] = {"error": repr(e)}
+    import threading
+
+    nodes_out: dict = {}
+    out_lock = threading.Lock()
+
+    def query(node):
+        calls, err = _call_node(node, "stuck_calls", timeout=15,
+                                threshold_s=threshold_s)
+        with out_lock:
+            nodes_out[node["node_id"]] = (calls if calls is not None
+                                          else {"error": err})
+
+    threads = [threading.Thread(target=query, args=(n,), daemon=True)
+               for n in rt._gcs.call("get_nodes", alive_only=True)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+    out["nodes"] = nodes_out
+    return out
+
+
+def flight_record(proc: str | None = None,
+                  last_s: float | None = None) -> dict:
+    """On-demand flight-recorder dump: the last ``last_s`` seconds of
+    spans + RPC events + in-flight calls. ``proc=None`` snapshots THIS
+    process (pure local memory — works while the GCS is unreachable);
+    ``proc="gcs"`` asks the GCS; any other value is a node id whose
+    raylet answers for itself and its workers."""
+    from ray_tpu.util import tracing as _tracing
+
+    if proc is None:
+        return {"local": _tracing.flight_snapshot(last_s)}
+    mode, rt = _mode()
+    if mode != "cluster":
+        raise RuntimeError(f"flight_record({proc!r}) needs a cluster "
+                           "runtime; use flight_record() for this process")
+    if proc == "gcs":
+        return {"gcs": rt._gcs.call("flight_record",
+                                    last_s=last_s)["flight"]}
+    for node in rt._gcs.call("get_nodes", alive_only=True):
+        if node["node_id"] == proc:
+            snap, err = _call_node(node, "flight_record", timeout=15,
+                                   last_s=last_s)
+            return {proc: snap if snap is not None else {"error": err}}
+    raise KeyError(f"no live node {proc!r}")
+
+
+# ---------------------------------------------------------------------------
 # profiling / stack introspection (reference: py-spy dump/record through
 # the dashboard reporter agent, profile_manager.py:11-51 — here every
 # raylet proxies its workers' in-process samplers)

@@ -1,22 +1,45 @@
-"""Distributed task tracing: spans around every remote call.
+"""Distributed tracing plane: spans around every remote call, collected
+cluster-wide through the metrics-plane transport.
 
 Reference analog: ``python/ray/util/tracing/tracing_helper.py``
 (``_inject_tracing_into_function:326``, ``_inject_tracing_into_class:450``)
 — the reference wraps every remote function with OpenTelemetry spans and
-propagates context in task metadata. Here spans are written as JSON lines
-to a trace directory (the "exporter"); context (trace_id, parent span)
-rides in the task spec, so a task's spans parent to its submitter's span
-across process boundaries (workers inherit the trace dir via env).
+propagates context in task metadata, exporting through an OTel exporter
+each process configures. Here there is no OTel dependency: context rides
+task specs AND a ``_trace`` header on every framed RPC; finished spans
+land in a per-process bounded ring drained by the MetricsPusher into the
+GCS :class:`TraceStore` (same drop-not-block contract as metric frames),
+with an optional JSONL file exporter kept for local runs.
+
+Four cooperating pieces:
+
+- **Propagation** — ``submission_context``/``execution_span`` thread
+  context through task specs (tasks + actor calls); ``wire_context``/
+  ``server_span`` do the same for raw framed RPCs so spans parent across
+  driver→GCS→raylet→worker hops.
+- **Collection** — ``_emit`` feeds a bounded push ring; the metrics
+  pusher ships it via ``push_spans`` into the GCS ``TraceStore`` ring
+  (tail-based retention: error/slow traces survive longest, normals are
+  sampled 1-in-``trace_sample_n``).
+- **Flight recorder** — every process keeps the last
+  ``trace_flight_window_s`` of spans + RPC events in memory;
+  ``dump_flight`` writes them on SIGTERM (``install_crash_dump``) or on
+  demand via ``util.state.flight_record``.
+- **Stuck-call watchdog** — ``call_started``/``call_finished`` maintain
+  an in-flight registry (RPCs, pulls, leases) surfaced through
+  ``local_stuck_calls`` / ``util.state.stuck_calls``.
 
 Usage:
-    ray_tpu.util.tracing.enable_tracing("/tmp/traces")
+    ray_tpu.util.tracing.enable_tracing()          # collected plane
+    ray_tpu.util.tracing.enable_tracing("/tmp/tr") # + JSONL exporter
     ... run work ...
-    spans = ray_tpu.util.tracing.read_spans("/tmp/traces")
+    trace = ray_tpu.util.state.get_trace(trace_id)
 
 Span records: {"name", "trace_id", "span_id", "parent_id", "start",
-"duration", "pid", "kind"}. ``to_chrome_trace`` converts to
-chrome://tracing format (complements ray_tpu.timeline(), which covers
-task lifecycle events without cross-task parentage).
+"duration", "pid", "kind"} (+ optional "attrs", "error").
+``to_chrome_trace`` converts to chrome://tracing format (complements
+ray_tpu.timeline(), which covers task lifecycle events without
+cross-task parentage).
 """
 
 from __future__ import annotations
@@ -24,19 +47,37 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import json
+import logging
 import os
+import signal
+import tempfile
 import threading
 import time
 import uuid
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 
+logger = logging.getLogger("ray_tpu.tracing")
+
 _ENV_DIR = "RAY_TPU_TRACE_DIR"
+_ENV_ON = "RAY_TPU_TRACE_ENABLED"
 
 # ambient span context (submission captures it; execution restores it)
 _current: contextvars.ContextVar["SpanContext | None"] = \
     contextvars.ContextVar("ray_tpu_trace_ctx", default=None)
 
 _write_lock = threading.Lock()
+
+
+def _cfg_attr(name: str, default):
+    """Config flag with an import-cycle-safe fallback (tracing is
+    imported by modules the config module itself pulls in)."""
+    try:
+        from ray_tpu.utils.config import get_config
+
+        return getattr(get_config(), name, default)
+    except Exception:  # pragma: no cover - early-import fallback
+        return default
 
 
 @dataclass
@@ -54,18 +95,23 @@ class SpanContext:
         return SpanContext(d["trace_id"], d["span_id"])
 
 
-def enable_tracing(trace_dir: str) -> None:
+def enable_tracing(trace_dir: str | None = None) -> None:
     """Turn tracing on for this process AND every worker spawned after
-    (the dir is inherited through the environment, like the reference's
-    tracing startup hook)."""
-    os.makedirs(trace_dir, exist_ok=True)
-    os.environ[_ENV_DIR] = trace_dir
+    (the switch is inherited through the environment, like the
+    reference's tracing startup hook). ``trace_dir`` is optional: with
+    one, finished spans are ALSO appended to per-pid JSONL files;
+    without one, collection is ring-buffer + pusher only."""
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        os.environ[_ENV_DIR] = trace_dir
+    os.environ[_ENV_ON] = "1"
     global _enabled_cache
     _enabled_cache = (True, time.monotonic())
 
 
 def disable_tracing() -> None:
     os.environ.pop(_ENV_DIR, None)
+    os.environ.pop(_ENV_ON, None)
     global _enabled_cache
     _enabled_cache = (False, time.monotonic())
 
@@ -83,7 +129,9 @@ def is_enabled() -> bool:
     value, checked = _enabled_cache
     now = time.monotonic()
     if now - checked > 0.2:
-        value = bool(os.environ.get(_ENV_DIR))
+        on = os.environ.get(_ENV_ON)
+        value = bool(os.environ.get(_ENV_DIR)) or \
+            bool(on and on not in ("0", "false", "False"))
         _enabled_cache = (value, now)
     return value
 
@@ -92,22 +140,96 @@ def current_context() -> SpanContext | None:
     return _current.get()
 
 
-def _emit(record: dict) -> None:
+def bind(ctx: SpanContext | None):
+    """Set the ambient context explicitly (worker threads don't inherit
+    contextvars — chunked pulls and executor threads re-bind the
+    captured context). Returns the reset token."""
+    return _current.set(ctx)
+
+
+# ---------------------------------------------------------------------------
+# span sinks: push ring (drained by the metrics pusher), flight ring
+# (recent-history recorder), optional JSONL file
+# ---------------------------------------------------------------------------
+
+_ring_lock = threading.Lock()
+_push_ring: deque | None = None
+_flight: deque | None = None
+
+
+def _rings() -> tuple[deque, deque]:
+    global _push_ring, _flight
+    if _push_ring is None:
+        with _ring_lock:
+            if _push_ring is None:
+                _flight = deque(
+                    maxlen=int(_cfg_attr("trace_flight_spans", 4096)))
+                _push_ring = deque(
+                    maxlen=int(_cfg_attr("trace_buffer_spans", 4096)))
+    return _push_ring, _flight
+
+
+def drain_spans(max_n: int | None = None) -> list[dict]:
+    """Pop up to ``max_n`` finished spans for shipment (pusher tick).
+    Oldest first; the ring itself already dropped anything past its
+    bound, so drain never blocks and never grows."""
+    ring, _ = _rings()
+    if not ring:
+        return []
+    if max_n is None:
+        max_n = int(_cfg_attr("trace_push_max_spans", 1024))
+    out: list[dict] = []
+    with _ring_lock:
+        while ring and len(out) < max_n:
+            out.append(ring.popleft())
+    return out
+
+
+def requeue_spans(spans: list[dict]) -> None:
+    """Put spans back at the FRONT after a failed push (bounded: the
+    ring's maxlen still drops the overflow — drop-not-block)."""
+    if not spans:
+        return
+    ring, _ = _rings()
+    with _ring_lock:
+        ring.extendleft(reversed(spans))
+
+
+def _file_sink(record: dict) -> None:
     trace_dir = os.environ.get(_ENV_DIR)
     if not trace_dir:
         return
     path = os.path.join(trace_dir, f"spans-{os.getpid()}.jsonl")
     line = json.dumps(record)
+    cap = int(_cfg_attr("trace_file_max_bytes", 64 << 20))
     with _write_lock:
         with open(path, "a") as f:
             f.write(line + "\n")
+            size = f.tell()
+        if cap > 0 and size > cap:
+            # single-generation rotation: the previous generation is
+            # overwritten, bounding disk at ~2x the cap per process
+            try:
+                os.replace(path, path + ".1")
+            except OSError:  # pragma: no cover - fs race
+                pass
+
+
+def _emit(record: dict) -> None:
+    ring, flight = _rings()
+    with _ring_lock:
+        ring.append(record)
+        flight.append(record)
+    _file_sink(record)
 
 
 @contextlib.contextmanager
 def span(name: str, *, kind: str = "local",
-         parent: SpanContext | None = None):
+         parent: SpanContext | None = None,
+         attrs: dict | None = None):
     """Record one span; inside the block, the ambient context points at
-    it (children created here parent to it)."""
+    it (children created here parent to it). An escaping exception marks
+    the span ``error`` (tail-based retention keeps such traces)."""
     if not is_enabled():
         yield None
         return
@@ -119,11 +241,15 @@ def span(name: str, *, kind: str = "local",
     )
     token = _current.set(ctx)
     start = time.time()
+    error = False
     try:
         yield ctx
+    except BaseException:
+        error = True
+        raise
     finally:
         _current.reset(token)
-        _emit({
+        rec = {
             "name": name,
             "trace_id": ctx.trace_id,
             "span_id": ctx.span_id,
@@ -132,8 +258,261 @@ def span(name: str, *, kind: str = "local",
             "duration": time.time() - start,
             "pid": os.getpid(),
             "kind": kind,
-        })
+        }
+        if attrs:
+            rec["attrs"] = attrs
+        if error:
+            rec["error"] = True
+        _emit(rec)
 
+
+def emit(name: str, *, start: float, duration: float,
+         parent: SpanContext | None = None, kind: str = "local",
+         attrs: dict | None = None,
+         ctx: SpanContext | None = None) -> SpanContext:
+    """Emit one already-timed span (the serve engine stamps queue_wait /
+    prefill / pipeline_stall from its own monotonic breakdown and emits
+    them after the fact). Returns the span's context so stage children
+    can parent to it."""
+    if ctx is None:
+        ctx = SpanContext(
+            trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
+            span_id=uuid.uuid4().hex[:16],
+        )
+    rec = {
+        "name": name,
+        "trace_id": ctx.trace_id,
+        "span_id": ctx.span_id,
+        "parent_id": parent.span_id if parent else None,
+        "start": start,
+        "duration": duration,
+        "pid": os.getpid(),
+        "kind": kind,
+    }
+    if attrs:
+        rec["attrs"] = attrs
+    _emit(rec)
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# RPC header propagation (runtime/rpc.py attaches/restores these)
+# ---------------------------------------------------------------------------
+
+def wire_context():
+    """Compact ``(trace_id, span_id)`` for the RPC ``_trace`` header, or
+    None when tracing is off / no ambient span (untraced RPCs carry no
+    header and produce no server spans — heartbeats stay span-free)."""
+    if not is_enabled():
+        return None
+    cur = _current.get()
+    if cur is None:
+        return None
+    return (cur.trace_id, cur.span_id)
+
+
+@contextlib.contextmanager
+def server_span(method: str, wire):
+    """Server-dispatch side of RPC propagation: restore the caller's
+    context so handler-side spans (and nested RPCs) parent across the
+    hop. No-op without a header."""
+    if wire is None or not is_enabled():
+        yield None
+        return
+    try:
+        parent = SpanContext(str(wire[0]), str(wire[1]))
+    except (TypeError, IndexError, KeyError):
+        yield None
+        return
+    with span(f"rpc:{method}", kind="rpc", parent=parent) as ctx:
+        yield ctx
+
+
+# ---------------------------------------------------------------------------
+# stuck-call watchdog: in-flight call registry
+# ---------------------------------------------------------------------------
+
+_inflight_lock = threading.Lock()
+_inflight: dict[int, dict] = {}
+_inflight_next = 0
+
+
+def call_started(kind: str, detail: str, target=None) -> int:
+    """Register one in-flight call (RPC / pull / lease / actor call).
+    Always on: two locked dict ops per call are noise next to a socket
+    round trip, and the watchdog must see calls that hung BEFORE anyone
+    thought to enable tracing."""
+    global _inflight_next
+    cur = _current.get()
+    entry = {
+        "kind": kind,
+        "detail": detail,
+        "target": target,
+        "start": time.time(),
+        "mono": time.monotonic(),
+        "pid": os.getpid(),
+        "thread": threading.current_thread().name,
+        "trace_id": cur.trace_id if cur else None,
+        "span_id": cur.span_id if cur else None,
+    }
+    with _inflight_lock:
+        _inflight_next += 1
+        token = _inflight_next
+        _inflight[token] = entry
+    return token
+
+
+def call_finished(token: int | None) -> None:
+    if token is None:
+        return
+    with _inflight_lock:
+        _inflight.pop(token, None)
+
+
+class _Inflight:
+    """Class-based (not generator) context manager: task execution is a
+    hot path and this runs with tracing OFF too."""
+
+    __slots__ = ("_token",)
+
+    def __init__(self, kind: str, detail: str, target=None):
+        self._token = call_started(kind, detail, target)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        call_finished(self._token)
+        return False
+
+
+def inflight(kind: str, detail: str, target=None) -> _Inflight:
+    """Scope-shaped call_started/call_finished pair, for call sites
+    where the whole in-flight window is one lexical block (task
+    execution); registered-RPC style token threading stays available
+    for split start/finish sites."""
+    return _Inflight(kind, detail, target)
+
+
+def local_stuck_calls(threshold_s: float | None = None) -> list[dict]:
+    """In-flight calls older than ``threshold_s`` (default
+    ``trace_stuck_threshold_s``), oldest first, with their parent span
+    chain coordinates (trace_id/span_id) when the call was traced."""
+    if threshold_s is None:
+        threshold_s = float(_cfg_attr("trace_stuck_threshold_s", 10.0))
+    now = time.monotonic()
+    with _inflight_lock:
+        entries = [dict(e) for e in _inflight.values()
+                   if now - e["mono"] >= threshold_s]
+    for e in entries:
+        e["age_s"] = now - e.pop("mono")
+    entries.sort(key=lambda e: -e["age_s"])
+    return entries
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def record_event(name: str, **attrs) -> None:
+    """Append one point event (RPC drop, router decision, lease grant)
+    to the flight ring only — never shipped, only dumped."""
+    if not is_enabled():
+        return
+    _, flight = _rings()
+    rec = {"event": name, "ts": time.time(), "pid": os.getpid()}
+    if attrs:
+        rec.update(attrs)
+    with _ring_lock:
+        flight.append(rec)
+
+
+def flight_snapshot(last_s: float | None = None) -> dict:
+    """The last ``last_s`` seconds (default ``trace_flight_window_s``)
+    of spans + events, plus every currently in-flight call. Pure local
+    memory — works while the GCS is unreachable."""
+    if last_s is None:
+        last_s = float(_cfg_attr("trace_flight_window_s", 30.0))
+    cutoff = time.time() - last_s
+    _, flight = _rings()
+    with _ring_lock:
+        records = list(flight)
+    spans_out, events_out = [], []
+    for r in records:
+        if "event" in r:
+            if r["ts"] >= cutoff:
+                events_out.append(r)
+        elif r["start"] + r.get("duration", 0.0) >= cutoff:
+            spans_out.append(r)
+    return {
+        "pid": os.getpid(),
+        "ts": time.time(),
+        "window_s": last_s,
+        "spans": spans_out,
+        "events": events_out,
+        "inflight": local_stuck_calls(0.0),
+    }
+
+
+def local_trace(trace_id: str) -> list[dict]:
+    """Spans of one trace still in the local flight ring (local-mode
+    ``util.state.get_trace`` backend)."""
+    _, flight = _rings()
+    with _ring_lock:
+        records = list(flight)
+    return sorted((r for r in records
+                   if "event" not in r and r.get("trace_id") == trace_id),
+                  key=lambda r: r["start"])
+
+
+def dump_flight(path: str | None = None, last_s: float | None = None) -> str:
+    """Write the flight snapshot as JSON; returns the path. Defaults to
+    ``flight-<pid>-<ts>.json`` in the trace dir (or tempdir)."""
+    snap = flight_snapshot(last_s)
+    if path is None:
+        base = os.environ.get(_ENV_DIR) or tempfile.gettempdir()
+        path = os.path.join(
+            base, f"flight-{os.getpid()}-{int(snap['ts'])}.json")
+    with open(path, "w") as f:
+        json.dump(snap, f)
+    return path
+
+
+_crash_dump_installed = False
+
+
+def install_crash_dump() -> bool:
+    """Chain a SIGTERM handler that dumps the flight ring before the
+    process dies (local files only — no network, so it works through a
+    partition). Safe off the main thread (no-op there) and idempotent."""
+    global _crash_dump_installed
+    if _crash_dump_installed:
+        return True
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            try:
+                if is_enabled():
+                    dump_flight()
+            except Exception:  # pragma: no cover - dying anyway
+                pass
+            if callable(prev) and prev not in (
+                    signal.SIG_IGN, signal.SIG_DFL):
+                prev(signum, frame)
+            else:
+                raise SystemExit(143)
+
+        signal.signal(signal.SIGTERM, _on_term)
+        _crash_dump_installed = True
+        return True
+    except ValueError:  # not the main thread
+        return False
+
+
+# ---------------------------------------------------------------------------
+# task-spec propagation (unchanged wire shape; api.py calls these)
+# ---------------------------------------------------------------------------
 
 def submission_context(function_name: str) -> dict | None:
     """Called at .remote() time: returns the wire context for the spec
@@ -169,12 +548,22 @@ def execution_span(function_name: str, wire_ctx: dict | None):
     if wire_ctx is None:
         yield
         return
+    global _enabled_cache
     wire_dir = wire_ctx.get("trace_dir")
+    changed = False
     if wire_dir and os.environ.get(_ENV_DIR) != wire_dir:
         # adopt/sync the submitter's trace dir: workers are spawned by
         # the raylet (no env inheritance from the driver), and a warm
         # worker must follow the driver when it switches directories
         os.environ[_ENV_DIR] = wire_dir
+        changed = True
+    if not os.environ.get(_ENV_ON):
+        # a wire context only exists when the submitter traces: adopt
+        # the dir-less switch too, so worker-side spans reach the ring
+        os.environ[_ENV_ON] = "1"
+        changed = True
+    if changed:
+        _enabled_cache = (True, time.monotonic())
     if not is_enabled():
         yield
         return
@@ -183,18 +572,214 @@ def execution_span(function_name: str, wire_ctx: dict | None):
         yield
 
 
-def read_spans(trace_dir: str) -> list[dict]:
-    out = []
+# ---------------------------------------------------------------------------
+# GCS-side collected store
+# ---------------------------------------------------------------------------
+
+class TraceStore:
+    """Bounded trace ring on the GCS with tail-based retention.
+
+    Spans arrive via ``push_spans`` grouped here by trace_id. When over
+    budget (``max_traces`` traces / ``max_spans`` total spans), eviction
+    walks classes in order: unsampled-normal first (trace_id hash not
+    selected by the 1-in-``sample_n`` sampler), then sampled-normal,
+    then error/slow — so the traces most worth keeping die last. Within
+    a class, oldest-activity first."""
+
+    def __init__(self, max_traces: int = 512, max_spans: int = 20000,
+                 sample_n: int = 1, slow_s: float = 1.0,
+                 per_trace_spans: int = 1024):
+        self.max_traces = max(1, int(max_traces))
+        self.max_spans = max(1, int(max_spans))
+        self.sample_n = max(1, int(sample_n))
+        self.slow_s = float(slow_s)
+        self.per_trace_spans = max(1, int(per_trace_spans))
+        self._lock = threading.Lock()
+        # trace_id -> {"spans", "first", "last", "error", "slow", "srcs"}
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+        self._total_spans = 0
+        self.dropped_spans = 0
+        self.evicted_traces = 0
+
+    def _sampled(self, trace_id: str) -> bool:
+        if self.sample_n <= 1:
+            return True
+        try:
+            return int(trace_id[:8], 16) % self.sample_n == 0
+        except ValueError:
+            return True
+
+    def _class_of(self, t: dict, trace_id: str) -> int:
+        if t["error"] or t["slow"]:
+            return 2
+        return 1 if self._sampled(trace_id) else 0
+
+    def ingest(self, src: str, spans: list[dict]) -> int:
+        accepted = 0
+        with self._lock:
+            for s in spans:
+                tid = s.get("trace_id")
+                if not tid or "start" not in s:
+                    self.dropped_spans += 1
+                    continue
+                t = self._traces.get(tid)
+                if t is None:
+                    t = {"spans": [], "first": s["start"], "last": 0.0,
+                         "error": False, "slow": False, "srcs": set()}
+                    self._traces[tid] = t
+                if len(t["spans"]) >= self.per_trace_spans:
+                    self.dropped_spans += 1
+                    continue
+                t["spans"].append(s)
+                self._total_spans += 1
+                accepted += 1
+                end = s["start"] + s.get("duration", 0.0)
+                t["first"] = min(t["first"], s["start"])
+                t["last"] = max(t["last"], end)
+                if s.get("error"):
+                    t["error"] = True
+                if s.get("duration", 0.0) >= self.slow_s:
+                    t["slow"] = True
+                if src:
+                    t["srcs"].add(src)
+            self._evict_locked()
+        return accepted
+
+    def _evict_locked(self) -> None:
+        while (len(self._traces) > self.max_traces
+               or self._total_spans > self.max_spans):
+            victim = None
+            for klass in (0, 1, 2):
+                candidates = [(t["last"], tid)
+                              for tid, t in self._traces.items()
+                              if self._class_of(t, tid) == klass]
+                if candidates:
+                    victim = min(candidates)[1]
+                    break
+            if victim is None:  # pragma: no cover - defensive
+                break
+            gone = self._traces.pop(victim)
+            self._total_spans -= len(gone["spans"])
+            self.evicted_traces += 1
+
+    def get(self, trace_id: str) -> dict | None:
+        with self._lock:
+            t = self._traces.get(trace_id)
+            if t is None:
+                return None
+            spans = sorted(t["spans"], key=lambda s: s["start"])
+            return {
+                "trace_id": trace_id,
+                "spans": spans,
+                "first": t["first"],
+                "last": t["last"],
+                "error": t["error"],
+                "slow": t["slow"],
+                "srcs": sorted(t["srcs"]),
+            }
+
+    def list(self, limit: int = 50) -> list[dict]:
+        with self._lock:
+            items = [
+                {
+                    "trace_id": tid,
+                    "spans": len(t["spans"]),
+                    "first": t["first"],
+                    "last": t["last"],
+                    "duration_s": max(0.0, t["last"] - t["first"]),
+                    "error": t["error"],
+                    "slow": t["slow"],
+                    "srcs": sorted(t["srcs"]),
+                    "root": next(
+                        (s["name"] for s in t["spans"]
+                         if not s.get("parent_id")),
+                        t["spans"][0]["name"] if t["spans"] else ""),
+                }
+                for tid, t in self._traces.items()
+            ]
+        items.sort(key=lambda i: -i["last"])
+        return items[:max(0, int(limit))]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"traces": len(self._traces),
+                    "spans": self._total_spans,
+                    "dropped_spans": self.dropped_spans,
+                    "evicted_traces": self.evicted_traces}
+
+
+def build_waterfall(spans: list[dict]) -> list[dict]:
+    """Depth-first waterfall rows for a trace: each span with its tree
+    depth and millisecond offset from the trace start (the dashboard
+    renders these directly as offset/width bars)."""
+    if not spans:
+        return []
+    spans = sorted(spans, key=lambda s: (s["start"], s.get("name", "")))
+    t0 = spans[0]["start"]
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict[str | None, list[dict]] = {}
+    roots: list[dict] = []
+    for s in spans:
+        pid = s.get("parent_id")
+        if pid and pid in by_id:
+            children.setdefault(pid, []).append(s)
+        else:
+            roots.append(s)
+    rows: list[dict] = []
+
+    def _walk(s: dict, depth: int) -> None:
+        rows.append({
+            "name": s["name"],
+            "span_id": s["span_id"],
+            "parent_id": s.get("parent_id"),
+            "depth": depth,
+            "kind": s.get("kind"),
+            "pid": s.get("pid"),
+            "start": s["start"],
+            "duration": s.get("duration", 0.0),
+            "offset_ms": (s["start"] - t0) * 1e3,
+            "dur_ms": s.get("duration", 0.0) * 1e3,
+            "error": bool(s.get("error")),
+            "attrs": s.get("attrs"),
+        })
+        for c in children.get(s["span_id"], ()):
+            _walk(c, depth + 1)
+
+    for r in roots:
+        _walk(r, 0)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# file exporter (kept for local runs; bounded + streaming)
+# ---------------------------------------------------------------------------
+
+def iter_spans(trace_dir: str):
+    """Stream span records from a trace dir without loading every file
+    into memory. Rotated generations (``.jsonl.1``) are yielded before
+    their live file so a per-pid stream stays roughly chronological."""
     if not os.path.isdir(trace_dir):
-        return out
-    for fn in sorted(os.listdir(trace_dir)):
-        if fn.startswith("spans-") and fn.endswith(".jsonl"):
+        return
+    names = [fn for fn in os.listdir(trace_dir)
+             if fn.startswith("spans-")
+             and (fn.endswith(".jsonl") or fn.endswith(".jsonl.1"))]
+    # (base name, generation) — generation 0 is the rotated (older) file
+    names.sort(key=lambda fn: (
+        fn[:-2] if fn.endswith(".1") else fn,
+        0 if fn.endswith(".1") else 1))
+    for fn in names:
+        try:
             with open(os.path.join(trace_dir, fn)) as f:
                 for line in f:
                     line = line.strip()
                     if line:
-                        out.append(json.loads(line))
-    return out
+                        yield json.loads(line)
+        except FileNotFoundError:  # rotated away mid-iteration
+            continue
+
+
+def read_spans(trace_dir: str) -> list[dict]:
+    return list(iter_spans(trace_dir))
 
 
 def to_chrome_trace(spans: list[dict]) -> list[dict]:
@@ -236,8 +821,9 @@ def export_chrome_trace(trace_dir: str | None = None,
     ``trace_dir`` defaults to the active trace dir (``enable_tracing``);
     with tracing off, the export is the timeline alone. Task events need
     an initialized runtime — without one the export is the spans alone.
-    Returns the merged event list; ``filename`` additionally dumps it as
-    JSON.
+    The merged list is stable-sorted by (ts, pid, name) so repeated
+    exports of the same data diff cleanly. Returns the event list;
+    ``filename`` additionally dumps it as JSON.
     """
     if trace_dir is None:
         trace_dir = os.environ.get(_ENV_DIR)
@@ -248,8 +834,13 @@ def export_chrome_trace(trace_dir: str | None = None,
         import ray_tpu
 
         events.extend(ray_tpu.timeline())
-    except Exception:  # noqa: BLE001 - no runtime: spans-only export
-        pass
+    except (ImportError, RuntimeError, AttributeError, TypeError) as e:
+        # no initialized runtime (or a partially torn-down one): the
+        # export is spans-only — say why instead of silently shrinking
+        logger.info("export_chrome_trace: skipping timeline merge: %s", e)
+    # stable order so repeated exports of the same spans diff cleanly
+    events.sort(key=lambda e: (e.get("ts", float("inf")),
+                               e.get("pid", 0), e.get("name", "")))
     # process_name metadata so the viewer labels each pid row group
     for pid in sorted({e["pid"] for e in events if "pid" in e}):
         events.append({"name": "process_name", "ph": "M", "pid": pid,

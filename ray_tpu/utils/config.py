@@ -377,6 +377,30 @@ class Config:
     # (satellite of ROADMAP #2): writes a collapsed-stack artifact.
     bench_profile_enabled: bool = False
 
+    # --- distributed tracing plane (util/tracing.py; reference analog:
+    # OpenTelemetry spans exported per process — here spans ride the
+    # metrics-plane push into a GCS TraceStore ring) ---
+    # Per-process push ring: spans queued past this are DROPPED (same
+    # drop-not-block contract as the metrics pusher buffer).
+    trace_buffer_spans: int = 4096
+    # Max spans shipped per pusher tick.
+    trace_push_max_spans: int = 1024
+    # Flight recorder: in-memory ring of recent spans + RPC events kept
+    # even when collection is off, dumped on SIGTERM or on demand.
+    trace_flight_spans: int = 4096
+    trace_flight_window_s: float = 30.0
+    # File exporter rotation cap per spans-<pid>.jsonl.
+    trace_file_max_bytes: int = 64 << 20
+    # Tail-based retention: normal traces are kept 1-in-N; error/slow
+    # traces (any span >= trace_slow_s) always survive eviction longest.
+    trace_sample_n: int = 1
+    trace_slow_s: float = 1.0
+    # GCS TraceStore ring bounds (traces / total spans).
+    trace_store_traces: int = 512
+    trace_store_spans: int = 20000
+    # Default threshold for util.state.stuck_calls().
+    trace_stuck_threshold_s: float = 10.0
+
     def __post_init__(self):
         for f in fields(self):
             setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))

@@ -671,6 +671,119 @@ def test_metrics_partitioned_gcs_work_unaffected_then_resumes(
     ray_tpu.kill(actor)
 
 
+def test_trace_span_chaos_never_blocks_work(metrics_chaos_cluster):
+    """Round 9: dropped, duplicated, AND delayed push_spans frames while
+    traced tasks and actor calls run at full speed — trace collection is
+    fire-and-forget on the pusher thread, so span-frame faults cost
+    trace fidelity only, never submission latency."""
+    from ray_tpu.util import tracing
+
+    c, pusher = metrics_chaos_cluster
+    tracing.enable_tracing()
+    try:
+        assert ray_tpu.get(double.remote(1), timeout=60) == 2
+        fi.put_plan(c.gcs_address, {
+            "version": 1, "seed": 7,
+            "rules": [
+                {"id": "delay-spans", "fault": "delay", "src": "gcs",
+                 "direction": "recv", "method": "push_spans",
+                 "delay_s": 0.2, "max_hits": 4},
+                {"id": "dup-spans", "fault": "duplicate", "src": "gcs",
+                 "direction": "recv", "method": "push_spans",
+                 "every": 3, "max_hits": 2},
+                {"id": "drop-spans", "fault": "drop", "src": "gcs",
+                 "direction": "recv", "method": "push_spans",
+                 "every": 2, "max_hits": 2},
+            ]})
+
+        rule_ids = ("delay-spans", "dup-spans", "drop-spans")
+        actor = Ordered.remote()
+        sent = 0
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            # traced workload: every round generates spans for the
+            # pusher to ship into the faulted wire
+            with tracing.span(f"chaos-round-{sent}"):
+                t0 = time.monotonic()
+                assert ray_tpu.get([double.remote(i) for i in range(10)],
+                                   timeout=60) == [i * 2
+                                                   for i in range(10)]
+                assert ray_tpu.get(actor.add.remote(sent),
+                                   timeout=60) == sent
+                # well under the 2s span-push RPC timeout: submission
+                # provably never waited on the faulted span wire
+                assert time.monotonic() - t0 < 2.0, \
+                    "traced submission slowed by span-frame faults"
+            sent += 1
+            if all(fi.plane.stats.get(r) for r in rule_ids):
+                break
+            time.sleep(0.1)
+        assert all(fi.plane.stats.get(r) for r in rule_ids), \
+            f"span faults never fired: {fi.plane.stats}"
+
+        _heal(c, version=2)
+        # span pushes keep flowing after the chaos
+        shipped = pusher.pushed_spans
+        with tracing.span("post-heal"):
+            pass
+        _wait(lambda: pusher.pushed_spans > shipped, 30,
+              "span pushes to resume after frame chaos")
+        ray_tpu.kill(actor)
+    finally:
+        tracing.disable_tracing()
+
+
+def test_trace_partitioned_gcs_flight_recorder_still_answers(
+        metrics_chaos_cluster):
+    """A full partition of the metrics/trace channel to the GCS: traced
+    work keeps completing, the LOCAL flight recorder still dumps (pure
+    process memory — the acceptance 'works while GCS unreachable'), and
+    span pushes resume on heal."""
+    from ray_tpu.util import state as state_api
+    from ray_tpu.util import tracing
+
+    c, pusher = metrics_chaos_cluster
+    tracing.enable_tracing()
+    try:
+        with tracing.span("pre-cut"):
+            assert ray_tpu.get(double.remote(1), timeout=60) == 2
+        _wait(lambda: pusher.pushed_spans > 0, 30, "first span push")
+
+        fi.put_plan(c.gcs_address, {
+            "version": 1, "seed": 7,
+            "endpoints": {"gcs": [_addr(c.gcs_address)]},
+            "rules": [{"id": "cut-trace-gcs", "fault": "partition",
+                       "src": "metrics", "dst": "gcs",
+                       "direction": "both"}]})
+        t_cut = time.monotonic()
+
+        # traced submission rides THROUGH the severed span channel
+        with tracing.span("during-cut") as cut_span:
+            assert ray_tpu.get([double.remote(i) for i in range(20)],
+                               timeout=60) == [i * 2 for i in range(20)]
+        # the flight recorder answers from local memory mid-partition
+        out = state_api.flight_record()
+        assert any(s["name"] == "during-cut"
+                   for s in out["local"]["spans"])
+        assert any(s["trace_id"] == cut_span.trace_id
+                   for s in out["local"]["spans"])
+        # ...and the local stuck-call registry stays queryable too
+        assert isinstance(tracing.local_stuck_calls(0.0), list)
+
+        _wait(lambda: fi.plane.stats.get("cut-trace-gcs"), 30,
+              "trace partition to fire")
+        time.sleep(max(0.0, PARTITION_S - (time.monotonic() - t_cut)))
+
+        shipped = pusher.pushed_spans
+        _heal(c, version=2)
+        with tracing.span("post-heal"):
+            pass
+        _wait(lambda: pusher.pushed_spans > shipped, 30,
+              "span pushes to resume after heal")
+    finally:
+        tracing.disable_tracing()
+
+
 def test_dropped_register_actors_retried_without_orphan(chaos_cluster):
     """Round-6 plane: a register_actors frame dropped on the GCS recv
     path leaves NO partial state (no orphan registration), and the

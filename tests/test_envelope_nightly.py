@@ -150,3 +150,91 @@ def test_cross_node_task_spray(big_cluster):
                             timeout=600))
     # queue-depth spillback must spread the flood across every raylet
     assert len(nodes) == 4, f"flood stayed on {len(nodes)} node(s)"
+
+
+def test_trace_context_survives_steady_actor_phase(big_cluster):
+    """Round-9 tracing leg: the steady actor phase runs with tracing
+    ENABLED and (a) a traced slice of the steady calls lands in the GCS
+    TraceStore as ONE trace whose worker-side ``run:`` spans prove the
+    context crossed real process boundaries at this scale, (b) the warm
+    actor-location resolve rate — the ``envelope_actor_resolves_per_sec``
+    axis ``ci/perf_gate.py`` fences — stays within 30% of the
+    tracing-off rate measured seconds earlier in the same session. The
+    bound is deliberately generous (nightly hosts are noisy); the tight
+    <3% hot-path fence lives in tests/test_tracing_plane.py.
+    """
+    from ray_tpu import api
+    from ray_tpu.util import state as state_api
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    n = _N_ACTORS
+    actors = [A.remote(i) for i in range(n)]
+    rt = api._runtime()
+    try:
+        assert ray_tpu.get([a.who.remote() for a in actors],
+                           timeout=1800) == list(range(n))
+
+        # baseline: warm location-resolve rate with tracing OFF
+        t0 = time.monotonic()
+        for a in actors:
+            rt._actor_location(a._actor_id.hex())
+        rate_off = n / max(time.monotonic() - t0, 1e-9)
+
+        tracing.enable_tracing()
+        try:
+            # full steady round with tracing enabled; a bounded slice
+            # rides inside ONE root span — the GCS store caps spans per
+            # trace, and 2k submit+run pairs in a single trace would
+            # blow past the cap while proving nothing more than 100 do.
+            # The workers were spawned BEFORE enable_tracing(), so the
+            # only way their spans exist at all is the wire context
+            # carrying the switch across the RPC (execution_span
+            # adoption) — exactly the survival this leg asserts.
+            traced_slice = actors[:100]
+            with tracing.span("nightly-steady") as root:
+                ray_tpu.get([a.who.remote() for a in traced_slice],
+                            timeout=600)
+            ray_tpu.get([a.who.remote() for a in actors[100:]],
+                        timeout=600)
+            tid = root.trace_id
+
+            # resolve rate again, tracing enabled
+            t0 = time.monotonic()
+            for a in actors:
+                rt._actor_location(a._actor_id.hex())
+            rate_on = n / max(time.monotonic() - t0, 1e-9)
+
+            # context survived: worker-side run: spans for the traced
+            # slice reached the GCS store under the SAME trace id
+            trace = None
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                rt._metrics_pusher.flush_now()
+                trace = state_api.get_trace(tid)
+                if trace and any(s["name"].startswith("run:")
+                                 for s in trace["spans"]):
+                    break
+                time.sleep(0.5)
+            assert trace is not None, "trace never reached the GCS store"
+            names = {s["name"] for s in trace["spans"]}
+            assert any(nm.startswith("run:") for nm in names), names
+            assert len({s["pid"] for s in trace["spans"]}) >= 2
+
+            print(f"\nresolves/s: off={rate_off:.0f} on={rate_on:.0f} "
+                  f"({rate_on / rate_off:.2f}x)")
+            assert rate_on >= 0.7 * rate_off, (
+                f"tracing regressed warm actor resolves: "
+                f"{rate_on:.0f}/s vs {rate_off:.0f}/s tracing-off")
+        finally:
+            tracing.disable_tracing()
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
