@@ -66,6 +66,12 @@ METRICS = {
         ("detail", "serve_scaleout", "legs", "2",
          "cluster_tokens_per_sec"),
         ("detail", "legs", "2", "cluster_tokens_per_sec")],
+    # training telemetry plane (round 9): share of run wall clock
+    # attributed to productive steps by the goodput accounting (absent
+    # in pre-round-9 baselines: skipped)
+    "train_goodput_fraction": [
+        ("detail", "train_telemetry", "goodput_fraction"),
+        ("detail", "goodput_fraction")],
 }
 
 # LOWER-is-better latency keys (round 7: measured serve TTFT
@@ -111,6 +117,13 @@ METRICS_CEILING = {
         [("detail", "core", "tracing_overhead", "ratio"),
          ("detail", "tracing_overhead", "ratio")],
         0.03),
+    # training telemetry stamping cost amortized over the steady-state
+    # per-step wall (min-of-k probe delta, same methodology) must stay
+    # under 1% — the ISSUE-13 acceptance fence
+    "train_telemetry_overhead_ratio": (
+        [("detail", "train_telemetry", "telemetry_overhead", "ratio"),
+         ("detail", "telemetry_overhead", "ratio")],
+        0.01),
 }
 
 # train metric paths only exist in full-run docs; the train bench value

@@ -34,6 +34,14 @@ if [ "${1:-}" = "--nightly" ]; then
   # timeouts; the fast default tier runs only the driver<->GCS smoke
   JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_partitions.py \
     -m nightly -q -s
+  stage "nightly train telemetry leg (step decomposition + goodput + overhead fence)"
+  # telemetry-ON train leg: asserts decomposition sums to step wall and
+  # stamping overhead < 1% of steady step wall; the gate re-checks the
+  # ceiling against the emitted doc
+  JAX_PLATFORMS=cpu BENCH_MODE=train_telemetry python bench.py \
+    > /tmp/bench_train_telemetry_ci.json
+  python ci/perf_gate.py /tmp/bench_train_telemetry_ci.json \
+    "$(ls BENCH_r*.json 2>/dev/null | sort -V | tail -1 || echo /tmp/bench_train_telemetry_ci.json)"
   echo "nightly tiers: green"
   exit 0
 fi

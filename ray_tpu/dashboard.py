@@ -144,16 +144,29 @@ class _Handler(BaseHTTPRequestHandler):
                     node_id=qs.get("node", [None])[0],
                     worker_id=qs.get("worker", [None])[0]))
             elif path == "/api/profile":
+                # ?worker=<id> profiles one worker; no worker fans ONE
+                # sampling window across the whole cluster (optionally
+                # filtered by ?procs=driver,gcs,raylet,worker) and
+                # returns the merged collapsed stacks
                 qs = parse_qs(self.path.partition("?")[2])
                 worker = qs.get("worker", [None])[0]
-                if not worker:
-                    self._send_json(
-                        {"error": "profile needs ?worker=<id>"}, 400)
-                else:
+                duration_s = float(qs.get("duration", ["2.0"])[0])
+                hz = int(qs.get("hz", ["100"])[0])
+                if worker:
                     self._send_json(_state.profile_worker(
-                        worker,
-                        duration_s=float(qs.get("duration", ["2.0"])[0]),
-                        hz=int(qs.get("hz", ["100"])[0])))
+                        worker, duration_s=duration_s, hz=hz))
+                else:
+                    procs = [p for p in
+                             qs.get("procs", [""])[0].split(",") if p]
+                    self._send_json(_state.profile_cluster(
+                        procs=procs or None, duration_s=duration_s,
+                        hz=hz))
+            elif path == "/api/profile/stacks":
+                # one-shot stack dump of any single process — no
+                # sampling window (?proc=driver|gcs|<node_id>|<worker>)
+                qs = parse_qs(self.path.partition("?")[2])
+                self._send_json(_state.dump_proc_stacks(
+                    proc=qs.get("proc", [None])[0]))
             elif path.startswith("/api/jobs/") and path.endswith("/logs"):
                 from ray_tpu.job_submission import JobSubmissionClient
 

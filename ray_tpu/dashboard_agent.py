@@ -103,6 +103,21 @@ class DashboardAgent(RpcServer):
         return self._raylet.call("flight_record", worker_id=worker_id,
                                  last_s=last_s, timeout=12)
 
+    def rpc_dump_stacks(self, conn, send_lock):
+        # proxied to the raylet: the one-shot dump must show the RAYLET
+        # process's threads, which only it can read
+        return self._raylet.call("dump_stacks", timeout=12)
+
+    def rpc_profile_node(self, conn, send_lock, *, duration_s: float = 2.0,
+                         hz: int = 100, include_workers: bool = True,
+                         include_raylet: bool = True):
+        # proxied: the node window must include the raylet sampling
+        # itself, and the raylet already owns the worker fan-out
+        return self._raylet.call(
+            "profile_node", duration_s=duration_s, hz=hz,
+            include_workers=include_workers,
+            include_raylet=include_raylet, timeout=duration_s + 35)
+
     def rpc_profile_worker(self, conn, send_lock, *, worker_id: str,
                            duration_s: float = 2.0, hz: int = 100):
         targets = self._targets(worker_id)

@@ -1830,6 +1830,22 @@ class GcsServer(RpcServer):
         from ray_tpu.util import tracing as _tracing
         return {"flight": _tracing.flight_snapshot(last_s)}
 
+    def rpc_dump_stacks(self, conn, send_lock):
+        """One-shot per-thread stack dump of the GCS process itself."""
+        from ray_tpu.util.profiling import dump_stacks
+        return {"stacks": dump_stacks()}
+
+    def rpc_profile(self, conn, send_lock, *, duration_s=2.0, hz=100):
+        """Sampling CPU profile of the GCS process (one leg of
+        util.state.profile_cluster's fan-out). The RPC thread blocks for
+        the window; the handler pool keeps serving other requests."""
+        from ray_tpu.util.profiling import sample_profile
+        from ray_tpu.utils.config import get_config
+        return sample_profile(
+            duration_s=min(float(duration_s),
+                           float(get_config().profile_max_duration_s)),
+            hz=hz)
+
     def _metrics_self_loop(self):
         """The GCS ingests its OWN registry (rpc handler timers, actor
         plane stage histograms) on the same delta protocol workers use —

@@ -1148,6 +1148,62 @@ class Raylet(RpcServer):
             if client is not None:
                 client.close()
 
+    def rpc_dump_stacks(self, conn, send_lock):
+        """One-shot per-thread stack dump of the raylet process itself
+        (the workers' dumps come via rpc_worker_stacks)."""
+        from ray_tpu.util.profiling import dump_stacks
+        return {"stacks": dump_stacks()}
+
+    def rpc_profile_node(self, conn, send_lock, *, duration_s: float = 2.0,
+                         hz: int = 100, include_workers: bool = True,
+                         include_raylet: bool = True):
+        """One sampling window over this whole node: the raylet samples
+        ITSELF while every local worker profiles concurrently over its
+        push port (util.state.profile_cluster fans this per node). The
+        worker windows overlap the raylet's, so the node costs one
+        ``duration_s``, not one per process."""
+        from ray_tpu.util.profiling import Sampler
+        from ray_tpu.utils.config import get_config
+
+        duration_s = min(float(duration_s),
+                         float(get_config().profile_max_duration_s))
+        workers: dict = {}
+        errors: dict = {}
+        out_lock = threading.Lock()
+
+        def query(wid, addr):
+            client = None
+            try:
+                client = RpcClient(addr, timeout=duration_s + 30,
+                                   label="raylet")
+                prof = client.call("profile", duration_s=duration_s,
+                                   hz=hz)
+            except Exception as e:  # noqa: BLE001 - worker busy/gone
+                with out_lock:
+                    errors[wid] = repr(e)
+                return
+            finally:
+                if client is not None:
+                    client.close()
+            with out_lock:
+                workers[wid] = prof
+
+        threads = []
+        if include_workers:
+            threads = [threading.Thread(target=query, args=t, daemon=True)
+                       for t in self.workers.push_targets(None)]
+        for t in threads:
+            t.start()
+        own = None
+        if include_raylet:
+            sampler = Sampler(
+                hz=hz, exclude_threads={threading.get_ident()}).start()
+            time.sleep(duration_s)
+            own = sampler.stop()
+        for t in threads:
+            t.join(timeout=duration_s + 35)
+        return {"raylet": own, "workers": workers, "errors": errors}
+
     def rpc_node_info(self, conn, send_lock):
         return {"node_id": self.node_id, "store_name": self.store_name,
                 "address": self.address, "resources": self.total_resources,

@@ -19,7 +19,14 @@ from ray_tpu.train.data_parallel_trainer import (
 )
 from ray_tpu.train.elastic import ElasticTrainer
 from ray_tpu.train.gbdt import GBTModel, LightGBMTrainer, XGBoostTrainer
-from ray_tpu.train.session import get_checkpoint_dir, get_context, report
+from ray_tpu.train.session import (
+    get_checkpoint_dir,
+    get_context,
+    report,
+    set_flops_per_step,
+    timeit,
+)
+from ray_tpu.train.telemetry import StepTelemetry, record_run_bucket
 from ray_tpu.train.accelerate import AccelerateTrainer
 from ray_tpu.train.lightning import LightningTrainer
 from ray_tpu.train.torch import TorchConfig, TorchTrainer
@@ -42,6 +49,7 @@ __all__ = [
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "StepTelemetry",
     "TorchConfig",
     "TorchTrainer",
     "TransformersTrainer",
@@ -50,5 +58,8 @@ __all__ = [
     "XGBoostTrainer",
     "get_checkpoint_dir",
     "get_context",
+    "record_run_bucket",
     "report",
+    "set_flops_per_step",
+    "timeit",
 ]

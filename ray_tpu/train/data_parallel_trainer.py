@@ -80,21 +80,31 @@ class DataParallelTrainer:
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
+        # stable run label for telemetry series/spans/annexes: the
+        # RunConfig name, or a per-trainer handle when unnamed (must NOT
+        # vary per attempt — restart badput accrues to the same run)
+        import uuid
+
+        self.run_name = self.run_config.name or f"run-{uuid.uuid4().hex[:8]}"
 
     def fit(self) -> Result:
         attempts = self.run_config.failure_config.max_failures + 1
         restore_dir = None
         last_error = None
+        failed_at = None
         for attempt in range(attempts):
-            result = self._run_once(restore_dir, attempt)
+            result = self._run_once(restore_dir, attempt,
+                                    failed_at=failed_at)
             if result.error is None:
                 return result
             last_error = result.error
             restore_dir = result.checkpoint_dir  # resume from last ckpt
+            failed_at = time.monotonic()
         result = Result(error=last_error, checkpoint_dir=restore_dir)
         return result
 
-    def _run_once(self, restore_dir: str | None, attempt: int) -> Result:
+    def _run_once(self, restore_dir: str | None, attempt: int,
+                  failed_at: float | None = None) -> Result:
         trial_dir = os.path.join(
             self.run_config.resolved_storage_path(),
             f"attempt_{attempt}_{int(time.time())}")
@@ -103,6 +113,12 @@ class DataParallelTrainer:
         if restore_dir:
             env["RAY_TPU_RESTORE_CHECKPOINT"] = restore_dir
         executor = BackendExecutor(self.scaling, env=env)
+        if failed_at is not None:
+            # retry attempt: the teardown->respawn gap is restart badput
+            from ray_tpu.train.telemetry import record_run_bucket
+
+            record_run_bucket(self.run_name, "restart",
+                              time.monotonic() - failed_at)
         manager = _TopKCheckpoints(self.run_config.checkpoint_config)
         config = dict(self.config)
         if self.datasets:
@@ -126,7 +142,8 @@ class DataParallelTrainer:
 
         try:
             run_refs = executor.start_training(
-                _wrap_with_shard(self.train_fn), config, trial_dir)
+                _wrap_with_shard(self.train_fn), config, trial_dir,
+                run_name=self.run_name)
             done = False
             while not done:
                 reports, done = executor.poll_reports()
@@ -203,16 +220,23 @@ class JaxMeshTrainer(DataParallelTrainer):
                 batch_size=config.get("batch_size", 8))
                 if shard is not None else None)
             for step in range(steps):
-                if batch_iter is not None:
-                    try:
-                        batch = next(batch_iter)["tokens"]
-                    except StopIteration:
-                        break
-                else:
-                    batch = jax.random.randint(
-                        jax.random.key(step), (config.get("batch_size", 8),
-                                               config.get("seq_len", 128)),
-                        0, model_config.vocab_size, dtype="int32")
+                with session.timeit("data_wait"):
+                    if batch_iter is not None:
+                        try:
+                            batch = next(batch_iter)["tokens"]
+                        except StopIteration:
+                            break
+                    else:
+                        batch = jax.random.randint(
+                            jax.random.key(step),
+                            (config.get("batch_size", 8),
+                             config.get("seq_len", 128)),
+                            0, model_config.vocab_size, dtype="int32")
+                if step == 0:
+                    n_params = sum(
+                        x.size for x in
+                        jax.tree_util.tree_leaves(state.params))
+                    session.set_flops_per_step(6.0 * n_params * batch.size)
                 state, metrics = trainer.train_step(state, batch)
                 session.report({k: float(v) for k, v in metrics.items()})
 

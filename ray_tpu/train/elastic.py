@@ -17,6 +17,7 @@ all-gather of the old state.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -48,14 +49,22 @@ class ElasticTrainer:
                  checkpoint_dir: str,
                  mesh_axes_fn: Callable[[int], dict] | None = None,
                  devices=None, checkpoint_every: int = 50,
-                 max_to_keep: int = 3):
+                 max_to_keep: int = 3, run_name: str | None = None):
         self.model_cfg = model_cfg
         self.train_cfg = train_cfg
         self.mesh_axes_fn = mesh_axes_fn or (lambda n: {"dp": n})
         self.checkpoint_every = checkpoint_every
+        self.run_name = run_name or \
+            os.path.basename(os.path.normpath(checkpoint_dir))
         self.ckpt = CheckpointManager(checkpoint_dir,
                                       max_to_keep=max_to_keep)
         self.reform_events: list[ReformEvent] = []
+        # elastic runs are driver-driven (no rank session): the trainer
+        # owns its own step clock so decomposition/goodput accounting
+        # matches DataParallelTrainer runs
+        from ray_tpu.train.telemetry import StepTelemetry
+
+        self.telemetry = StepTelemetry(self.run_name, 0)
         self._build(devices if devices is not None else jax.devices())
 
     def _build(self, devices):
@@ -98,6 +107,13 @@ class ElasticTrainer:
                             new_devices=len(self.devices),
                             seconds=time.perf_counter() - t0)
         self.reform_events.append(event)
+        # reform wall clock is restart badput for the run; the step
+        # clock skips past it so the gap is not double-counted into the
+        # next step's residual
+        from ray_tpu.train.telemetry import record_run_bucket
+
+        record_run_bucket(self.run_name, "restart", event.seconds)
+        self.telemetry.mark_gap()
         return state
 
     # -- driving loop ----------------------------------------------------
@@ -109,14 +125,19 @@ class ElasticTrainer:
         resumes; this loop only owns the happy path + checkpoint cadence.
         """
         for _ in range(steps):
-            batch = next(data_iter)
+            with self.telemetry.timeit("data_wait"):
+                batch = next(data_iter)
             state, metrics = self.trainer.train_step(state, batch)
-            step = int(metrics["step"])
+            step = int(metrics["step"])  # forces the async dispatch
             if on_metrics:
                 on_metrics({k: float(v) for k, v in metrics.items()})
             if step % self.checkpoint_every == 0:
-                self.save(state, metrics={"loss": float(metrics["loss"])})
+                with self.telemetry.timeit("checkpoint"):
+                    self.save(state,
+                              metrics={"loss": float(metrics["loss"])})
+            self.telemetry.on_report(metrics)
         return state
 
     def close(self):
+        self.telemetry.close()
         self.ckpt.close()

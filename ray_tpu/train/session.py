@@ -74,6 +74,40 @@ def _init_session(context: TrainContext, bus=None):
     _session.context = context
     _session.bus = bus
     _session.iteration = 0
+    from ray_tpu.train.telemetry import StepTelemetry, _enabled, \
+        detect_peak_flops
+
+    _session.telemetry = StepTelemetry(
+        run=context.experiment_name, rank=context.rank,
+        world_size=context.world_size,
+        peak_flops=detect_peak_flops()) if _enabled() else None
+
+
+def telemetry():
+    """This rank's StepTelemetry (None outside a session or when
+    ``train_telemetry_enabled`` is off)."""
+    return getattr(_session, "telemetry", None)
+
+
+def set_flops_per_step(flops: float, peak_flops: float | None = None):
+    """Declare the model's FLOPs per optimizer step (and optionally the
+    chip's peak FLOP/s — auto-detected on TPU) so every report carries
+    MFU. The usual declaration is ``6 * n_params * tokens_per_step``."""
+    t = telemetry()
+    if t is not None:
+        t.set_flops_per_step(flops, peak_flops)
+
+
+def timeit(bucket: str):
+    """Context manager attributing the block's wall clock to one step
+    stage (``data_wait`` / ``compute`` / ``collective_sync`` /
+    ``checkpoint``). No-op outside a session."""
+    t = telemetry()
+    if t is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    return t.timeit(bucket)
 
 
 def get_context() -> TrainContext:
@@ -90,6 +124,12 @@ def report(metrics: dict, *, checkpoint_dir: str | None = None):
     ctx = get_context()
     bus = getattr(_session, "bus", None)
     _session.iteration = getattr(_session, "iteration", 0) + 1
+    # close the telemetry step BEFORE the bus round trip: the push is
+    # reporting overhead, booked into the NEXT step's wall (residual ->
+    # compute), never into the step being stamped
+    t = telemetry()
+    if t is not None:
+        t.on_report(metrics)
     if bus is not None:
         ray_tpu.get(bus.push.remote(ctx.rank, dict(metrics), checkpoint_dir))
 

@@ -43,17 +43,30 @@ class _RankWorker:
                 num_processes=world_size, process_id=rank)
 
     def run(self, fn_blob_or_fn, config: dict, bus, trial_dir: str,
-            restore_checkpoint: str | None = None):
+            restore_checkpoint: str | None = None, run_name: str = ""):
         import cloudpickle
+
+        from ray_tpu.train import session as _session_mod
 
         fn = (cloudpickle.loads(fn_blob_or_fn)
               if isinstance(fn_blob_or_fn, bytes) else fn_blob_or_fn)
         ctx = TrainContext(rank=self.rank, world_size=self.world_size,
                            local_rank=self.rank, trial_dir=trial_dir,
+                           experiment_name=run_name,
                            restore_checkpoint=restore_checkpoint)
         _init_session(ctx, bus)
+        # trainer-config FLOPs declaration (the alternative to calling
+        # session.set_flops_per_step() inside the loop)
+        if isinstance(config, dict) and config.get("flops_per_step"):
+            _session_mod.set_flops_per_step(
+                config["flops_per_step"], config.get("peak_flops"))
         try:
-            result = fn(config) if _wants_config(fn) else fn()
+            try:
+                result = fn(config) if _wants_config(fn) else fn()
+            finally:
+                t = _session_mod.telemetry()
+                if t is not None:
+                    t.close()
         except BaseException as e:  # noqa: BLE001
             import traceback
 
@@ -133,12 +146,13 @@ class BackendExecutor:
 
     def start_training(self, train_fn: Callable, config: dict,
                        trial_dir: str,
-                       restore_checkpoint: str | None = None) -> list:
+                       restore_checkpoint: str | None = None,
+                       run_name: str = "") -> list:
         import cloudpickle
 
         blob = cloudpickle.dumps(train_fn, protocol=5)
         return [w.run.remote(blob, config, self.bus, trial_dir,
-                             restore_checkpoint)
+                             restore_checkpoint, run_name)
                 for w in self.group.workers]
 
     def poll_reports(self) -> tuple[list, bool]:

@@ -7,10 +7,23 @@ remote memory; the TPU-native stand-in is cooperative in-process
 sampling over ``sys._current_frames()`` — no ptrace, works in every
 worker, and emits the same collapsed-stack format flamegraph.pl /
 speedscope consume.
+
+Two entry points:
+
+- :class:`Sampler` — the managed lifecycle: a background sampling
+  thread with re-entrant/idempotent start/stop, joined on the last
+  stop, and a bounded folded-stack table (overflow is COUNTED, never
+  grows without bound). ``util.state.profile_cluster`` runs one of
+  these per process and merges the results.
+- :func:`sample_profile` — the blocking convenience wrapper (one
+  Sampler for ``duration_s``), kept signature-compatible with the
+  original inline loop for the worker ``profile`` RPC and the envelope
+  bench.
 """
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
@@ -39,31 +52,166 @@ def _folded_stack(frame) -> str:
     return ";".join(reversed(parts))
 
 
+def _max_stacks_default() -> int:
+    try:
+        from ray_tpu.utils.config import get_config
+
+        return int(get_config().profile_folded_max_stacks)
+    except Exception:  # noqa: BLE001 - config import cycle during boot
+        return 10_000
+
+
+class Sampler:
+    """Background sampling profiler with a managed lifecycle.
+
+    ``start``/``stop`` are re-entrant (nested starts are counted; the
+    thread stops on the LAST stop) and idempotent (a stop with no
+    matching start is a no-op, a second start while running just bumps
+    the nesting count). ``stop`` JOINS the sampler thread before
+    returning, so no sampling thread outlives its caller — the leak the
+    envelope bench hit when it exited a profiling window early.
+
+    The folded-stack table is capped at ``max_stacks`` distinct stacks;
+    samples landing on a NEW stack past the cap are dropped and counted
+    in ``dropped_stacks`` (known-stack counts keep accumulating), so a
+    pathological workload cannot balloon the table.
+    """
+
+    def __init__(self, *, hz: int = 100, max_stacks: int | None = None,
+                 exclude_threads=()):
+        self.hz = max(int(hz), 1)
+        self.max_stacks = (max_stacks if max_stacks is not None
+                           else _max_stacks_default())
+        self._exclude = set(exclude_threads)
+        # RLock: stop() reads result() under the lifecycle lock
+        self._lock = threading.RLock()
+        self._depth = 0
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._counts: Counter = Counter()
+        self._samples = 0
+        self._dropped = 0
+        self._started_at: float | None = None
+        self._elapsed = 0.0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "Sampler":
+        with self._lock:
+            self._depth += 1
+            if self._thread is not None:
+                return self   # idempotent: already sampling
+            self._stop.clear()
+            self._started_at = time.monotonic()
+            self._thread = threading.Thread(
+                target=self._loop, name="ray_tpu-sampler", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> dict:
+        """Unwind one start; on the last one, stop AND JOIN the sampler
+        thread. Always returns the current result (idempotent: calling
+        stop on a never-started or already-stopped sampler just reads
+        the accumulated result)."""
+        with self._lock:
+            if self._depth > 0:
+                self._depth -= 1
+            if self._depth > 0 or self._thread is None:
+                return self.result()
+            thread = self._thread
+            self._thread = None
+            self._stop.set()
+        thread.join(timeout=timeout)
+        with self._lock:
+            if self._started_at is not None:
+                self._elapsed += time.monotonic() - self._started_at
+                self._started_at = None
+        return self.result()
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None
+
+    # -- sampling ------------------------------------------------------
+
+    def _loop(self):
+        interval = 1.0 / self.hz
+        me = threading.get_ident()
+        while not self._stop.wait(interval):
+            self.sample_once(extra_exclude=(me,))
+
+    def sample_once(self, extra_exclude=()) -> None:
+        """Take one sample of every live thread (minus exclusions)."""
+        excl = self._exclude
+        for ident, frame in sys._current_frames().items():
+            if ident in excl or ident in extra_exclude:
+                continue
+            stack = _folded_stack(frame)
+            with self._lock:
+                if stack not in self._counts and \
+                        len(self._counts) >= self.max_stacks:
+                    self._dropped += 1
+                    continue
+                self._counts[stack] += 1
+        with self._lock:
+            self._samples += 1
+
+    def result(self) -> dict:
+        with self._lock:
+            elapsed = self._elapsed
+            if self._started_at is not None:
+                elapsed += time.monotonic() - self._started_at
+            folded = "\n".join(f"{stack} {n}"
+                               for stack, n in self._counts.most_common())
+            return {"folded": folded, "samples": self._samples,
+                    "duration_s": round(elapsed, 3),
+                    "dropped_stacks": self._dropped,
+                    "pid": os.getpid()}
+
+
 def sample_profile(duration_s: float = 2.0, hz: int = 100,
                    exclude_thread: int | None = None,
                    stop: "threading.Event | None" = None) -> dict:
     """Sample all threads for ``duration_s`` and aggregate folded stacks
     (py-spy ``record`` analog). Returns {"folded": "stack count" lines,
-    "samples": N, "duration_s": d} — feed ``folded`` to any flamegraph
-    renderer. ``stop`` ends the run early — callers profiling a
-    workload of unknown length pass a generous duration plus the event."""
-    interval = 1.0 / max(hz, 1)
-    counts: Counter = Counter()
-    samples = 0
-    me = threading.get_ident()
-    start = time.monotonic()
-    deadline = start + duration_s
-    while time.monotonic() < deadline and \
-            not (stop is not None and stop.is_set()):
-        for ident, frame in sys._current_frames().items():
-            if ident == me or ident == exclude_thread:
+    "samples": N, "duration_s": d, ...} — feed ``folded`` to any
+    flamegraph renderer. ``stop`` ends the run early — callers profiling
+    a workload of unknown length pass a generous duration plus the
+    event. The calling thread (blocked here) is always excluded, so the
+    wait frame never pollutes the profile."""
+    exclude = {threading.get_ident()}
+    if exclude_thread is not None:
+        exclude.add(exclude_thread)
+    sampler = Sampler(hz=hz, exclude_threads=exclude).start()
+    deadline = time.monotonic() + duration_s
+    try:
+        while time.monotonic() < deadline and \
+                not (stop is not None and stop.is_set()):
+            if stop is not None:
+                stop.wait(min(0.05, max(deadline - time.monotonic(), 0)))
+            else:
+                time.sleep(min(0.05, max(deadline - time.monotonic(), 0)))
+    finally:
+        result = sampler.stop()
+    return result
+
+
+def merge_folded(parts: dict[str, str]) -> str:
+    """Merge per-process collapsed-stack blobs into ONE flamegraph
+    input: each process's stacks are rooted under a frame named after
+    the process (`driver;...`, `gcs;...`), exactly how flamegraph.pl /
+    speedscope render multi-process profiles. Counts are preserved."""
+    merged: Counter = Counter()
+    for proc, folded in sorted(parts.items()):
+        for line in (folded or "").splitlines():
+            stack, _, count = line.rpartition(" ")
+            if not stack:
                 continue
-            counts[_folded_stack(frame)] += 1
-        samples += 1
-        time.sleep(interval)
-    folded = "\n".join(f"{stack} {n}" for stack, n in counts.most_common())
-    return {"folded": folded, "samples": samples,
-            "duration_s": round(time.monotonic() - start, 3)}
+            try:
+                merged[f"{proc};{stack}"] += int(count)
+            except ValueError:
+                continue
+    return "\n".join(f"{stack} {n}" for stack, n in merged.most_common())
 
 
 def host_stats(spill_dir: str | None = None) -> dict:

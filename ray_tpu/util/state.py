@@ -404,3 +404,236 @@ def profile_worker(worker_id: str, *, node_id: str | None = None,
         return {"error": f"profiling {worker_id!r} failed",
                 "node_errors": transport_errors}
     return {"error": f"worker {worker_id!r} not found on any live node"}
+
+
+def profile_cluster(procs=None, duration_s: float = 2.0,
+                    hz: int = 100) -> dict:
+    """One sampling window across the whole cluster: driver, GCS, every
+    raylet, and every worker profile CONCURRENTLY for ``duration_s``;
+    the per-process collapsed stacks come back merged into one
+    flamegraph.pl / speedscope input, each process rooted under its own
+    frame. ``procs`` filters by category ({"driver", "gcs", "raylet",
+    "worker"}); None profiles everything. Local mode samples this
+    process only."""
+    import threading
+
+    from ray_tpu.util.profiling import merge_folded, sample_profile
+    from ray_tpu.utils.config import get_config
+
+    duration_s = min(float(duration_s),
+                     float(get_config().profile_max_duration_s))
+    want = set(procs) if procs else {"driver", "gcs", "raylet", "worker"}
+    results: dict[str, dict] = {}
+    errors: dict[str, str] = {}
+    out_lock = threading.Lock()
+    mode, rt = _mode()
+    if mode != "cluster":
+        prof = sample_profile(duration_s=duration_s, hz=hz)
+        return {"folded": merge_folded({"driver": prof["folded"]}),
+                "procs": {"driver": _prof_meta(prof)}, "errors": {}}
+
+    def run_driver():
+        with out_lock:
+            results["driver"] = sample_profile(duration_s=duration_s,
+                                               hz=hz)
+
+    def run_gcs():
+        try:
+            prof = rt._gcs.call("profile", timeout=duration_s + 30,
+                                duration_s=duration_s, hz=hz)
+        except Exception as e:  # noqa: BLE001 - partial beats none
+            with out_lock:
+                errors["gcs"] = repr(e)
+            return
+        with out_lock:
+            results["gcs"] = prof
+
+    def run_node(node):
+        nid = node["node_id"]
+        res, err = _call_node(node, "profile_node",
+                              timeout=duration_s + 30,
+                              duration_s=duration_s, hz=hz,
+                              include_workers="worker" in want,
+                              include_raylet="raylet" in want)
+        with out_lock:
+            if res is None:
+                errors[f"node:{nid[:8]}"] = err
+                return
+            if res.get("raylet"):
+                results[f"raylet:{nid[:8]}"] = res["raylet"]
+            for wid, prof in (res.get("workers") or {}).items():
+                results[f"worker:{wid[:8]}"] = prof
+            for wid, werr in (res.get("errors") or {}).items():
+                errors[f"worker:{wid[:8]}"] = werr
+
+    threads = []
+    if "driver" in want:
+        threads.append(threading.Thread(target=run_driver, daemon=True))
+    if "gcs" in want:
+        threads.append(threading.Thread(target=run_gcs, daemon=True))
+    if want & {"raylet", "worker"}:
+        threads += [threading.Thread(target=run_node, args=(n,),
+                                     daemon=True)
+                    for n in rt._gcs.call("get_nodes", alive_only=True)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 40)
+    return {
+        "folded": merge_folded(
+            {name: prof.get("folded", "") for name, prof in
+             results.items()}),
+        "procs": {name: _prof_meta(prof)
+                  for name, prof in results.items()},
+        "errors": errors,
+    }
+
+
+def _prof_meta(prof: dict) -> dict:
+    return {k: prof.get(k) for k in
+            ("samples", "duration_s", "pid", "dropped_stacks")}
+
+
+def dump_proc_stacks(proc: str | None = None) -> dict:
+    """One-shot per-thread stack dump of any single process — no
+    profiling window (py-spy ``dump``). ``proc``: None/"driver" for
+    this process, "gcs", a node id (its raylet), or a worker id."""
+    if proc in (None, "driver"):
+        from ray_tpu.util.profiling import dump_stacks
+
+        return {"proc": "driver", "stacks": dump_stacks()}
+    mode, rt = _mode()
+    if mode != "cluster":
+        raise RuntimeError(f"dump_proc_stacks({proc!r}) needs a cluster "
+                           "runtime")
+    if proc == "gcs":
+        return {"proc": "gcs",
+                "stacks": rt._gcs.call("dump_stacks")["stacks"]}
+    nodes = rt._gcs.call("get_nodes", alive_only=True)
+    for node in nodes:
+        if node["node_id"] == proc:
+            stacks, err = _call_node(node, "dump_stacks", timeout=15)
+            if stacks is None:
+                return {"proc": proc, "error": err}
+            return {"proc": proc, "stacks": stacks["stacks"]}
+    # not a node id: treat as a worker id (raylets locate their own)
+    dump = dump_worker_stacks(worker_id=proc)
+    for nid, workers in dump.items():
+        if isinstance(workers, dict) and proc in workers:
+            return {"proc": proc, "node_id": nid,
+                    "stacks": workers[proc]}
+    return {"proc": proc,
+            "error": f"no process {proc!r} (not gcs, a node id, or a "
+                     "live worker id)"}
+
+
+# ---------------------------------------------------------------------------
+# training telemetry (train/telemetry.py publishes per-rank progress
+# annexes + train.* series; these APIs read them back cluster-wide)
+# ---------------------------------------------------------------------------
+
+
+def _train_progress(run: str) -> dict[str, dict]:
+    """Newest progress payload per rank for ``run``, merged from the
+    GCS annex store AND this process's local annex registry (the driver
+    records restart badput locally; in cluster mode it has no pusher)."""
+    from ray_tpu.train.telemetry import ANNEX_PREFIX
+
+    prefix = f"{ANNEX_PREFIX}{run}/"
+    merged: dict[str, tuple[float, dict]] = {}
+
+    def take(key: str, ts: float, payload):
+        if not isinstance(payload, dict):
+            return
+        rank = key[len(prefix):]
+        if rank not in merged or ts > merged[rank][0]:
+            merged[rank] = (ts, payload)
+
+    for item in cluster_metric_annexes(prefix=prefix):
+        take(item["key"], item["ts"], item["payload"])
+    from ray_tpu.runtime import metrics_plane as _mp
+
+    for key, (ts, payload) in _mp.local_annexes().items():
+        if key.startswith(prefix):
+            take(key, ts, payload)
+    return {rank: payload for rank, (ts, payload) in merged.items()}
+
+
+def train_goodput(run: str) -> dict:
+    """Goodput/badput accounting for one training run: cumulative
+    seconds per bucket (init / compile / productive / checkpoint /
+    stall / restart) summed across ranks, plus the goodput fraction
+    (productive / total). Sourced from the per-rank progress annexes —
+    cumulative totals that survive metric-window expiry — with the
+    ``train.goodput_s`` counter series as fallback."""
+    from ray_tpu.train.telemetry import GOODPUT_BUCKETS
+
+    buckets = {b: 0.0 for b in GOODPUT_BUCKETS}
+    per_rank: dict[str, dict] = {}
+    for rank, payload in _train_progress(run).items():
+        good = payload.get("goodput") or {}
+        per_rank[rank] = {"step": payload.get("step"),
+                          "ts": payload.get("ts"),
+                          "goodput": good}
+        for b, v in good.items():
+            buckets[b] = buckets.get(b, 0.0) + float(v)
+    if not per_rank:
+        # no annexes (e.g. expired + restarted GCS): fall back to the
+        # windowed counter series
+        q = cluster_metrics("train.goodput_s", tags={"run": run},
+                            group_by=["bucket"])
+        for g in q.get("groups") or []:
+            bucket = g.get("tags", {}).get("bucket", "")
+            value = g.get("value")
+            if bucket and isinstance(value, (int, float)):
+                buckets[bucket] = buckets.get(bucket, 0.0) + float(value)
+    total = sum(buckets.values())
+    return {
+        "run": run,
+        "buckets": buckets,
+        "total_s": total,
+        "goodput_fraction": (buckets.get("productive", 0.0) / total
+                             if total > 0 else None),
+        "ranks": per_rank,
+    }
+
+
+def train_stragglers(run: str, *, skew_s: float | None = None) -> dict:
+    """Per-rank step skew for one run: which ranks lag the front rank,
+    by how many steps, and by how much wall clock since their last
+    step end. A rank is flagged a straggler when it is >=1 step behind
+    AND lags past ``skew_s`` (default config
+    ``train_straggler_skew_s``). Sustained stragglers ALSO surface in
+    ``stuck_calls()``: every in-progress step holds a ``train_step``
+    in-flight token."""
+    from ray_tpu.utils.config import get_config
+
+    if skew_s is None:
+        skew_s = float(get_config().train_straggler_skew_s)
+    progress = {rank: p for rank, p in _train_progress(run).items()
+                if rank != "driver"}   # driver entries carry no steps
+    if not progress:
+        return {"run": run, "ranks": {}, "max_step": 0,
+                "skew_steps": 0, "stragglers": []}
+    max_step = max(int(p.get("step") or 0) for p in progress.values())
+    front_ts = max(float(p.get("ts") or 0.0) for p in progress.values())
+    ranks = {}
+    stragglers = []
+    for rank, p in sorted(progress.items()):
+        step = int(p.get("step") or 0)
+        ts = float(p.get("ts") or 0.0)
+        behind = max_step - step
+        lag = max(front_ts - ts, 0.0)
+        flagged = behind >= 1 and lag > skew_s
+        ranks[rank] = {"step": step, "behind_steps": behind,
+                       "lag_s": lag, "step_s": p.get("step_s"),
+                       "straggler": flagged}
+        if flagged:
+            stragglers.append(rank)
+    return {
+        "run": run,
+        "ranks": ranks,
+        "max_step": max_step,
+        "skew_steps": max(r["behind_steps"] for r in ranks.values()),
+        "stragglers": stragglers,
+    }

@@ -401,6 +401,24 @@ class Config:
     # Default threshold for util.state.stuck_calls().
     trace_stuck_threshold_s: float = 10.0
 
+    # --- training telemetry plane (train/telemetry.py; reference
+    # analog: Ray Train's _internal/state run tracking — here per-step
+    # decomposition/MFU/goodput ride the metrics+tracing planes) ---
+    # Master switch for per-step stamping. Off turns session.report's
+    # telemetry hook and the goodput/annex publishes into no-ops.
+    train_telemetry_enabled: bool = True
+    # Progress-annex publish throttle per rank (the straggler/goodput
+    # payload piggybacking on metric frames).
+    train_progress_interval_s: float = 0.5
+    # A rank is a straggler when it is >=1 step behind AND its last
+    # step-end lags the front rank by more than this.
+    train_straggler_skew_s: float = 5.0
+    # On-demand cluster profiling (util/profiling.py Sampler):
+    # per-request duration cap and the folded-stack table bound
+    # (distinct stacks past the cap are dropped and counted).
+    profile_max_duration_s: float = 30.0
+    profile_folded_max_stacks: int = 10000
+
     def __post_init__(self):
         for f in fields(self):
             setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))

@@ -942,3 +942,72 @@ def test_metrics_partition_degrades_autoscaler_then_heals(
         stop.set()
         th.join(timeout=60)
     assert not failures, failures
+
+
+def test_train_telemetry_partition_never_blocks_steps(
+        metrics_chaos_cluster):
+    """Round 9: a metrics<->GCS partition during training costs
+    telemetry fidelity only — step stamping stays registry-local and
+    fast (frames drop on the pusher thread, steps never wait), the
+    train.* series resume on heal, and train_goodput keeps answering
+    from the surviving progress annexes."""
+    from ray_tpu.train.telemetry import StepTelemetry
+    from ray_tpu.util import state as state_api
+
+    c, pusher = metrics_chaos_cluster
+    t = StepTelemetry("chaos-train", 0)
+    for _ in range(3):
+        with t.timeit("compute"):
+            pass
+        t.on_report({})
+    _wait(lambda: pusher.pushed > 0, 30, "first metrics frames")
+
+    fi.put_plan(c.gcs_address, {
+        "version": 1, "seed": 7,
+        "endpoints": {"gcs": [_addr(c.gcs_address)]},
+        "rules": [{"id": "cut-metrics-gcs", "fault": "partition",
+                   "src": "metrics", "dst": "gcs", "direction": "both"}]})
+    t_cut = time.monotonic()
+
+    # train THROUGH the severed metrics channel: every stamp must stay
+    # far under the 2s metrics RPC timeout (telemetry drops, not blocks)
+    steps_during = 0
+    while time.monotonic() - t_cut < PARTITION_S:
+        with t.timeit("compute"):
+            pass
+        t0 = time.monotonic()
+        t.on_report({})
+        assert time.monotonic() - t0 < 0.5, \
+            "step stamping waited on the partitioned metrics wire"
+        steps_during += 1
+        time.sleep(0.02)
+    assert steps_during > 10
+    _wait(lambda: fi.plane.stats.get("cut-metrics-gcs"), 30,
+          "metrics partition to fire")
+
+    # goodput still answers mid-partition (driver-local annexes survive)
+    g = state_api.train_goodput("chaos-train")
+    assert g["buckets"]["productive"] > 0, g
+
+    pushed_during = pusher.pushed
+    _heal(c, version=2)
+    # series flow again after heal: new steps land fresh observations
+    _wait(lambda: pusher.pushed > pushed_during, 30,
+          "metrics pushes to resume after heal")
+    for _ in range(2):
+        with t.timeit("compute"):
+            pass
+        t.on_report({})
+    t.close()
+
+    def step_series_groups():
+        q = state_api.cluster_metrics("train.step_s",
+                                      tags={"run": "chaos-train"},
+                                      group_by=["rank"])
+        return q.get("groups") or []
+
+    _wait(lambda: len(step_series_groups()) > 0, 30,
+          "train.step_s series to land in the GCS store after heal")
+    g = state_api.train_goodput("chaos-train")
+    assert g["buckets"]["productive"] > 0
+    assert g["goodput_fraction"] is not None
