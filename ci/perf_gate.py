@@ -124,6 +124,14 @@ METRICS_CEILING = {
         [("detail", "train_telemetry", "telemetry_overhead", "ratio"),
          ("detail", "telemetry_overhead", "ratio")],
         0.01),
+    # log-plane capture cost: per-LINE emit delta (stamped tee write
+    # minus a plain write) amortized over the per-op cost must stay
+    # under 3% — the ISSUE-14 acceptance fence (ship/store/echo run
+    # off-process; the emit is the whole hot-path tax)
+    "log_capture_overhead_ratio": (
+        [("detail", "core", "log_overhead", "ratio"),
+         ("detail", "log_overhead", "ratio")],
+        0.03),
 }
 
 # train metric paths only exist in full-run docs; the train bench value

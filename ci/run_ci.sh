@@ -34,6 +34,13 @@ if [ "${1:-}" = "--nightly" ]; then
   # timeouts; the fast default tier runs only the driver<->GCS smoke
   JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_partitions.py \
     -m nightly -q -s
+  stage "nightly log plane (rotation holds disk bounded under worker churn at scale)"
+  # a flood of printing workers must keep the node's log dir under the
+  # rotation budget (max_bytes * (rotate_count+1) per proc) while every
+  # line still reaches the store — proves capture rotation + monitor
+  # cleanup hold disk bounded for the envelope tiers above
+  JAX_PLATFORMS=cpu python -m pytest tests/test_log_plane_nightly.py \
+    -m nightly -q -s
   stage "nightly train telemetry leg (step decomposition + goodput + overhead fence)"
   # telemetry-ON train leg: asserts decomposition sums to step wall and
   # stamping overhead < 1% of steady step wall; the gate re-checks the

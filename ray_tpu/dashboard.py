@@ -212,6 +212,26 @@ class _Handler(BaseHTTPRequestHandler):
                         "trace": trace,
                         "waterfall": _tracing.build_waterfall(
                             trace.get("spans") or [])})
+            elif path == "/api/logs":
+                # log-plane overview: per-proc listing + error groups
+                qs = parse_qs(self.path.partition("?")[2])
+                last_s = qs.get("last_s", [None])[0]
+                self._send_json({
+                    "logs": _state.list_logs(),
+                    "errors": _state.summarize_errors(
+                        float(last_s) if last_s else None)})
+            elif path == "/api/logs/tail":
+                # ?proc=<name>&n=100 or ?task_id=<id> (exact segment)
+                qs = parse_qs(self.path.partition("?")[2])
+                proc = qs.get("proc", [None])[0]
+                task_id = qs.get("task_id", [None])[0]
+                if not proc and not task_id:
+                    self._send_json(
+                        {"error": "need ?proc= or ?task_id="}, 400)
+                else:
+                    self._send_json(_state.get_log(
+                        proc=proc, task_id=task_id,
+                        tail=int(qs.get("n", ["100"])[0])))
             elif path == "/api/stuck_calls":
                 qs = parse_qs(self.path.partition("?")[2])
                 t = qs.get("threshold_s", [None])[0]

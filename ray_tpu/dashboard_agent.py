@@ -26,12 +26,14 @@ from ray_tpu.runtime.rpc import ReconnectingRpcClient, RpcClient, RpcServer
 
 class DashboardAgent(RpcServer):
     def __init__(self, *, node_id: str, raylet_address, gcs_address,
-                 spill_dir: str | None = None, host: str = "127.0.0.1"):
+                 spill_dir: str | None = None, log_dir: str | None = None,
+                 host: str = "127.0.0.1"):
         super().__init__(host, 0)
         self.node_id = node_id
         self.raylet_address = tuple(raylet_address)
         self.gcs_address = tuple(gcs_address)
         self.spill_dir = spill_dir
+        self.log_dir = log_dir
         self._raylet = ReconnectingRpcClient(self.raylet_address)
 
     def start(self):
@@ -108,6 +110,48 @@ class DashboardAgent(RpcServer):
         # process's threads, which only it can read
         return self._raylet.call("dump_stacks", timeout=12)
 
+    # -- node log files (raw reads off the observability plane; the
+    # ingested/attributed view lives in the GCS LogStore) --------------
+
+    def rpc_list_log_files(self, conn, send_lock):
+        import os
+
+        if not self.log_dir:
+            return {"files": [], "error": "agent has no log_dir"}
+        files = []
+        try:
+            for name in sorted(os.listdir(self.log_dir)):
+                path = os.path.join(self.log_dir, name)
+                try:
+                    files.append({"name": name,
+                                  "size": os.path.getsize(path)})
+                except OSError:
+                    continue
+        except OSError as e:
+            return {"files": [], "error": repr(e)}
+        return {"files": files, "log_dir": self.log_dir}
+
+    def rpc_read_log_file(self, conn, send_lock, *, name: str,
+                          tail_bytes: int = 1 << 16):
+        """Raw tail of one capture file (debugging escape hatch when
+        the stored ring has already evicted the lines)."""
+        import os
+
+        if not self.log_dir:
+            return {"error": "agent has no log_dir"}
+        if os.sep in name or name.startswith("."):
+            return {"error": f"bad log file name {name!r}"}
+        path = os.path.join(self.log_dir, name)
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                f.seek(max(0, size - int(tail_bytes)))
+                data = f.read(int(tail_bytes))
+        except OSError as e:
+            return {"error": repr(e)}
+        return {"name": name, "size": size,
+                "data": data.decode("utf-8", "replace")}
+
     def rpc_profile_node(self, conn, send_lock, *, duration_s: float = 2.0,
                          hz: int = 100, include_workers: bool = True,
                          include_raylet: bool = True):
@@ -145,6 +189,7 @@ def main():
         raylet_address=tuple(cfg["raylet_address"]),
         gcs_address=tuple(cfg["gcs_address"]),
         spill_dir=cfg.get("spill_dir"),
+        log_dir=cfg.get("log_dir"),
     ).start()
     print(json.dumps({"address": agent.address}), flush=True)
     # lifetime = the raylet's: block on a dedicated connection and exit

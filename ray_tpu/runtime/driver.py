@@ -182,12 +182,14 @@ class ClusterRuntime:
         # in-worker runtimes echoing would loop their own output back
         # through the capture files forever.
         self._log_sub = None
+        # per-source echo rate limiter: proc -> [tokens, last, suppressed]
+        self._echo_state: dict[str, list] = {}
         if log_to_driver:
             from ray_tpu.runtime.rpc import PushSubscriber
 
             self._log_sub = PushSubscriber(
                 self.gcs_address,
-                {"method": "subscribe", "channels": ["log"]},
+                {"method": "subscribe", "channels": ["logs"]},
                 self._print_worker_logs,
                 reconnect=True,   # survive a GCS restart like _gcs does
                 label="driver")
@@ -263,17 +265,66 @@ class ClusterRuntime:
             "ray_tpu_actor_resolve_s",
             "actor location resolve latency (cache misses only)").handle()
 
-    @staticmethod
-    def _print_worker_logs(msg: dict):
+    def _print_worker_logs(self, msg: dict):
+        """Echo CH_LOGS lines as ``(fn pid=N, node=M)``-prefixed output
+        (reference: the driver-side worker-log echo). Lines stamped with
+        another job's namespace are filtered out; unstamped lines (raw
+        .out/.err crash output, pre-capture startup prints) always echo.
+        A per-source token bucket keeps a log-spamming worker from
+        wedging the driver's terminal — suppressed lines are summarized,
+        not silently dropped."""
         import sys
 
-        for entry in msg.get("entries", ()):
-            stream = (sys.stderr if entry.get("stream") == "err"
-                      else sys.stdout)
-            prefix = (f"(pid={entry.get('pid')}, "
-                      f"node={msg.get('node_id', '')[:8]})")
-            for line in entry.get("lines", ()):
-                print(f"{prefix} {line}", file=stream)
+        msgs = msg.get("batch") if isinstance(msg.get("batch"), list) \
+            else [msg]
+        for m in msgs:
+            entry = m.get("entry")
+            if not entry:
+                continue
+            node = (m.get("node_id") or "")[:8]
+            proc = entry.get("proc") or "?"
+            pid = entry.get("pid") or 0
+            for rec in entry.get("lines", ()):
+                try:
+                    _off, _ts, stream, text, _trace, _task, name, job = rec
+                except (TypeError, ValueError):
+                    continue
+                if job is not None and job != self.namespace:
+                    continue
+                ok, missed = self._echo_allow(proc)
+                out = sys.stderr if stream == "e" else sys.stdout
+                if missed:
+                    print(f"({proc} pid={pid}, node={node}) "
+                          f"... {missed} line(s) suppressed by the echo "
+                          f"rate limit (RAY_TPU_LOG_ECHO_RATE_LINES_S)",
+                          file=out)
+                if not ok:
+                    continue
+                fn = name or proc
+                print(f"({fn} pid={pid}, node={node}) {text}", file=out)
+
+    def _echo_allow(self, proc: str) -> tuple:
+        """Token-bucket admission for one source; returns (allowed,
+        suppressed_count_to_report)."""
+        from ray_tpu.utils.config import get_config
+
+        rate = float(get_config().log_echo_rate_lines_s)
+        if rate <= 0:   # 0 disables the limiter
+            return True, 0
+        now = time.monotonic()
+        st = self._echo_state.get(proc)
+        if st is None:
+            if len(self._echo_state) > 512:   # dead-proc churn bound
+                self._echo_state.clear()
+            st = self._echo_state[proc] = [rate, now, 0]
+        st[0] = min(rate, st[0] + (now - st[1]) * rate)
+        st[1] = now
+        if st[0] < 1.0:
+            st[2] += 1
+            return False, 0
+        st[0] -= 1.0
+        missed, st[2] = st[2], 0
+        return True, missed
 
     # ------------------------------------------------------------------
     # refcount flushing

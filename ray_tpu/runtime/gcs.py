@@ -30,7 +30,7 @@ CH_NODE = "node"            # node added/dead
 CH_ACTOR = "actor"          # actor state transitions
 CH_OBJECT = "object"        # object location added (get() wakeups)
 CH_ERROR = "error"          # error broadcast to drivers
-CH_LOG = "log"              # worker log forwarding
+CH_LOGS = "logs"            # captured log lines (log plane fan-out)
 CH_METRICS = "metrics"      # rolled metric-window summaries (dashboards)
 
 
@@ -278,6 +278,14 @@ class GcsServer(RpcServer):
             max_spans=_pcfg.trace_store_spans,
             sample_n=_pcfg.trace_sample_n,
             slow_s=_pcfg.trace_slow_s)
+        # --- cluster log plane: bounded per-proc line rings + error
+        # groups, fed by rpc_push_logs; accepted lines fan out on
+        # CH_LOGS (runtime/log_plane.py) ---
+        from ray_tpu.runtime.log_plane import LogStore
+        self._log_store = LogStore(
+            lines_per_proc=_pcfg.log_store_lines,
+            error_lines=_pcfg.log_store_error_lines,
+            error_groups=_pcfg.log_store_error_groups)
         self._hb_timeout = heartbeat_timeout_s
         # --- distributed refcounting (reference: reference_count.h:61;
         # centralized here to match the centralized object directory).
@@ -568,13 +576,22 @@ class GcsServer(RpcServer):
         send_msg(conn, {"subscribed": channels}, send_lock)
         return RpcServer.HELD
 
-    def rpc_publish_logs(self, conn, send_lock, *, node_id: str,
-                         entries: list):
-        """Raylet log monitors forward worker stdout/stderr lines here;
-        fan-out to CH_LOG subscribers (drivers echoing worker output —
+    def rpc_push_logs(self, conn, send_lock, *, node_id: str,
+                      entries: list):
+        """Raylet log monitors ship captured line batches here. Ingest
+        is idempotent per (proc, file@epoch, offset) watermark — a
+        chaos-duplicated frame (or a monitor retry after a lost ack)
+        neither double-stores nor double-echoes; only the ACCEPTED lines
+        fan out to CH_LOGS subscribers (drivers echoing worker output —
         reference: log_monitor.py -> GCS pubsub -> driver stdout)."""
-        self.publish(CH_LOG, {"node_id": node_id, "entries": entries})
-        return {}
+        self._ingest_logs(node_id, entries)
+        return {"ok": True}
+
+    def _ingest_logs(self, node_id: str, entries: list):
+        accepted = self._log_store.ingest(node_id, entries or [])
+        for entry in accepted:
+            self.publish(CH_LOGS, {"node_id": node_id, "entry": entry})
+        return accepted
 
     def publish(self, channel: str, message: dict):
         message = {"channel": channel, **message}
@@ -582,7 +599,8 @@ class GcsServer(RpcServer):
             subs = list(self._subs.get(channel, []))
         if not subs:
             return
-        if channel in (CH_ACTOR, CH_METRICS) and self._pub_flush_s > 0:
+        if channel in (CH_ACTOR, CH_METRICS, CH_LOGS) and \
+                self._pub_flush_s > 0:
             # coalesce: buffer per (subscriber, channel), flusher ships
             # one framed batch per window — the publisher (often
             # rpc_actor_ready under the creation flood, or a metrics
@@ -1830,6 +1848,50 @@ class GcsServer(RpcServer):
         from ray_tpu.util import tracing as _tracing
         return {"flight": _tracing.flight_snapshot(last_s)}
 
+    # ------------------------------------------------------------------
+    # cluster log plane queries (store: runtime/log_plane.LogStore)
+    # ------------------------------------------------------------------
+
+    def rpc_get_log(self, conn, send_lock, *, proc=None, task_id=None,
+                    tail=100, after=None):
+        """Recent lines of one process, or exactly one task's attributed
+        segment. The task path resolves through the ``logs/segments/*``
+        metric annexes (pushed by the emitting worker's MetricsPusher)
+        to a (file@epoch, start, end) window, then filters interleaved
+        neighbors by the per-line task stamp."""
+        if task_id:
+            seg = self._find_log_segment(task_id)
+            if seg is None:
+                return {"task": task_id, "lines": [],
+                        "error": f"no log segment for task {task_id!r} "
+                                 f"(annex not pushed yet, or the task "
+                                 f"predates capture)"}
+            out = self._log_store.segment(seg)
+            # offsets bound the window; the per-line stamp is the
+            # authority on WHOSE lines they are (concurrent async-actor
+            # tasks interleave inside each other's offset windows)
+            out["lines"] = [r for r in out["lines"]
+                            if r.get("task") in (task_id, None)]
+            return out
+        if not proc:
+            return {"lines": [], "error": "get_log needs proc or task_id"}
+        return self._log_store.tail(
+            proc, n=tail, after=tuple(after) if after else None)
+
+    def _find_log_segment(self, task_id: str):
+        from ray_tpu.runtime import log_plane as _log_plane
+        for item in self._metrics_store.annexes(_log_plane.ANNEX_PREFIX):
+            for seg in item["payload"] or []:
+                if seg.get("task") == task_id:
+                    return seg
+        return None
+
+    def rpc_list_logs(self, conn, send_lock):
+        return self._log_store.list()
+
+    def rpc_summarize_errors(self, conn, send_lock, *, last_s=None):
+        return {"groups": self._log_store.summarize_errors(last_s)}
+
     def rpc_dump_stacks(self, conn, send_lock):
         """One-shot per-thread stack dump of the GCS process itself."""
         from ray_tpu.util.profiling import dump_stacks
@@ -1879,6 +1941,24 @@ class GcsServer(RpcServer):
                     spans = _tracing.drain_spans()
                     if spans:
                         self._trace_store.ingest("gcs", spans)
+                # self-ingest captured log lines: no raylet monitor
+                # tails the external GCS's files, so it drains its own
+                # capture straight into the store
+                from ray_tpu.runtime import log_plane as _log_plane
+                cap = _log_plane.active_capture()
+                if cap is not None:
+                    recs = cap.drain_records()
+                    if recs:
+                        by_file: dict[str, dict] = {}
+                        for r in recs:
+                            e = by_file.setdefault(r["file"], {
+                                "proc": cap.proc, "pid": r["pid"],
+                                "file": r["file"], "lines": []})
+                            e["lines"].append(
+                                (r["offset"], r["ts"], r["stream"],
+                                 r["line"], r["trace"], r["task"],
+                                 r["name"], r["job"]))
+                        self._ingest_logs("gcs", list(by_file.values()))
             except Exception:  # noqa: BLE001 - observability only
                 pass
 
@@ -1923,10 +2003,22 @@ def main():
     from ray_tpu.util import tracing as _tracing
     _tracing.install_crash_dump()
     print(json.dumps({"address": server.address}), flush=True)
+    # capture AFTER the readiness line (the parent blocks reading the
+    # JSON above from the real stdout pipe); the GCS self-ingests its
+    # drain ring in _metrics_self_loop — no monitor tails these files
+    import shutil
+    import tempfile
+
+    from ray_tpu.runtime import log_plane as _log_plane
+    log_dir = tempfile.mkdtemp(prefix="raytpu-gcs-logs-")
+    _log_plane.install_capture(f"gcs-{server.address[1]}",
+                               log_dir=log_dir)
     try:
         stop_ev.wait()
     finally:
+        _log_plane.uninstall_capture()
         server.stop()
+        shutil.rmtree(log_dir, ignore_errors=True)
 
 
 def _ns_key(namespace: str, name: str) -> str:

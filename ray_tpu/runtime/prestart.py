@@ -310,6 +310,10 @@ class PrestartManager:
             "JAX_PLATFORMS": env_get_default("JAX_PLATFORMS", "cpu"),
             "PYTHONUNBUFFERED": "1",
         })
+        if getattr(node, "log_dir", None):
+            # forked children re-enter Worker() directly; the in-process
+            # log capture reads this to find its stamped-file home
+            env["RAY_TPU_LOG_DIR"] = node.log_dir
         env.pop("RAY_TPU_WORKER_ID", None)
         env.pop("RAY_TPU_RUNTIME_ENV", None)
         return env

@@ -230,6 +230,7 @@ class Raylet(RpcServer):
         cfg = {"node_id": self.node_id,
                "raylet_address": list(self.address),
                "gcs_address": list(self.gcs_address),
+               "log_dir": self.log_dir,
                "spill_dir": (self.objects.spill_dir
                              if self.objects.spill_is_local else None)}
         # same PYTHONPATH stripping the worker spawn does: a
@@ -251,17 +252,76 @@ class Raylet(RpcServer):
 
     def _log_monitor_loop(self, poll_s: float = 0.25,
                           dead_linger_s: float = 5.0):
-        """Tail every capture file in the log dir and forward new
-        COMPLETE lines to the GCS log channel (reference:
-        log_monitor.py). Scanning the DIRECTORY (not live worker
-        handles) means a crashed worker's final output — its traceback —
-        still ships even though the pool reaps the handle within
-        ~0.1s; fully-drained files of dead workers are deleted after a
-        short linger so dicts and disk stay bounded under worker churn."""
-        offsets: dict[str, int] = {}
-        partial: dict[str, bytes] = {}
+        """Tail every capture file in the log dir and ship new COMPLETE
+        lines to the GCS LogStore over ``push_logs`` (reference:
+        log_monitor.py). Two file kinds coexist: ``<proc>.log`` is the
+        in-process tee's stamped+rotated output (parsed per line, epoch
+        headers tracked so offsets stay attributable across rotation);
+        ``<proc>.out/.err`` is the raw Popen fd capture that only
+        interpreter-level crashes write to (shipped unparsed). Scanning
+        the DIRECTORY (not live worker handles) means a crashed worker's
+        final output — its traceback — still ships even though the pool
+        reaps the handle within ~0.1s; fully-drained files of dead
+        workers are deleted after a short linger so dicts and disk stay
+        bounded under worker churn.
+
+        Drop-not-block: pushes go over a dedicated short-timeout client
+        (fault label "metrics" — a metrics↔GCS partition covers logs
+        too) into a bounded pending deque; a slow or partitioned GCS
+        costs at most one 2s timeout per tick and then old batches,
+        never task execution."""
+        from collections import deque as _deque
+
+        from ray_tpu.runtime import log_plane as _log_plane
+        from ray_tpu.utils.config import get_config
+
+        offsets: dict[str, int] = {}        # path -> bytes consumed
+        partial: dict[str, bytes] = {}      # path -> incomplete tail
+        epochs: dict[str, int] = {}         # path -> live generation
+        inodes: dict[str, int] = {}
         pid_of: dict[str, int] = {}         # filename stem -> pid
         dead_since: dict[str, float] = {}
+        pending: _deque = _deque(maxlen=max(
+            8, int(get_config().log_push_buffer)))
+        self._log_push_client = None
+        self._log_push_dropped = 0
+
+        def _parse_block(path, name, data, base_off, out):
+            """Split ``data`` (starting at byte ``base_off``) into wire
+            line tuples, tracking epoch headers; incomplete tail bytes
+            go back to ``partial``."""
+            lines = data.split(b"\n")
+            if lines and lines[-1]:
+                partial[path] = lines[-1]
+            else:
+                partial.pop(path, None)
+            lines = lines[:-1]
+            stamped = name.endswith(".log")
+            stream_default = "e" if name.endswith(".err") else "o"
+            off = base_off
+            cur = None               # (epoch, [wire tuples])
+            for raw in lines:
+                text = raw.decode("utf-8", "replace")
+                start = off
+                off += len(raw) + 1
+                if stamped:
+                    ep = _log_plane.parse_epoch(text)
+                    if ep is not None:
+                        epochs[path] = ep
+                        continue
+                    parsed = _log_plane.parse_line(text)
+                    ts, stream, trace, task, tname, job, body = parsed
+                    rec = (start, ts, stream, body, trace, task, tname,
+                           job)
+                else:
+                    rec = (start, time.time(), stream_default, text,
+                           None, None, None, None)
+                epoch = epochs.get(path, 0) if stamped else 0
+                if cur is None or cur[0] != epoch or len(cur[1]) >= 500:
+                    cur = (epoch, [])
+                    out.append((path, name, epoch, cur[1]))
+                cur[1].append(rec)
+
         while not self._stopping:
             with self.workers.lock:
                 live = {h.worker_id[:12]: (h.proc.pid if h.proc else 0)
@@ -270,45 +330,59 @@ class Raylet(RpcServer):
             # files read as dead-worker leftovers and get deleted
             live.update(self.workers.prestart.log_stems())
             pid_of.update(live)
-            entries = []
+            blocks = []   # (path, name, epoch, [wire tuples])
             try:
                 names = sorted(os.listdir(self.log_dir))
             except OSError:
                 names = []
             for name in names:
+                stem, _, ext = name.rpartition(".")
+                if ext not in ("log", "out", "err"):
+                    continue   # rotated generations read on demand below
                 path = os.path.join(self.log_dir, name)
-                stem, _, stream = name.rpartition(".")
-                stem = stem[len("worker-"):] if stem.startswith(
+                short = stem[len("worker-"):] if stem.startswith(
                     "worker-") else stem
                 try:
-                    size = os.path.getsize(path)
+                    st = os.stat(path)
+                    size, ino = st.st_size, st.st_ino
                 except OSError:
                     continue
                 off = offsets.get(path, 0)
+                if ext == "log" and (ino != inodes.setdefault(path, ino)
+                                     or size < off):
+                    # the live file rotated out from under us: drain the
+                    # unread remainder from the shifted generation, then
+                    # restart at the new file's epoch header
+                    prev = f"{path}.1"
+                    try:
+                        psize = os.path.getsize(prev)
+                        if psize > off:
+                            tail = partial.pop(path, b"")
+                            with open(prev, "rb") as f:
+                                f.seek(off)
+                                data = tail + f.read(
+                                    min(psize - off, 1 << 20))
+                            _parse_block(path, name, data,
+                                         off - len(tail), blocks)
+                    except OSError:
+                        pass
+                    partial.pop(path, None)
+                    offsets[path] = off = 0
+                    inodes[path] = ino
                 if size > off:
                     take = min(size - off, 1 << 20)
                     try:
                         with open(path, "rb") as f:
                             f.seek(off)
-                            data = partial.pop(path, b"") + f.read(take)
+                            tail = partial.pop(path, b"")
+                            data = tail + f.read(take)
                     except OSError:
                         continue
                     offsets[path] = off + take
-                    lines = data.split(b"\n")
-                    if lines and lines[-1]:
-                        partial[path] = lines[-1]   # incomplete tail
-                    lines = lines[:-1]
-                    # chunked, not truncated: every line ships even on
-                    # a burst bigger than one publish frame
-                    for i in range(0, len(lines), 500):
-                        entries.append({
-                            "pid": pid_of.get(stem, 0),
-                            "worker_id": stem,
-                            "stream": stream,
-                            "lines": [ln.decode("utf-8", "replace")
-                                      for ln in lines[i:i + 500]],
-                        })
-                elif stem not in live:
+                    _parse_block(path, name, data, off - len(tail),
+                                 blocks)
+                elif short not in live and not stem.startswith(
+                        ("raylet", "gcs", "driver")):
                     # drained file of a dead worker: linger, then drop
                     first = dead_since.setdefault(path, time.monotonic())
                     if time.monotonic() - first > dead_linger_s:
@@ -316,27 +390,57 @@ class Raylet(RpcServer):
                         if tail:
                             # a crashed worker's final line may lack a
                             # trailing newline — ship it before cleanup
-                            entries.append({
-                                "pid": pid_of.get(stem, 0),
-                                "worker_id": stem,
-                                "stream": stream,
-                                "lines": [tail.decode("utf-8", "replace")],
-                            })
-                        for d in (offsets, partial, dead_since):
+                            _parse_block(path, name, tail + b"\n",
+                                         offsets.get(path, 0) -
+                                         len(tail), blocks)
+                        for d in (offsets, partial, dead_since, epochs,
+                                  inodes):
                             d.pop(path, None)
-                        pid_of.pop(stem, None)
-                        try:
-                            os.unlink(path)
-                        except OSError:
-                            pass
-            if entries:
+                        pid_of.pop(short, None)
+                        for gen in [path] + [f"{path}.{i}"
+                                             for i in range(1, 10)]:
+                            try:
+                                os.unlink(gen)
+                            except OSError:
+                                if gen != path:
+                                    break   # no further generations
+            for path, name, epoch, recs in blocks:
+                if not recs:
+                    continue
+                stem = name.rpartition(".")[0]
+                short = stem[len("worker-"):] if stem.startswith(
+                    "worker-") else stem
+                before = len(pending)
+                pending.append({
+                    "proc": stem,
+                    "pid": pid_of.get(short, 0),
+                    "file": f"{name}@{epoch}",
+                    "lines": recs,
+                })
+                if len(pending) == before:   # maxlen hit: oldest fell
+                    self._log_push_dropped += 1
+            if pending:
                 try:
-                    with self._gcs_lock:
-                        self._gcs.call("publish_logs",
-                                       node_id=self.node_id,
-                                       entries=entries)
-                except Exception:  # noqa: BLE001 - GCS mid-restart
-                    pass
+                    if self._log_push_client is None:
+                        # dedicated short-timeout channel: the shared GCS
+                        # client would serialize log pushes behind
+                        # scheduling traffic (and vice versa on a stall)
+                        self._log_push_client = RpcClient(
+                            self.gcs_address, timeout=2.0,
+                            label="metrics")
+                    batch = list(pending)
+                    self._log_push_client.call(
+                        "push_logs", node_id=self.node_id, entries=batch)
+                    for _ in batch:
+                        if pending:
+                            pending.popleft()
+                except Exception:  # noqa: BLE001 - GCS slow/partitioned
+                    try:
+                        if self._log_push_client is not None:
+                            self._log_push_client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._log_push_client = None
             self._interruptible_sleep(poll_s)
 
     def stop(self):
@@ -352,6 +456,12 @@ class Raylet(RpcServer):
         for t in self._threads:
             t.join(timeout=2.0)
         self.workers.stop()
+        client = getattr(self, "_log_push_client", None)
+        if client is not None:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
         agent = getattr(self, "_agent_proc", None)
         if agent is not None and agent.poll() is None:
             agent.terminate()
@@ -1394,9 +1504,17 @@ def main():  # runs a raylet as a standalone process (cluster_utils spawns it)
     # signal readiness to the parent via stdout
     print(json.dumps({"address": raylet.address,
                       "store_name": raylet.store_name}), flush=True)
+    # capture AFTER the readiness line: the parent blocks on reading the
+    # JSON above from the real stdout pipe. The raylet's own log monitor
+    # tails this file, so raylet prints reach the cluster log store like
+    # any worker's.
+    from ray_tpu.runtime import log_plane as _log_plane
+    _log_plane.install_capture(f"raylet-{raylet.node_id[:12]}",
+                               log_dir=raylet.log_dir)
     try:
         stop_ev.wait()
     finally:
+        _log_plane.uninstall_capture()
         raylet.stop()
 
 

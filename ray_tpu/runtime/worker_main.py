@@ -35,6 +35,20 @@ from ray_tpu.runtime.rpc import (
 from ray_tpu.utils import exceptions as exc
 
 
+def _task_log_context(task: dict, job: str | None = None):
+    """Log-plane execution bracket for ``task``: binds the ambient
+    task_id and records the (file, start_offset, end_offset) segment in
+    the offset annex so captured lines are attributable (reference: the
+    task-log offsets the worker reports next to its log file)."""
+    from ray_tpu.runtime import log_plane as _log_plane
+
+    tc = task.get("trace_ctx") or {}
+    return _log_plane.task_context(
+        task.get("task_id"), task.get("name", "?"),
+        job if job is not None else task.get("namespace"),
+        tc.get("trace_id") if isinstance(tc, dict) else None)
+
+
 class TaskPushServer(RpcServer):
     """Owner-facing task port (reference: the worker-side gRPC PushTask
     service the lease protocol pushes to, ``direct_task_transport.cc:234``).
@@ -290,6 +304,13 @@ class Worker:
         port = int(os.environ["RAY_TPU_RAYLET_PORT"])
         self.worker_id = os.environ["RAY_TPU_WORKER_ID"]
         self.node_id = os.environ["RAY_TPU_NODE_ID"]
+        # log plane capture: stdout/stderr through the stamped tee into
+        # a rotating <proc>.log the raylet's log monitor tails. Here
+        # (not main()) so the zygote fork path — which re-enters
+        # Worker() directly — is captured too. The Popen fd redirect to
+        # .out/.err stays underneath for interpreter-level last words.
+        from ray_tpu.runtime import log_plane as _log_plane
+        _log_plane.install_capture(f"worker-{self.worker_id[:12]}")
         self.raylet_addr = (host, port)
         from ray_tpu.runtime import fault_injection as _fi
         _fi.maybe_init_from_config((os.environ["RAY_TPU_GCS_HOST"],
@@ -695,6 +716,22 @@ class Worker:
         self.ctrl.call("request_space", nbytes=nbytes)
 
     def _store_error(self, task: dict, error: BaseException):
+        # errors are sealed into TaskError objects (never printed here),
+        # so a captured worker also emits the traceback into its log
+        # file — that is what summarize_errors() aggregates; local-mode
+        # (no capture) keeps the console quiet as before
+        from ray_tpu.runtime import log_plane as _log_plane
+
+        cap = _log_plane.active_capture()
+        if cap is not None:
+            try:
+                tb = getattr(error, "remote_traceback", None) or "".join(
+                    traceback.format_exception(
+                        type(error), error, error.__traceback__))
+                for ln in str(tb).splitlines():
+                    cap.emit("e", ln)
+            except Exception:  # noqa: BLE001 - logging must not mask
+                pass
         sink = task.get("_direct_sink")
         for oid_hex in task["return_oids"]:
             oid = bytes.fromhex(oid_hex)
@@ -811,7 +848,12 @@ class Worker:
 
         ns_token = set_task_namespace(task.get("namespace"))
         try:
-            self._execute_inner(task)
+            # log-plane bracket: begin/end byte offsets around the WHOLE
+            # execution (arg resolve through error sealing) so every
+            # captured line — including the stored traceback — is
+            # attributable to this task_id via the offset annex
+            with _task_log_context(task):
+                self._execute_inner(task)
         finally:
             reset_task_namespace(ns_token)
             self._release_task_pin(task)
@@ -861,7 +903,8 @@ class Worker:
                 # frame on the per-task hot path (the in-flight entry is
                 # always on — a hung task must be visible in stuck_calls
                 # even when nobody enabled tracing beforehand)
-                with _tracing.inflight("task", task.get("name", "?")):
+                with _tracing.inflight("task", task.get("name", "?"),
+                                       task.get("task_id")):
                     result = _call()
             else:
                 # the coroutine drive stays INSIDE the span: an async
@@ -869,7 +912,8 @@ class Worker:
                 # the call that returns the coroutine
                 with _tracing.execution_span(task.get("name", "?"),
                                              trace_ctx), \
-                        _tracing.inflight("task", task.get("name", "?")):
+                        _tracing.inflight("task", task.get("name", "?"),
+                                          task.get("task_id")):
                     result = _call()
         except BaseException as e:  # noqa: BLE001
             self._store_error(
@@ -1020,33 +1064,37 @@ class Worker:
             def done():
                 self._release_task_pin(task)
                 _done()
-            try:
-                from ray_tpu.util import tracing as _tracing
+            with _task_log_context(
+                    task, getattr(self, "actor_namespace", None)):
+                try:
+                    from ray_tpu.util import tracing as _tracing
 
-                method = getattr(self.actor_instance, task["method_name"])
-                with _tracing.execution_span(task.get("name", "?"),
-                                             task.get("trace_ctx")), \
-                        _tracing.inflight("actor_task",
-                                          task.get("name", "?")):
-                    result = method(*args, **kwargs)
-                    if inspect.isawaitable(result):
-                        result = await result
-            except BaseException as e:  # noqa: BLE001
-                self._store_error(
-                    task, exc.TaskError(task.get("name", "?"), e,
-                                        tb=traceback.format_exc()))
-                self._report_task_event(task, started, False)
+                    method = getattr(self.actor_instance,
+                                     task["method_name"])
+                    with _tracing.execution_span(task.get("name", "?"),
+                                                 task.get("trace_ctx")), \
+                            _tracing.inflight("actor_task",
+                                              task.get("name", "?"),
+                                              task.get("task_id")):
+                        result = method(*args, **kwargs)
+                        if inspect.isawaitable(result):
+                            result = await result
+                except BaseException as e:  # noqa: BLE001
+                    self._store_error(
+                        task, exc.TaskError(task.get("name", "?"), e,
+                                            tb=traceback.format_exc()))
+                    self._report_task_event(task, started, False)
+                    done()
+                    return
+                try:
+                    self._store_returns(task, result)
+                except BaseException as e:  # noqa: BLE001
+                    self._store_error(task, e)
+                    self._report_task_event(task, started, False)
+                    done()
+                    return
+                self._report_task_event(task, started, True)
                 done()
-                return
-            try:
-                self._store_returns(task, result)
-            except BaseException as e:  # noqa: BLE001
-                self._store_error(task, e)
-                self._report_task_event(task, started, False)
-                done()
-                return
-            self._report_task_event(task, started, True)
-            done()
 
     def _run_actor_task(self, task: dict):
         import time as _time
@@ -1068,32 +1116,35 @@ class Worker:
             done()
             return
         started = _time.monotonic()
-        try:
-            from ray_tpu.util import tracing as _tracing
+        with _task_log_context(task, getattr(self, "actor_namespace",
+                                             None)):
+            try:
+                from ray_tpu.util import tracing as _tracing
 
-            args, kwargs = self._resolve_args(task)
-            method = getattr(self.actor_instance, task["method_name"])
-            with _tracing.execution_span(task.get("name", "?"),
-                                         task.get("trace_ctx")), \
-                    _tracing.inflight("actor_task",
-                                      task.get("name", "?")):
-                result = method(*args, **kwargs)
-        except BaseException as e:  # noqa: BLE001
-            self._store_error(
-                task, exc.TaskError(task.get("name", "?"), e,
-                                    tb=traceback.format_exc()))
-            self._report_task_event(task, started, False)
+                args, kwargs = self._resolve_args(task)
+                method = getattr(self.actor_instance, task["method_name"])
+                with _tracing.execution_span(task.get("name", "?"),
+                                             task.get("trace_ctx")), \
+                        _tracing.inflight("actor_task",
+                                          task.get("name", "?"),
+                                          task.get("task_id")):
+                    result = method(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                self._store_error(
+                    task, exc.TaskError(task.get("name", "?"), e,
+                                        tb=traceback.format_exc()))
+                self._report_task_event(task, started, False)
+                done()
+                return
+            try:
+                self._store_returns(task, result)
+            except BaseException as e:  # noqa: BLE001
+                self._store_error(task, e)
+                self._report_task_event(task, started, False)
+                done()
+                return
+            self._report_task_event(task, started, True)
             done()
-            return
-        try:
-            self._store_returns(task, result)
-        except BaseException as e:  # noqa: BLE001
-            self._store_error(task, e)
-            self._report_task_event(task, started, False)
-            done()
-            return
-        self._report_task_event(task, started, True)
-        done()
 
 
 def _iscoroutine(x) -> bool:

@@ -46,6 +46,15 @@ class RuntimeContext:
     def get_job_id(self) -> str:
         return self.job_id
 
+    def get_task_id(self) -> str | None:
+        """Id of the currently executing task/actor method, or None
+        outside one (reference: ``RuntimeContext.get_task_id``). Comes
+        from the log plane's execution bracket, so it is also the key
+        ``util.state.get_log(task_id=...)`` resolves."""
+        from ray_tpu.runtime import log_plane as _lp
+
+        return _lp.current_task_id()
+
 
 def get_runtime_context() -> RuntimeContext:
     from ray_tpu.runtime import core as _core

@@ -100,6 +100,19 @@ class _Replica:
             self._total += 1
             self._set_ongoing_gauge()
         token = set_request_model_id(model_id)
+        # log attribution: lines the handler prints echo/store under the
+        # deployment/replica tag instead of the generic actor-method name
+        from ray_tpu.runtime import log_plane as _log_plane
+
+        with _log_plane.label_context(
+                f"{self._deployment}/{self._tag}"):
+            return await self._handle_request_inner(
+                method_name, args, kwargs, token)
+
+    async def _handle_request_inner(self, method_name, args, kwargs,
+                                    token):
+        import inspect
+
         try:
             target = (self._instance if method_name == "__call__"
                       else getattr(self._instance, method_name))

@@ -637,3 +637,112 @@ def train_stragglers(run: str, *, skew_s: float | None = None) -> dict:
         "skew_steps": max(r["behind_steps"] for r in ranks.values()),
         "stragglers": stragglers,
     }
+
+
+# ---------------------------------------------------------------------------
+# cluster log plane (runtime/log_plane.py): captured stdout/stderr in
+# the GCS LogStore, task-attributed via the logs/segments/* annexes —
+# reference analog: ray.util.state.get_log / list_logs
+# ---------------------------------------------------------------------------
+
+
+def get_log(proc: str | None = None, task_id: str | None = None,
+            follow: bool = False, tail: int = 100):
+    """Captured log lines for one process (``proc`` — a proc name like
+    ``worker-ab12cd34ef56``, a worker-id prefix, ``raylet-...``, or
+    ``gcs``) or exactly one task's attributed segment (``task_id`` —
+    resolved through the offset annex the emitting worker pushed).
+
+    Returns a dict ``{proc, lines, ...}``; with ``follow=True`` (proc
+    mode only) returns a generator yielding each new line dict as it
+    reaches the store, polling forever — iterate with a consumer-side
+    stop condition."""
+    mode, rt = _mode()
+    if mode != "cluster":
+        # local mode: serve from this process's own capture, if any
+        from ray_tpu.runtime import log_plane as _lp
+
+        cap = _lp.active_capture()
+        if cap is None:
+            return {"proc": proc, "lines": [],
+                    "error": "no cluster runtime and no local capture"}
+        return {"proc": cap.proc, "lines": cap.tail(tail, task_id)}
+    if task_id is not None:
+        return rt._gcs.call("get_log", task_id=task_id)
+    if not proc:
+        raise ValueError("get_log needs proc or task_id")
+    if not follow:
+        return rt._gcs.call("get_log", proc=proc, tail=tail)
+
+    def _follow():
+        import time as _time
+
+        cursor = None
+        first = rt._gcs.call("get_log", proc=proc, tail=tail)
+        while True:
+            for rec in first.get("lines") or []:
+                cursor = (rec["file"], rec["offset"])
+                yield rec
+            _time.sleep(0.5)
+            first = rt._gcs.call("get_log", proc=proc, tail=1000,
+                                 after=cursor)
+
+    return _follow()
+
+
+def list_logs() -> dict:
+    """Every process with stored lines: ``{procs: {name: {node, pid,
+    lines, last_ts, files}}, ingested, deduped}``."""
+    mode, rt = _mode()
+    if mode != "cluster":
+        from ray_tpu.runtime import log_plane as _lp
+
+        cap = _lp.active_capture()
+        if cap is None:
+            return {"procs": {}, "ingested": 0, "deduped": 0}
+        return {"procs": {cap.proc: {"node": "local", "pid": None,
+                                     "lines": cap.lines,
+                                     "last_ts": None,
+                                     "files": [cap.file_token()]}},
+                "ingested": cap.lines, "deduped": 0}
+    return rt._gcs.call("list_logs")
+
+
+def summarize_errors(last_s: float | None = None) -> list[dict]:
+    """Deduplicated error groups (ERROR/CRITICAL/FATAL lines and final
+    traceback lines, signature-normalized): ``[{signature, sample,
+    count, first_ts, last_ts, procs, traces, tasks}]`` sorted by count.
+    ``traces`` links each group to its distributed traces when the line
+    was emitted inside a span."""
+    mode, rt = _mode()
+    if mode != "cluster":
+        from ray_tpu.runtime import log_plane as _lp
+
+        groups: dict = {}
+        for rec in _lp.log_tail(None):
+            if not _lp.is_error_line(rec["line"]):
+                continue
+            sig = _lp.error_signature(rec["line"])
+            g = groups.setdefault(sig, {
+                "signature": sig, "sample": rec["line"], "count": 0,
+                "first_ts": rec["ts"], "last_ts": rec["ts"],
+                "procs": set(), "traces": set(), "tasks": set()})
+            g["count"] += 1
+            g["first_ts"] = min(g["first_ts"], rec["ts"])
+            g["last_ts"] = max(g["last_ts"], rec["ts"])
+            if rec.get("trace"):
+                g["traces"].add(rec["trace"])
+            if rec.get("task"):
+                g["tasks"].add(rec["task"])
+        import time as _time
+
+        now = _time.time()
+        out = [dict(g) for g in groups.values()
+               if last_s is None or now - g["last_ts"] <= last_s]
+        for g in out:
+            g["procs"], g["traces"], g["tasks"] = (
+                sorted(g["procs"]), sorted(g["traces"]),
+                sorted(g["tasks"]))
+        out.sort(key=lambda g: (-g["count"], -g["last_ts"]))
+        return out
+    return rt._gcs.call("summarize_errors", last_s=last_s)["groups"]

@@ -407,6 +407,20 @@ def local_stuck_calls(threshold_s: float | None = None) -> list[dict]:
     for e in entries:
         e["age_s"] = now - e.pop("mono")
     entries.sort(key=lambda e: -e["age_s"])
+    # a stuck TASK report is actionable without a second query: append
+    # the hung task's last captured log lines (needs the in-process
+    # capture; target carries the task_id the execution bracket stamps)
+    try:
+        from ray_tpu.runtime import log_plane as _log_plane
+
+        if _log_plane.active_capture() is not None:
+            for e in entries:
+                if e.get("kind") in ("task", "actor_task") \
+                        and e.get("target"):
+                    e["log_tail"] = _log_plane.recent_lines(
+                        e["target"], 5)
+    except Exception:  # pragma: no cover - teardown
+        pass
     return entries
 
 
@@ -444,6 +458,14 @@ def flight_snapshot(last_s: float | None = None) -> dict:
                 events_out.append(r)
         elif r["start"] + r.get("duration", 0.0) >= cutoff:
             spans_out.append(r)
+    # a crashed/partitioned worker's last words ride the dump: the last
+    # ~50 captured log lines (empty when no capture is installed)
+    try:
+        from ray_tpu.runtime import log_plane as _log_plane
+
+        log_tail = _log_plane.log_tail(50)
+    except Exception:  # pragma: no cover - teardown
+        log_tail = []
     return {
         "pid": os.getpid(),
         "ts": time.time(),
@@ -451,6 +473,7 @@ def flight_snapshot(last_s: float | None = None) -> dict:
         "spans": spans_out,
         "events": events_out,
         "inflight": local_stuck_calls(0.0),
+        "log_tail": log_tail,
     }
 
 
@@ -838,6 +861,25 @@ def export_chrome_trace(trace_dir: str | None = None,
         # no initialized runtime (or a partially torn-down one): the
         # export is spans-only — say why instead of silently shrinking
         logger.info("export_chrome_trace: skipping timeline merge: %s", e)
+    # attributed log lines as instant events on the emitting task's
+    # trace lane (tid = trace_id, same lane its spans render on): this
+    # process's capture plus — cluster mode — the GCS log store rings
+    try:
+        from ray_tpu.runtime import log_plane as _log_plane
+
+        events.extend(_log_plane.chrome_instant_events())
+        from ray_tpu.runtime import core as _core
+        if _core.is_initialized():
+            from ray_tpu.util import state as _state
+
+            recs: list = []
+            listing = _state.list_logs()
+            for proc_name in (listing.get("procs") or {}):
+                got = _state.get_log(proc=proc_name, tail=1000)
+                recs.extend(got.get("lines") or [])
+            events.extend(_log_plane.chrome_instant_events(recs))
+    except Exception as e:  # noqa: BLE001 - observability only
+        logger.info("export_chrome_trace: skipping log merge: %s", e)
     # stable order so repeated exports of the same spans diff cleanly
     events.sort(key=lambda e: (e.get("ts", float("inf")),
                                e.get("pid", 0), e.get("name", "")))

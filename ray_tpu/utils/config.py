@@ -401,6 +401,37 @@ class Config:
     # Default threshold for util.state.stuck_calls().
     trace_stuck_threshold_s: float = 10.0
 
+    # --- cluster log plane (runtime/log_plane.py; reference analog:
+    # per-worker session log files + log_monitor.py tailing them into
+    # GCS pubsub and the dashboard) ---
+    # Master switch for the in-process stdout/stderr tee in workers /
+    # external raylets / external GCS (the Popen fd capture stays on
+    # regardless — interpreter crashes must leave last words somewhere).
+    log_capture_enabled: bool = True
+    # Rotation bounds per capture file (<proc>.log, .log.1, ...):
+    # rotate past log_max_bytes, keep log_rotate_count old generations
+    # (env: RAY_TPU_LOG_MAX_BYTES / RAY_TPU_LOG_ROTATE_COUNT).
+    log_max_bytes: int = 16 << 20
+    log_rotate_count: int = 3
+    # Log-monitor tail/push period and its bounded pending-entry queue:
+    # entries queued past the cap are DROPPED oldest-first (same
+    # drop-not-block contract as the metrics pusher buffer).
+    log_push_interval_s: float = 0.25
+    log_push_buffer: int = 256
+    # GCS LogStore rings: recent lines kept per process, and the global
+    # error ring feeding summarize_errors (deduplicated groups).
+    log_store_lines: int = 2000
+    log_store_error_lines: int = 2000
+    log_store_error_groups: int = 256
+    # Driver echo budget per SOURCE process (token bucket, lines/s): a
+    # chatty worker is summarized, not allowed to bury the terminal.
+    log_echo_rate_lines_s: float = 200.0
+    # task_id -> (file, start, end) offset-segment annex: how many
+    # recent task segments each worker publishes on its metric frames.
+    log_segments_max: int = 128
+    # Flight-recorder log tail (last captured lines in crash dumps).
+    log_tail_lines: int = 50
+
     # --- training telemetry plane (train/telemetry.py; reference
     # analog: Ray Train's _internal/state run tracking — here per-step
     # decomposition/MFU/goodput ride the metrics+tracing planes) ---
