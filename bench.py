@@ -1139,6 +1139,43 @@ def bench_envelope() -> dict:
     }
 
 
+def bench_chaos_soak() -> dict:
+    """Seeded crash/partition soak with conservation invariants
+    (ray_tpu/chaos_soak.py). Knobs: CHAOS_SOAK_DURATION (seconds per
+    seed, default 300), CHAOS_SOAK_SEEDS (comma list, default "0"),
+    CHAOS_SOAK_OUT (report path, default CHAOS_r10.json next to this
+    file). The gate metric is the violation count — the MTTR means ride
+    in detail for the perf-gate ceilings."""
+    from ray_tpu.chaos_soak import run_soak_matrix
+
+    duration = float(os.environ.get("CHAOS_SOAK_DURATION", "300"))
+    seeds = [int(s) for s in
+             os.environ.get("CHAOS_SOAK_SEEDS", "0").split(",")
+             if s.strip()]
+    out = os.environ.get(
+        "CHAOS_SOAK_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "CHAOS_r10.json"))
+    report = run_soak_matrix(
+        duration, seeds, out_path=out,
+        log=lambda *a: print(*a, file=sys.stderr))
+    detail = {"seeds": report["seeds"],
+              "chaos_soak_invariant_violations":
+                  report["chaos_soak_invariant_violations"]}
+    for key in ("chaos_mttr_replica_mean_s", "chaos_mttr_raylet_mean_s"):
+        if key in report:
+            detail[key] = report[key]
+    if isinstance(report.get("probe_overhead"), dict):
+        detail["probe_overhead"] = report["probe_overhead"]
+    return {
+        "metric": "chaos_soak_invariant_violations",
+        "value": report["chaos_soak_invariant_violations"],
+        "unit": "violations",
+        "vs_baseline": None,
+        "detail": detail,
+    }
+
+
 def _bench_subprocess(mode: str, timeout: float = 900.0) -> dict:
     """Run one bench mode in a FRESH interpreter (parity with a
     standalone ``BENCH_MODE=<mode>`` run; ray_perf runs standalone too).
@@ -1224,6 +1261,7 @@ if __name__ == "__main__":
     fn = {"serve": bench_serve, "core": bench_core,
           "envelope": bench_envelope,
           "serve_scaleout": bench_serve_scaleout,
+          "chaos_soak": bench_chaos_soak,
           "train": bench_train,
           "train_telemetry": bench_train_telemetry}.get(mode, bench_all)
     print(json.dumps(fn()))

@@ -132,6 +132,31 @@ METRICS_CEILING = {
         [("detail", "core", "log_overhead", "ratio"),
          ("detail", "log_overhead", "ratio")],
         0.03),
+    # crash chaos soak (round 10): conservation is absolute — a single
+    # lost or wedged call is a failure regardless of history (ceiling
+    # 0 means any violation trips the gate), and the per-class MTTR
+    # means fence recovery latency. Keys absent (doc from another
+    # bench mode): skipped.
+    "chaos_soak_invariant_violations": (
+        [("detail", "chaos_soak", "chaos_soak_invariant_violations"),
+         ("detail", "chaos_soak_invariant_violations")],
+        0.0),
+    "chaos_mttr_replica_mean_s": (
+        [("detail", "chaos_soak", "chaos_mttr_replica_mean_s"),
+         ("detail", "chaos_mttr_replica_mean_s")],
+        5.0),
+    "chaos_mttr_raylet_mean_s": (
+        [("detail", "chaos_soak", "chaos_mttr_raylet_mean_s"),
+         ("detail", "chaos_mttr_raylet_mean_s")],
+        10.0),
+    # health-probe tax on a serving replica (probe rate x min ping RTT,
+    # a deliberate over-estimate) must stay under 1% — proactive
+    # failover may not cost serving throughput (ISSUE-16 guard vs the
+    # round-8 serve plane)
+    "serve_probe_overhead_ratio": (
+        [("detail", "chaos_soak", "probe_overhead", "ratio"),
+         ("detail", "probe_overhead", "ratio")],
+        0.01),
 }
 
 # train metric paths only exist in full-run docs; the train bench value

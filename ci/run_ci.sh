@@ -34,6 +34,17 @@ if [ "${1:-}" = "--nightly" ]; then
   # timeouts; the fast default tier runs only the driver<->GCS smoke
   JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_partitions.py \
     -m nightly -q -s
+  stage "nightly crash chaos soak (3 seeds x 300s, worker/replica/raylet/GCS + partitions)"
+  # seeded process-kill + partition schedule over a mixed workload
+  # (tasks, actors, serve) with conservation invariants: every
+  # submitted call resolves or raises typed, nothing wedges, planes
+  # stay intact. The gate fences violations==0, the per-class MTTR
+  # means, and the <1% health-probe overhead guard (ISSUE-16).
+  JAX_PLATFORMS=cpu CHAOS_SOAK_SEEDS=0,1,2 CHAOS_SOAK_DURATION=300 \
+    CHAOS_SOAK_OUT=/tmp/chaos_nightly.json \
+    BENCH_MODE=chaos_soak python bench.py > /tmp/bench_chaos_ci.json
+  python ci/perf_gate.py /tmp/bench_chaos_ci.json \
+    "$(ls BENCH_r*.json 2>/dev/null | sort -V | tail -1 || echo /tmp/bench_chaos_ci.json)"
   stage "nightly log plane (rotation holds disk bounded under worker churn at scale)"
   # a flood of printing workers must keep the node's log dir under the
   # rotation budget (max_bytes * (rotate_count+1) per proc) while every

@@ -49,7 +49,8 @@ class ClusterRuntime:
         # set) BEFORE any channel dials: a startup plan must see every
         # connection this runtime makes
         from ray_tpu.runtime import fault_injection as _fi
-        _fi.maybe_init_from_config(self.gcs_address)
+        _fi.maybe_init_from_config(self.gcs_address,
+                                   process_label="driver")
         # reconnecting: survives a GCS restart (file-backed recovery)
         self._gcs = ReconnectingRpcClient(self.gcs_address, label="driver")
         self.caller_id = WorkerID.from_random().hex()
@@ -146,6 +147,14 @@ class ClusterRuntime:
         # cached per-address actor-call clients (see _actor_client)
         self._actor_clients: dict[tuple, RpcClient] = {}
         self._actor_clients_lock = threading.Lock()
+        # acked-but-unresolved actor calls: the worker accepted them
+        # into its queue, so the submit plane forgot them — but a crash
+        # takes the queue down with the worker and nobody else will
+        # ever write their return oids. The reaper sweeps this against
+        # the pushed actor table and fails the refs of DEAD actors with
+        # a typed ActorDiedError (actor_hex -> task_id -> (oids, inc)).
+        self._actor_inflight: dict[str, dict[str, tuple]] = {}
+        self._inflight_lock = threading.Lock()
         from ray_tpu.utils.config import get_config as _gc
         self._actor_client_cap = _gc().actor_client_cache_size
         self._actor_client_soft_cap = _gc().actor_client_soft_cap
@@ -1679,6 +1688,8 @@ class ClusterRuntime:
                     err = e
             window.popleft()
             self._ack_actor_tasks(actor_hex, len(tasks))
+            if err is None:
+                self._record_acked_tasks(actor_hex, tasks)
             if err is not None:
                 failed = [(t, addr) for t in tasks]
                 while window:
@@ -1733,6 +1744,7 @@ class ClusterRuntime:
                     task["incarnation"] = incarnation
                 client = self._actor_client(addr)
                 client.call("submit_actor_task", task=task, timeout=30)
+                self._record_acked_tasks(actor_hex, (task,))
                 return
             except (exc.ActorDiedError, exc.ActorUnavailableError) as e:
                 err = e      # GCS verdict: no amount of redialing helps
@@ -1757,7 +1769,8 @@ class ClusterRuntime:
                     break
                 time.sleep(delay)
         err = err if isinstance(err, exc.RayTpuError) else \
-            exc.ActorDiedError(actor_hex, repr(err))
+            exc.ActorDiedError(actor_hex, repr(err),
+                               restart_count=task.get("incarnation", 0))
         if task.get("pinned"):
             self._refs.release_task_pin(task.get("task_id", ""))
         for oid_hex in task.get("return_oids", ()):
@@ -1813,6 +1826,102 @@ class ClusterRuntime:
                         if filler in fs:
                             fs.remove(filler)
 
+    def _record_acked_tasks(self, actor_hex: str, tasks):
+        """Track acked-but-unresolved calls for the dead-actor sweep.
+        Once the worker acks a submit, the submit plane (window +
+        resend) is done with the task — but its return oids are only as
+        durable as the worker's queue. Entries leave via the sweep:
+        pruned when their oids land, failed typed when the actor dies."""
+        with self._inflight_lock:
+            per = self._actor_inflight.setdefault(actor_hex, {})
+            for t in tasks:
+                if t.get("noop") or not t.get("return_oids"):
+                    continue
+                per[t["task_id"]] = (tuple(t["return_oids"]),
+                                     t.get("incarnation", 0),
+                                     bool(t.get("pinned")))
+            if not per:
+                self._actor_inflight.pop(actor_hex, None)
+
+    def _sweep_dead_actor_calls(self):
+        """Reaper duty: fail calls that died INSIDE a dead actor's
+        queue. A crash-killed worker takes its accepted-but-unfinished
+        queue down with it; nothing on the submit plane retries those
+        (they were acked), so without this sweep their return oids are
+        never written and an untimed get() wedges forever. The pushed
+        actor table (CH_ACTOR) is the authority: state DEAD — or an
+        ALIVE entry whose incarnation has advanced past the one that
+        accepted the call — means the accepting queue is gone, and the
+        unresolved oids get a typed ActorDiedError."""
+        with self._inflight_lock:
+            snapshot = [(a, dict(per))
+                        for a, per in self._actor_inflight.items()]
+        for actor_hex, per in snapshot:
+            resolved = [tid for tid, (oids, _, _p) in per.items()
+                        if all(self.store.contains(bytes.fromhex(o))
+                               for o in oids)]
+            if resolved:
+                with self._inflight_lock:
+                    live = self._actor_inflight.get(actor_hex)
+                    if live:
+                        for tid in resolved:
+                            live.pop(tid, None)
+                            per.pop(tid, None)
+                        if not live:
+                            self._actor_inflight.pop(actor_hex, None)
+            if not per:
+                continue
+            with self._actor_table_cv:
+                ent = self._actor_table.get(actor_hex)
+            reg_err = self._reg_failed.get(actor_hex)
+            if ent is None and reg_err is None:
+                continue
+            if reg_err is not None:
+                dead = {tid: exc.ActorDiedError(actor_hex, reg_err)
+                        for tid in per}
+            elif ent["state"] == "DEAD":
+                restarts = ent.get("num_restarts", 0)
+                dead = {tid: exc.ActorDiedError(
+                            actor_hex, ent.get("death_reason", "dead"),
+                            restart_count=restarts)
+                        for tid in per}
+            else:
+                # ALIVE but restarted: calls acked into an OLDER
+                # incarnation died with it (the fresh process has an
+                # empty queue and will never see them)
+                restarts = ent.get("num_restarts", 0)
+                dead = {tid: exc.ActorDiedError(
+                            actor_hex,
+                            f"actor restarted; incarnation {inc} died "
+                            f"holding this call",
+                            restart_count=restarts)
+                        for tid, (_, inc, _p) in per.items()
+                        if inc < restarts}
+            if not dead:
+                continue
+            for tid, err in dead.items():
+                oids, _inc, pinned = per[tid]
+                for oid_hex in oids:
+                    oid = bytes.fromhex(oid_hex)
+                    if not self.store.contains(oid):
+                        try:
+                            object_codec.put_value(self.store, oid, err,
+                                                   is_error=True)
+                        except Exception:  # noqa: BLE001
+                            pass
+                if pinned:
+                    try:
+                        self._refs.release_task_pin(tid)
+                    except Exception:  # noqa: BLE001
+                        pass
+            with self._inflight_lock:
+                live = self._actor_inflight.get(actor_hex)
+                if live:
+                    for tid in dead:
+                        live.pop(tid, None)
+                    if not live:
+                        self._actor_inflight.pop(actor_hex, None)
+
     def _ensure_actor_reaper(self):
         """Start the actor submit flusher: the single thread that sends
         outbox batches, drains reply windows (surfacing failures of the
@@ -1827,6 +1936,7 @@ class ClusterRuntime:
 
         def loop():
             gap_tick = 0.0
+            sweep_tick = 0.0
             while not self._closed:
                 linger = False
                 with self._outbox_cv:
@@ -1860,6 +1970,12 @@ class ClusterRuntime:
                     gap_tick = now
                     try:
                         self._flush_gap_fillers()
+                    except Exception:  # noqa: BLE001
+                        pass
+                if now - sweep_tick >= 0.5:
+                    sweep_tick = now
+                    try:
+                        self._sweep_dead_actor_calls()
                     except Exception:  # noqa: BLE001
                         pass
 

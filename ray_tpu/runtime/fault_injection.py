@@ -22,9 +22,15 @@ A *plan* is a dict::
 Rule fields (all optional except ``fault``):
 
 - ``fault``: ``drop`` | ``delay`` | ``duplicate`` | ``reset`` |
-  ``partition``. ``partition`` severs matching live channels AND refuses
-  new connections until the rule is removed (healed); the other faults
-  act per message.
+  ``partition`` | ``crash``. ``partition`` severs matching live channels
+  AND refuses new connections until the rule is removed (healed); the
+  other message faults act per message. ``crash`` is a PROCESS fault:
+  it never matches message traffic and instead fires at named *crash
+  points* registered throughout the runtime (``maybe_crash("gcs.
+  after_wal_append")``) — on the nth seeded hit the host process writes
+  a last-words marker line to raw stderr (the log plane's ``.err``
+  redirect keeps it; supervisors harvest it) and dies via ``os._exit``
+  (or SIGKILL with ``signal: "kill"``).
 - ``src``: the LOCAL endpoint label of the channel (clients are labeled
   at construction — ``driver``, ``owner``, ``raylet``, ``worker``;
   servers consult with their ``fault_label``). ``*``/absent matches any.
@@ -32,9 +38,22 @@ Rule fields (all optional except ``fault``):
   through the plan's ``endpoints`` map, or ``*``.
 - ``direction``: ``send`` | ``recv`` | ``both`` (one-way faults).
 - ``method``: RPC method name, or ``*``.
+- ``point`` (``crash`` rules): crash-point name or fnmatch pattern
+  (``worker.*``). The catalog lives in docs/crash_chaos.md.
+- ``proc`` (``crash`` rules): process role the rule may kill —
+  ``gcs`` | ``raylet`` | ``worker`` | ``driver`` | ``*``. Every entry
+  point stamps its role on the plane (:func:`set_process_label`); the
+  driver-hosted in-process GCS/head raylet keep the ``driver`` label,
+  so a ``proc: "raylet"`` rule can only ever kill an external raylet,
+  never the test/driver process.
 - ``nth`` (fire only on the nth matching call), ``every`` (every nth),
   ``p`` (seeded probability), ``max_hits`` (stop after N injections).
+  Counters are per process: a ``crash`` rule with ``nth: 1`` kills each
+  matching process at its next hit of the point.
 - ``delay_s``: sleep for ``delay`` faults (default 0.05).
+- ``signal`` (``crash`` rules): ``exit`` (default, ``os._exit(137)``)
+  or ``kill`` (``SIGKILL`` to self — no atexit, no buffered flush
+  beyond the already-written marker).
 
 Runtime switching: plans live under the GCS KV key
 (``__fault_injection__`` / ``plan``) — the GCS applies writes to its own
@@ -52,7 +71,9 @@ Config flags (``ray_tpu/utils/config.py``, env ``RAY_TPU_FAULT_*``):
 
 from __future__ import annotations
 
+import fnmatch
 import json
+import os
 import random
 import threading
 import time
@@ -78,8 +99,18 @@ PARTITION = "partition"
 # should pin ``method: "fork_worker"`` to avoid burning hit budgets on
 # unrelated messages.
 KILL_TEMPLATE = "kill_template"
+# Process-crash rule kind: fires at named maybe_crash() points, not on
+# message traffic (consult/check_connect skip it entirely).
+CRASH = "crash"
 
-_FAULTS = (DROP, DELAY, DUPLICATE, RESET, PARTITION, KILL_TEMPLATE)
+_FAULTS = (DROP, DELAY, DUPLICATE, RESET, PARTITION, KILL_TEMPLATE,
+           CRASH)
+
+# Last-words marker written to raw fd 2 right before an injected death.
+# The worker/raylet ``.err`` redirect keeps it even through SIGKILL;
+# supervisors and the log plane key off this prefix (see
+# log_plane.CRASH_MARKER ingestion and worker_pool last-words harvest).
+CRASH_MARKER = "RAY_TPU_CRASH"
 
 
 class InjectedConnectionReset(OSError):
@@ -90,7 +121,7 @@ class InjectedConnectionReset(OSError):
 class _Rule:
     __slots__ = ("rid", "fault", "src", "dst", "direction", "method",
                  "nth", "every", "p", "max_hits", "delay_s",
-                 "calls", "hits", "rng")
+                 "point", "proc", "signal", "calls", "hits", "rng")
 
     def __init__(self, spec: dict, index: int, seed: int):
         fault = spec.get("fault")
@@ -107,11 +138,22 @@ class _Rule:
         self.p = spec.get("p")
         self.max_hits = spec.get("max_hits")
         self.delay_s = float(spec.get("delay_s", 0.05))
+        # crash-rule fields (ignored by message faults)
+        self.point = spec.get("point", "*")
+        self.proc = spec.get("proc", "*")
+        self.signal = spec.get("signal", "exit")
         self.calls = 0
         self.hits = 0
         # per-rule seeded stream: decisions replay exactly for a given
         # (plan seed, rule position, rule id) regardless of other rules
         self.rng = random.Random(f"{seed}:{index}:{self.rid}")
+
+    def matches_point(self, point: str, proc_label: str | None) -> bool:
+        if self.proc != "*" and self.proc != proc_label:
+            return False
+        if self.point == "*" or self.point == point:
+            return True
+        return fnmatch.fnmatchcase(point, self.point)
 
     def matches(self, label: str | None, direction: str, peer_key: str,
                 method: str | None, endpoints: dict) -> bool:
@@ -168,6 +210,12 @@ class FaultPlane:
         self.version = -1
         self.active = False
         self.stats: dict[str, int] = {}
+        # role stamp consulted by crash rules' ``proc`` scoping; set
+        # once per process by set_process_label() at the entry point
+        self.process_label: str | None = None
+        # test seam: a harness may intercept the injected death instead
+        # of losing its own process (in-process GCS chaos tests)
+        self._crash_handler = None
 
     # -- plan management ------------------------------------------------
 
@@ -234,6 +282,8 @@ class FaultPlane:
         action = PASS
         with self._lock:
             for rule in self._rules:
+                if rule.fault == CRASH:
+                    continue   # process fault: fires at maybe_crash only
                 if not rule.matches(label, direction, peer_key, method,
                                     self._endpoints):
                     continue
@@ -249,11 +299,78 @@ class FaultPlane:
             time.sleep(delay)
         return action
 
+    def maybe_crash(self, point: str):
+        """Named crash point. A no-op (one attribute read) unless a plan
+        with a matching ``crash`` rule is loaded; on the nth seeded hit
+        the process writes a last-words marker to raw fd 2 and dies.
+        Registered points form the catalog in docs/crash_chaos.md —
+        ``gcs.after_wal_append``, ``raylet.before_lease_grant``,
+        ``worker.mid_task``, ``replica.mid_decode``, ...
+        """
+        if not self.active:
+            return
+        fired = None
+        with self._lock:
+            for rule in self._rules:
+                if rule.fault != CRASH:
+                    continue
+                if not rule.matches_point(point, self.process_label):
+                    continue
+                if not rule.fires():
+                    continue
+                self._count(rule)
+                fired = rule
+                break
+        if fired is None:
+            return
+        self._die(point, fired)
+
+    def _die(self, point: str, rule: _Rule):
+        """Injected death: marker first (raw fd 2 — survives SIGKILL
+        because it is already in the .err redirect by the time we die),
+        then exit without any cleanup, exactly like a real crash."""
+        marker = (f"{CRASH_MARKER} point={point} rule={rule.rid} "
+                  f"pid={os.getpid()} "
+                  f"proc={self.process_label or '?'}\n")
+        try:
+            os.write(2, marker.encode())
+        except OSError:
+            pass
+        if self._crash_handler is not None:
+            self._crash_handler(point, rule)
+            return
+        if rule.signal == "kill":
+            import signal as _signal
+
+            os.kill(os.getpid(), _signal.SIGKILL)
+            time.sleep(60)   # pending-signal window; never returns
+        os._exit(137)
+
+    def set_crash_handler(self, fn):
+        """Test seam: ``fn(point, rule)`` replaces the injected death
+        (None restores real semantics). In-process chaos tests use this
+        to crash an embedded server without losing the host process."""
+        self._crash_handler = fn
+
     def _count(self, rule: _Rule):
         self.stats[rule.rid] = self.stats.get(rule.rid, 0) + 1
 
 
 plane = FaultPlane()
+
+
+def set_process_label(label: str):
+    """Stamp this process's role (``gcs``/``raylet``/``worker``/
+    ``driver``) for crash rules' ``proc`` scoping. Entry points call it
+    unconditionally — it is one attribute write and must happen even
+    when injection is disabled, so a plan enabled later via env in a
+    child finds the label in place."""
+    plane.process_label = label
+
+
+def maybe_crash(point: str):
+    """Module-level convenience for the process-global plane."""
+    plane.maybe_crash(point)
 
 
 # ----------------------------------------------------------------------
@@ -348,13 +465,16 @@ def reset_after_fork():
     plane = FaultPlane()
 
 
-def maybe_init_from_config(gcs_address=None):
+def maybe_init_from_config(gcs_address=None, process_label=None):
     """Called by every process entry point (driver runtime, raylet, GCS,
-    worker). No-op unless ``RAY_TPU_FAULT_INJECTION_ENABLED`` is set —
-    the disabled path costs one config read at startup, nothing per
+    worker). The role stamp is applied unconditionally; everything else
+    is a no-op unless ``RAY_TPU_FAULT_INJECTION_ENABLED`` is set — the
+    disabled path costs one config read at startup, nothing per
     message."""
     from ray_tpu.utils.config import get_config
 
+    if process_label is not None:
+        set_process_label(process_label)
     cfg = get_config()
     if not cfg.fault_injection_enabled:
         return

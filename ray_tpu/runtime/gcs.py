@@ -873,6 +873,8 @@ class GcsServer(RpcServer):
                 self._log_actor(created)
             if named_key is not None:
                 self._log("named", named_key, actor_id)
+        if created is not None or named_key is not None:
+            _fi.maybe_crash("gcs.after_wal_append")
         if not result["ok"]:
             raise ValueError(result["error"])
         if created is None:
@@ -903,6 +905,10 @@ class GcsServer(RpcServer):
             self._plane["register_actors"] += len(actors)
             self._plane["register_batch_max"] = max(
                 self._plane["register_batch_max"], len(actors))
+        # crash point: WAL record durable, client reply NOT sent — the
+        # retried batch after restart must be absorbed by per-actor-id
+        # idempotency, not double-registered (tests/test_gcs_ft.py)
+        _fi.maybe_crash("gcs.after_wal_append")
         node_ids = self._schedule_actors(to_schedule)
         for result, ent in zip(results, actors):
             if result["ok"] and "node_id" not in result:
@@ -1709,6 +1715,11 @@ class GcsServer(RpcServer):
                 return {"ok": False}
             table[key] = value
             self._log("kv", (ns, key), value)
+        # crash point BEFORE the fault-plan self-apply below: a plan
+        # arriving through this very handler must not trip its own crash
+        # rule on the write that installs it — only the NEXT WAL append
+        # (e.g. the retried durable put) can fire
+        _fi.maybe_crash("gcs.after_wal_append")
         if ns == _fi.KV_NS and key == _fi.KV_KEY:
             # the fault-plan switch key: other processes poll it, the
             # GCS applies it to its own plane at write time (outside the
@@ -1988,6 +1999,10 @@ def main():
     import signal
     import sys
 
+    # role stamp BEFORE construction: crash rules scoped proc="gcs" may
+    # only ever kill a standalone control plane, never a driver-hosted
+    # in-process GcsServer (whose process keeps the "driver" label)
+    _fi.set_process_label("gcs")
     cfg = json.loads(sys.argv[1]) if len(sys.argv) > 1 else {}
     server = GcsServer(
         host=cfg.get("host", "127.0.0.1"),

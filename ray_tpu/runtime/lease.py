@@ -482,10 +482,14 @@ class LeaseManager:
         try:
             client = RpcClient(lease.raylet_addr, timeout=5,
                                label="driver")
-            return client.call("worker_death_info",
+            info = client.call("worker_death_info",
                                worker_id=lease.worker_id) or {}
+            info.setdefault("node_id", lease.node_id)
+            info.setdefault("worker_id", lease.worker_id)
+            return info
         except Exception:  # noqa: BLE001 - node died with the worker
-            return {}
+            return {"node_unreachable": True, "node_id": lease.node_id,
+                    "worker_id": lease.worker_id}
         finally:
             if client is not None:
                 client.close()
@@ -520,7 +524,8 @@ class LeaseManager:
             task["max_retries"] -= 1
             self.submit(task)
         else:
-            self._on_task_failed(task, error)
+            self._on_task_failed(
+                task, _typed_death_error(task, error, death_info))
 
     # ------------------------------------------------------------------
 
@@ -561,3 +566,29 @@ class LeaseManager:
             if client is not None:
                 client.close()
         return ("running", task)
+
+
+def _typed_death_error(task: dict, error: BaseException,
+                       death_info: dict) -> BaseException:
+    """Death-boundary error taxonomy: a crashed peer surfaces as
+    NodeDiedError / WorkerCrashedError (carrying node/worker identity
+    and the injected crash point when there is one), never a bare
+    transport ConnectionLost/TimeoutError whose redial deadline happens
+    to be the thing that fired."""
+    from ray_tpu.utils import exceptions as exc
+
+    if isinstance(error, exc.RayTpuError):
+        return error
+    name = task.get("name", "?")
+    if death_info.get("node_unreachable"):
+        return exc.NodeDiedError(
+            death_info.get("node_id"),
+            f"raylet unreachable while task {name!r} was leased there "
+            f"({error!r})")
+    reason = f"worker died while running task {name!r}"
+    if death_info.get("crash_point"):
+        reason += f" at crash point {death_info['crash_point']}"
+    if death_info.get("last_words"):
+        last = " | ".join(death_info["last_words"][-2:])
+        reason += f"; last words: {last}"
+    return exc.WorkerCrashedError(f"{reason} ({error!r})")

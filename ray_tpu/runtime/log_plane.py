@@ -545,9 +545,27 @@ _ERR_RE = re.compile(
     r"|^\s*[A-Za-z_][\w.]*(Error|Exception|Interrupt|Exit)\b\s*(:|$)")
 _NORM_NUM = re.compile(r"0x[0-9a-fA-F]+|\b[0-9a-f]{8,}\b|\d+")
 
+# Last words of a fault-injected process death. FaultPlane._die writes
+# this marker straight to fd 2 before os._exit/SIGKILL, so it is the one
+# record a killed process leaves behind (no flight-recorder dump, no
+# atexit). Keep in sync with fault_injection.CRASH_MARKER.
+_CRASH_MARKER = "RAY_TPU_CRASH"
+
 
 def is_error_line(text: str) -> bool:
-    return bool(_ERR_RE.search(text))
+    return bool(_ERR_RE.search(text)) or _CRASH_MARKER in text
+
+
+def crash_point(text: str) -> str | None:
+    """Crash-point name from a ``RAY_TPU_CRASH point=... rule=...`` line
+    (None when the line is not a crash marker)."""
+    pos = text.find(_CRASH_MARKER)
+    if pos < 0:
+        return None
+    for tok in text[pos:].split():
+        if tok.startswith("point="):
+            return tok[len("point="):]
+    return "?"
 
 
 def error_signature(text: str) -> str:
@@ -653,13 +671,27 @@ class LogStore:
         return accepted
 
     def _group_locked(self, rec: dict):
-        sig = error_signature(rec["line"])
+        line = rec["line"]
+        point = crash_point(line)
+        if point is not None:
+            # group crash deaths by crash point, not by raw text — the
+            # marker may ride the tail of an unterminated stdout line,
+            # and pid/rule ids vary per death
+            pos = line.find(_CRASH_MARKER)
+            sig = error_signature(line[pos:])
+            kind = "crash"
+        else:
+            sig = error_signature(line)
+            kind = "error"
         g = self._groups.get(sig)
         if g is None:
             g = self._groups[sig] = {
-                "signature": sig, "sample": rec["line"], "count": 0,
+                "signature": sig, "kind": kind, "sample": line,
+                "count": 0,
                 "first_ts": rec["ts"], "last_ts": rec["ts"],
                 "procs": set(), "traces": set(), "tasks": set()}
+            if point is not None:
+                g["crash_point"] = point
             while len(self._groups) > self._max_groups:
                 self._groups.popitem(last=False)
         else:

@@ -562,9 +562,15 @@ class Raylet(RpcServer):
             task["max_retries"] -= 1
             self._enqueue(task)
         else:
-            self._store_task_error(
-                task, RuntimeError(
-                    f"worker died executing {task.get('name')}"))
+            from ray_tpu.utils import exceptions as exc
+            info = self.workers.death_info(w.worker_id) or {}
+            reason = f"worker died executing {task.get('name')}"
+            if info.get("crash_point"):
+                reason += f" at crash point {info['crash_point']}"
+            if info.get("last_words"):
+                reason += ("; last words: "
+                           + " | ".join(info["last_words"][-2:]))
+            self._store_task_error(task, exc.WorkerCrashedError(reason))
 
     def _store_task_error(self, task: dict, error: BaseException):
         from ray_tpu.utils import exceptions as exc
@@ -1482,6 +1488,11 @@ class Raylet(RpcServer):
 def main():  # runs a raylet as a standalone process (cluster_utils spawns it)
     import json
     import signal
+
+    from ray_tpu.runtime import fault_injection as _fi
+    # role stamp BEFORE construction: crash rules scoped proc="raylet"
+    # may only ever kill external raylet processes like this one
+    _fi.set_process_label("raylet")
     cfg = json.loads(sys.argv[1])
     raylet = Raylet(
         node_id=cfg["node_id"],

@@ -408,6 +408,14 @@ class RpcClient:
             pending = list(self._pending.values())
             self._pending.clear()
             self._closed = True
+        # release the fd NOW: a client whose peer was SIGKILLed sits in
+        # per-address caches as a dead entry until eviction, and a
+        # fault-churned cluster (chaos soak) leaks one fd per killed
+        # peer otherwise
+        try:
+            self._sock.close()
+        except OSError:
+            pass
         for ev_reply in pending:
             _tracing.call_finished(ev_reply[3])
             ev_reply[1] = {"error": ConnectionLost(

@@ -22,6 +22,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 
+from ray_tpu.runtime import fault_injection as _fi
 from ray_tpu.runtime.gcs import _fits
 from ray_tpu.runtime.rpc import send_msg
 
@@ -396,6 +397,10 @@ class TaskScheduler:
                 with pool.lock:
                     worker.state = "idle"
                 continue
+            # crash point: waiter claimed, resources acquired, grant not
+            # yet sent — the owner's retry must land on a respawned node
+            # or spill elsewhere (chaos soak raylet class)
+            _fi.maybe_crash("raylet.before_lease_grant")
             with pool.lock:
                 worker.state = "leased"
                 worker.acquired = dict(waiter["demand"])

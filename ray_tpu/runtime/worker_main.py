@@ -314,7 +314,8 @@ class Worker:
         self.raylet_addr = (host, port)
         from ray_tpu.runtime import fault_injection as _fi
         _fi.maybe_init_from_config((os.environ["RAY_TPU_GCS_HOST"],
-                                    int(os.environ["RAY_TPU_GCS_PORT"])))
+                                    int(os.environ["RAY_TPU_GCS_PORT"])),
+                                   process_label="worker")
         self.store = ShmObjectStore(os.environ["RAY_TPU_STORE_NAME"])
         # control client: request/response to the raylet (ensure_local etc.)
         self.ctrl = RpcClient(self.raylet_addr, label="worker")
@@ -884,6 +885,12 @@ class Worker:
             self._report_task_event(task, started, False)
             return
         def _call():
+            from ray_tpu.runtime import fault_injection as _fi
+
+            # crash point: args resolved, function loaded, mid-execution
+            # — the owner's lease channel breaks with no reply and the
+            # retry/typed-error path must cover it (chaos worker class)
+            _fi.maybe_crash("worker.mid_task")
             result = fn(*args, **kwargs)
             if _iscoroutine(result):
                 # async def remote function: drive it to completion
@@ -1121,8 +1128,14 @@ class Worker:
             try:
                 from ray_tpu.util import tracing as _tracing
 
+                from ray_tpu.runtime import fault_injection as _fi
+
                 args, kwargs = self._resolve_args(task)
                 method = getattr(self.actor_instance, task["method_name"])
+                # crash point: actor method about to run — exercises the
+                # actor RESTARTING/DEAD reconciliation + typed
+                # ActorDiedError surfacing at the caller
+                _fi.maybe_crash("worker.mid_actor_task")
                 with _tracing.execution_span(task.get("name", "?"),
                                              task.get("trace_ctx")), \
                         _tracing.inflight("actor_task",

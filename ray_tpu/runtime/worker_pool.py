@@ -279,13 +279,21 @@ class WorkerPool:
         node = self._node
         if node._stopping:
             return
+        info = {"oom_killed": w.oom_killed}
+        if w.proc is not None:
+            info["exit_code"] = w.proc.poll()
+        # SIGKILL leaves no flight-recorder dump: the raw .err redirect
+        # holds the interpreter-level last words (and the fault plane's
+        # injected-crash marker) — harvest them into death info so the
+        # lease/actor layers can surface a typed, attributed error
+        info.update(_last_words(w.log_err))
         with self.lock:
             if w.state == "dead":
                 return  # channel reader and monitor both report deaths
             prior_state = w.state
             w.state = "dead"
             self.workers.pop(w.worker_id, None)
-            self._death_info[w.worker_id] = {"oom_killed": w.oom_killed}
+            self._death_info[w.worker_id] = info
             while len(self._death_info) > 256:
                 self._death_info.pop(next(iter(self._death_info)))
         # reclaim created-but-unsealed allocations and pinned read refs of
@@ -297,15 +305,23 @@ class WorkerPool:
         node._release(w.acquired)
         w.acquired = {}
         if prior_state == "actor" and w.actor_id is not None:
+            reason = f"actor worker {w.worker_id[:8]} died"
+            if info.get("crash_point"):
+                reason += f" at crash point {info['crash_point']}"
             try:
                 with node._gcs_lock:
                     node._gcs.call(
                         "actor_failed", actor_id=w.actor_id,
-                        reason=f"actor worker {w.worker_id[:8]} died")
+                        reason=reason)
             except Exception:  # noqa: BLE001 - gcs may be shutting down
                 pass
         elif task is not None:
             node._retry_or_fail_dead_worker_task(w, task)
+        # proactive respawn: a crashed worker whose slot had parked lease
+        # waiters (or a leased channel an owner will re-acquire) should
+        # not wait for the next demand-driven spawn — kick the dispatch
+        # loop so _serve_lease_waiters spawns/grants a replacement now
+        node._kick_dispatch()
 
     def death_info(self, worker_id: str) -> dict | None:
         with self.lock:
@@ -653,3 +669,33 @@ def _worker_pythonpath(current: str) -> str:
             continue
         entries.append(p)
     return os.pathsep.join(entries)
+
+
+def _last_words(path: str | None, nbytes: int = 4096) -> dict:
+    """Tail a dead worker's raw ``.err`` redirect: the last non-empty
+    lines plus the injected crash-point name when the fault plane killed
+    it (SIGKILL leaves no flight-recorder dump; the redirect is all
+    there is)."""
+    if not path:
+        return {}
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - nbytes))
+            tail = f.read().decode("utf-8", "replace")
+    except OSError:
+        return {}
+    lines = [ln.strip() for ln in tail.splitlines() if ln.strip()]
+    if not lines:
+        return {}
+    out: dict = {"last_words": lines[-6:]}
+    from ray_tpu.runtime import fault_injection as _fi
+
+    for ln in reversed(lines):
+        if _fi.CRASH_MARKER in ln:
+            for part in ln.split():
+                if part.startswith("point="):
+                    out["crash_point"] = part[6:]
+            break
+    return out

@@ -113,6 +113,12 @@ class _Replica:
                                     token):
         import inspect
 
+        from ray_tpu.runtime import fault_injection as _fi
+
+        # crash point: request admitted and counted in-flight — the
+        # router must fail callers typed-fast and the controller's
+        # health probes must replace this replica (chaos replica class)
+        _fi.maybe_crash("replica.mid_request")
         try:
             target = (self._instance if method_name == "__call__"
                       else getattr(self._instance, method_name))
@@ -171,11 +177,17 @@ class _Replica:
             self._set_ongoing_gauge()
 
         def pump():
+            from ray_tpu.runtime import fault_injection as _fi
+
             token = set_request_model_id(model_id)
             try:
                 target = (self._instance if method_name == "__call__"
                           else getattr(self._instance, method_name))
                 for chunk in target(*args, **kwargs):
+                    # crash point: mid-stream, chunks already delivered —
+                    # the consumer's next_chunks call must fail typed-
+                    # fast, not hang out a redial window
+                    _fi.maybe_crash("replica.mid_decode")
                     q.put(("chunk", chunk), timeout=60.0)
                 q.put(("end", None), timeout=60.0)
             except _q.Full:  # consumer gone: abandon the stream
@@ -288,6 +300,17 @@ class _Replica:
     def ping(self):
         return True
 
+    def drain(self):
+        """Scale-down prep: retract this replica's prefix digest so the
+        affinity router stops steering new prefixes here, and report the
+        in-flight count the controller waits on before killing us. The
+        route-table version bump already stopped new admissions; any
+        straggler from a stale table still gets served."""
+        from ray_tpu.runtime import metrics_plane as _mp
+        _mp.set_annex(f"serve/prefix_digest/{self._tag}", None)
+        with self._lock:
+            return self._ongoing
+
 
 class ServeController:
     """Named actor ('SERVE_CONTROLLER'). Deployment lifecycle + replica
@@ -302,6 +325,12 @@ class ServeController:
         # request path) and PUSHED to handles inside the routing table,
         # replacing each handle's own per-request 1s-TTL replica sweep
         self._models_polled_at = 0.0
+        # proactive failover: periodic replica health probes; each
+        # detected death is recorded for MTTR accounting and stamped
+        # replaced_at when the reconciler admits the replacement
+        self._probed_at = 0.0
+        self._probes = 0
+        self._crash_events: list[dict] = []
         self._loop = threading.Thread(target=self._control_loop, daemon=True)
         self._loop.start()
 
@@ -316,6 +345,23 @@ class ServeController:
                 return {}
             return {"asgi": bool(dep["config"].get("asgi"))}
 
+    @staticmethod
+    def _same_spec(prev, cls_blob, init_args, init_kwargs,
+                   config) -> bool:
+        """True when only the replica COUNT differs: that is a scale
+        event (graceful drain / spawn), not a code change, and must not
+        tear down live replicas. Unpicklable/odd arg objects fail the
+        comparison and fall back to the redeploy path (conservative)."""
+        try:
+            strip = lambda c: {k: v for k, v in c.items()  # noqa: E731
+                               if k != "num_replicas"}
+            return (prev["cls_blob"] == cls_blob
+                    and prev["init_args"] == init_args
+                    and prev["init_kwargs"] == init_kwargs
+                    and strip(prev["config"]) == strip(config))
+        except Exception:  # noqa: BLE001 - uncomparable: full redeploy
+            return False
+
     def deploy(self, name: str, cls_blob: bytes, init_args, init_kwargs,
                config: dict):
         with self._lock:
@@ -329,13 +375,17 @@ class ServeController:
                 "tags": prev["tags"] if prev else [],
                 "models": prev["models"] if prev else {},
                 "next_idx": prev["next_idx"] if prev else 0,
+                "draining": prev.get("draining", []) if prev else [],
+                "replaced": prev.get("replaced", 0) if prev else 0,
+                "probe_failures": {},
                 "autoscale_mode": None,
                 "target": (config.get("autoscaling") or {}).get(
                     "min_replicas", config.get("num_replicas", 1))
                 if config.get("autoscaling")
                 else config.get("num_replicas", 1),
                 "last_scale": time.monotonic(),
-                "redeploy": prev is not None,
+                "redeploy": prev is not None and not self._same_spec(
+                    prev, cls_blob, init_args, init_kwargs, config),
             }
             self._version += 1
         return True
@@ -347,6 +397,8 @@ class ServeController:
         if dep:
             for r in dep["replicas"]:
                 _kill_quietly(r)
+            for ent in dep.get("draining", ()):
+                _kill_quietly(ent["replica"])
         return True
 
     def get_replicas(self, name: str):
@@ -393,13 +445,31 @@ class ServeController:
         for dep in deps:
             for r in dep["replicas"]:
                 _kill_quietly(r)
+            for ent in dep.get("draining", ()):
+                _kill_quietly(ent["replica"])
         return True
+
+    def failover_stats(self):
+        """Replica-failover accounting for the chaos soak's MTTR: one
+        event per probed-out replica with detection and replacement
+        timestamps, plus per-deployment replacement totals."""
+        with self._lock:
+            return {
+                "events": [dict(e) for e in self._crash_events],
+                "replaced": {n: d.get("replaced", 0)
+                             for n, d in self._deployments.items()},
+                "draining": {n: len(d.get("draining", ()))
+                             for n, d in self._deployments.items()},
+                "probes": self._probes,
+            }
 
     # -- reconciliation --------------------------------------------------
     def _control_loop(self):
         while not self._stop:
             try:
                 self._reconcile_once()
+                self._drain_once()
+                self._health_probe_once()
                 self._poll_models_once()
                 self._autoscale_once()
             except Exception:  # noqa: BLE001 - keep the loop alive
@@ -443,13 +513,112 @@ class ServeController:
                 dep["tags"].append(tag)
                 with self._lock:
                     self._version += 1
+                    # a spawn while crash events are pending IS the
+                    # replacement: stamp the oldest unreplaced one
+                    for ev in self._crash_events:
+                        if (ev["deployment"] == name
+                                and ev["replaced_at"] is None):
+                            ev["replaced_at"] = time.time()
+                            break
             while len(replicas) > target:
+                # graceful scale-down: unpublish the route first (the
+                # version bump stops new admissions), let in-flight
+                # requests finish; _drain_once kills when ongoing hits
+                # zero or the drain deadline passes
                 victim = replicas.pop()
                 tag = dep["tags"].pop() if dep["tags"] else None
                 dep["models"].pop(tag, None)
-                _kill_quietly(victim)
+                dep.setdefault("draining", []).append(
+                    {"replica": victim, "tag": tag,
+                     "since": time.monotonic(), "drained": False})
                 with self._lock:
                     self._version += 1
+
+    def _drain_once(self):
+        from ray_tpu.utils import exceptions
+        from ray_tpu.utils.config import get_config
+        cfg = get_config()
+        with self._lock:
+            items = list(self._deployments.items())
+        for _name, dep in items:
+            keep = []
+            for ent in dep.get("draining", ()):
+                r = ent["replica"]
+                try:
+                    if not ent["drained"]:
+                        # one-shot: retract the prefix digest, get the
+                        # in-flight count to wait on
+                        ongoing = ray_tpu.get(r.drain.remote(), timeout=2)
+                        ent["drained"] = True
+                    else:
+                        ongoing = ray_tpu.get(
+                            r.metrics.remote(), timeout=2)["ongoing"]
+                except exceptions.ActorError:
+                    ongoing = 0    # already dead: reap the handle
+                except Exception:  # noqa: BLE001 - busy/slow, NOT dead
+                    # a replica mid-request can miss the poll timeout;
+                    # only the drain deadline may condemn it
+                    ongoing = 1
+                deadline = ent["since"] + cfg.serve_drain_timeout_s
+                if ongoing <= 0 or time.monotonic() > deadline:
+                    _kill_quietly(r)
+                else:
+                    keep.append(ent)
+            dep["draining"] = keep
+
+    def _health_probe_once(self):
+        """Proactively ping every replica; replace ones that died
+        instead of waiting for a request to trip over the corpse. A
+        typed actor-death error is immediate; bare timeouts must repeat
+        ``serve_health_probe_failures`` times (a busy replica is slow,
+        not dead)."""
+        from ray_tpu.utils import exceptions as exc
+        from ray_tpu.utils.config import get_config
+        cfg = get_config()
+        if not cfg.serve_health_probing_enabled:
+            return
+        now = time.monotonic()
+        if now - self._probed_at < cfg.serve_health_probe_period_s:
+            return
+        self._probed_at = now
+        with self._lock:
+            items = list(self._deployments.items())
+        for name, dep in items:
+            fails = dep.setdefault("probe_failures", {})
+            for r, tag in list(zip(dep["replicas"], dep["tags"])):
+                dead = False
+                self._probes += 1
+                try:
+                    ray_tpu.get(r.ping.remote(),
+                                timeout=cfg.serve_health_probe_timeout_s)
+                    fails.pop(tag, None)
+                except exc.ActorError:
+                    dead = True
+                except Exception:  # noqa: BLE001 - timeout/transport
+                    fails[tag] = fails.get(tag, 0) + 1
+                    dead = fails[tag] >= cfg.serve_health_probe_failures
+                if dead:
+                    self._bury_replica(name, dep, r, tag)
+
+    def _bury_replica(self, name: str, dep: dict, replica, tag):
+        """Drop a crashed replica from the route set NOW (the version
+        bump makes stale handles re-pull and fail in-flight calls fast)
+        and leave len(replicas) < target for the reconciler to refill."""
+        with self._lock:
+            try:
+                i = dep["tags"].index(tag)
+            except ValueError:
+                return    # already buried by a racing path
+            dep["replicas"].pop(i)
+            dep["tags"].pop(i)
+            dep["models"].pop(tag, None)
+            dep.setdefault("probe_failures", {}).pop(tag, None)
+            dep["replaced"] = dep.get("replaced", 0) + 1
+            self._version += 1
+            self._crash_events.append({
+                "deployment": name, "tag": tag,
+                "detected_at": time.time(), "replaced_at": None})
+        _kill_quietly(replica)
 
     def _poll_models_once(self, interval_s: float = 0.25):
         """Refresh each replica's multiplexed model-id set (throttled).

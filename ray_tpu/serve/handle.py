@@ -16,6 +16,7 @@ import time
 
 import ray_tpu
 from ray_tpu.util import tracing as _tracing
+from ray_tpu.utils.exceptions import ActorError, ReplicaDiedError
 
 
 class DeploymentHandle:
@@ -70,12 +71,21 @@ class DeploymentHandle:
             for mid in e["models"]:
                 model_map.setdefault(mid, []).append(e["replica"])
         with self._lock:
+            old_tags = set(self._tags.values())
             self._replicas = replicas
             self._tags = {e["replica"]: e["tag"] for e in table}
             self._model_map = model_map
             self._version = version
             self._checked_at = now
             self._inflight = {r: self._inflight.get(r, []) for r in replicas}
+            gone = old_tags - set(self._tags.values())
+            router = self._router
+        # tags the controller dropped (crash/drain) lose their prefix-
+        # digest routing entries immediately — the annex TTL would keep
+        # steering warm prefixes at a corpse for seconds otherwise
+        if router is not None:
+            for tag in gone:
+                router.forget(tag)
 
     def _evict(self, replica):
         """Drop a failed replica from every routing structure NOW: the
@@ -216,8 +226,9 @@ class DeploymentHandle:
                         self._version = -1
                     time.sleep(0.05 * attempt)
                     self._refresh(ttl=0)
-        raise RuntimeError(
-            f"could not route request to {self.deployment_name!r}: {last!r}")
+        raise ReplicaDiedError(
+            deployment=self.deployment_name,
+            reason=f"could not route request after 5 attempts: {last!r}")
 
     def stream(self, *args, **kwargs):
         """Call a GENERATOR method and iterate its chunks as they are
@@ -254,14 +265,26 @@ class DeploymentHandle:
                 time.sleep(0.05 * attempt)
                 self._refresh(ttl=0)
         else:
-            raise RuntimeError(
-                f"could not start stream on {self.deployment_name!r}: "
-                f"{last!r}")
+            raise ReplicaDiedError(
+                deployment=self.deployment_name,
+                reason=f"could not start stream after 5 attempts: {last!r}")
+
+        with self._lock:
+            tag = self._tags.get(replica)
 
         def gen():
             while True:
-                state, chunks = ray_tpu.get(
-                    replica.next_chunks.remote(stream_id))
+                try:
+                    state, chunks = ray_tpu.get(
+                        replica.next_chunks.remote(stream_id))
+                except ActorError as e:
+                    # replica died mid-stream: fail the consumer fast
+                    # with a typed error (a retry cannot resume a half-
+                    # emitted stream) and stop routing at the corpse
+                    self._evict(replica)
+                    raise ReplicaDiedError(
+                        tag, self.deployment_name,
+                        reason=f"died mid-stream: {e!r}") from e
                 yield from chunks
                 if state == "end":
                     return
@@ -272,9 +295,8 @@ class DeploymentHandle:
         """Sync convenience: remote + get. A replica torn down mid-request
         (redeploy/downscale) surfaces at get(); retry against the
         refreshed replica set (reference: router resend on replica death)."""
-        from ray_tpu.utils.exceptions import ActorError
-
         last = None
+        tag = None
         for attempt in range(3):
             ref = None
             try:
@@ -284,11 +306,17 @@ class DeploymentHandle:
                 last = e
                 owner = self._owner_of(ref) if ref is not None else None
                 if owner is not None:
+                    with self._lock:
+                        tag = self._tags.get(owner, tag)
                     self._evict(owner)
                 with self._lock:
                     self._version = -1
                 time.sleep(0.05 * (attempt + 1))
-        raise last
+        if isinstance(last, ReplicaDiedError):
+            raise last
+        raise ReplicaDiedError(
+            tag, self.deployment_name,
+            reason=f"call failed after 3 attempts: {last!r}") from last
 
     def _owner_of(self, ref):
         with self._lock:

@@ -333,6 +333,19 @@ class Config:
     # A digest older than this is ignored by the router (replica dead
     # or metrics plane partitioned — fall back to p2c).
     serve_digest_ttl_s: float = 5.0
+    # Proactive replica health probing: the controller pings every
+    # replica on this period and replaces ones that stop answering,
+    # instead of waiting for a request to trip over the corpse.
+    serve_health_probing_enabled: bool = True
+    serve_health_probe_period_s: float = 0.5
+    serve_health_probe_timeout_s: float = 1.0
+    # Consecutive probe timeouts before a replica is declared dead
+    # (a typed actor-death error from the runtime is immediate).
+    serve_health_probe_failures: int = 3
+    # Scale-down grace: a draining replica keeps serving its in-flight
+    # requests (digest retracted, route unpublished) up to this long
+    # before the controller kills it anyway.
+    serve_drain_timeout_s: float = 5.0
 
     # --- envelope / benchmark tiers (tests/test_envelope*.py) ---
     envelope_actors: int = 200

@@ -52,13 +52,67 @@ class ActorError(RayTpuError):
 class ActorDiedError(ActorError):
     """The actor process died (or was killed) before/while executing the call."""
 
-    def __init__(self, actor_id=None, reason: str = "actor died"):
+    def __init__(self, actor_id=None, reason: str = "actor died",
+                 restart_count: int = 0):
         self.actor_id = actor_id
-        super().__init__(f"Actor {actor_id} unavailable: {reason}")
+        self.reason = reason
+        self.restart_count = restart_count
+        tail = (f" (restarted {restart_count}x)" if restart_count else "")
+        super().__init__(f"Actor {actor_id} unavailable: {reason}{tail}")
+
+    def __reduce__(self):
+        return (ActorDiedError,
+                (self.actor_id, self.reason, self.restart_count))
 
 
 class ActorUnavailableError(ActorError):
     """The actor is temporarily unreachable (restarting)."""
+
+
+class NodeDiedError(RayTpuError):
+    """The node hosting the work died (raylet process gone / heartbeat
+    lost). Carries the node id and how many times the cluster supervisor
+    has respawned raylets so far — a crashed peer must surface as this,
+    never as a bare redial-deadline ``TimeoutError`` (reference:
+    ``NodeDiedError`` / ``RayletDiedError``)."""
+
+    def __init__(self, node_id=None, reason: str = "node died",
+                 restart_count: int = 0):
+        self.node_id = node_id
+        self.reason = reason
+        self.restart_count = restart_count
+        tail = (f" (node respawned {restart_count}x)"
+                if restart_count else "")
+        super().__init__(f"Node {node_id} died: {reason}{tail}")
+
+    def __reduce__(self):
+        return (NodeDiedError,
+                (self.node_id, self.reason, self.restart_count))
+
+
+class ReplicaDiedError(ActorError):
+    """A serve replica died while (or before) handling the request. The
+    router raises this typed-fast for in-flight requests instead of
+    letting them ride a transport redial window; carries the replica tag
+    and the deployment's replacement count so callers can tell a one-off
+    crash from a crash loop."""
+
+    def __init__(self, replica_tag=None, deployment=None,
+                 reason: str = "replica died", restart_count: int = 0):
+        self.replica_tag = replica_tag
+        self.deployment = deployment
+        self.reason = reason
+        self.restart_count = restart_count
+        tail = (f" (deployment replaced {restart_count} replicas)"
+                if restart_count else "")
+        super().__init__(
+            f"Replica {replica_tag} of {deployment!r} died: "
+            f"{reason}{tail}")
+
+    def __reduce__(self):
+        return (ReplicaDiedError,
+                (self.replica_tag, self.deployment, self.reason,
+                 self.restart_count))
 
 
 class ObjectLostError(RayTpuError):

@@ -1,0 +1,273 @@
+"""Crash chaos plane (round 10): seeded process-kill rules, typed
+surfacing of injected deaths, worker/replica supervision, graceful
+drain, and a fixed-seed smoke soak over the conservation invariants.
+
+The full multi-seed soak with the raylet/GCS classes runs nightly
+(ci/run_ci.sh --nightly via scripts/run_chaos_soak.py); this module is
+the tier-1 fence."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.runtime import fault_injection as fi
+from ray_tpu.utils import exceptions as exc
+
+
+# ----------------------------------------------------------------------
+# unit: the crash rule engine through the test seam (no real deaths)
+# ----------------------------------------------------------------------
+
+def _plane_with(rules, label="worker", seed=0):
+    plane = fi.FaultPlane()
+    plane.process_label = label
+    plane.load_plan({"version": 1, "seed": seed, "rules": rules})
+    return plane
+
+
+def test_crash_rule_fires_on_exactly_the_nth_hit():
+    plane = _plane_with([{"id": "r", "fault": "crash",
+                          "point": "worker.mid_task", "proc": "worker",
+                          "nth": 3}])
+    died = []
+    plane.set_crash_handler(lambda point, rule: died.append(
+        (point, rule.rid)))
+    for _ in range(2):
+        plane.maybe_crash("worker.mid_task")
+    assert died == []
+    plane.maybe_crash("worker.mid_task")
+    assert died == [("worker.mid_task", "r")]
+    plane.maybe_crash("worker.mid_task")   # nth fires ONCE, not >=
+    assert len(died) == 1
+
+
+def test_crash_rule_scopes_by_proc_and_globs_points():
+    plane = _plane_with([{"id": "g", "fault": "crash",
+                          "point": "replica.mid_*", "proc": "worker"}],
+                        label="gcs")
+    died = []
+    plane.set_crash_handler(lambda p, r: died.append(p))
+    plane.maybe_crash("replica.mid_decode")   # wrong proc label
+    assert died == []
+    plane.process_label = "worker"
+    plane.maybe_crash("raylet.before_lease_grant")   # point mismatch
+    assert died == []
+    plane.maybe_crash("replica.mid_decode")
+    plane.maybe_crash("replica.mid_request")
+    assert died == ["replica.mid_decode", "replica.mid_request"]
+
+
+def test_crash_rule_probability_is_seeded_and_replayable():
+    def firing_indices(seed):
+        plane = _plane_with([{"id": "p", "fault": "crash", "point": "x",
+                              "p": 0.5}], seed=seed)
+        fired = []
+        plane.set_crash_handler(lambda p, r: fired.append(True))
+        out = []
+        for i in range(64):
+            n = len(fired)
+            plane.maybe_crash("x")
+            if len(fired) > n:
+                out.append(i)
+        return out
+
+    a, b = firing_indices(7), firing_indices(7)
+    assert a == b and a, "same seed must replay the same schedule"
+    assert firing_indices(8) != a, "different seed, different schedule"
+
+
+def test_crash_marker_format_survives_to_handler():
+    plane = _plane_with([{"id": "m", "fault": "crash", "point": "x"}])
+    seen = {}
+    plane.set_crash_handler(lambda p, r: seen.update(point=p, rid=r.rid))
+    plane.maybe_crash("x")
+    assert seen == {"point": "x", "rid": "m"}
+    # the marker the real _die path writes is what the log plane keys on
+    assert fi.CRASH_MARKER == "RAY_TPU_CRASH"
+
+
+# ----------------------------------------------------------------------
+# integration: real injected deaths on a live cluster
+# ----------------------------------------------------------------------
+
+@pytest.fixture
+def chaos_cluster(monkeypatch):
+    from ray_tpu import serve
+
+    monkeypatch.setenv("RAY_TPU_FAULT_INJECTION_ENABLED", "1")
+    ray_tpu.shutdown()
+    fi.plane.clear()
+    c = Cluster(heartbeat_timeout_s=2.0)
+    c.add_node(num_cpus=4)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    serve.shutdown()
+    fi.put_plan(c.gcs_address, {"version": 99, "rules": []})
+    ray_tpu.shutdown()
+    fi.stop_kv_watcher()
+    c.shutdown()
+    fi.plane.clear()
+
+
+def test_worker_crash_surfaces_typed_error_and_crash_group(chaos_cluster):
+    c = chaos_cluster
+    from ray_tpu.util import state as state_api
+
+    @ray_tpu.remote
+    def victim(x):
+        return x * 2
+
+    assert ray_tpu.get(victim.remote(2), timeout=30) == 4   # warm pool
+
+    fi.put_plan(c.gcs_address, {"version": 1, "rules": [
+        {"id": "midtask", "fault": "crash", "point": "worker.mid_task",
+         "proc": "worker", "nth": 1}]})
+    time.sleep(0.4)   # workers poll the KV plan key
+
+    # every crashed call resolves with a TYPED error, never a bare
+    # timeout and never a wedge (the conservation invariant)
+    with pytest.raises(exc.RayTpuError) as ei:
+        ray_tpu.get(victim.remote(3), timeout=30)
+    assert not isinstance(ei.value, TimeoutError)
+
+    fi.put_plan(c.gcs_address, {"version": 2, "rules": []})
+    # the pool respawns the crashed worker: new work flows
+    assert ray_tpu.get(victim.remote(5), timeout=30) == 10
+
+    # last-words harvest: the raw-fd marker became a trace-linked
+    # 'crash' group naming the crash point
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        crash = [g for g in state_api.summarize_errors()
+                 if g.get("kind") == "crash"
+                 and g.get("crash_point") == "worker.mid_task"]
+        if crash:
+            break
+        time.sleep(0.2)
+    assert crash, "no crash group for worker.mid_task in summarize_errors"
+    assert crash[0]["count"] >= 1
+
+
+def test_replica_crash_failover_replaces_and_call_survives(chaos_cluster):
+    c = chaos_cluster
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Echo.bind(), name="echo_failover")
+    assert h.call(1) == 1
+
+    fi.put_plan(c.gcs_address, {"version": 1, "rules": [
+        {"id": "midreq", "fault": "crash", "point": "replica.mid_request",
+         "proc": "worker", "nth": 1, "max_hits": 1}]})
+    time.sleep(0.4)
+
+    # the handling replica dies mid-request; the caller either gets an
+    # answer via retry against a survivor or a TYPED fast-fail — while
+    # the plan stays armed every fresh replica's FIRST request crashes
+    # too (per-process nth), so both outcomes are legal. What is never
+    # legal: a wedge or a bare timeout.
+    t0 = time.monotonic()
+    try:
+        assert h.call(7) == 7
+    except exc.ReplicaDiedError:
+        pass
+    assert time.monotonic() - t0 < 30
+
+    fi.put_plan(c.gcs_address, {"version": 2, "rules": []})
+
+    # the controller's probe buries the corpse and the reconciler
+    # replaces it; failover_stats records detection AND recovery
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        stats = ray_tpu.get(controller.failover_stats.remote(),
+                            timeout=10)
+        done = [e for e in stats["events"]
+                if e["deployment"] == "echo_failover"
+                and e.get("replaced_at") is not None]
+        if done:
+            break
+        time.sleep(0.2)
+    assert done, f"no completed replacement in failover_stats: {stats}"
+    assert stats["replaced"].get("echo_failover", 0) >= 1
+    # steady state returns once the plan is cleared (replacements may
+    # briefly still be dying from pre-clear requests)
+    deadline = time.monotonic() + 20
+    while True:
+        try:
+            assert h.call(9) == 9
+            break
+        except exc.ReplicaDiedError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.3)
+
+
+def test_scale_down_drains_gracefully_without_killing_inflight(
+        chaos_cluster):
+    """The drain guarantee: a scale-down victim finishes its in-flight
+    request before the controller kills it — the caller never sees a
+    ReplicaDiedError for a deliberate downscale."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Slow:
+        def __call__(self, delay):
+            time.sleep(delay)
+            return "done"
+
+    h = serve.run(Slow.bind(), name="drain_me")
+    assert h.call(0.01) == "done"
+
+    # park one slow request on EACH replica so the drain victim
+    # (whichever the controller picks) is mid-request when scaled down
+    refs = [h.remote(2.0) for _ in range(4)]
+    time.sleep(0.3)
+    serve.run(Slow.options(num_replicas=1).bind(), name="drain_me")
+    assert [ray_tpu.get(r, timeout=30) for r in refs] == ["done"] * 4
+
+    # the deployment settles at 1 replica and still serves
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        _, table = ray_tpu.get(controller.get_routing_table.remote(
+            "drain_me"), timeout=10)
+        stats = ray_tpu.get(controller.failover_stats.remote(),
+                            timeout=10)
+        if len(table) == 1 and not stats["draining"].get("drain_me"):
+            break
+        time.sleep(0.2)
+    assert len(table) == 1
+    assert h.call(0.01) == "done"
+
+
+# ----------------------------------------------------------------------
+# smoke soak: the nightly harness at tier-1 scale (fixed seed, <=60s)
+# ----------------------------------------------------------------------
+
+def test_smoke_soak_conservation_holds(monkeypatch):
+    from ray_tpu.chaos_soak import run_soak
+
+    ray_tpu.shutdown()
+    fi.plane.clear()
+    # sized for the tier-1 budget: the injection schedule stops
+    # max(6, inject_period) before t_end, so 14s still fits >= 2
+    # windows; the tighter get timeout also shrinks the settle tail
+    # (recovery MTTRs in this config are well under a second)
+    report = run_soak(14.0, seed=11, classes=("worker", "replica"),
+                      partitions=False, inject_period_s=4.0,
+                      get_timeout_s=15.0, log=lambda *a: None)
+    assert report["chaos_soak_invariant_violations"] == 0, \
+        report["violations"]
+    inj = {cls: ent["injections"]
+           for cls, ent in report["per_class"].items()}
+    assert inj.get("worker", 0) + inj.get("replica", 0) >= 2, inj
+    # every submitted op resolved (value or typed error): conservation
+    for name, w in report["workloads"].items():
+        assert w["untyped_errors"] == 0, (name, w)

@@ -11,6 +11,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu.cluster_utils import Cluster
+from ray_tpu.runtime import fault_injection as fi
 
 
 @pytest.fixture
@@ -71,6 +72,106 @@ def test_gcs_restart_preserves_named_actors_and_kv(ft_cluster):
     again = ray_tpu.get_actor("survivor")
     assert ray_tpu.get(again.add.remote(1), timeout=20) == 6
     assert internal_kv.internal_kv_get("durable_key") == b"durable_value"
+
+
+# ----------------------------------------------------------------------
+# crash coverage of the WAL window (round 10): kill the GCS BETWEEN the
+# WAL append and the client reply. The record is durable but the caller
+# never hears back — after the restart the retried request must be
+# absorbed by idempotency, not applied twice.
+# ----------------------------------------------------------------------
+
+@pytest.fixture
+def crash_ft_cluster(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FAULT_INJECTION_ENABLED", "1")
+    ray_tpu.shutdown()
+    fi.plane.clear()
+    # external GCS: the injected death must kill a real process, not
+    # the test interpreter
+    c = Cluster(gcs_fault_tolerance=True, external_gcs=True,
+                heartbeat_timeout_s=2.0)
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.gcs_address)
+    c.start_supervisor(poll_s=0.2)
+    yield c
+    ray_tpu.shutdown()
+    fi.stop_kv_watcher()
+    c.shutdown()
+    fi.plane.clear()
+
+
+def _arm_wal_crash(c):
+    """One crash on the NEXT WAL append. The put installing this plan
+    runs through rpc_kv_put itself, but its crash point is consulted
+    BEFORE the plan self-applies — only the following append can fire."""
+    fi.put_plan(c.gcs_address, {"version": 1, "rules": [
+        {"id": "walcrash", "fault": "crash",
+         "point": "gcs.after_wal_append", "proc": "gcs", "nth": 1}]})
+
+
+def _wait_gcs_respawn(c, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if any(ev["class"] == "gcs" for ev in c.crash_events):
+            return
+        time.sleep(0.1)
+    pytest.fail("supervisor never restarted the crashed GCS")
+
+
+def test_gcs_crash_between_wal_append_and_reply_kv_put(crash_ft_cluster):
+    c = crash_ft_cluster
+    from ray_tpu.experimental import internal_kv
+
+    _arm_wal_crash(c)
+    try:
+        # WAL-logged, then the GCS dies before replying; the client's
+        # redial window may retry into the restarted GCS (where the key
+        # already exists) or burn out — both are fine here
+        internal_kv.internal_kv_put("walkey", b"first", overwrite=False)
+    except Exception:  # noqa: BLE001 - reply lost to the injected crash
+        pass
+    _wait_gcs_respawn(c)
+
+    # durable despite the lost reply: WAL replay restored the write
+    assert internal_kv.internal_kv_get("walkey") == b"first"
+    # the caller-side retry of the unacked put must be ABSORBED (key
+    # exists from replay), never clobber the durable value
+    internal_kv.internal_kv_put("walkey", b"second", overwrite=False)
+    assert internal_kv.internal_kv_get("walkey") == b"first"
+    # the repaired control plane takes new writes
+    internal_kv.internal_kv_put("postcrash", b"ok")
+    assert internal_kv.internal_kv_get("postcrash") == b"ok"
+
+    ev = next(e for e in c.crash_events if e["class"] == "gcs")
+    assert ev["crash_point"] == "gcs.after_wal_append"
+    assert any(fi.CRASH_MARKER in ln for ln in (ev["last_words"] or ()))
+
+
+def test_gcs_crash_between_wal_append_and_reply_register(crash_ft_cluster):
+    c = crash_ft_cluster
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    _arm_wal_crash(c)
+    # the registration frame WAL-logs the actor, then the GCS dies
+    # before acking; the coalescer's redial retries the batch against
+    # the restarted GCS where per-actor-id idempotency absorbs it
+    actor = Counter.options(name="walsurvivor").remote()
+    assert ray_tpu.get(actor.add.remote(5), timeout=60) == 5
+    _wait_gcs_respawn(c)
+
+    # exactly ONE instance: the name resolves to the same live actor
+    # (a double-register would have rejected its own name or spawned a
+    # second instance with fresh state)
+    again = ray_tpu.get_actor("walsurvivor")
+    assert ray_tpu.get(again.add.remote(1), timeout=30) == 6
 
 
 def test_gcs_restart_pending_task_completes(ft_cluster):
