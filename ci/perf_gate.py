@@ -72,6 +72,16 @@ METRICS = {
     "train_goodput_fraction": [
         ("detail", "train_telemetry", "goodput_fraction"),
         ("detail", "goodput_fraction")],
+    # data plane leg (round 11): map_batches scan throughput and
+    # push-based shuffle row rate on a two-node cluster, with
+    # per-stage bytes/s coming from the memory plane's object
+    # accounting (absent in pre-round-11 baselines: skipped)
+    "data_map_batches_gib_per_sec": [
+        ("detail", "data", "map_batches_gib_per_sec"),
+        ("detail", "map_batches_gib_per_sec")],
+    "data_push_shuffle_rows_per_sec": [
+        ("detail", "data", "push_shuffle_rows_per_sec"),
+        ("detail", "push_shuffle_rows_per_sec")],
 }
 
 # LOWER-is-better latency keys (round 7: measured serve TTFT
@@ -157,6 +167,15 @@ METRICS_CEILING = {
         [("detail", "chaos_soak", "probe_overhead", "ratio"),
          ("detail", "probe_overhead", "ratio")],
         0.01),
+    # memory plane (round 11): owner-side accounting tax on a put —
+    # callsite capture + owned-table store probe delta (min-of-k)
+    # amortized over the measured per-put cost must stay under 3%
+    # (ISSUE-17 acceptance fence; same probe methodology as tracing
+    # and log above)
+    "memory_accounting_overhead_ratio": (
+        [("detail", "core", "memory_accounting_overhead", "ratio"),
+         ("detail", "memory_accounting_overhead", "ratio")],
+        0.03),
 }
 
 # train metric paths only exist in full-run docs; the train bench value

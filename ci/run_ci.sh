@@ -52,6 +52,13 @@ if [ "${1:-}" = "--nightly" ]; then
   # cleanup hold disk bounded for the envelope tiers above
   JAX_PLATFORMS=cpu python -m pytest tests/test_log_plane_nightly.py \
     -m nightly -q -s
+  stage "nightly memory leak soak (50k ref churn across 2 raylets, planted leak)"
+  # churns >= 50k owned refs through put/submit/release cycles on a
+  # two-external-raylet cluster: the leak detector must flag ZERO
+  # false positives on the churn (refs die promptly), then flag
+  # exactly the one deliberately-held ref with its creation call site
+  JAX_PLATFORMS=cpu python -m pytest tests/test_memory_leak_nightly.py \
+    -m nightly -q -s
   stage "nightly train telemetry leg (step decomposition + goodput + overhead fence)"
   # telemetry-ON train leg: asserts decomposition sums to step wall and
   # stamping overhead < 1% of steady step wall; the gate re-checks the

@@ -17,6 +17,9 @@ same information surface:
 - ``GET /api/stuck_calls``        cluster-wide in-flight calls past a
                                   threshold; ``/api/flight_record``
                                   dumps a process's recent span window
+- ``GET /api/memory``             ownership-attributed memory summary
+                                  (pinned/spilled/in-proc per owner,
+                                  call sites, pressure) + leak suspects
 - ``GET /metrics``                Prometheus text (``ray.util.metrics``
                                   analog + runtime counters)
 - ``GET /api/version``
@@ -243,6 +246,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(_state.flight_record(
                     proc=qs.get("proc", [None])[0],
                     last_s=float(last_s) if last_s else None))
+            elif path == "/api/memory":
+                # cluster memory plane: ownership-attributed summary
+                # (?top_n=20), plus suspected leaks
+                qs = parse_qs(self.path.partition("?")[2])
+                top_n = int(qs.get("top_n", ["20"])[0])
+                self._send_json({
+                    "summary": _state.memory_summary(top_n=top_n),
+                    "leaks": _state.memory_leaks()})
             elif path == "/api/latencies":
                 # per-stage latency digest (live dashboard view)
                 qs = parse_qs(self.path.partition("?")[2])

@@ -23,6 +23,7 @@ import cloudpickle
 
 from ray_tpu._private.shm_store import ShmObjectStore
 from ray_tpu.runtime import object_codec
+from ray_tpu.runtime import refcount as _refcount
 from ray_tpu.runtime.object_ref import ObjectRef
 from ray_tpu.runtime.rpc import (
     ConnectionLost,
@@ -240,6 +241,10 @@ class ClusterRuntime:
         self._promote_pending: set[str] = set()
         self._use_memstore = self._ref_enabled
         self._memstore_put_limit = _cfg.max_direct_call_object_size
+        # memory plane: owned-object accounting knobs (see
+        # refcount.note_owned / ownership_snapshot)
+        self._mem_callsite = _cfg.memory_callsite_enabled
+        self._mem_annex_max = _cfg.memory_annex_max_entries
         if self._use_memstore:
             self._memstore_release_hook = self._evict_mem_objects
             self._memstore_serialize_hook = self._promote_mem_object
@@ -269,6 +274,23 @@ class ClusterRuntime:
         self._metrics_pusher = MetricsPusher(
             self.gcs_address, src=self.client_id[:12],
             kind="worker" if in_worker else "driver").start()
+        # memory plane: this process's ownership table rides the metric
+        # frames as a live mem/owners annex (providers re-evaluate at
+        # every pusher snapshot — the table is never publish-frozen)
+        from ray_tpu.runtime import metrics_plane as _mp
+        self._mem_annex_key = f"mem/owners/{self.client_id[:12]}"
+        _kind = "worker" if in_worker else "driver"
+
+        def _mem_owners_annex(_cid=self.client_id, _k=_kind):
+            if not _refcount.is_active():
+                return None
+            snap = self._refs.ownership_snapshot(self._mem_annex_max)
+            snap["client_id"] = _cid
+            snap["kind"] = _k
+            snap["pressure"] = object_codec.recent_pressure()
+            return snap
+
+        _mp.set_annex_provider(self._mem_annex_key, _mem_owners_annex)
         from ray_tpu.util import metrics as _metrics
         self._h_actor_resolve = _metrics.histogram(
             "ray_tpu_actor_resolve_s",
@@ -429,6 +451,7 @@ class ClusterRuntime:
                     # the put value contains ObjectRefs: contains-edges
                     # anchor on the outer oid (same as direct returns)
                     self._refs.add_contains(oid_hex, caught)
+                self._note_owned(oid_hex, len(payload))
                 return ObjectRef(oid)
             # too large for the memory tier: reuse the serialized form
             size = object_codec.put_value_durable(
@@ -445,7 +468,22 @@ class ClusterRuntime:
             with self._put_report_cv:
                 self._put_report_buf.append((oid.hex(), size))
                 self._put_report_cv.notify()
+        self._note_owned(oid.hex(), size)
         return ObjectRef(oid)
+
+    def _note_owned(self, oid_hex: str, size: int,
+                    callsite: str | None = None):
+        """Owner-side accounting for an object this process created
+        (memory plane). Active only while the process has a ref drain —
+        same gate ObjectRef tracking uses."""
+        if not _refcount.is_active():
+            return
+        if callsite is None and self._mem_callsite:
+            # inlined capture: one call frame instead of two on the
+            # hot path (fenced by memory_accounting_overhead_ratio)
+            self._refs.note_owned_here(oid_hex, size)
+            return
+        self._refs.note_owned(oid_hex, size, callsite)
 
     def _evict_mem_objects(self, oids: list):
         """Refcount release hook: every local ref to these oids died —
@@ -503,6 +541,9 @@ class ClusterRuntime:
         the durable shm path when ref counting is off (nothing would
         ever evict the memory copies)."""
         if self._use_memstore and not self._closed:
+            if _refcount.is_active():
+                for oid_hex, payload in results.items():
+                    self._refs.note_owned_size(oid_hex, len(payload))
             with self._mem_cv:
                 self._memstore.update(results)
                 self._mem_arrivals += 1
@@ -555,6 +596,8 @@ class ClusterRuntime:
                         pass
                     time.sleep(0.02)
             if placed:
+                if _refcount.is_active():
+                    self._refs.note_owned_size(oid_hex, len(payload))
                 with self._put_report_cv:
                     self._put_report_buf.append((oid_hex, len(payload)))
                     self._put_report_cv.notify()
@@ -1094,6 +1137,14 @@ class ClusterRuntime:
         # later get() waits forever.
         out_refs = ([] if streaming
                     else [ObjectRef(oid) for oid in spec.return_ids])
+        if out_refs and _refcount.is_active():
+            # this process OWNS the submitted task's returns: one
+            # callsite capture per submit, shared across the return ids
+            # (sizes backfill when the results report in)
+            cs = (_refcount.capture_callsite()
+                  if self._mem_callsite else None)
+            for oid in spec.return_ids:
+                self._refs.note_owned(oid.hex(), 0, cs)
         if spec.task_type == TaskType.ACTOR_TASK:
             self._submit_actor_task(spec)
         else:
@@ -2033,6 +2084,11 @@ class ClusterRuntime:
             self._refs.remove_serialize_hook(self._memstore_serialize_hook)
             self._memstore.clear()
         self._closed = True
+        try:
+            from ray_tpu.runtime import metrics_plane as _mp
+            _mp.set_annex_provider(self._mem_annex_key, None)
+        except Exception:  # noqa: BLE001 - best-effort plane teardown
+            pass
         try:
             self._metrics_pusher.stop()
         except Exception:  # noqa: BLE001 - best-effort plane teardown

@@ -540,6 +540,11 @@ class GcsServer(RpcServer):
     def stop(self):
         super().stop()
         self._metrics_stop.set()
+        # release the process-wide pusher claim the self-loop may hold:
+        # a later runtime in this process (test clusters churn them)
+        # must be able to claim, or its annex/metric frames never ship
+        from ray_tpu.runtime import metrics_plane as _mp
+        _mp.release_pusher(f"gcs:{self.address[1]}")
         with self._place_cv:
             self._place_cv.notify_all()   # placement workers exit
         with self._pub_cv:
@@ -1832,6 +1837,225 @@ class GcsServer(RpcServer):
             prefix, max_age_s=max_age_s)}
 
     # ------------------------------------------------------------------
+    # cluster memory plane (reference: `ray memory` / memory_summary
+    # aggregating every core worker's reference table plus plasma
+    # occupancy). Ownership tables arrive as mem/owners/<proc> annexes
+    # on metric frames; node occupancy as mem/node/<node> annexes; this
+    # side joins them against the ref/pin/contains/directory tables.
+    # ------------------------------------------------------------------
+
+    def _mem_owner_annexes(self, max_age_s: float | None = 60.0) -> list:
+        out = []
+        for item in self._metrics_store.annexes("mem/owners/",
+                                                max_age_s=max_age_s):
+            p = item.get("payload")
+            if isinstance(p, dict) and p.get("client_id"):
+                p = dict(p)
+                p["annex_ts"] = item["ts"]
+                p["src"] = item["src"]
+                out.append(p)
+        return out
+
+    def _mem_node_annexes(self, max_age_s: float | None = 60.0) -> list:
+        out = []
+        seen = set()
+        for item in self._metrics_store.annexes("mem/node/",
+                                                max_age_s=max_age_s):
+            p = item.get("payload")
+            if isinstance(p, dict) and p.get("node_id") \
+                    and p["node_id"] not in seen:
+                seen.add(p["node_id"])
+                p = dict(p)
+                p["annex_ts"] = item["ts"]
+                out.append(p)
+        return out
+
+    def rpc_memory_table(self, conn, send_lock, *, oids=None,
+                         limit=10_000):
+        """Per-object reference view: size, holder clients, pin and
+        contained-in contributions, directory locations — the join
+        surface list_objects and memory_summary price owners with."""
+        with self._lock:
+            if oids is None:
+                sel = list(self._object_dir)
+                if len(sel) < limit:
+                    sel.extend(o for o in self._ref_holders
+                               if o not in self._object_dir)
+                sel = sel[:limit]
+            else:
+                sel = list(oids)
+            rows = {}
+            for oid in sel:
+                rows[oid] = {
+                    "size": self._object_meta.get(oid, 0),
+                    "holders": sorted(self._ref_holders.get(oid, ())),
+                    "pins": self._ref_pin_count.get(oid, 0),
+                    "contained": self._ref_contained.get(oid, 0),
+                    "locations": sorted(self._object_dir.get(oid, ())),
+                    "released": oid in self._ref_released,
+                }
+        return {"objects": rows}
+
+    def rpc_memory_summary(self, conn, send_lock, *, top_n=20,
+                           max_age_s=60.0):
+        """Cluster-wide ownership-attributed memory summary: per-owner
+        pinned/spilled/memstore bytes with top-N objects (state,
+        borrower count, task pins, creation call site), per-callsite
+        aggregation, per-node occupancy decomposition, and make-room
+        pressure events attributed back to the owners whose pinned
+        bytes were spilled. Totals reconcile owner bytes against node
+        store stats (± in-flight transfers)."""
+        now = time.time()
+        owner_ann = self._mem_owner_annexes(max_age_s)
+        nodes = self._mem_node_annexes(max_age_s)
+        spilled_on: dict[str, str] = {}
+        pulling_on: dict[str, str] = {}
+        for nd in nodes:
+            for o in nd.get("spilled_oids", ()):
+                spilled_on[o] = nd["node_id"]
+            for o in nd.get("being_pulled_oids", ()):
+                pulling_on[o] = nd["node_id"]
+        owners = []
+        callsites: dict[str, dict] = {}
+        oid_owner: dict[str, str] = {}
+        with self._lock:
+            for p in owner_ann:
+                cid = p["client_id"]
+                ents = []
+                pinned_b = spilled_b = mem_b = joined_b = 0
+                for ent in p.get("entries", ()):
+                    oid, size, cs, created = ent[0], ent[1], ent[2], ent[3]
+                    size = size or self._object_meta.get(oid, 0)
+                    oid_owner[oid] = cid
+                    holders = self._ref_holders.get(oid, ())
+                    borrowers = max(
+                        0, len(holders) - (1 if cid in holders else 0))
+                    locs = self._object_dir.get(oid, ())
+                    if oid in spilled_on:
+                        state = "spilled"
+                        spilled_b += size
+                    elif oid in pulling_on:
+                        state = "being_pulled"
+                        pinned_b += size
+                    elif locs:
+                        # a directory location means a raylet-pinned
+                        # primary in this runtime
+                        state = "pinned"
+                        pinned_b += size
+                    else:
+                        state = "in_memory"   # owner's in-process store
+                        mem_b += size
+                    joined_b += size
+                    ents.append({
+                        "object_id": oid, "size_bytes": size,
+                        "callsite": cs,
+                        "age_s": round(now - created, 1),
+                        "state": state, "borrowers": borrowers,
+                        "task_pins": self._ref_pin_count.get(oid, 0),
+                        "locations": sorted(locs)})
+                    if cs:
+                        c = callsites.setdefault(
+                            cs, {"callsite": cs, "count": 0, "bytes": 0})
+                        c["count"] += 1
+                        c["bytes"] += size
+                ents.sort(key=lambda e: -e["size_bytes"])
+                owners.append({
+                    "owner": cid, "kind": p.get("kind"),
+                    "owned": p.get("owned", len(ents)),
+                    "owned_bytes": joined_b,
+                    "pinned_bytes": pinned_b,
+                    "spilled_bytes": spilled_b,
+                    "memstore_bytes": mem_b,
+                    "refs_held": p.get("refs_held", 0),
+                    "last_activity": p.get("last_activity"),
+                    "truncated": p.get("truncated", 0),
+                    "pressure": p.get("pressure", []),
+                    "top": ents[:top_n]})
+        owners.sort(key=lambda o: -(o["pinned_bytes"]
+                                    + o["spilled_bytes"]
+                                    + o["memstore_bytes"]))
+        pressure = []
+        for nd in nodes:
+            for ev in nd.get("pressure_events", ()):
+                spilled_owners: dict[str, int] = {}
+                for o in ev.get("spilled", ()):
+                    own = oid_owner.get(o)
+                    if own:
+                        spilled_owners[own] = spilled_owners.get(own,
+                                                                 0) + 1
+                pressure.append({"node_id": nd["node_id"], **ev,
+                                 "owners": spilled_owners})
+        pressure.sort(key=lambda e: e.get("ts", 0))
+        totals = {
+            "num_owners": len(owners),
+            "owned_bytes": sum(o["owned_bytes"] for o in owners),
+            "pinned_bytes": sum(o["pinned_bytes"] for o in owners),
+            "spilled_bytes": sum(o["spilled_bytes"] for o in owners),
+            "memstore_bytes": sum(o["memstore_bytes"] for o in owners),
+            "store_allocated_bytes": sum(
+                nd.get("allocated_bytes", 0) for nd in nodes),
+            "store_pinned_bytes": sum(
+                nd.get("pinned_bytes", 0) for nd in nodes),
+            "store_spilled_bytes": sum(
+                nd.get("spilled_bytes", 0) for nd in nodes),
+            "in_flight_bytes": sum(
+                nd.get("being_pulled_bytes", 0) for nd in nodes),
+        }
+        cs_rows = sorted(callsites.values(), key=lambda c: -c["bytes"])
+        return {"ts": now, "mode": "cluster", "owners": owners,
+                "nodes": nodes, "callsites": cs_rows[:max(1, top_n)],
+                "pressure": pressure[-32:], "totals": totals}
+
+    def _detect_leaks(self, threshold_s=None, idle_s=None) -> list:
+        """Refs held past the threshold with zero borrowers, zero task
+        pins, zero contained-in edges, owned by an IDLE (but alive)
+        process — flagged with their creation call site."""
+        from ray_tpu.utils.config import get_config
+        cfg = get_config()
+        if threshold_s is None:
+            threshold_s = cfg.memory_leak_threshold_s
+        if idle_s is None:
+            idle_s = cfg.memory_leak_idle_s
+        now = time.time()
+        leaks = []
+        for p in self._mem_owner_annexes():
+            cid = p.get("client_id")
+            last_act = p.get("last_activity") or 0.0
+            if now - last_act < idle_s:
+                continue   # owner still churning refs: not a leak
+            with self._lock:
+                c = self._clients.get(cid)
+                if c is None or not c.get("alive", True):
+                    continue   # dead owners are reaped, not leaked
+                for ent in p.get("entries", ()):
+                    oid, size, cs, created = ent[0], ent[1], ent[2], ent[3]
+                    if now - created < threshold_s:
+                        continue
+                    if oid in self._ref_released:
+                        continue
+                    holders = self._ref_holders.get(oid, set())
+                    if holders - {cid}:
+                        continue   # borrowed elsewhere: someone wants it
+                    if self._ref_pin_count.get(oid, 0):
+                        continue   # pinned by an in-flight task
+                    if self._ref_contained.get(oid, 0):
+                        continue   # reachable through an outer object
+                    leaks.append({
+                        "object_id": oid, "owner": cid,
+                        "owner_kind": p.get("kind"),
+                        "size_bytes": size or self._object_meta.get(oid,
+                                                                    0),
+                        "age_s": round(now - created, 1),
+                        "owner_idle_s": round(now - last_act, 1),
+                        "callsite": cs})
+        leaks.sort(key=lambda lk: -lk["size_bytes"])
+        return leaks
+
+    def rpc_memory_leaks(self, conn, send_lock, *, threshold_s=None,
+                         idle_s=None):
+        return {"leaks": self._detect_leaks(threshold_s, idle_s)}
+
+    # ------------------------------------------------------------------
     # distributed tracing plane
     # ------------------------------------------------------------------
 
@@ -1901,7 +2125,36 @@ class GcsServer(RpcServer):
         return self._log_store.list()
 
     def rpc_summarize_errors(self, conn, send_lock, *, last_s=None):
-        return {"groups": self._log_store.summarize_errors(last_s)}
+        groups = self._log_store.summarize_errors(last_s)
+        try:
+            leaks = self._detect_leaks()
+        except Exception:
+            leaks = []
+        if leaks:
+            now = time.time()
+            by_site: dict[str, dict] = {}
+            for lk in leaks:
+                sig = "leaked object ref @ " + (lk["callsite"]
+                                                or "unknown")
+                g = by_site.setdefault(sig, {
+                    "signature": sig, "kind": "leak",
+                    "sample": (
+                        f"{lk['object_id'][:16]} owned by "
+                        f"{lk['owner'][:12]} held {lk['age_s']:.0f}s "
+                        "with zero borrowers and an idle owner"),
+                    "count": 0, "first_ts": now, "last_ts": now,
+                    "procs": set(), "traces": [], "tasks": [],
+                    "bytes": 0, "objects": []})
+                g["count"] += 1
+                g["bytes"] += lk["size_bytes"]
+                g["first_ts"] = min(g["first_ts"], now - lk["age_s"])
+                g["procs"].add(lk["owner"][:12])
+                if len(g["objects"]) < 8:
+                    g["objects"].append(lk["object_id"])
+            for g in by_site.values():
+                g["procs"] = sorted(g["procs"])
+                groups.append(g)
+        return {"groups": groups}
 
     def rpc_dump_stacks(self, conn, send_lock):
         """One-shot per-thread stack dump of the GCS process itself."""

@@ -52,6 +52,12 @@ _claimed: str | None = None
 _annex_lock = threading.Lock()
 _annexes: dict[str, tuple[float, object]] = {}
 _annex_version = 0
+# annex PROVIDERS: key -> zero-arg callable evaluated at snapshot time,
+# for payloads that must reflect live state (the memory plane's
+# ownership table) rather than a value frozen at publish time. A
+# provider returning None skips the key this round; exceptions are
+# swallowed (best-effort, same contract as the frames they ride).
+_annex_providers: dict[str, object] = {}
 
 
 def set_annex(key: str, payload) -> None:
@@ -65,15 +71,55 @@ def set_annex(key: str, payload) -> None:
         _annex_version += 1
 
 
-def local_annexes() -> dict[str, tuple[float, object]]:
-    """{key: (ts, payload)} snapshot of this process's annexes."""
+def set_annex_provider(key: str, fn) -> None:
+    """Register (fn) or retract (None) a live annex under ``key``:
+    ``fn()`` is called on every pusher snapshot and its return value
+    ships as the payload. Providers re-ship on the pusher's periodic
+    annex re-stamp cadence (``max(1.0, 2 * interval)``) even when no
+    static annex changed, so a live table is never staler than ~2
+    push intervals while the plane is healthy."""
+    global _annex_version
     with _annex_lock:
-        return dict(_annexes)
+        if fn is None:
+            _annex_providers.pop(key, None)
+        else:
+            _annex_providers[key] = fn
+        _annex_version += 1
+
+
+def local_annexes() -> dict[str, tuple[float, object]]:
+    """{key: (ts, payload)} snapshot of this process's annexes,
+    providers included (evaluated now) — the memory plane's degraded
+    local-mode answers read through this during GCS partitions."""
+    with _annex_lock:
+        out = dict(_annexes)
+        providers = list(_annex_providers.items())
+    now = time.time()
+    for key, fn in providers:
+        try:
+            payload = fn()
+        except Exception:  # noqa: BLE001 - provider is best-effort
+            continue
+        if payload is not None:
+            out[key] = (now, payload)
+    return out
 
 
 def _annex_snapshot():
     with _annex_lock:
-        return _annex_version, {k: v[1] for k, v in _annexes.items()}
+        ver = _annex_version
+        out = {k: v[1] for k, v in _annexes.items()}
+        providers = list(_annex_providers.items())
+    # providers run OUTSIDE the annex lock: they take their own locks
+    # (refcount table) and must not order against annex publication
+    for key, fn in providers:
+        try:
+            payload = fn()
+        except Exception:  # noqa: BLE001 - provider is best-effort
+            continue
+        if payload is not None:
+            out[key] = payload
+    return ver, out
 
 
 def claim_pusher(owner: str) -> bool:

@@ -17,12 +17,50 @@ from __future__ import annotations
 
 import pickle
 import struct
+import threading
+import time as _time_mod
+from collections import deque
 
 from ray_tpu.runtime.refcount import global_counter as _refs
 from ray_tpu.runtime.serialization import SerializedObject, deserialize, serialize
+from ray_tpu.util import metrics as _metrics
 
 _U64 = struct.Struct("<Q")
 FLAG_ERROR = 1
+
+# -- memory-pressure attribution (the make-room/OOM path) ----------------
+#
+# Every StoreFullError a writer hits in put_value_durable is recorded
+# here: a counter for the metrics plane and a small ring of recent
+# events ({ts, oid, size, rounds}) that rides this process's
+# mem/owners annex — so a forced spill on the raylet can be joined back
+# to the WRITER whose allocation applied the pressure, not just the
+# owners whose pinned bytes were spilled to relieve it.
+_c_store_full = _metrics.counter(
+    "ray_tpu_mem_store_full_total",
+    "store-full (make-room) rounds hit by writers in this process")
+_pressure_lock = threading.Lock()
+_pressure_ring: deque = deque(maxlen=32)
+
+
+def _note_store_full(oid_hex: str, size: int):
+    if _metrics.enabled():
+        _c_store_full.inc()
+    with _pressure_lock:
+        if _pressure_ring and _pressure_ring[-1]["oid"] == oid_hex:
+            _pressure_ring[-1]["rounds"] += 1
+            _pressure_ring[-1]["ts"] = _time_mod.time()
+        else:
+            _pressure_ring.append({"ts": _time_mod.time(),
+                                   "oid": oid_hex, "size": int(size),
+                                   "rounds": 1})
+
+
+def recent_pressure() -> list[dict]:
+    """Recent store-full events this process's writers hit, newest
+    last (shipped on the mem/owners annex)."""
+    with _pressure_lock:
+        return [dict(e) for e in _pressure_ring]
 
 
 def _serialize_capturing(value):
@@ -141,6 +179,7 @@ def put_value_durable(store, object_id: bytes, value, *,
         except ObjectExistsError:
             return 0  # first write wins (see put_value)
         except StoreFullError:
+            _note_store_full(object_id.hex(), size)
             if _time.monotonic() >= deadline:
                 raise
             if request_space is not None:

@@ -17,10 +17,32 @@ import shutil
 import tempfile
 import threading
 import time
+from collections import deque
 
 from ray_tpu._private.shm_store import ObjectNotFoundError
 from ray_tpu.runtime import object_codec
+from ray_tpu.util import metrics as _metrics
 from ray_tpu.utils.ids import ObjectID
+
+# memory-plane node occupancy series: updated from the 0.2s spill-loop
+# tick (never from a put/spill hot path) and pushed by the raylet's
+# MetricsPusher like every other plane
+_g_mem_pinned = _metrics.gauge(
+    "ray_tpu_mem_pinned_bytes",
+    "primary-copy (raylet-pinned) bytes resident in the local store")
+_g_mem_cached = _metrics.gauge(
+    "ray_tpu_mem_cached_replica_bytes",
+    "unpinned (pulled-secondary / releasable) bytes in the local store")
+_g_mem_spilled = _metrics.gauge(
+    "ray_tpu_mem_spilled_bytes", "bytes currently spilled to disk")
+_g_mem_used = _metrics.gauge(
+    "ray_tpu_mem_store_used_bytes", "shm store bytes allocated")
+_c_make_room = _metrics.counter(
+    "ray_tpu_mem_make_room_total",
+    "make-room rounds triggered by writers hitting store-OOM")
+_c_make_room_bytes = _metrics.counter(
+    "ray_tpu_mem_make_room_spilled_bytes",
+    "bytes spilled by writer-triggered make-room rounds")
 
 
 class SpillStorage:
@@ -148,7 +170,19 @@ class LocalObjectManager:
         # (lost ~1 in 200k task returns under memory pressure)
         self._spilling: set[str] = set()
         self.spill_stats = {"num_spilled": 0, "bytes_spilled": 0,
-                            "num_restored": 0, "bytes_restored": 0}
+                            "num_restored": 0, "bytes_restored": 0,
+                            "spill_wall_s": 0.0, "restore_wall_s": 0.0}
+        # memory plane: per-object size view (fed by location reports,
+        # pulls, and spills) + current spilled-byte total — what the
+        # occupancy decomposition prices the pinned/spilled sets with
+        self._sizes: dict[str, int] = {}
+        self._spilled_sizes: dict[str, int] = {}
+        self._spilled_bytes = 0
+        # recent writer-triggered make-room rounds, newest last: each is
+        # {ts, requested, spilled: [oid,...], spilled_bytes} — the
+        # cluster-level spill/OOM attribution joins these oids back to
+        # their owners through the GCS ref table
+        self._pressure_events: deque = deque(maxlen=64)
         # Primary-copy pins: every object CREATED on this node is pinned
         # (one raylet-held read ref) so the store's LRU eviction can never
         # destroy the sole copy — memory is reclaimed by SPILLING pinned
@@ -250,6 +284,8 @@ class LocalObjectManager:
         with self._pin_lock:
             self._pinned.difference_update(gone)
         for oid_hex in gone:
+            self._sizes.pop(oid_hex, None)
+        for oid_hex in gone:
             try:
                 with node._gcs_lock:
                     node._gcs.call("remove_object_location", oid=oid_hex,
@@ -328,6 +364,8 @@ class LocalObjectManager:
         return True
 
     def queue_location(self, oid: str, size: int):
+        if size:
+            self._sizes[oid] = size   # GIL-atomic; occupancy pricing
         with self._loc_cv:
             self._loc_buf.append((oid, size))
             self._loc_cv.notify()
@@ -380,6 +418,7 @@ class LocalObjectManager:
             self.unpin_object(oid_hex)
             with self._spill_lock:
                 entry = self._spilled.pop(oid_hex, None)
+                self._spilled_bytes -= self._spilled_sizes.pop(oid_hex, 0)
             if entry is not None:
                 self._spill_fs.unlink(entry[0])
                 freed += 1
@@ -418,6 +457,7 @@ class LocalObjectManager:
             with self._local_objects_lock:
                 was_local = oid_hex in self._local_objects
                 self._local_objects.discard(oid_hex)
+            self._sizes.pop(oid_hex, None)
             if deregister and (was_local or had_spill):
                 try:
                     with node._gcs_lock:
@@ -442,26 +482,56 @@ class LocalObjectManager:
         # (1/8 capacity) — a fixed large floor would thrash small stores
         cap = self.store.capacity
         target = min(max(2 * int(nbytes), cap // 8), cap)
-        n = self.spill_bytes(target)
+        spilled: list[str] = []
+        n = self.spill_bytes(target, collect=spilled)
         if n == 0:
             # nothing pinned-idle; last resort, spill unpinned cold
             # entries too (they are evictable anyway — spilling keeps
             # them readable instead of destroying them)
             for oid in self.store.spill_candidates(target, pin_pid=0):
-                n += bool(self.spill_one(oid[:ObjectID.SIZE]))
+                oid_hex = oid[:ObjectID.SIZE].hex()
+                if self.spill_one(oid[:ObjectID.SIZE]):
+                    n += 1
+                    spilled.append(oid_hex)
+        # make-room attribution: record WHICH objects a pressured writer
+        # forced out; memory_summary joins these oids to their owners
+        spilled_bytes = sum(self._sizes.get(o, 0)
+                            or self._spilled_sizes.get(o, 0)
+                            for o in spilled)
+        self._pressure_events.append({
+            "ts": time.time(), "requested": int(nbytes),
+            "spilled": spilled, "spilled_bytes": spilled_bytes})
+        if _metrics.enabled():
+            _c_make_room.inc()
+            if spilled_bytes:
+                _c_make_room_bytes.inc(spilled_bytes)
         return n
 
-    def spill_bytes(self, target: int) -> int:
+    def spill_bytes(self, target: int, collect: list | None = None) -> int:
         n = 0
         for oid in self.store.spill_candidates(target,
                                                pin_pid=os.getpid()):
-            n += bool(self.spill_one(oid[:ObjectID.SIZE]))
+            oid_hex = oid[:ObjectID.SIZE].hex()
+            if self.spill_one(oid[:ObjectID.SIZE]):
+                n += 1
+                if collect is not None:
+                    collect.append(oid_hex)
         return n
 
     def spill_loop(self):
         node = self._node
+        tick = 0
         while not node._stopping:
             time.sleep(0.2)
+            tick += 1
+            if tick % 10 == 0:
+                # occupancy gauges on a ~2s cadence (the metrics push
+                # period): pricing the pinned set is O(pinned objects),
+                # too heavy for every 0.2s spill tick at scale
+                try:
+                    self.publish_occupancy_metrics()
+                except Exception:  # noqa: BLE001 - best-effort plane
+                    pass
             try:
                 st = self.store.stats()
             except Exception:  # noqa: BLE001 - store closing
@@ -488,6 +558,7 @@ class LocalObjectManager:
                 self._spilling.discard(oid_hex)
 
     def _spill_one_locked(self, oid: bytes, oid_hex: str) -> bool:
+        t0 = time.perf_counter()
         try:
             payload = object_codec.raw_bytes(self.store, oid, timeout_ms=0)
         except Exception:  # noqa: BLE001 - vanished (freed/evicted) — fine
@@ -503,6 +574,8 @@ class LocalObjectManager:
         was_primary = self._capture_and_unpin(oid_hex)
         with self._spill_lock:
             self._spilled[oid_hex] = (path, was_primary)
+            self._spilled_sizes[oid_hex] = len(payload)
+            self._spilled_bytes += len(payload)
         rc = self.store.try_delete(oid)
         if rc == TS_ERR:
             # a reader still holds a ref: keep the shm copy authoritative —
@@ -510,17 +583,21 @@ class LocalObjectManager:
             self.pin_object(oid_hex)
             with self._spill_lock:
                 self._spilled.pop(oid_hex, None)
+                self._spilled_bytes -= self._spilled_sizes.pop(oid_hex, 0)
             self._spill_fs.unlink(path)
             return False
         # TS_OK: we removed it. TS_NOT_FOUND: a concurrent evict/spill beat
         # us to it — the file we just wrote may now be the ONLY copy, so it
         # must stay registered either way.
+        self._sizes.setdefault(oid_hex, len(payload))
         self.spill_stats["num_spilled"] += 1
         self.spill_stats["bytes_spilled"] += len(payload)
+        self.spill_stats["spill_wall_s"] += time.perf_counter() - t0
         return rc == TS_OK
 
     def restore_spilled(self, oid_hex: str) -> bool:
         """Load a locally-spilled object back into shm (for readers)."""
+        t0 = time.perf_counter()
         with self._spill_lock:
             entry = self._spilled.get(oid_hex)
         if entry is None:
@@ -535,6 +612,8 @@ class LocalObjectManager:
             if not self._spill_fs.exists(path):
                 with self._spill_lock:
                     self._spilled.pop(oid_hex, None)
+                    self._spilled_bytes -= self._spilled_sizes.pop(
+                        oid_hex, 0)
             return False
         from ray_tpu._private.shm_store import (ObjectExistsError,
                                                 StoreFullError)
@@ -572,9 +651,11 @@ class LocalObjectManager:
             return self.store.contains(oid)
         with self._spill_lock:
             self._spilled.pop(oid_hex, None)
+            self._spilled_bytes -= self._spilled_sizes.pop(oid_hex, 0)
         self._spill_fs.unlink(path)
         self.spill_stats["num_restored"] += 1
         self.spill_stats["bytes_restored"] += len(payload)
+        self.spill_stats["restore_wall_s"] += time.perf_counter() - t0
         return True
 
     def read_spilled(self, oid_hex: str) -> bytes | None:
@@ -730,3 +811,77 @@ class LocalObjectManager:
     def _on_pulled(self, oid_hex: str, size: int):
         self.track_local(oid_hex)
         self.queue_location(oid_hex, size)
+
+    # ------------------------------------------------------------------
+    # memory plane: node occupancy decomposition
+    # ------------------------------------------------------------------
+
+    def occupancy(self) -> dict:
+        """Where this node's object memory is: pinned primaries vs
+        unpinned cached replicas vs spilled files, plus cumulative
+        spill/restore/eviction accounting and the in-flight pull load
+        (reference analog: the per-node breakdown in `ray memory`'s
+        store stats footer)."""
+        try:
+            st = self.store.stats()
+        except Exception:  # noqa: BLE001 - store closing
+            st = {"capacity": 0, "bytes_allocated": 0, "num_objects": 0,
+                  "num_evictions": 0, "bytes_evicted": 0}
+        with self._pin_lock:
+            pinned = list(self._pinned)
+        sizes = self._sizes
+        pinned_bytes = 0
+        for o in pinned:
+            pinned_bytes += sizes.get(o, 0)
+        with self._spill_lock:
+            num_spilled_now = len(self._spilled)
+            spilled_bytes = self._spilled_bytes
+        pull = self.pulls.stats()
+        return {
+            "capacity_bytes": st.get("capacity", 0),
+            "allocated_bytes": st.get("bytes_allocated", 0),
+            "num_objects": st.get("num_objects", 0),
+            "num_pinned": len(pinned),
+            # primaries ARE the pinned set in this runtime: every object
+            # created on the node is pinned by its raylet until spill
+            "pinned_bytes": pinned_bytes,
+            "primary_bytes": pinned_bytes,
+            "cached_replica_bytes": max(
+                0, st.get("bytes_allocated", 0) - pinned_bytes),
+            "spilled_bytes": spilled_bytes,
+            "num_spilled_now": num_spilled_now,
+            "num_evictions": st.get("num_evictions", 0),
+            "bytes_evicted": st.get("bytes_evicted", 0),
+            "being_pulled": pull.get("num_active", 0),
+            "being_pulled_bytes": pull.get("in_flight_bytes", 0),
+            "spill_stats": dict(self.spill_stats),
+            "pressure_events": list(self._pressure_events)[-16:],
+            "ts": time.time(),
+        }
+
+    def spilled_oids(self, limit: int = 512) -> list[str]:
+        """Currently-spilled oids (capped, largest first) for per-object
+        state classification in list_objects / memory_summary."""
+        with self._spill_lock:
+            rows = sorted(self._spilled_sizes.items(),
+                          key=lambda kv: -kv[1])
+        return [oid for oid, _ in rows[:limit]]
+
+    def being_pulled(self) -> set:
+        """oids with a pull in flight right now (annotates list_objects
+        / ownership state with 'being-pulled')."""
+        return self.pulls.active_oids()
+
+    def spilled_state(self, oid_hex: str) -> bool:
+        with self._spill_lock:
+            return oid_hex in self._spilled
+
+    def publish_occupancy_metrics(self):
+        """Refresh the ray_tpu_mem_* gauges (spill-loop tick cadence)."""
+        if not _metrics.enabled():
+            return
+        occ = self.occupancy()
+        _g_mem_pinned.set(occ["pinned_bytes"])
+        _g_mem_cached.set(occ["cached_replica_bytes"])
+        _g_mem_spilled.set(occ["spilled_bytes"])
+        _g_mem_used.set(occ["allocated_bytes"])

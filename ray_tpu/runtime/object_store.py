@@ -182,3 +182,24 @@ class ObjectStore:
                 "used_bytes": self._used,
                 "capacity_bytes": self._capacity,
             }
+
+    def entries(self, limit: int = 10_000) -> list[dict]:
+        """Per-object listing for ``util.state.list_objects`` (local
+        mode): same field shape the cluster path answers with, so
+        callers never branch on mode. Largest first, capped."""
+        import sys as _sys
+
+        now = time.monotonic()
+        with self._lock:
+            # local mode stores raw values with no recorded payload
+            # size; getsizeof at listing time keeps the hot path free
+            rows = [(oid.hex(),
+                     e.size_bytes or _sys.getsizeof(e.value, 0),
+                     e.is_error, now - e.created_at)
+                    for oid, e in self._objects.items()]
+        rows.sort(key=lambda r: -r[1])
+        return [{"object_id": oid, "size_bytes": size,
+                 "is_error": err, "age_s": round(age, 3),
+                 "locations": ["local"], "state": "in_memory",
+                 "holders": [], "pins": 0}
+                for oid, size, err, age in rows[:limit]]

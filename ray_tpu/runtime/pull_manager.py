@@ -81,6 +81,22 @@ class PullManager:
         self._fault_label = fault_label
         self._stopping = False
 
+    def stats(self) -> dict:
+        """Memory-plane view of in-flight transfer load: how many pulls
+        are active and how many admitted bytes are currently in flight
+        (the ± slack memory_summary allows when reconciling owner bytes
+        against store occupancy)."""
+        with self._budget_cv:
+            in_flight = self._in_flight_bytes
+        with self._pulls_lock:
+            active = len(self._pulls)
+        return {"num_active": active, "in_flight_bytes": in_flight,
+                "budget_bytes": self._budget}
+
+    def active_oids(self) -> set:
+        with self._pulls_lock:
+            return set(self._pulls)
+
     def stop(self):
         self._stopping = True
         with self._conns_lock:

@@ -135,6 +135,23 @@ class Raylet(RpcServer):
         from ray_tpu.util import metrics as _metrics
         self._metrics_pusher = MetricsPusher(
             self.gcs_address, src=self.node_id[:12], kind="raylet")
+        # memory plane: node occupancy decomposition rides the metric
+        # frames as a live mem/node annex (in in-process clusters the
+        # driver's pusher ships it — the annex registry is process-wide
+        # and keys carry the node id)
+        from ray_tpu.runtime import metrics_plane as _mp
+        self._mem_annex_key = f"mem/node/{self.node_id[:12]}"
+
+        def _mem_node_annex():
+            if self._stopping:
+                return None
+            occ = self.objects.occupancy()
+            occ["node_id"] = self.node_id
+            occ["spilled_oids"] = self.objects.spilled_oids()
+            occ["being_pulled_oids"] = sorted(self.objects.being_pulled())
+            return occ
+
+        _mp.set_annex_provider(self._mem_annex_key, _mem_node_annex)
         self._h_lease_grant = _metrics.histogram(
             "ray_tpu_lease_grant_s",
             "raylet-side lease grant latency (request to grant, parking "
@@ -445,6 +462,11 @@ class Raylet(RpcServer):
 
     def stop(self):
         super().stop()
+        try:
+            from ray_tpu.runtime import metrics_plane as _mp
+            _mp.set_annex_provider(self._mem_annex_key, None)
+        except Exception:  # noqa: BLE001 - best-effort plane teardown
+            pass
         self._metrics_pusher.stop()
         self.objects.stop()
         self.scheduler.stop()
@@ -1079,6 +1101,16 @@ class Raylet(RpcServer):
     def rpc_request_space(self, conn, send_lock, *, nbytes: int = 0):
         return {"spilled": self.objects.request_space(nbytes)}
 
+    def rpc_memory_stats(self, conn, send_lock):
+        """Node-level memory-plane decomposition: store occupancy split
+        by pinned-primary / cached-replica / spilled, cumulative
+        spill/restore accounting, and recent make-room pressure events
+        (util.state.memory_summary fans this out per node)."""
+        occ = self.objects.occupancy()
+        occ["node_id"] = self.node_id
+        occ["being_pulled_oids"] = sorted(self.objects.being_pulled())
+        return occ
+
     def rpc_fetch_object(self, conn, send_lock, *, oid: str):
         return self.objects.fetch_object(oid)
 
@@ -1326,6 +1358,7 @@ class Raylet(RpcServer):
                 "available": self._avail_snapshot(),
                 "num_workers": len(self.workers.workers),
                 "spill_stats": dict(self.objects.spill_stats),
+                "occupancy": self.objects.occupancy(),
                 "prestart": self.workers.prestart.snapshot()}
 
     def rpc_stuck_calls(self, conn, send_lock, *, threshold_s=None):

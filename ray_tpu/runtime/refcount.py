@@ -29,8 +29,72 @@ notices go through a lock-free deque drained on the next flush.
 
 from __future__ import annotations
 
+import os
+import sys
 import threading
+import time
 from collections import deque
+
+# package root for callsite capture: frames under this directory are
+# runtime internals, the first frame OUTSIDE it is the user's call site
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) + os.sep
+# filename -> is-internal memo, and (filename, lineno) -> "file:line"
+# interning: a put/submit loop hits the same callsite every iteration,
+# so the steady-state capture is two dict probes, no string building
+_internal_files: dict[str, bool] = {}
+_callsite_strings: dict[tuple, str] = {}
+# bound once: note_owned sits on the put/submit hot path, fenced by
+# memory_accounting_overhead_ratio in ci/perf_gate.py
+_time_time = time.time
+
+
+def capture_callsite() -> str | None:
+    """First stack frame outside the ray_tpu package, as ``file:line``.
+
+    Raw ``sys._getframe`` walk — no traceback/inspect object allocation
+    — with memoized per-file classification and interned result
+    strings, because this sits on the owner-side put/submit path and is
+    fenced by ``memory_accounting_overhead_ratio`` in ci/perf_gate.py."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:  # pragma: no cover - interpreter without frames
+        return None
+    # memo first, loop-free: strings only ever holds EXTERNAL frames, so
+    # a hit on the immediate caller skips classification AND the walk.
+    # Key on (code, lasti) — f_lineno is COMPUTED per access (line-table
+    # walk), f_lasti is a plain slot.
+    site = _callsite_strings.get((f.f_code, f.f_lasti))
+    if site is not None:
+        return site
+    return _capture_walk(f)
+
+
+def _capture_walk(f) -> str | None:
+    """Slow path of :func:`capture_callsite`: classify and walk frames
+    until the first one outside the package, memoizing as it goes."""
+    strings = _callsite_strings
+    imap = _internal_files
+    for _ in range(24):
+        if f is None:
+            return None
+        code = f.f_code
+        key = (code, f.f_lasti)
+        site = strings.get(key)
+        if site is not None:
+            return site
+        fn = code.co_filename
+        internal = imap.get(fn)
+        if internal is None:
+            internal = fn.startswith(_PKG_DIR) or "importlib" in fn
+            if len(imap) < 4096:
+                imap[fn] = internal
+        if not internal:
+            site = f"{fn}:{f.f_lineno}"
+            if len(strings) < 16384:
+                strings[key] = site
+            return site
+        f = f.f_back
+    return None
 
 
 class RefCounter:
@@ -71,6 +135,16 @@ class RefCounter:
         # channels); the owner memory store promotes through it so a
         # ref shipped off-process always has a cluster-visible object
         self._serialize_hooks: list = []
+        # -- memory plane: owner-side object accounting ----------------
+        # oid hex -> (size_bytes, callsite, created_ts) for objects this
+        # process OWNS (its puts + its submitted tasks' returns). Fed by
+        # note_owned from the owning creation sites only — never from
+        # on_created, which fires for every ObjectRef construction
+        # including borrows and deserializes.
+        self._owned: dict[str, tuple] = {}
+        # last wall time this process saw ref churn (a non-empty flush
+        # or a new owned object) — the leak detector's idle-owner signal
+        self.last_activity: float = time.time()
 
     # ------------------------------------------------------------------
     # instance tracking (ObjectRef hooks)
@@ -170,6 +244,93 @@ class RefCounter:
         """Current local instance count (GIL-atomic dict read)."""
         return self._counts.get(oid_hex, 0)
 
+    # ------------------------------------------------------------------
+    # memory plane: owned-object metadata + ownership snapshots
+    # ------------------------------------------------------------------
+
+    def note_owned(self, oid_hex: str, size: int,
+                   callsite: str | None = None):
+        """Record owner-side metadata for an object this process created
+        (a put, or a submitted task's return). Size may be 0 when not
+        yet known (task returns) — ``note_owned_size`` backfills it."""
+        # single dict store + attribute store, both GIL-atomic: no lock
+        # on the put/submit hot path (ownership_snapshot reads with a
+        # retry loop instead). A pop racing in take_flush cannot
+        # resurrect an entry — creation always precedes the ref's death.
+        now = _time_time()
+        self._owned[oid_hex] = (size or 0, callsite, now)
+        self.last_activity = now
+
+    def note_owned_here(self, oid_hex: str, size: int):
+        """``note_owned`` with the callsite capture INLINED: one method
+        call instead of two on the put hot path (the fenced overhead
+        budget is ~400ns; a second Python call frame is ~15% of it).
+        Captures the caller's caller — same depth convention as
+        ``capture_callsite`` invoked from the same spot."""
+        try:
+            f = sys._getframe(2)
+        except ValueError:  # pragma: no cover
+            f = None
+        site = None
+        if f is not None:
+            site = _callsite_strings.get((f.f_code, f.f_lasti))
+            if site is None:
+                site = _capture_walk(f)
+        now = _time_time()
+        self._owned[oid_hex] = (size or 0, site, now)
+        self.last_activity = now
+
+    def note_owned_size(self, oid_hex: str, size: int):
+        """Backfill the byte size of an owned object once it is known
+        (task returns report sizes after execution, not at submit)."""
+        if not size:
+            return
+        with self._lock:
+            ent = self._owned.get(oid_hex)
+            if ent is not None and not ent[0]:
+                self._owned[oid_hex] = (int(size), ent[1], ent[2])
+
+    def owned_meta(self, oid_hex: str):
+        """(size, callsite, created_ts) for an owned oid, else None."""
+        return self._owned.get(oid_hex)
+
+    def ownership_snapshot(self, max_entries: int = 512) -> dict:
+        """Per-process ownership table for the ``mem/owners/<proc>``
+        metrics annex: largest-first owned entries (capped), process
+        totals, and the idle-owner signal. Entries are
+        ``[oid, size, callsite, created_ts]``."""
+        now = time.time()
+        for _ in range(4):
+            # note_owned writes lock-free; retry if a resize lands
+            # mid-iteration, then fall back to excluding writers
+            try:
+                ents = [(oid, m[0], m[1], m[2])
+                        for oid, m in self._owned.items()]
+                break
+            except RuntimeError:
+                continue
+        else:
+            with self._lock:
+                ents = [(oid, m[0], m[1], m[2])
+                        for oid, m in self._owned.items()]
+        refs_held = len(self._counts)
+        last = self.last_activity
+        ents.sort(key=lambda e: -e[1])
+        owned_bytes = 0
+        for e in ents:
+            owned_bytes += e[1]
+        truncated = max(0, len(ents) - max_entries)
+        return {
+            "entries": [[oid, s, cs, ts]
+                        for oid, s, cs, ts in ents[:max_entries]],
+            "owned": len(ents),
+            "owned_bytes": owned_bytes,
+            "refs_held": refs_held,
+            "last_activity": last,
+            "truncated": truncated,
+            "ts": now,
+        }
+
     def created_epoch(self) -> int:
         """Monotone counter of ObjectRef constructions in this process;
         callers compare before/after a deserialize to decide whether a
@@ -236,6 +397,15 @@ class RefCounter:
             pins, self._pins = self._pins, []
             rel, self._pin_releases = self._pin_releases, []
             contains, self._contains = self._contains, []
+            # owner dropped its last local ref: the owned-metadata entry
+            # goes with it (the GCS keeps size + holders for objects
+            # that live on through borrowers)
+            for oid_hex in remove:
+                self._owned.pop(oid_hex, None)
+            for oid_hex in transient:
+                self._owned.pop(oid_hex, None)
+            if add or remove or transient or pins or rel or contains:
+                self.last_activity = time.time()
         if (remove or transient) and self._release_hooks:
             dead = remove + transient
             for hook in self._release_hooks:
@@ -321,6 +491,10 @@ class RefCounter:
             # positive transitions carry no local-mode action: clear all
             # so the dirty set stays bounded
             self._dirty.clear()
+            for oid_hex in zeroed:
+                self._owned.pop(oid_hex, None)
+            if zeroed:
+                self.last_activity = time.time()
         for oid_hex in zeroed:
             try:
                 cb(oid_hex)
@@ -340,6 +514,7 @@ class RefCounter:
             self._local_release_cb = None
             self._release_hooks.clear()
             self._serialize_hooks.clear()
+            self._owned.clear()
 
 
 def flush_once(counter: "RefCounter", call, client_id: str, kind: str,

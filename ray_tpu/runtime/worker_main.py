@@ -402,6 +402,26 @@ class Worker:
             (os.environ["RAY_TPU_GCS_HOST"],
              int(os.environ["RAY_TPU_GCS_PORT"])),
             src=self.worker_id[:12], kind="worker").start()
+        # memory plane: this process's ownership table (objects put by
+        # the task code it runs, actor-held refs) rides the metric
+        # frames as a live mem/owners annex. A nested in-worker runtime
+        # registers the SAME key (client_id == worker_id), so the table
+        # is never double-counted.
+        from ray_tpu.runtime import metrics_plane as _mp
+
+        def _mem_owners_annex():
+            from ray_tpu.runtime import object_codec as _oc
+            if not _refcount.is_active():
+                return None
+            snap = self._refs.ownership_snapshot(
+                _get_config().memory_annex_max_entries)
+            snap["client_id"] = self.worker_id
+            snap["kind"] = "worker"
+            snap["pressure"] = _oc.recent_pressure()
+            return snap
+
+        _mp.set_annex_provider(f"mem/owners/{self.worker_id[:12]}",
+                               _mem_owners_annex)
         self._install_sigint_router()
         # Owner-facing push port, then registration — ALL execution state
         # above must exist first: the instant registration lands, the

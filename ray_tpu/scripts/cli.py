@@ -124,18 +124,145 @@ def cmd_timeline(args):
     print(f"wrote chrome://tracing timeline to {path}")
 
 
-def cmd_memory(args):
-    client = _gcs_client(args)
-    nodes = client.call("get_nodes", alive_only=True)
-    from ray_tpu.runtime.rpc import RpcClient
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return (f"{n:.0f} {unit}" if unit == "B"
+                    else f"{n:.1f} {unit}")
+        n /= 1024
+    return f"{n:.1f} TiB"
 
-    for n in nodes:
-        try:
-            info = RpcClient(tuple(n["address"])).call("node_info")
-            print(f"{n['node_id'][:12]}: workers={info['num_workers']} "
-                  f"available={info['available']}")
-        except OSError:
-            print(f"{n['node_id'][:12]}: unreachable")
+
+def _format_table(headers, rows) -> str:
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+              if rows else len(str(h)) for i, h in enumerate(headers)]
+    line = "  ".join(str(h).ljust(w) for h, w in zip(headers, widths))
+    out = [line, "-" * len(line)]
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w)
+                             for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def _print_table(headers, rows):
+    print(_format_table(headers, rows))
+
+
+def render_memory_summary(summary: dict, *, top: int = 20) -> str:
+    """`ray memory`-style rendering of util.state.memory_summary().
+    Returns the formatted text (callers print it)."""
+    t = summary.get("totals", {})
+    mode = summary.get("mode", "?")
+    out = [f"======== Cluster memory summary (mode={mode}) ========"]
+    if summary.get("degraded"):
+        out.append(f"!! GCS unreachable — local-process answer only "
+                   f"({summary['degraded']})")
+    out.append(
+        f"Owned: {_fmt_bytes(t.get('owned_bytes'))} across "
+        f"{t.get('num_owners', 0)} owners | store allocated "
+        f"{_fmt_bytes(t.get('store_allocated_bytes'))} (pinned "
+        f"{_fmt_bytes(t.get('store_pinned_bytes'))}) | spilled "
+        f"{_fmt_bytes(t.get('store_spilled_bytes'))} | in-flight "
+        f"{_fmt_bytes(t.get('in_flight_bytes'))}")
+
+    owners = summary.get("owners", [])
+    if owners:
+        out.append(f"\n--- Owners (top {min(top, len(owners))} "
+                   f"by bytes) ---")
+        out.append(_format_table(
+            ["OWNER", "KIND", "REFS", "OBJECTS", "PINNED", "SPILLED",
+             "IN-PROC"],
+            [[o.get("owner", "?")[:12], o.get("kind") or "?",
+              o.get("refs_held", 0), o.get("owned", 0),
+              _fmt_bytes(o.get("pinned_bytes")),
+              _fmt_bytes(o.get("spilled_bytes")),
+              _fmt_bytes(o.get("memstore_bytes"))]
+             for o in owners[:top]]))
+
+    objs = [dict(e, owner=o.get("owner", "?"))
+            for o in owners for e in o.get("top", ())]
+    objs.sort(key=lambda e: -e["size_bytes"])
+    if objs:
+        out.append(f"\n--- Top objects (top {min(top, len(objs))}) ---")
+        out.append(_format_table(
+            ["OBJECT ID", "SIZE", "STATE", "OWNER", "BORROW", "PINS",
+             "AGE", "CALLSITE"],
+            [[e["object_id"][:16], _fmt_bytes(e["size_bytes"]),
+              e.get("state", "?"), e["owner"][:12],
+              e.get("borrowers") if e.get("borrowers") is not None
+              else "?",
+              e.get("task_pins") if e.get("task_pins") is not None
+              else "?",
+              f"{e.get('age_s', 0):.0f}s", e.get("callsite") or "-"]
+             for e in objs[:top]]))
+
+    nodes = summary.get("nodes", [])
+    if nodes:
+        out.append("\n--- Nodes ---")
+        out.append(_format_table(
+            ["NODE", "CAPACITY", "ALLOC", "PINNED", "CACHED", "SPILLED",
+             "SPILLS", "RESTORES", "EVICT"],
+            [[nd.get("node_id", "?")[:12],
+              _fmt_bytes(nd.get("capacity_bytes")),
+              _fmt_bytes(nd.get("allocated_bytes")),
+              _fmt_bytes(nd.get("pinned_bytes")),
+              _fmt_bytes(nd.get("cached_replica_bytes")),
+              _fmt_bytes(nd.get("spilled_bytes")),
+              "{} ({:.2f}s)".format(
+                  (nd.get("spill_stats") or {}).get("num_spilled", 0),
+                  (nd.get("spill_stats") or {}).get("spill_wall_s", 0)),
+              "{} ({:.2f}s)".format(
+                  (nd.get("spill_stats") or {}).get("num_restored", 0),
+                  (nd.get("spill_stats") or {}).get("restore_wall_s",
+                                                    0)),
+              nd.get("num_evictions", 0)]
+             for nd in nodes]))
+
+    sites = summary.get("callsites", [])
+    if sites:
+        out.append("\n--- Callsites ---")
+        out.append(_format_table(
+            ["BYTES", "COUNT", "CALLSITE"],
+            [[_fmt_bytes(c["bytes"]), c["count"], c["callsite"]]
+             for c in sites[:top]]))
+
+    for ev in summary.get("pressure", [])[-8:]:
+        owners_s = ", ".join(
+            f"{o[:12]}:{n}"
+            for o, n in (ev.get("owners") or {}).items())
+        out.append(
+            f"\nmake-room on {ev.get('node_id', '?')[:12]}: requested "
+            f"{_fmt_bytes(ev.get('requested'))}, spilled "
+            f"{len(ev.get('spilled', ()))} objects "
+            f"({_fmt_bytes(ev.get('spilled_bytes'))})"
+            + (f" owned by {owners_s}" if owners_s else ""))
+    return "\n".join(out)
+
+
+def cmd_memory(args):
+    """Ownership-attributed memory table (reference: ``ray memory``)."""
+    client = _gcs_client(args)
+    if getattr(args, "leaks", False):
+        leaks = client.call("memory_leaks")["leaks"]
+        if args.json:
+            print(json.dumps(leaks, indent=2, default=str))
+            return
+        if not leaks:
+            print("no suspected leaks")
+            return
+        _print_table(
+            ["OBJECT ID", "SIZE", "OWNER", "AGE", "IDLE", "CALLSITE"],
+            [[lk["object_id"][:16], _fmt_bytes(lk["size_bytes"]),
+              lk["owner"][:12], f"{lk['age_s']:.0f}s",
+              f"{lk['owner_idle_s']:.0f}s", lk.get("callsite") or "-"]
+             for lk in leaks])
+        return
+    summary = client.call("memory_summary", top_n=args.top)
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+        return
+    print(render_memory_summary(summary, top=args.top))
 
 
 def cmd_serve_deploy(args):
@@ -222,8 +349,15 @@ def main(argv=None):
     p.add_argument("args", nargs="*")
     p.set_defaults(fn=cmd_submit)
 
-    p = sub.add_parser("memory", help="per-node store/worker stats")
+    p = sub.add_parser("memory",
+                       help="ownership-attributed memory table")
     p.add_argument("--address", required=True)
+    p.add_argument("--top", type=int, default=20,
+                   help="rows per table section")
+    p.add_argument("--json", action="store_true",
+                   help="raw summary JSON instead of tables")
+    p.add_argument("--leaks", action="store_true",
+                   help="suspected leaked refs only")
     p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("summary", help="cluster/actor/task summaries")

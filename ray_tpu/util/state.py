@@ -70,14 +70,46 @@ def list_tasks(limit: int = 1000) -> list[dict]:
     return []
 
 
-def list_objects() -> list[dict]:
+def list_objects(limit: int = 10_000) -> list[dict]:
+    """Per-object rows with a CONSISTENT field shape in both modes:
+    ``{object_id, size_bytes, state, locations, holders, pins}``.
+    ``state`` is one of in_memory / pinned / spilled / being_pulled;
+    cluster mode joins the GCS object directory + ref tables with the
+    per-node occupancy annexes for spill/pull state."""
     mode, rt = _mode()
     if mode == "local":
-        return [{"object_id": k.hex() if hasattr(k, "hex") else str(k)}
+        if hasattr(rt.store, "entries"):
+            return rt.store.entries(limit)
+        return [{"object_id": k.hex() if hasattr(k, "hex") else str(k),
+                 "size_bytes": 0, "state": "in_memory",
+                 "locations": ["local"], "holders": [], "pins": 0}
                 for k in getattr(rt.store, "_objects", {})]
     if mode == "cluster":
-        stats = rt.store.stats()
-        return [{"local_store": stats}]
+        table = rt._gcs.call("memory_table", limit=limit)["objects"]
+        spilled, pulling = set(), set()
+        for item in cluster_metric_annexes(prefix="mem/node/"):
+            p = item.get("payload")
+            if isinstance(p, dict):
+                spilled.update(p.get("spilled_oids", ()))
+                pulling.update(p.get("being_pulled_oids", ()))
+        rows = []
+        for oid, row in table.items():
+            if oid in spilled:
+                state = "spilled"
+            elif oid in pulling:
+                state = "being_pulled"
+            elif row["locations"]:
+                state = "pinned"   # directory entries are raylet-pinned
+            else:
+                state = "in_memory"
+            rows.append({"object_id": oid,
+                         "size_bytes": row["size"],
+                         "state": state,
+                         "locations": row["locations"],
+                         "holders": row["holders"],
+                         "pins": row["pins"]})
+        rows.sort(key=lambda r: -r["size_bytes"])
+        return rows
     return []
 
 
@@ -746,3 +778,123 @@ def summarize_errors(last_s: float | None = None) -> list[dict]:
         out.sort(key=lambda g: (-g["count"], -g["last_ts"]))
         return out
     return rt._gcs.call("summarize_errors", last_s=last_s)["groups"]
+
+
+# ---------------------------------------------------------------------------
+# cluster memory plane (refcount ownership annexes + raylet occupancy
+# annexes, joined in the GCS) — reference analog: `ray memory` /
+# ray._private.internal_api.memory_summary
+# ---------------------------------------------------------------------------
+
+
+def memory_summary(*, top_n: int = 20) -> dict:
+    """Cluster-wide ownership-attributed memory accounting: per-owner
+    pinned / spilled / in-process bytes with top-N objects (state,
+    borrower count, task pins, creation call site), per-callsite and
+    per-node groupings, make-room pressure events attributed to the
+    owners whose pinned bytes were spilled, and totals that reconcile
+    owner bytes against node store occupancy (± in-flight transfers).
+
+    Cluster mode is one GCS RPC. When the GCS is unreachable
+    (partition), degrades to this process's OWN annex payloads — the
+    answer is marked ``degraded`` and heals on the next call once the
+    GCS is back."""
+    mode, rt = _mode()
+    if mode == "cluster":
+        try:
+            # bounded: a partitioned GCS must degrade the answer, not
+            # hang the debugging surface behind redial backoff
+            return rt._gcs.call("memory_summary", top_n=top_n,
+                                timeout=5.0)
+        except Exception as e:  # noqa: BLE001 - degraded beats none
+            return _local_memory_summary(top_n, degraded=repr(e))
+    return _local_memory_summary(top_n)
+
+
+def _local_memory_summary(top_n: int, degraded: str | None = None) -> dict:
+    """Summary from this process's local annex registry only: its own
+    ownership snapshot (and, in local mode, the in-process store as a
+    pseudo-node). No GCS join, so borrower/pin counts are unknown."""
+    import time as _time
+
+    from ray_tpu.runtime import metrics_plane as _mp
+
+    now = _time.time()
+    owners, nodes = [], []
+    callsites: dict[str, dict] = {}
+    for key, (ts, payload) in sorted(_mp.local_annexes().items()):
+        if not isinstance(payload, dict):
+            continue
+        if key.startswith("mem/owners/"):
+            ents = []
+            for e in payload.get("entries", ()):
+                ents.append({"object_id": e[0], "size_bytes": e[1],
+                             "callsite": e[2],
+                             "age_s": round(now - e[3], 1),
+                             "state": "in_memory", "borrowers": None,
+                             "task_pins": None, "locations": []})
+                if e[2]:
+                    c = callsites.setdefault(
+                        e[2], {"callsite": e[2], "count": 0, "bytes": 0})
+                    c["count"] += 1
+                    c["bytes"] += e[1]
+            ents.sort(key=lambda en: -en["size_bytes"])
+            owners.append({
+                "owner": payload.get("client_id"),
+                "kind": payload.get("kind"),
+                "owned": payload.get("owned", len(ents)),
+                "owned_bytes": payload.get("owned_bytes", 0),
+                "pinned_bytes": 0, "spilled_bytes": 0,
+                "memstore_bytes": payload.get("owned_bytes", 0),
+                "refs_held": payload.get("refs_held", 0),
+                "last_activity": payload.get("last_activity"),
+                "truncated": payload.get("truncated", 0),
+                "pressure": payload.get("pressure", []),
+                "top": ents[:top_n]})
+        elif key.startswith("mem/node/"):
+            nodes.append(dict(payload))
+    mode, rt = _mode()
+    if mode == "local" and rt is not None and hasattr(rt, "store"):
+        st = rt.store.stats()
+        nodes.append({"node_id": "local",
+                      "capacity_bytes": st.get("capacity_bytes", 0),
+                      "allocated_bytes": st.get("used_bytes", 0),
+                      "num_objects": st.get("num_objects", 0),
+                      "pinned_bytes": 0, "cached_replica_bytes": 0,
+                      "spilled_bytes": 0, "being_pulled_bytes": 0})
+    totals = {
+        "num_owners": len(owners),
+        "owned_bytes": sum(o["owned_bytes"] for o in owners),
+        "pinned_bytes": 0,
+        "spilled_bytes": sum(nd.get("spilled_bytes", 0) for nd in nodes),
+        "memstore_bytes": sum(o["memstore_bytes"] for o in owners),
+        "store_allocated_bytes": sum(
+            nd.get("allocated_bytes", 0) for nd in nodes),
+        "store_pinned_bytes": sum(
+            nd.get("pinned_bytes", 0) for nd in nodes),
+        "store_spilled_bytes": sum(
+            nd.get("spilled_bytes", 0) for nd in nodes),
+        "in_flight_bytes": sum(
+            nd.get("being_pulled_bytes", 0) for nd in nodes),
+    }
+    out = {"ts": now, "mode": "local", "owners": owners, "nodes": nodes,
+           "callsites": sorted(callsites.values(),
+                               key=lambda c: -c["bytes"])[:max(1, top_n)],
+           "pressure": [], "totals": totals}
+    if degraded is not None:
+        out["mode"] = "degraded"
+        out["degraded"] = degraded
+    return out
+
+
+def memory_leaks(threshold_s: float | None = None,
+                 idle_s: float | None = None) -> list[dict]:
+    """Suspected leaked refs: held past ``threshold_s`` with zero
+    borrowers / task pins / contained-in edges, owned by an idle but
+    alive process. Each carries the creation call site. These also
+    surface in ``summarize_errors()`` as ``kind="leak"`` groups."""
+    mode, rt = _mode()
+    if mode == "cluster":
+        return rt._gcs.call("memory_leaks", threshold_s=threshold_s,
+                            idle_s=idle_s)["leaks"]
+    return []   # local mode: no distributed refs to leak

@@ -445,6 +445,27 @@ class Config:
     # Flight-recorder log tail (last captured lines in crash dumps).
     log_tail_lines: int = 50
 
+    # --- cluster memory plane (runtime/refcount.py ownership snapshots,
+    # object_manager occupancy decomposition, util.state.memory_summary;
+    # reference analog: `ray memory` / memory_summary() aggregating every
+    # core worker's reference table plus plasma occupancy) ---
+    # Capture creation call sites on owned objects (one raw-frame walk
+    # per put / task submission at the OWNING site only; the
+    # memory_accounting_overhead_ratio fence measures with this ON).
+    memory_callsite_enabled: bool = True
+    # Entries per mem/owners annex payload, largest-first (the
+    # remainder is counted, not shipped — the annex must stay a small
+    # piggyback on metric frames, never a bulk channel).
+    memory_annex_max_entries: int = 512
+    # Leak detector: an owned ref older than this with zero borrowers,
+    # zero submitted-task pins, zero contained-in edges, and an IDLE
+    # owner is flagged (surfaced through summarize_errors()).
+    memory_leak_threshold_s: float = 300.0
+    # Owner idle horizon for the leak detector: a process with any ref
+    # churn (non-empty flush) inside this window is considered active,
+    # so a busy driver holding refs on purpose is never flagged.
+    memory_leak_idle_s: float = 30.0
+
     # --- training telemetry plane (train/telemetry.py; reference
     # analog: Ray Train's _internal/state run tracking — here per-step
     # decomposition/MFU/goodput ride the metrics+tracing planes) ---

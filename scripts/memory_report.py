@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Cluster memory report: ownership-attributed object accounting in a
+`ray memory`-style table (who owns what, pinned vs spilled vs
+in-process, creation call sites, make-room pressure attribution).
+
+    python scripts/memory_report.py --address 127.0.0.1:6379
+    python scripts/memory_report.py --address ... --leaks
+    python scripts/memory_report.py --address ... --watch 5
+
+Omitting --address starts a local runtime and reports this process
+only. See docs/memory_plane.md.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--address", default=None,
+                    help="GCS host:port (omit for a local runtime)")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows per table section")
+    ap.add_argument("--json", action="store_true",
+                    help="raw summary JSON instead of tables")
+    ap.add_argument("--leaks", action="store_true",
+                    help="suspected leaked refs only")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SEC",
+                    help="re-render every SEC seconds until ^C")
+    args = ap.parse_args(argv)
+
+    import ray_tpu
+    from ray_tpu.scripts.cli import _fmt_bytes, _print_table, \
+        render_memory_summary
+    from ray_tpu.util import state as _state
+
+    if args.address:
+        ray_tpu.init(address=args.address)
+    else:
+        ray_tpu.init()
+    try:
+        while True:
+            if args.leaks:
+                leaks = _state.memory_leaks()
+                if args.json:
+                    print(json.dumps(leaks, indent=2, default=str))
+                elif not leaks:
+                    print("no suspected leaks")
+                else:
+                    _print_table(
+                        ["OBJECT ID", "SIZE", "OWNER", "AGE", "IDLE",
+                         "CALLSITE"],
+                        [[lk["object_id"][:16],
+                          _fmt_bytes(lk["size_bytes"]),
+                          lk["owner"][:12], f"{lk['age_s']:.0f}s",
+                          f"{lk['owner_idle_s']:.0f}s",
+                          lk.get("callsite") or "-"]
+                         for lk in leaks])
+            else:
+                summary = _state.memory_summary(top_n=args.top)
+                if args.json:
+                    print(json.dumps(summary, indent=2, default=str))
+                else:
+                    print(render_memory_summary(summary, top=args.top))
+            if not args.watch:
+                return 0
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1136,3 +1136,123 @@ def test_log_partition_tasks_flow_echo_resumes(metrics_chaos_cluster,
         time.sleep(0.2)
     assert "chaos-shout-post-heal-xyzzy" in seen, \
         f"echo never resumed after heal; saw:\n{seen[-2000:]}"
+
+
+# ----------------------------------------------------------------------
+# round 11: memory-plane chaos — mem/owners + mem/node annex frames
+# ride push_metrics; faults on that wire cost accounting freshness
+# only, never puts, spills, or the debugging surface's availability
+# ----------------------------------------------------------------------
+
+def test_mem_annex_frame_chaos_never_blocks_puts_and_spills(
+        metrics_chaos_cluster):
+    """Dropped, duplicated, AND delayed annex-carrying metrics frames:
+    puts stay fast, a forced make-room spill completes, and after heal
+    the ownership annexes are fresh with no dup-frame double count."""
+    from ray_tpu.runtime import core as _core
+    from ray_tpu.util import state as state_api
+
+    c, _pusher = metrics_chaos_cluster
+    driver_id = _core.get_runtime().client_id
+    fi.put_plan(c.gcs_address, {
+        "version": 1, "seed": 7,
+        "rules": [
+            {"id": "delay-mem-annex", "fault": "delay", "src": "gcs",
+             "direction": "recv", "method": "push_metrics",
+             "delay_s": 0.2, "max_hits": 4},
+            {"id": "dup-mem-annex", "fault": "duplicate", "src": "gcs",
+             "direction": "recv", "method": "push_metrics",
+             "every": 3, "max_hits": 2},
+            {"id": "drop-mem-annex", "fault": "drop", "src": "gcs",
+             "direction": "recv", "method": "push_metrics",
+             "every": 2, "max_hits": 2},
+        ]})
+
+    refs = []
+    rule_ids = ("delay-mem-annex", "dup-mem-annex", "drop-mem-annex")
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        t0 = time.monotonic()
+        refs.extend(ray_tpu.put(b"a" * (64 << 10)) for _ in range(4))
+        # puts never wait on the faulted metrics wire (accounting is a
+        # lock-free in-process store; the annex ships off-thread)
+        assert time.monotonic() - t0 < 2.0, \
+            "puts slowed by metrics-frame faults"
+        if all(fi.plane.stats.get(r) for r in rule_ids):
+            break
+        time.sleep(0.1)
+    assert all(fi.plane.stats.get(r) for r in rule_ids), \
+        f"annex frame faults never fired: {fi.plane.stats}"
+
+    # a forced make-room spill completes under the faulted plane (the
+    # pressure path never touches the metrics channel)
+    raylet = _head_raylet(c)
+    t0 = time.monotonic()
+    raylet.objects.spill_bytes(64 << 10)
+    assert time.monotonic() - t0 < 5.0, \
+        "make-room spill waited on the faulted metrics wire"
+    assert ray_tpu.get(refs[0], timeout=60) == b"a" * (64 << 10)
+
+    _heal(c, version=2)
+
+    # annexes heal: the summary converges on the LIVE ownership table —
+    # duplicated frames cannot double-count (annexes are last-write-
+    # wins by key, not accumulated)
+    n_refs = len(refs)
+
+    def fresh():
+        s = state_api.memory_summary(top_n=5)
+        mine = [o for o in s["owners"] if o["owner"] == driver_id]
+        return mine[0] if s["mode"] == "cluster" and mine else None
+
+    _wait(lambda: (m := fresh()) is not None and m["owned"] >= n_refs,
+          40, "ownership annex to refresh after heal")
+    mine = fresh()
+    assert mine is not None and mine["owned"] <= n_refs + 8, \
+        f"dup annex frames double-counted ownership: {mine['owned']} " \
+        f"owned vs {n_refs} live refs"
+    del refs
+
+
+def test_memory_summary_degrades_mid_partition_and_heals(chaos_cluster):
+    """A full driver<->GCS partition: memory_summary() answers from the
+    local annex registry (marked degraded) in bounded time instead of
+    hanging, then heals back to cluster mode."""
+    from ray_tpu.runtime import core as _core
+    from ray_tpu.util import state as state_api
+
+    c = chaos_cluster
+    driver_id = _core.get_runtime().client_id
+    refs = [ray_tpu.put(b"d" * (32 << 10)) for _ in range(4)]
+
+    def cluster_mode():
+        s = state_api.memory_summary(top_n=5)
+        return s if s["mode"] == "cluster" and any(
+            o["owner"] == driver_id for o in s["owners"]) else None
+
+    _wait(cluster_mode, 40, "cluster-mode summary before the cut")
+
+    _open_partition(c, src="driver", dst_name="gcs",
+                    dst_addrs=[c.gcs_address], version=1)
+    t_cut = time.monotonic()
+    try:
+        t0 = time.monotonic()
+        s = state_api.memory_summary(top_n=5)
+        wall = time.monotonic() - t0
+        # bounded and NEVER an exception: the surface degrades
+        assert wall < 20.0, f"degraded answer took {wall:.1f}s"
+        assert s["mode"] == "degraded", s["mode"]
+        assert s.get("degraded"), "degraded answer must carry the cause"
+        # the local answer still knows this process's OWN objects
+        mine = [o for o in s["owners"] if o.get("owner") == driver_id]
+        assert mine and mine[0]["owned"] >= 4, \
+            f"local-process fallback lost owned entries: {s['owners']}"
+        time.sleep(max(0.0, PARTITION_S - (time.monotonic() - t_cut)))
+        assert fi.plane.stats.get("cut-driver-gcs"), \
+            f"partition never fired: {fi.plane.stats}"
+    finally:
+        _heal(c, version=2)
+
+    # heals: back to the GCS-joined cluster answer
+    _wait(cluster_mode, 40, "summary to heal back to cluster mode")
+    del refs

@@ -1,0 +1,374 @@
+"""Cluster memory plane: ownership-attributed object accounting,
+spill/OOM visibility, and the `ray memory`-style debugging surface.
+
+Reference analog: ``python/ray/tests/test_memstat.py`` + the
+``ray memory`` CLI — per-object ownership rows with creation call
+sites, node occupancy decomposition, and make-room attribution.
+
+Covers (ISSUE-17):
+- owner-side accounting unit behavior (callsite capture + memoization,
+  ownership snapshots under churn, size backfill),
+- ``util.state.list_objects`` field consistency across local and
+  cluster mode,
+- the two-raylet acceptance: per-owner pinned+spilled bytes reconcile
+  with store occupancy, the CLI renders the top-N owner table, and a
+  forced make-room spill is attributed to the owning process with its
+  creation call site,
+- the leak detector: a planted held ref is flagged with its creation
+  site and surfaces through ``summarize_errors()``; churned refs are
+  not flagged.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime import core as _core
+from ray_tpu.runtime import refcount as _refcount
+from ray_tpu.scripts.cli import render_memory_summary
+from ray_tpu.util import state as state_api
+from ray_tpu.utils.config import get_config, reset_config
+
+
+def _wait(predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = predicate()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# unit: owner-side accounting (refcount.py)
+# ---------------------------------------------------------------------------
+
+def test_callsite_capture_points_here():
+    def outer():
+        return _refcount.capture_callsite()
+
+    sites = []
+    for _ in range(3):
+        sites.append(outer())   # the SAME call line every iteration
+    # capture walks to OUR frame (first outside the pkg)
+    assert sites[0] is not None and __file__.split("/")[-1] in sites[0]
+    # memoized: the same call site returns the identical interned string
+    assert sites[0] is sites[1] is sites[2]
+
+
+def test_note_owned_here_inlines_capture():
+    rc = _refcount.RefCounter()
+
+    def put_like():
+        rc.note_owned_here("ab" * 16, 123)   # caller's caller = our caller
+
+    def user_frame():
+        put_like()
+
+    user_frame()
+    size, site, ts = rc.owned_meta("ab" * 16)
+    assert size == 123
+    assert site is not None and __file__.split("/")[-1] in site
+    assert time.time() - ts < 5.0
+
+
+def test_ownership_snapshot_shape_and_backfill():
+    rc = _refcount.RefCounter()
+    for i in range(8):
+        rc.note_owned("%032x" % i, 0 if i < 4 else 100, f"f.py:{i}")
+    for i in range(4):
+        rc.note_owned_size("%032x" % i, 50)      # task-return backfill
+    rc.note_owned_size("%032x" % 7, 999)         # must NOT overwrite
+    snap = rc.ownership_snapshot(max_entries=512)
+    assert snap["owned"] == 8
+    assert snap["owned_bytes"] == 4 * 50 + 4 * 100
+    by_oid = {e[0]: e for e in snap["entries"]}
+    assert by_oid["%032x" % 0][1] == 50
+    assert by_oid["%032x" % 7][1] == 100
+    assert by_oid["%032x" % 3][2] == "f.py:3"
+    assert snap["truncated"] == 0
+    # truncation keeps the LARGEST entries and reports the cut
+    small = rc.ownership_snapshot(max_entries=3)
+    assert len(small["entries"]) == 3 and small["truncated"] == 5
+    assert all(e[1] == 100 for e in small["entries"])
+
+
+def test_snapshot_consistent_under_lockfree_churn():
+    import threading
+
+    rc = _refcount.RefCounter()
+    stop = []
+
+    def churn():
+        i = 0
+        while not stop:
+            rc.note_owned("%032x" % (i & 1023), i, "c.py:1")
+            i += 1
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        for _ in range(100):
+            snap = rc.ownership_snapshot()
+            for e in snap["entries"]:
+                assert len(e) == 4
+    finally:
+        stop.append(1)
+        t.join(5)
+
+
+# ---------------------------------------------------------------------------
+# local mode: list_objects consistency + degraded-free summary
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def local_runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init()
+    yield
+    ray_tpu.shutdown()
+
+
+def test_local_list_objects_fields(local_runtime):
+    refs = [ray_tpu.put(b"x" * (1000 * (i + 1))) for i in range(4)]
+    rows = state_api.list_objects()
+    assert len(rows) >= 4
+    for row in rows:
+        # the SAME field shape cluster mode answers with — no branching
+        # on mode in callers (the round-11 field-skew fix)
+        assert {"object_id", "size_bytes", "state", "locations",
+                "holders", "pins"} <= set(row)
+        assert row["size_bytes"] > 0, \
+            f"local row lost its size: {row}"   # the skew this PR fixed
+    assert rows == sorted(rows, key=lambda r: -r["size_bytes"])
+    del refs
+
+
+def test_local_memory_summary_and_render(local_runtime):
+    keep = ray_tpu.put(b"y" * 4096)
+    s = state_api.memory_summary()
+    assert s["mode"] == "local"
+    assert isinstance(s["owners"], list) and isinstance(s["nodes"], list)
+    assert s["totals"]["store_allocated_bytes"] >= 0
+    text = render_memory_summary(s)
+    assert "MEMORY SUMMARY" in text.upper() or "mode" in text
+    assert "NODE" in text
+    assert state_api.memory_leaks() == []   # no distributed refs locally
+    del keep
+
+
+# ---------------------------------------------------------------------------
+# acceptance: two-external-raylet cluster — reconciliation, CLI table,
+# forced make-room spill attribution
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def two_raylet_cluster(monkeypatch):
+    from ray_tpu.cluster_utils import Cluster
+
+    monkeypatch.setenv("RAY_TPU_METRICS_PUSH_INTERVAL_S", "0.2")
+    reset_config()
+    ray_tpu.shutdown()
+    c = Cluster(external_gcs=True)
+    c.add_node(num_cpus=2, external=True)
+    c.add_node(num_cpus=2, resources={"side": 4}, external=True)
+    ray_tpu.init(address=c.gcs_address)
+    c.wait_for_nodes(2)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+    reset_config()
+
+
+def test_cluster_memory_summary_reconciles(two_raylet_cluster):
+    """Per-owner pinned+spilled bytes reconcile with store occupancy
+    (± in-flight transfers), and the CLI renders the owner table."""
+    driver_id = _core.get_runtime().client_id
+    refs = [ray_tpu.put(b"m" * (256 << 10)) for _ in range(6)]
+
+    def summary_ready():
+        s = state_api.memory_summary(top_n=10)
+        if s["mode"] != "cluster":
+            return None
+        mine = [o for o in s["owners"] if o["owner"] == driver_id]
+        if not mine or mine[0]["pinned_bytes"] < 6 * (256 << 10):
+            return None
+        if s["totals"]["store_pinned_bytes"] <= 0:
+            return None   # node occupancy annex not in the GCS yet
+        return s
+
+    s = _wait(summary_ready, 40, "owner + node annexes to land in GCS")
+    mine = [o for o in s["owners"] if o["owner"] == driver_id][0]
+    t = s["totals"]
+
+    # reconciliation: what owners say they pinned+spilled must match
+    # what the stores say they hold, up to in-flight transfers and
+    # unattributed system objects (cached replicas are counted on the
+    # store side only)
+    owner_bytes = sum(o["pinned_bytes"] + o["spilled_bytes"]
+                      for o in s["owners"])
+    store_bytes = t["store_pinned_bytes"] + t["store_spilled_bytes"]
+    slack = t["in_flight_bytes"] + 64 << 10
+    assert abs(owner_bytes - store_bytes) <= slack, \
+        f"owner accounting {owner_bytes} vs store occupancy " \
+        f"{store_bytes} diverges past in-flight slack {slack}"
+
+    # ownership rows carry this test as the creation call site
+    top = mine["top"]
+    assert top and any(e["callsite"] and
+                       __file__.split("/")[-1] in e["callsite"]
+                       for e in top), top
+    assert all(e["state"] in ("pinned", "in_memory", "spilled",
+                              "being_pulled") for e in top)
+
+    # borrower/pin joins answered from the GCS ref tables
+    assert all(e["borrowers"] is not None for e in top)
+
+    # the CLI table renders the owner row and the callsite grouping
+    text = render_memory_summary(s, top=10)
+    assert driver_id[:12] in text
+    assert "OWNER" in text and "CALLSITE" in text.upper()
+
+    # field-consistent cluster listing (the list_objects skew fix)
+    rows = state_api.list_objects()
+    mine_rows = [r for r in rows
+                 if (256 << 10) <= r["size_bytes"] <= (256 << 10) + 4096]
+    assert len(mine_rows) >= 6
+    for row in mine_rows:
+        assert {"object_id", "size_bytes", "state", "locations",
+                "holders", "pins"} <= set(row)
+        assert row["state"] in ("pinned", "spilled", "in_memory",
+                                "being_pulled")
+        assert row["holders"], "cluster rows must name their holders"
+    del refs
+
+
+@pytest.fixture
+def tiny_store_cluster(monkeypatch):
+    from ray_tpu.cluster_utils import Cluster
+
+    monkeypatch.setenv("RAY_TPU_METRICS_PUSH_INTERVAL_S", "0.2")
+    reset_config()
+    ray_tpu.shutdown()
+    c = Cluster()
+    # 2 MiB store: a handful of 256 KiB puts crosses the 0.8 spill
+    # threshold and forces make-room
+    c.add_node(num_cpus=2, store_capacity=2 << 20)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+    reset_config()
+
+
+def test_forced_spill_attributed_to_owner(tiny_store_cluster):
+    """Pinned bytes past the spill threshold force make-room; the
+    pressure event names the owners whose bytes were spilled, and the
+    spilled objects keep their creation call site."""
+    driver_id = _core.get_runtime().client_id
+    # hold every ref: the ONLY way to make room is spilling pinned
+    # primaries, which is exactly what the attribution must explain
+    refs = [ray_tpu.put(b"s" * (256 << 10)) for _ in range(12)]
+
+    def spilled_summary():
+        s = state_api.memory_summary(top_n=32)
+        if s["mode"] != "cluster" or not s["pressure"]:
+            return None
+        mine = [o for o in s["owners"] if o["owner"] == driver_id]
+        if not mine or mine[0]["spilled_bytes"] <= 0:
+            return None
+        return s
+
+    s = _wait(spilled_summary, 40, "make-room spill + annexes in GCS")
+    mine = [o for o in s["owners"] if o["owner"] == driver_id][0]
+
+    # the make-room event is attributed to the owning process
+    attributed = [ev for ev in s["pressure"]
+                  if ev.get("owners") and driver_id in ev["owners"]]
+    assert attributed, \
+        f"no pressure event attributed to the driver: {s['pressure']}"
+
+    # spilled entries keep their creation call site
+    spilled = [e for e in mine["top"] if e["state"] == "spilled"]
+    assert spilled, mine["top"]
+    assert any(e["callsite"] and __file__.split("/")[-1] in e["callsite"]
+               for e in spilled), spilled
+
+    # node decomposition saw the spill + the store survived (puts/gets
+    # still work under pressure)
+    nd = [n for n in s["nodes"] if n.get("spilled_bytes", 0) > 0]
+    assert nd and nd[0]["spill_stats"]["num_spilled"] >= 1
+    assert nd[0]["spill_stats"]["spill_wall_s"] > 0
+    assert ray_tpu.get(refs[0], timeout=60) == b"s" * (256 << 10)
+
+    # the CLI surfaces the attribution line
+    text = render_memory_summary(s, top=32)
+    assert "make-room" in text or "pressure" in text.lower()
+    del refs
+
+
+# ---------------------------------------------------------------------------
+# leak detector: planted ref flagged with creation site, churn is clean
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def leak_cluster(monkeypatch):
+    from ray_tpu.cluster_utils import Cluster
+
+    monkeypatch.setenv("RAY_TPU_METRICS_PUSH_INTERVAL_S", "0.2")
+    monkeypatch.setenv("RAY_TPU_MEMORY_LEAK_THRESHOLD_S", "1.5")
+    monkeypatch.setenv("RAY_TPU_MEMORY_LEAK_IDLE_S", "0.4")
+    reset_config()
+    ray_tpu.shutdown()
+    c = Cluster()
+    c.add_node(num_cpus=2)
+    ray_tpu.init(address=c.gcs_address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+    reset_config()
+
+
+def test_leak_detector_flags_planted_ref_only(leak_cluster):
+    cfg = get_config()
+    assert cfg.memory_leak_threshold_s == 1.5
+
+    # churn: refs created and dropped immediately must never be flagged
+    for i in range(50):
+        ray_tpu.put(b"c" * 1024)
+
+    planted = ray_tpu.put(b"L" * 8192)   # held for the whole test
+
+    def planted_flagged():
+        leaks = state_api.memory_leaks()
+        # sizes are SERIALIZED payload bytes (slightly over the raw 8 KiB)
+        return leaks if any(l["size_bytes"] >= 8192 for l in leaks) \
+            else None
+
+    leaks = _wait(planted_flagged, 30,
+                  "planted ref to age past the leak threshold")
+    flagged = [l for l in leaks if l["size_bytes"] >= 8192]
+    assert len(flagged) == 1
+    leak = flagged[0]
+    assert leak["callsite"] and __file__.split("/")[-1] in leak["callsite"]
+    assert leak["age_s"] >= cfg.memory_leak_threshold_s
+    assert leak["owner_kind"] == "driver"
+    # churned refs never show up (they died before the threshold)
+    assert all(l["size_bytes"] >= 8192 for l in leaks), leaks
+
+    # ...and the same suspicion surfaces through error aggregation
+    groups = state_api.summarize_errors()
+    leak_groups = [g for g in groups if g.get("kind") == "leak"]
+    assert leak_groups, groups
+    g = leak_groups[0]
+    assert "leaked object ref @" in g["signature"]
+    assert __file__.split("/")[-1] in g["signature"]
+    assert g["bytes"] >= 8192 and g["count"] >= 1
+
+    del planted
+    # flag clears once the ref dies and the release flush lands
+    _wait(lambda: not any(l["size_bytes"] >= 8192
+                          for l in state_api.memory_leaks()),
+          30, "leak flag to clear after release")
